@@ -1,0 +1,58 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with the API surface of
+Apache MXNet, built from scratch on JAX/XLA/Pallas/pjit.
+
+Layer map (TPU-native redesign of the reference's, see SURVEY.md §1):
+
+  user code / model zoo        mxnet_tpu.gluon.model_zoo, mxnet_tpu.models
+  frontends                    nd / np / npx / sym / gluon / module / autograd
+  eager runtime                ops.invoke (≙ Imperative::Invoke) + autograd tape
+  compiled runtime             jax.jit tracing (≙ CachedOp/GraphExecutor+nnvm)
+  ops                          ops/* → jax.numpy / lax / Pallas (≙ src/operator)
+  distributed                  kvstore + parallel/* → XLA collectives over
+                               ICI/DCN (≙ src/kvstore ps-lite/NCCL)
+  memory/scheduling            XLA + PJRT (≙ src/engine, src/storage)
+"""
+__version__ = "2.0.0.tpu0"
+
+from . import base
+from .base import MXNetError
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
+                      num_gpus, num_tpus)
+from . import ops
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+from . import _rng
+
+# mx.random: module-level alias of nd.random plus seed()
+from .ndarray import random  # noqa: F401
+from .ndarray import NDArray  # noqa: F401
+
+
+def _lazy(name):
+    import importlib
+    return importlib.import_module(f".{name}", __name__)
+
+
+# Lazy subpackages (heavy or cyclic): accessed as attributes.
+_LAZY_MODULES = ("numpy", "numpy_extension", "symbol", "gluon", "module",
+                 "optimizer", "metric", "initializer", "io", "kvstore",
+                 "image", "parallel", "models", "profiler", "lr_scheduler",
+                 "callback", "test_utils", "util", "runtime", "amp",
+                 "recordio", "executor", "monitor")
+
+_ALIAS = {"np": "numpy", "npx": "numpy_extension", "sym": "symbol",
+          "mod": "module", "kv": "kvstore"}
+
+
+def __getattr__(name):
+    target = _ALIAS.get(name, name)
+    if target in _LAZY_MODULES:
+        mod = _lazy(target)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")
+
+
+def waitall():
+    nd.waitall()

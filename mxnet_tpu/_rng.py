@@ -1,0 +1,35 @@
+"""Global PRNG state.
+
+TPU-native replacement for the reference's per-device resource RNG
+(reference: include/mxnet/random_generator.h, src/resource.cc kRandom).
+JAX PRNGs are counter-based and functional; the imperative API keeps a
+process-global key that is split per call — same user contract as
+``mx.random.seed`` (python/mxnet/random.py) with deterministic replay.
+
+Functional code paths (hybridized blocks, pjit training steps) should NOT
+use this module — they thread explicit keys (see gluon.block rng plumbing).
+"""
+from __future__ import annotations
+
+import itertools
+import jax
+
+_seed = 0
+_counter = itertools.count()
+_base_key = None
+
+
+def seed(seed_state: int, ctx=None):
+    """Seed the global RNG (reference: mx.random.seed). ``ctx`` is accepted
+    for API parity; JAX keys are device-independent."""
+    global _seed, _base_key, _counter
+    _seed = int(seed_state)
+    _base_key = jax.random.key(_seed)
+    _counter = itertools.count()
+
+
+def next_key():
+    global _base_key
+    if _base_key is None:
+        seed(0)
+    return jax.random.fold_in(_base_key, next(_counter))

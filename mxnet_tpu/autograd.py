@@ -1,0 +1,287 @@
+"""Autograd: tape-based reverse-mode AD over ``jax.vjp``.
+
+TPU-native re-design of the reference's imperative autograd
+(reference: src/imperative/imperative.cc:204 ``RecordOp``, :376 ``Backward``;
+python/mxnet/autograd.py). The reference tapes nnvm nodes and then builds a
+gradient *graph* with the MXGradient pass; here each recorded op eagerly
+captures its ``jax.vjp`` closure (XLA keeps the residuals on-device) and
+``backward()`` walks the tape in reverse — no graph pass needed, XLA already
+compiled each primal/adjoint pair.
+
+The recording/train-mode scopes mirror the reference API exactly:
+``record()``, ``pause()``, ``train_mode()``, ``predict_mode()``,
+``mark_variables()``, ``backward()``, ``grad()``.
+"""
+from __future__ import annotations
+
+import itertools
+import weakref
+from typing import Dict, List, Optional
+
+import numpy as _np
+import jax
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode", "is_recording",
+    "is_training", "set_recording", "set_training", "mark_variables",
+    "backward", "grad",
+]
+
+# ---------------------------------------------------------------- state ----
+
+_RECORDING = False
+_TRAINING = False
+_SLOT = itertools.count()
+_SEQ = itertools.count()
+
+
+class _Tape:
+    def __init__(self):
+        self.nodes: List["_Node"] = []
+        self.slot_producer: Dict[int, "_Node"] = {}
+        # leaf slot -> (weakref to NDArray, grad_req)
+        self.leaves: Dict[int, tuple] = {}
+
+    def clear_graph(self):
+        self.nodes = []
+        self.slot_producer = {}
+
+    def drop_nodes(self, node_ids):
+        """Drop only the given nodes (post-backward cleanup of the traversed
+        subgraph — other recorded-but-not-yet-backpropagated heads in the
+        same scope stay differentiable, matching the reference)."""
+        self.nodes = [n for n in self.nodes if id(n) not in node_ids]
+        self.slot_producer = {s: n for s, n in self.slot_producer.items()
+                              if id(n) not in node_ids}
+
+
+_TAPE = _Tape()
+
+
+class _Node:
+    """One recorded op application."""
+
+    __slots__ = ("seq", "vjp_fn", "in_slots", "out_slots", "out_avals")
+
+    def __init__(self, vjp_fn, in_slots, out_slots, out_avals):
+        self.seq = next(_SEQ)
+        self.vjp_fn = vjp_fn
+        self.in_slots = in_slots      # per input: slot int or None (no grad)
+        self.out_slots = out_slots
+        self.out_avals = out_avals    # (shape, dtype) per output
+
+
+def new_slot() -> int:
+    return next(_SLOT)
+
+
+def register_leaf(slot: int, array, grad_req: str):
+    _TAPE.leaves[slot] = (weakref.ref(array), grad_req)
+
+
+def record_node(vjp_fn, in_slots, out_slots, out_avals) -> _Node:
+    node = _Node(vjp_fn, in_slots, out_slots, out_avals)
+    _TAPE.nodes.append(node)
+    for s in out_slots:
+        _TAPE.slot_producer[s] = node
+    return node
+
+
+# ------------------------------------------------------------- scopes ------
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        global _RECORDING, _TRAINING
+        self._old = (_RECORDING, _TRAINING)
+        if self._rec and not _RECORDING:
+            # entering a fresh outermost recording scope: the previous
+            # iteration's graph (if any survived without a backward) is
+            # unreachable by user code now — drop it so vjp residuals don't
+            # pin HBM across training iterations.
+            _TAPE.clear_graph()
+        if self._rec is not None:
+            _RECORDING = self._rec
+        if self._train is not None:
+            _TRAINING = self._train
+        return self
+
+    def __exit__(self, *exc):
+        global _RECORDING, _TRAINING
+        _RECORDING, _TRAINING = self._old
+        return False
+
+
+def record(train_mode: bool = True) -> _Scope:
+    """Scope in which executed ops land on the autograd tape
+    (reference: python/mxnet/autograd.py record)."""
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+def is_recording() -> bool:
+    return _RECORDING
+
+
+def is_training() -> bool:
+    return _TRAINING
+
+
+def set_recording(is_record: bool) -> bool:
+    global _RECORDING
+    prev, _RECORDING = _RECORDING, is_record
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    global _TRAINING
+    prev, _TRAINING = _TRAINING, train
+    return prev
+
+
+# ------------------------------------------------------------ backward -----
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference API parity)."""
+    if not isinstance(variables, (list, tuple)):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v.attach_grad(grad_req=req)
+        if g is not None:
+            v._grad = g
+
+
+def _zero_cotangent(shape, dtype):
+    d = _np.dtype(dtype)
+    if _np.issubdtype(d, _np.inexact) or d.name == "bfloat16" or d.kind == "V":
+        import jax.numpy as jnp
+        return jnp.zeros(shape, dtype)
+    return _np.zeros(shape, jax.dtypes.float0)
+
+
+def _run_backward(heads, head_grads, retain_graph):
+    """Reverse-walk the tape from ``heads``; returns {slot: grad}."""
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray  # local import: avoids cycle
+
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    grads: Dict[int, object] = {}
+    roots = []
+    for h, hg in zip(heads, head_grads):
+        slot = getattr(h, "_ag_slot", None)
+        if slot is None:
+            raise ValueError(
+                "cannot differentiate a head that was not computed inside "
+                "autograd.record() (reference: Imperative::Backward check)")
+        g = (jnp.ones(h.shape, h.dtype) if hg is None
+             else (hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)))
+        grads[slot] = grads[slot] + g if slot in grads else g
+        prod = _TAPE.slot_producer.get(slot)
+        if prod is not None:
+            roots.append(prod)
+
+    # reachable set (walk producers backwards)
+    reachable = set()
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if id(node) in reachable:
+            continue
+        reachable.add(id(node))
+        for s in node.in_slots:
+            if s is not None:
+                p = _TAPE.slot_producer.get(s)
+                if p is not None and id(p) not in reachable:
+                    stack.append(p)
+
+    ordered = sorted((n for n in _TAPE.nodes if id(n) in reachable),
+                     key=lambda n: n.seq, reverse=True)
+    for node in ordered:
+        cots = tuple(
+            grads.get(s) if s in grads else _zero_cotangent(*aval)
+            for s, aval in zip(node.out_slots, node.out_avals))
+        in_grads = node.vjp_fn(cots if len(cots) > 1 else cots[0])
+        for s, g in zip(node.in_slots, in_grads):
+            if s is None or g is None or (hasattr(g, "dtype")
+                                          and g.dtype == jax.dtypes.float0):
+                continue
+            grads[s] = grads[s] + g if s in grads else g
+
+    if not retain_graph:
+        _TAPE.drop_nodes(reachable)
+    return grads
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of ``heads`` w.r.t. all attached variables and
+    store them in each variable's ``.grad`` (reference:
+    python/mxnet/autograd.py backward → MXAutogradBackwardEx)."""
+    grads = _run_backward(heads, head_grads, retain_graph)
+    from .ndarray.ndarray import NDArray
+    for slot, (ref, req) in list(_TAPE.leaves.items()):
+        arr = ref()
+        if arr is None:
+            del _TAPE.leaves[slot]
+            continue
+        if slot in grads and req != "null":
+            g = grads[slot]
+            if req == "add" and arr._grad is not None:
+                arr._grad = NDArray(arr._grad._data + g)
+            else:
+                arr._grad = NDArray(g)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of ``heads`` w.r.t. ``variables`` without touching
+    ``.grad`` buffers (reference: python/mxnet/autograd.py grad).
+
+    ``create_graph`` (higher-order gradients) is not yet supported — the
+    reference builds a differentiable grad-graph; here that requires taping
+    the vjp application itself (planned: route backward through apply_op).
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order grad) not yet supported; "
+            "use jax.grad composition via mxnet_tpu.npx.grad for now")
+    single = not isinstance(variables, (list, tuple))
+    vars_ = [variables] if single else list(variables)
+    if retain_graph is None:
+        retain_graph = False
+    grads = _run_backward(heads, head_grads, retain_graph)
+    from .ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+    out = []
+    for v in vars_:
+        slot = getattr(v, "_ag_slot", None)
+        if slot is None or slot not in grads:
+            out.append(NDArray(jnp.zeros(v.shape, v.dtype)))
+        else:
+            out.append(NDArray(grads[slot]))
+    return out[0] if single else out
+
+
+def get_symbol(x):  # reference API parity; graph introspection n/a here
+    raise NotImplementedError("autograd.get_symbol is not supported on the "
+                              "TPU backend (no nnvm graph); use Symbol API")

@@ -1,0 +1,105 @@
+"""Shared small utilities: dtype mapping, name management, errors.
+
+Replaces the reference's ctypes plumbing (python/mxnet/base.py) — there is no
+C handle layer here, so this module only keeps the pieces with user-visible
+semantics: dtype name mapping (bfloat16 is TPU-first where the reference used
+float16), the global name manager used by Symbol/Gluon naming, and MXNetError
+for API-parity exception handling.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import numpy as _np
+import jax.numpy as jnp
+
+__all__ = ["MXNetError", "dtype_np", "dtype_name", "NameManager", "string_types"]
+
+string_types = (str,)
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: python/mxnet/base.py MXNetError)."""
+
+
+# dtype codes from the reference (include/mxnet/base.h / mshadow type flags),
+# kept for .params / NDArray binary save-load compatibility.
+_DTYPE_CODE_TO_NP = {
+    0: _np.float32, 1: _np.float64, 2: _np.float16, 3: _np.uint8,
+    4: _np.int32, 5: _np.int8, 6: _np.int64, 7: bool,
+    12: jnp.bfloat16,
+}
+_NP_TO_DTYPE_CODE = {_np.dtype(v): k for k, v in _DTYPE_CODE_TO_NP.items()}
+
+_ALIASES = {
+    "float": "float32", "double": "float64", "half": "float16",
+    "bf16": "bfloat16", "bool": "bool_",
+}
+
+
+def dtype_np(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp dtype, int code) to np.dtype."""
+    if dtype is None:
+        return _np.dtype(_np.float32)
+    if isinstance(dtype, int):
+        return _np.dtype(_DTYPE_CODE_TO_NP[dtype])
+    if isinstance(dtype, str):
+        dtype = _ALIASES.get(dtype, dtype)
+        if dtype == "bfloat16":
+            return _np.dtype(jnp.bfloat16)
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = dtype_np(dtype)
+    if d == _np.dtype(jnp.bfloat16):
+        return "bfloat16"
+    n = d.name
+    return "bool" if n == "bool" else n
+
+
+def dtype_code(dtype) -> int:
+    return _NP_TO_DTYPE_CODE[dtype_np(dtype)]
+
+
+class NameManager:
+    """Global auto-naming for symbols/blocks (reference: python/mxnet/name.py).
+
+    Thread-local stack of managers so `with NameManager():` scopes nest.
+    """
+
+    _tls = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        if not hasattr(NameManager._tls, "stack"):
+            NameManager._tls.stack = [NameManager()]
+        NameManager._tls.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._tls.stack.pop()
+        return False
+
+    @classmethod
+    def current(cls) -> "NameManager":
+        if not hasattr(cls._tls, "stack"):
+            cls._tls.stack = [NameManager()]
+        return cls._tls.stack[-1]
+
+
+_PYTHONIFY = re.compile(r"[^0-9a-zA-Z_]")
+
+
+def pythonify(name: str) -> str:
+    return _PYTHONIFY.sub("_", name)

@@ -1,0 +1,504 @@
+"""NDArray: imperative array facade over ``jax.Array``.
+
+TPU-native re-design of the reference NDArray
+(reference: include/mxnet/ndarray.h:82, src/ndarray/ndarray.cc). The
+reference NDArray is a ref-counted mutable Chunk plus an engine variable;
+asynchronous ordering (write-after-read etc.) is enforced by the dependency
+engine. Here the backing store is an immutable ``jax.Array``: "mutation"
+rebinds ``_data`` to a new buffer, which is race-free by construction —
+any already-recorded autograd closure or in-flight XLA computation holds the
+old value. ``wait_to_read`` maps to ``block_until_ready`` (the reference's
+``WaitToRead``, include/mxnet/ndarray.h:374).
+
+Async semantics match the reference: ops return immediately (JAX async
+dispatch), Python only blocks on ``asnumpy()``/``wait_to_read()``.
+"""
+from __future__ import annotations
+
+import functools
+import operator
+from typing import Optional
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..base import MXNetError, dtype_np, dtype_name
+from ..context import Context, current_context
+from ..ops.invoke import apply_fn, apply_op, as_jax
+
+__all__ = ["NDArray"]
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+class NDArray:
+    """An imperative, context-aware n-dimensional array.
+
+    Wraps either a concrete ``jax.Array`` or (inside ``jit`` tracing of
+    hybridized blocks) a JAX tracer — the whole eager API is trace-
+    transparent, which is how HybridBlock/CachedOp compilation works without
+    a separate Symbol path.
+    """
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_ag_slot", "__weakref__")
+
+    # numpy should defer to us in mixed expressions
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not (isinstance(data, jax.Array) or _is_tracer(data)):
+            data = jnp.asarray(data, dtype=dtype_np(dtype) if dtype else None)
+        elif dtype is not None and data.dtype != dtype_np(dtype):
+            data = data.astype(dtype_np(dtype))
+        if ctx is not None and not _is_tracer(data):
+            data = jax.device_put(data, ctx.jax_device)
+        self._data = data
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_slot = None
+
+    # ------------------------------------------------------------ basics --
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return functools.reduce(operator.mul, self.shape, 1)
+
+    @property
+    def context(self) -> Context:
+        if _is_tracer(self._data):
+            return current_context()
+        try:
+            dev = next(iter(self._data.devices()))
+        except Exception:
+            return current_context()
+        from ..context import device as _device
+        return _device(dev)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return f"<NDArray tracer {self.shape} {dtype_name(self.dtype)}>"
+        return (f"\n{_np.asarray(self.asnumpy())}\n"
+                f"<NDArray {'x'.join(map(str, self.shape))} "
+                f"@{self.context}>")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple "
+                             "elements is ambiguous.")
+        return bool(self.asnumpy().item())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------- sync points --
+    def asnumpy(self) -> _np.ndarray:
+        """Blocking device→host copy (reference: NDArray::SyncCopyToCPU)."""
+        return _np.asarray(jax.device_get(self._data))
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().item()
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def wait_to_read(self):
+        """Reference: NDArray::WaitToRead → jax block_until_ready."""
+        if not _is_tracer(self._data):
+            self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype else a
+
+    # ------------------------------------------------------------ dtypes --
+    def astype(self, dtype, copy=True):
+        d = dtype_np(dtype)
+        if not copy and self.dtype == d:
+            return self
+        return apply_fn(lambda x: x.astype(d), [self])
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    # ----------------------------------------------------------- copying --
+    def copy(self):
+        return apply_fn(lambda x: x + 0, [self])
+
+    def copyto(self, other):
+        """Copy into an existing array or to a context
+        (reference: NDArray::CopyTo / SyncCopyFromNDArray)."""
+        if isinstance(other, NDArray):
+            # copy INTO the destination's context (reference NDArray::CopyTo
+            # keeps the destination device — this is the host→device
+            # parameter-loading idiom)
+            dst_ctx = other.context
+            other._data = jax.device_put(
+                jnp.asarray(self._data, dtype=other.dtype),
+                dst_ctx.jax_device)
+            return other
+        if isinstance(other, Context):
+            return NDArray(self._data, ctx=other)
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def as_in_context(self, ctx: Context):
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device))
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    # ----------------------------------------------------------- autograd --
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """Allocate a gradient buffer updated by ``autograd.backward``
+        (reference: python/mxnet/ndarray/ndarray.py attach_grad)."""
+        self._grad = NDArray(jnp.zeros(self.shape, self.dtype))
+        self._grad_req = grad_req
+        if self._ag_slot is None:
+            self._ag_slot = autograd.new_slot()
+        autograd.register_leaf(self._ag_slot, self, grad_req)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ----------------------------------------------------------- indexing --
+    def _canon_key(self, key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._canon_key(key)
+        if isinstance(key, (jax.Array, _np.ndarray)) or _is_tracer(key):
+            # advanced indexing with an array operand — keep it an op input
+            karr = NDArray(key) if not isinstance(key, NDArray) else key
+            if karr.dtype == _np.bool_:
+                # boolean mask: dynamic output shape; must leave trace-land
+                mask = _np.asarray(jax.device_get(key))
+                return apply_fn(lambda x: x[mask], [self])
+            return apply_fn(lambda x, k: x[k.astype(jnp.int32)], [self, karr])
+        return apply_fn(lambda x: x[key], [self])
+
+    def __setitem__(self, key, value):
+        key = self._canon_key(key)
+        v = as_jax(value)
+        if isinstance(key, slice) and key == slice(None):
+            # x[:] = v — full overwrite preserving shape/dtype
+            self._data = jnp.broadcast_to(jnp.asarray(v, dtype=self.dtype),
+                                          self.shape)
+        else:
+            self._data = self._data.at[key].set(
+                jnp.asarray(v, dtype=self.dtype) if not _np.isscalar(v) else v)
+
+    # ---------------------------------------------------------- arithmetic --
+    def _binop(self, other, opname, scalar_op):
+        if isinstance(other, NDArray):
+            return apply_op(opname, [self, other])
+        if _is_tracer(other) or isinstance(other, (jax.Array, _np.ndarray)):
+            return apply_op(opname, [self, NDArray(other)])
+        return apply_op(scalar_op, [self], {"scalar": float(other)})
+
+    def _rbinop(self, other, opname, scalar_op):
+        if isinstance(other, (jax.Array, _np.ndarray)) or _is_tracer(other):
+            return apply_op(opname, [NDArray(other), self])
+        return apply_op(scalar_op, [self], {"scalar": float(other)})
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._rbinop(o, "broadcast_sub", "_rminus_scalar")
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._rbinop(o, "broadcast_div", "_rdiv_scalar")
+
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._rbinop(o, "broadcast_mod", "_rmod_scalar")
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._rbinop(o, "broadcast_power", "_rpower_scalar")
+
+    def __neg__(self):
+        return apply_op("negative", [self])
+
+    def __abs__(self):
+        return apply_op("abs", [self])
+
+    def __eq__(self, o):  # noqa: D105  (mx semantics: elementwise)
+        if o is None:
+            return False
+        return self._binop(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__  # identity hash like the reference handle
+
+    def __iadd__(self, o):
+        r = self.__add__(o)
+        self._data = r._data
+        return self
+
+    def __isub__(self, o):
+        r = self.__sub__(o)
+        self._data = r._data
+        return self
+
+    def __imul__(self, o):
+        r = self.__mul__(o)
+        self._data = r._data
+        return self
+
+    def __itruediv__(self, o):
+        r = self.__truediv__(o)
+        self._data = r._data
+        return self
+
+    # --------------------------------------------------- method op mirrors --
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return apply_op("reshape", [self], {"shape": tuple(shape)})
+
+    def reshape_like(self, other):
+        return apply_op("reshape_like", [self, other])
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return apply_op("transpose", [self], {"axes": axes or None})
+
+    def swapaxes(self, dim1, dim2):
+        return apply_op("swapaxes", [self], {"dim1": dim1, "dim2": dim2})
+
+    def flatten(self):
+        return apply_op("flatten", [self])
+
+    def expand_dims(self, axis):
+        return apply_op("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return apply_op("squeeze", [self], {"axis": axis})
+
+    def broadcast_to(self, shape):
+        return apply_op("broadcast_to", [self], {"shape": tuple(shape)})
+
+    def broadcast_like(self, other):
+        return apply_op("broadcast_like", [self, other])
+
+    def tile(self, reps):
+        return apply_op("tile", [self], {"reps": tuple(reps) if
+                                         isinstance(reps, (tuple, list)) else (reps,)})
+
+    def repeat(self, repeats, axis=None):
+        return apply_op("repeat", [self], {"repeats": repeats, "axis": axis})
+
+    def flip(self, axis):
+        return apply_op("flip", [self], {"axis": axis})
+
+    def clip(self, a_min=None, a_max=None):
+        return apply_op("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def slice_axis(self, axis, begin, end):
+        return apply_op("slice_axis", [self],
+                        {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return apply_op("take", [self, indices], {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        return apply_op("one_hot", [self], {"depth": depth,
+                                            "on_value": on_value,
+                                            "off_value": off_value,
+                                            "dtype": dtype})
+
+    def _reduce(self, opname, axis=None, keepdims=False, **kw):
+        params = {"axis": axis, "keepdims": keepdims}
+        params.update(kw)
+        return apply_op(opname, [self], params)
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce("sum", axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce("mean", axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce("prod", axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", axis, keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return apply_op("norm", [self], {"ord": ord, "axis": axis,
+                                         "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return self._reduce("argmax", axis, keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return self._reduce("argmin", axis, keepdims)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return apply_op("argsort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return apply_op("sort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return apply_op("topk", [self], {"axis": axis, "k": k,
+                                         "ret_typ": ret_typ,
+                                         "is_ascend": is_ascend})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return apply_op("dot", [self, other],
+                        {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+    def abs(self):
+        return apply_op("abs", [self])
+
+    def sqrt(self):
+        return apply_op("sqrt", [self])
+
+    def square(self):
+        return apply_op("square", [self])
+
+    def exp(self):
+        return apply_op("exp", [self])
+
+    def log(self):
+        return apply_op("log", [self])
+
+    def relu(self):
+        return apply_op("relu", [self])
+
+    def sigmoid(self):
+        return apply_op("sigmoid", [self])
+
+    def tanh(self):
+        return apply_op("tanh", [self])
+
+    def softmax(self, axis=-1):
+        return apply_op("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return apply_op("log_softmax", [self], {"axis": axis})
+
+    def zeros_like(self):
+        return apply_op("zeros_like", [self])
+
+    def ones_like(self):
+        return apply_op("ones_like", [self])
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return apply_op("split", [self], {"num_outputs": num_outputs,
+                                          "axis": axis,
+                                          "squeeze_axis": squeeze_axis})
+
+    def pad(self, mode, pad_width, constant_value=0):
+        return apply_op("pad", [self], {"mode": mode,
+                                        "pad_width": tuple(pad_width),
+                                        "constant_value": constant_value})
+
+    # --------------------------------------------------------------- misc --
+    def as_np_ndarray(self):
+        from ..numpy import ndarray as np_ndarray
+        out = np_ndarray(self._data)
+        out._ag_slot = self._ag_slot
+        out._grad = self._grad
+        return out
+
+    def to_dlpack_for_read(self):
+        return jax.dlpack.to_dlpack(self._data)
+
+    to_dlpack_for_write = to_dlpack_for_read

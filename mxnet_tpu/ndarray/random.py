@@ -1,0 +1,118 @@
+"""``nd.random``: random sampling namespace.
+
+Reference: python/mxnet/ndarray/random.py. Scalar-parameter calls route to
+the ``_random_*`` ops; NDArray-parameter calls route to ``_sample_*``
+(per-element distribution parameters), matching the reference dispatch.
+"""
+from __future__ import annotations
+
+from ..ops.invoke import apply_op
+from .ndarray import NDArray
+from ..context import current_context
+from .. import _rng
+
+__all__ = ["uniform", "normal", "randn", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "randint", "shuffle", "seed", "bernoulli"]
+
+
+def seed(seed_state, ctx="all"):
+    _rng.seed(seed_state)
+
+
+def _place(res, ctx):
+    if ctx is None or res is None:
+        return res
+    if isinstance(res, tuple):
+        return tuple(r.as_in_context(ctx) for r in res)
+    return res.as_in_context(ctx)
+
+
+def _dispatch(scalar_op, sample_op, scalar_params, arr_args, shape, dtype,
+              ctx, out):
+    if any(isinstance(a, NDArray) for a in arr_args):
+        # per-element distribution parameters: broadcast scalars/arrays to a
+        # common shape first (reference raises on mixed types; we accept and
+        # broadcast, which is a superset)
+        import numpy as _np
+        import jax.numpy as jnp
+        datas = [a._data if isinstance(a, NDArray) else jnp.asarray(a, jnp.float32)
+                 for a in arr_args]
+        common = _np.broadcast_shapes(*[tuple(d.shape) for d in datas])
+        arrs = [NDArray(jnp.broadcast_to(d, common)) for d in datas]
+        res = apply_op(sample_op, arrs, {"shape": shape, "dtype": dtype},
+                       out=out)
+        return _place(res, ctx)
+    params = dict(scalar_params)
+    params.update({"shape": shape or (1,), "dtype": dtype})
+    return _place(apply_op(scalar_op, [], params, out=out), ctx)
+
+
+def uniform(low=0, high=1, shape=None, dtype="float32", ctx=None, out=None):
+    return _dispatch("_random_uniform", "_sample_uniform",
+                     {"low": low, "high": high}, (low, high), shape, dtype,
+                     ctx, out)
+
+
+def normal(loc=0, scale=1, shape=None, dtype="float32", ctx=None, out=None):
+    return _dispatch("_random_normal", "_sample_normal",
+                     {"loc": loc, "scale": scale}, (loc, scale), shape,
+                     dtype, ctx, out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape or (1,), dtype, ctx)
+
+
+def gamma(alpha=1, beta=1, shape=None, dtype="float32", ctx=None, out=None):
+    return _dispatch("_random_gamma", "_sample_gamma",
+                     {"alpha": alpha, "beta": beta}, (alpha, beta), shape,
+                     dtype, ctx, out)
+
+
+def exponential(scale=1, shape=None, dtype="float32", ctx=None, out=None):
+    return _place(apply_op("_random_exponential", [],
+                           {"lam": 1.0 / scale, "shape": shape or (1,),
+                            "dtype": dtype}, out=out), ctx)
+
+
+def poisson(lam=1, shape=None, dtype="float32", ctx=None, out=None):
+    return _place(apply_op("_random_poisson", [],
+                           {"lam": lam, "shape": shape or (1,),
+                            "dtype": dtype}, out=out), ctx)
+
+
+def negative_binomial(k=1, p=1, shape=None, dtype="float32", ctx=None,
+                      out=None):
+    return _place(apply_op("_random_negative_binomial", [],
+                           {"k": k, "p": p, "shape": shape or (1,),
+                            "dtype": dtype}, out=out), ctx)
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=None, dtype="float32",
+                                  ctx=None, out=None):
+    return _place(apply_op("_random_generalized_negative_binomial", [],
+                           {"mu": mu, "alpha": alpha, "shape": shape or (1,),
+                            "dtype": dtype}, out=out), ctx)
+
+
+def multinomial(data, shape=None, get_prob=False, out=None, dtype="int32"):
+    return apply_op("_sample_multinomial", [data],
+                    {"shape": shape, "get_prob": get_prob, "dtype": dtype},
+                    out=out)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    return _place(apply_op("_random_randint", [],
+                           {"low": low, "high": high, "shape": shape or (1,),
+                            "dtype": dtype}, out=out), ctx)
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, out=None):
+    return _place(apply_op("_sample_bernoulli", [],
+                           {"prob": prob, "shape": shape or (1,),
+                            "dtype": dtype}, out=out), ctx)
+
+
+def shuffle(data, out=None):
+    return apply_op("_shuffle", [data], {}, out=out)

@@ -1,0 +1,129 @@
+"""Sparse NDArray storage types.
+
+Reference: include/mxnet/ndarray.h:63-65 (kDefaultStorage, kRowSparseStorage,
+kCSRStorage), python/mxnet/ndarray/sparse.py. XLA has no native sparse
+tensors, so the TPU design keeps the *API* (stype, indices/data accessors,
+cast_storage, sparse row_sparse_pull semantics in kvstore) over an explicit
+index+values representation; compute densifies at op boundaries. This is the
+"explicit gather/scatter" strategy called out in SURVEY.md §7 hard-parts.
+Gradient row-sparsity (Embedding sparse_grad) is handled structurally by the
+optimizer taking the row-index fast path when it sees a RowSparseNDArray.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage"]
+
+
+class RowSparseNDArray(NDArray):
+    """Row-sparse array: (indices, values) over the leading axis."""
+
+    __slots__ = ("_indices", "_values")
+
+    def __init__(self, values, indices, shape):
+        vals = values._data if isinstance(values, NDArray) else jnp.asarray(values)
+        idx = indices._data if isinstance(indices, NDArray) else \
+            jnp.asarray(indices, jnp.int32)
+        dense = jnp.zeros(tuple(shape), vals.dtype).at[idx].set(vals)
+        super().__init__(dense)
+        self._indices = idx
+        self._values = vals
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    @property
+    def data(self):
+        return NDArray(self._values)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        return self
+
+
+class CSRNDArray(NDArray):
+    """Compressed sparse row matrix."""
+
+    __slots__ = ("_indptr", "_indices", "_values")
+
+    def __init__(self, data, indptr, indices, shape):
+        vals = _np.asarray(data)
+        ip = _np.asarray(indptr, _np.int32)
+        ind = _np.asarray(indices, _np.int32)
+        dense = _np.zeros(tuple(shape), vals.dtype)
+        for r in range(shape[0]):
+            dense[r, ind[ip[r]:ip[r + 1]]] = vals[ip[r]:ip[r + 1]]
+        super().__init__(jnp.asarray(dense))
+        self._indptr = jnp.asarray(ip)
+        self._indices = jnp.asarray(ind)
+        self._values = jnp.asarray(vals)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    @property
+    def data(self):
+        return NDArray(self._values)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        return self
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        values, indices = arg1
+        return RowSparseNDArray(values, indices, shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    nz = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(dense[nz], nz, dense.shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(data, indptr, indices, shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    import numpy as np
+    indptr = [0]
+    indices = []
+    data = []
+    for row in dense:
+        nz = np.nonzero(row)[0]
+        indices.extend(nz.tolist())
+        data.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(np.asarray(data, dense.dtype), indptr, indices,
+                      dense.shape)
+
+
+def cast_storage(arr, stype):
+    """Reference: src/operator/tensor/cast_storage.cc."""
+    if stype == "default":
+        return NDArray(arr._data)
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    if stype == "csr":
+        return csr_matrix(arr)
+    raise ValueError(stype)

@@ -1,0 +1,15 @@
+"""Op registry + families. Importing this package registers all ops.
+
+TPU-native analogue of the reference's src/operator/ tree: each submodule
+mirrors one reference op family (see the per-file docstrings for the
+file:line provenance map).
+"""
+from .registry import Operator, register, get, list_ops, alias  # noqa: F401
+from . import elemwise      # noqa: F401
+from . import reduce        # noqa: F401
+from . import shape_ops     # noqa: F401
+from . import nn            # noqa: F401
+from . import linalg        # noqa: F401
+from . import random_ops    # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from .invoke import apply_op, apply_fn  # noqa: F401

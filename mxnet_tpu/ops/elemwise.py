@@ -1,0 +1,141 @@
+"""Elementwise unary / binary / scalar ops.
+
+TPU-native replacement of the reference's elemwise op families
+(reference: src/operator/tensor/elemwise_unary_op_basic.cc,
+elemwise_binary_broadcast_op_*.cc, elemwise_binary_scalar_op_*.cc,
+src/operator/mshadow_op.h). The reference hand-writes ~200 mshadow kernel
+structs plus CUDA instantiations; here each op is one jax.numpy expression —
+XLA fuses chains of them into single VPU loops, which is exactly what the
+reference's NVRTC pointwise-fusion pass (src/operator/fusion/) tried to
+recover at runtime.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias, _REGISTRY, Operator
+
+
+def _reg(name, fn, differentiable=True):
+    _REGISTRY[name] = Operator(name, fn, differentiable=differentiable)
+
+
+# ----------------------------------------------------------------- unary ---
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "ceil": jnp.ceil, "floor": jnp.floor,
+    "rint": jnp.rint, "round": jnp.round, "trunc": jnp.trunc,
+    "fix": jnp.trunc, "square": jnp.square, "sqrt": jnp.sqrt,
+    "cbrt": jnp.cbrt, "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10,
+    "log2": jnp.log2, "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos, "arctan": jnp.arctan, "sinh": jnp.sinh,
+    "cosh": jnp.cosh, "tanh": jnp.tanh, "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "reciprocal": jnp.reciprocal, "negative": jnp.negative,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gammaln": jax.scipy.special.gammaln,
+    "identity": lambda x: x,
+}
+for _n, _f in _UNARY.items():
+    _reg(_n, _f)
+
+_reg("rsqrt", lambda x: lax.rsqrt(x))
+_reg("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_reg("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_reg("logical_not", lambda x: jnp.logical_not(x).astype(x.dtype),
+     differentiable=False)
+_reg("relu", lambda x: jnp.maximum(x, 0))
+_reg("sigmoid", jax.nn.sigmoid)
+_reg("softsign", jax.nn.soft_sign)
+_reg("hard_sigmoid", lambda x, alpha=0.2, beta=0.5:
+     jnp.clip(alpha * x + beta, 0.0, 1.0))
+_reg("softrelu", jax.nn.softplus)
+_reg("gelu", jax.nn.gelu)
+_reg("silu", jax.nn.silu)
+_reg("log_sigmoid", jax.nn.log_sigmoid)
+_reg("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+_reg("isnan", lambda x: jnp.isnan(x), differentiable=False)
+_reg("isinf", lambda x: jnp.isinf(x), differentiable=False)
+_reg("isfinite", lambda x: jnp.isfinite(x), differentiable=False)
+
+alias("stop_gradient", "identity")
+_reg("BlockGrad", lambda x: lax.stop_gradient(x))
+alias("make_loss", "identity")
+
+# ------------------------------------------------------- binary broadcast ---
+
+_BINARY = {
+    "broadcast_add": jnp.add, "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply, "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod, "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum, "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot, "arctan2": jnp.arctan2,
+    "elemwise_add": jnp.add, "elemwise_sub": jnp.subtract,
+    "elemwise_mul": jnp.multiply, "elemwise_div": jnp.divide,
+}
+for _n, _f in _BINARY.items():
+    _reg(_n, _f)
+
+alias("broadcast_plus", "broadcast_add")
+alias("broadcast_minus", "broadcast_sub")
+alias("maximum", "broadcast_maximum")
+alias("minimum", "broadcast_minimum")
+alias("hypot", "broadcast_hypot")
+
+_CMP = {
+    "broadcast_equal": jnp.equal, "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less, "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": jnp.logical_and,
+    "broadcast_logical_or": jnp.logical_or,
+    "broadcast_logical_xor": jnp.logical_xor,
+}
+for _n, _f in _CMP.items():
+    # comparisons return same-dtype 0/1 arrays in the reference nd API
+    def _make(f):
+        return lambda a, b: f(a, b).astype(jnp.result_type(a, b))
+    _reg(_n, _make(_f), differentiable=False)
+
+_reg("smooth_l1", lambda x, scalar=1.0: jnp.where(
+    jnp.abs(x) < 1.0 / (scalar * scalar),
+    0.5 * (scalar * x) ** 2, jnp.abs(x) - 0.5 / (scalar * scalar)))
+
+# ----------------------------------------------------------- scalar forms ---
+# Reference: src/operator/tensor/elemwise_binary_scalar_op_basic.cc (_plus_scalar …)
+
+_SCALAR = {
+    "_plus_scalar": lambda x, scalar: x + scalar,
+    "_minus_scalar": lambda x, scalar: x - scalar,
+    "_rminus_scalar": lambda x, scalar: scalar - x,
+    "_mul_scalar": lambda x, scalar: x * scalar,
+    "_div_scalar": lambda x, scalar: x / scalar,
+    "_rdiv_scalar": lambda x, scalar: scalar / x,
+    "_mod_scalar": lambda x, scalar: jnp.mod(x, scalar),
+    "_rmod_scalar": lambda x, scalar: jnp.mod(scalar, x),
+    "_power_scalar": lambda x, scalar: jnp.power(x, scalar),
+    "_rpower_scalar": lambda x, scalar: jnp.power(scalar, x),
+    "_maximum_scalar": lambda x, scalar: jnp.maximum(x, scalar),
+    "_minimum_scalar": lambda x, scalar: jnp.minimum(x, scalar),
+    "_hypot_scalar": lambda x, scalar: jnp.hypot(x, scalar),
+}
+for _n, _f in _SCALAR.items():
+    _reg(_n, _f)
+
+_SCALAR_CMP = {
+    "_equal_scalar": jnp.equal, "_not_equal_scalar": jnp.not_equal,
+    "_greater_scalar": jnp.greater, "_greater_equal_scalar": jnp.greater_equal,
+    "_lesser_scalar": jnp.less, "_lesser_equal_scalar": jnp.less_equal,
+}
+for _n, _f in _SCALAR_CMP.items():
+    def _make_s(f):
+        return lambda x, scalar: f(x, scalar).astype(x.dtype)
+    _reg(_n, _make_s(_f), differentiable=False)
+
+_reg("where", lambda cond, x, y: jnp.where(cond.astype(bool), x, y))
+_reg("zeros_like", jnp.zeros_like, differentiable=False)
+_reg("ones_like", jnp.ones_like, differentiable=False)

@@ -1,0 +1,131 @@
+"""Eager op invocation.
+
+TPU-native replacement of the reference's imperative invoke path
+(reference: src/imperative/imperative.cc:98 ``Imperative::Invoke`` →
+``SetShapeType`` → ``PushFCompute`` → engine). There is no dependency engine
+here: JAX's async dispatch + XLA give the same "Python returns immediately,
+device runs later" contract, and read/write ordering is inherent because
+arrays are immutable values (mutation = rebinding the buffer).
+
+``apply_op`` is the single chokepoint every generated ``nd.*`` function goes
+through — the analogue of ``MXImperativeInvokeEx`` — and is also where
+autograd taping happens (reference: ``Imperative::RecordOp``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd, _rng
+from .registry import Operator, get as get_op
+
+__all__ = ["apply_op", "apply_fn", "wrap_out", "as_jax"]
+
+
+def _ndarray_cls():
+    from ..ndarray.ndarray import NDArray
+    return NDArray
+
+
+def as_jax(x):
+    """Unwrap NDArray / coerce array-likes to jax values."""
+    NDArray = _ndarray_cls()
+    if isinstance(x, NDArray):
+        return x._data
+    return x  # tracers, jnp arrays, numpy, scalars pass through
+
+
+def wrap_out(data):
+    NDArray = _ndarray_cls()
+    return NDArray(data)
+
+
+def _participating_slots(inputs):
+    slots = []
+    any_part = False
+    for x in inputs:
+        s = getattr(x, "_ag_slot", None)
+        slots.append(s)
+        any_part = any_part or (s is not None)
+    return slots, any_part
+
+
+def apply_fn(fn, inputs: Sequence, nout: int = 1, differentiable: bool = True,
+             out=None):
+    """Run a pure jax function over NDArray inputs with autograd taping.
+
+    This is the generic path used both by registered ops and by ad-hoc
+    differentiable closures (indexing, fused expressions).
+    """
+    NDArray = _ndarray_cls()
+    xs = tuple(as_jax(i) for i in inputs)
+
+    in_slots, any_part = _participating_slots(inputs)
+    recorded = (differentiable and autograd.is_recording() and any_part)
+
+    if recorded:
+        outs, vjp_fn = jax.vjp(fn, *xs)
+    else:
+        outs = fn(*xs)
+
+    single = not isinstance(outs, (tuple, list))
+    outs_t = (outs,) if single else tuple(outs)
+
+    results = []
+    if out is not None:
+        # write-to-output form (reference `out=` kwarg): rebind the
+        # destination's buffer; not taped (matches reference kWriteTo refusal
+        # to record in-place writes of graph arrays)
+        targets = (out,) if isinstance(out, NDArray) else tuple(out)
+        for t, o in zip(targets, outs_t):
+            t._data = jnp.asarray(o, dtype=t.dtype) if o.dtype != t.dtype else o
+            results.append(t)
+    else:
+        results = [NDArray(o) for o in outs_t]
+
+    if recorded and out is None:
+        out_slots = [autograd.new_slot() for _ in results]
+        out_avals = [(r.shape, r._data.dtype) for r in results]
+        for r, s in zip(results, out_slots):
+            r._ag_slot = s
+        autograd.record_node(vjp_fn, in_slots, out_slots, out_avals)
+
+    return results[0] if single else tuple(results)
+
+
+def apply_op(op, inputs: Sequence, params: Optional[dict] = None, out=None):
+    """Invoke a registered op on NDArray inputs."""
+    if not isinstance(op, Operator):
+        op = get_op(op)
+    params = dict(params) if params else {}
+
+    if op.needs_rng and "rng" not in params:
+        params["rng"] = _rng.next_key()
+    if op.needs_train and "_training" not in params:
+        params["_training"] = autograd.is_training()
+
+    if op.mutates:
+        # optimizer-style in-place update: impl returns the new values of the
+        # mutated inputs; rebind their buffers (reference: kWriteInplace ops
+        # like sgd_update, src/operator/optimizer_op.cc)
+        xs = tuple(as_jax(i) for i in inputs)
+        outs = op.impl(*xs, **params) if not op.variadic else op.impl(list(xs), **params)
+        outs_t = (outs,) if not isinstance(outs, (tuple, list)) else tuple(outs)
+        results = []
+        for k, m in enumerate(op.mutates):
+            tgt = inputs[m]
+            tgt._data = outs_t[k]
+            results.append(tgt)
+        return results[0] if len(results) == 1 else tuple(results)
+
+    if op.variadic:
+        arrs = list(inputs)
+        fn = lambda *xs: op.impl(list(xs), **params)  # noqa: E731
+        return apply_fn(fn, arrs, nout=op.nout,
+                        differentiable=op.differentiable, out=out)
+
+    fn = lambda *xs: op.impl(*xs, **params)  # noqa: E731
+    return apply_fn(fn, inputs, nout=op.nout,
+                    differentiable=op.differentiable, out=out)

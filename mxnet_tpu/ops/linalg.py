@@ -1,0 +1,144 @@
+"""Linear-algebra ops (``linalg_*`` / ``la_*`` family).
+
+TPU-native replacement of the reference's LAPACK/cuSOLVER-backed linalg ops
+(reference: src/operator/tensor/la_op.cc, src/operator/linalg.h,
+c_lapack_api.h). Dense factorizations ride XLA's native TPU implementations
+(QR/Cholesky/triangular-solve run on the MXU); there is no LAPACK dispatch
+layer to manage.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import _REGISTRY, Operator, alias
+
+
+def _reg(name, fn, nout=1, differentiable=True):
+    _REGISTRY[name] = Operator(name, fn, nout=nout,
+                               differentiable=differentiable)
+
+
+def _gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+def _gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+          beta=1.0, axis=-2):
+    return _gemm2(a, b, transpose_a, transpose_b, alpha) + beta * c
+
+
+_reg("_linalg_gemm2", _gemm2)
+_reg("_linalg_gemm", _gemm)
+alias("linalg_gemm2", "_linalg_gemm2")
+alias("linalg_gemm", "_linalg_gemm")
+
+_reg("_linalg_potrf", lambda a: jnp.linalg.cholesky(a))
+alias("linalg_potrf", "_linalg_potrf")
+
+
+def _potri(a):
+    # input is the Cholesky factor L (reference la_op potri contract)
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = lax.linalg.triangular_solve(a, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+_reg("_linalg_potri", _potri)
+alias("linalg_potri", "_linalg_potri")
+
+
+def _trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    out = lax.linalg.triangular_solve(
+        a, alpha * b, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+    return out
+
+
+_reg("_linalg_trsm", _trsm)
+alias("linalg_trsm", "_linalg_trsm")
+
+
+def _trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b))
+
+
+_reg("_linalg_trmm", _trmm)
+alias("linalg_trmm", "_linalg_trmm")
+
+
+def _syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+_reg("_linalg_syrk", _syrk)
+alias("linalg_syrk", "_linalg_syrk")
+
+_reg("_linalg_syevd", lambda a: jnp.linalg.eigh(a), nout=2)
+alias("linalg_syevd", "_linalg_syevd")
+
+
+def _gelqf(a):
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+_REGISTRY["_linalg_gelqf"] = Operator("_linalg_gelqf", _gelqf, nout=2)
+alias("linalg_gelqf", "_linalg_gelqf")
+
+_reg("_linalg_sumlogdiag",
+     lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1))
+alias("linalg_sumlogdiag", "_linalg_sumlogdiag")
+
+
+def _extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+_reg("_linalg_extractdiag", _extractdiag)
+alias("linalg_extractdiag", "_linalg_extractdiag")
+
+
+def _makediag(a, offset=0):
+    n = a.shape[-1] + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    return out.at[..., r, c].set(a)
+
+
+_reg("_linalg_makediag", _makediag)
+alias("linalg_makediag", "_linalg_makediag")
+
+_reg("_linalg_inverse", lambda a: jnp.linalg.inv(a))
+alias("linalg_inverse", "_linalg_inverse")
+_reg("_linalg_det", lambda a: jnp.linalg.det(a))
+alias("linalg_det", "_linalg_det")
+
+
+def _slogdet(a):
+    sign, ld = jnp.linalg.slogdet(a)
+    return sign, ld
+
+
+_REGISTRY["_linalg_slogdet"] = Operator("_linalg_slogdet", _slogdet, nout=2)
+alias("linalg_slogdet", "_linalg_slogdet")
+
+_reg("khatri_rao", lambda *mats: _khatri_rao(mats))
+
+
+def _khatri_rao(mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(
+            out.shape[0] * m.shape[0], *out.shape[1:])
+    return out

@@ -1,0 +1,591 @@
+"""Neural-network ops.
+
+TPU-native replacement of the reference's nn operator family
+(reference: src/operator/nn/ — convolution.cc, fully_connected.cc,
+pooling.cc, batch_norm.cc, layer_norm.cc, softmax.cc, dropout.cc,
+activation.cc, upsampling.cc; src/operator/softmax_output.cc,
+src/operator/rnn.cc, src/operator/nn/ctc_loss.cc).
+
+Design: the reference dispatches each of these to cuDNN/MKLDNN/mshadow
+hand kernels per device. Here each op is one XLA computation:
+``lax.conv_general_dilated`` and ``lax.dot_general`` land on the MXU,
+``lax.reduce_window`` handles pooling, and normalization/softmax chains are
+left to XLA fusion (a single fused VPU pass — what the reference needed
+separate cuDNN calls for). All ops keep the reference's NCHW default layout;
+XLA relayouts internally for the TPU's (8,128) tiling so no NHWC rewrite is
+needed in user code.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import dtype_np
+from .registry import _REGISTRY, Operator, alias
+
+
+def _reg(name, fn, **kw):
+    _REGISTRY[name] = Operator(name, fn, **kw)
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n if n else ()
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+# ------------------------------------------------------------- dense -------
+
+def _fully_connected(*args, num_hidden=0, no_bias=False, flatten=True):
+    x, w = args[0], args[1]
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    elif not flatten and x.ndim > 2:
+        pass  # apply to last axis
+    out = lax.dot_general(x, w, (((x.ndim - 1,), (1,)), ((), ())))
+    if not no_bias and len(args) > 2:
+        out = out + args[2]
+    return out
+
+
+_reg("FullyConnected", _fully_connected)
+alias("fully_connected", "FullyConnected")
+
+
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    # reference dot: contract last axis of a with first axis of b
+    # (src/operator/tensor/dot-inl.h)
+    if transpose_a:
+        a = jnp.transpose(a)
+    if transpose_b:
+        b = jnp.transpose(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return lax.dot_general(a, b, (((a.ndim - 1,), (0,)), ((), ())))
+
+
+_reg("dot", _dot)
+
+
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+_reg("batch_dot", _batch_dot)
+
+
+# -------------------------------------------------------------- conv -------
+
+def _conv_dims(kernel):
+    return len(kernel)
+
+
+def _convolution(*args, kernel=None, stride=None, dilate=None, pad=None,
+                 num_filter=0, num_group=1, no_bias=False, layout=None,
+                 workspace=None, cudnn_tune=None, cudnn_off=None):
+    x, w = args[0], args[1]
+    nd = _conv_dims(kernel) if kernel else x.ndim - 2
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad, nd) if pad is not None else (0,) * nd
+    spec = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    out = lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, feature_group_count=num_group,
+        dimension_numbers=spec)
+    if not no_bias and len(args) > 2:
+        b = args[2].reshape((1, -1) + (1,) * nd)
+        out = out + b
+    return out
+
+
+_reg("Convolution", _convolution)
+alias("convolution", "Convolution")
+
+
+def _deconvolution(*args, kernel=None, stride=None, dilate=None, pad=None,
+                   adj=None, target_shape=None, num_filter=0, num_group=1,
+                   no_bias=True, layout=None, workspace=None,
+                   cudnn_tune=None, cudnn_off=None):
+    x, w = args[0], args[1]
+    nd = _conv_dims(kernel) if kernel else x.ndim - 2
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad, nd) if pad is not None else (0,) * nd
+    adj = _tup(adj, nd) if adj is not None else (0,) * nd
+    # transposed conv = gradient of conv w.r.t. input. weight layout in the
+    # reference is (in_channels, out_channels/group, kH, kW)
+    spec = {1: ("NCH", "IOH", "NCH"), 2: ("NCHW", "IOHW", "NCHW"),
+            3: ("NCDHW", "IODHW", "NCDHW")}[nd]
+    pads = []
+    for i in range(nd):
+        k = (w.shape[2 + i] - 1) * dilate[i] + 1
+        pads.append((k - 1 - pad[i], k - 1 - pad[i] + adj[i]))
+    out = lax.conv_general_dilated(
+        x, jnp.flip(w, axis=tuple(range(2, 2 + nd))),
+        window_strides=(1,) * nd, padding=pads, lhs_dilation=stride,
+        rhs_dilation=dilate, feature_group_count=num_group,
+        dimension_numbers=spec)
+    if not no_bias and len(args) > 2:
+        out = out + args[2].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+_reg("Deconvolution", _deconvolution)
+
+
+# ------------------------------------------------------------ pooling ------
+
+def _pool_pads(x, kernel, stride, pad, convention):
+    nd = len(kernel)
+    pads = []
+    for i in range(nd):
+        if convention == "full":
+            # reference 'full' convention: ceil instead of floor
+            # (src/operator/nn/pooling-inl.h)
+            in_sz = x.shape[2 + i] + 2 * pad[i]
+            out_sz = -(-(in_sz - kernel[i]) // stride[i]) + 1
+            need = (out_sz - 1) * stride[i] + kernel[i] - x.shape[2 + i]
+            pads.append((pad[i], max(need - pad[i], pad[i])))
+        else:
+            pads.append((pad[i], pad[i]))
+    return pads
+
+
+def _pooling(x, kernel=None, pool_type="max", global_pool=False, stride=None,
+             pad=None, pooling_convention="valid", count_include_pad=True,
+             layout=None, cudnn_off=None, p_value=None):
+    nd = x.ndim - 2
+    if global_pool:
+        kernel = x.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride, nd) if stride is not None else kernel if global_pool else _tup(stride, nd)
+    pad = _tup(pad, nd) if pad is not None else (0,) * nd
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = [(0, 0), (0, 0)] + _pool_pads(x, kernel, stride, pad,
+                                         pooling_convention)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+                                 window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+                              window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return s / jnp.asarray(denom, x.dtype)
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add,
+                                window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        p = p_value or 2
+        s = lax.reduce_window(jnp.abs(x) ** p, jnp.asarray(0, x.dtype),
+                              lax.add, window, strides, pads)
+        return s ** (1.0 / p)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+_reg("Pooling", _pooling)
+alias("pooling", "Pooling")
+
+
+def _adaptive_avg_pool2d(x, output_size=1):
+    os = _tup(output_size, 2)
+    return jax.image.resize(
+        jnp.mean(x, axis=(2, 3), keepdims=True), x.shape[:2] + os,
+        method="nearest") if os == (1, 1) else _adaptive_pool_general(x, os)
+
+
+def _adaptive_pool_general(x, os):
+    b, c, h, w = x.shape
+    oh, ow = os
+    # exact when divisible; interpolated otherwise
+    if h % oh == 0 and w % ow == 0:
+        return jnp.mean(x.reshape(b, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+    return jax.image.resize(x, (b, c, oh, ow), method="linear")
+
+
+_reg("_contrib_AdaptiveAvgPooling2D",
+     lambda x, output_size=1: _adaptive_pool_general(x, _tup(output_size, 2)))
+
+
+def _upsampling(*args, scale=1, sample_type="nearest", num_filter=0,
+                multi_input_mode="concat", num_args=1, workspace=None):
+    x = args[0]
+    b, c, h, w = x.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    return jax.image.resize(x, (b, c, h * scale, w * scale), method="linear")
+
+
+_reg("UpSampling", _upsampling)
+
+
+def _bilinear_resize2d(x, height=None, width=None, scale_height=None,
+                       scale_width=None, mode=None, align_corners=True):
+    b, c, h, w = x.shape
+    oh = height or int(h * scale_height)
+    ow = width or int(w * scale_width)
+    return jax.image.resize(x, (b, c, oh, ow), method="linear")
+
+
+_reg("_contrib_BilinearResize2D", _bilinear_resize2d)
+
+
+# ------------------------------------------------------- normalization -----
+
+def _batch_norm(*args, eps=1e-3, momentum=0.9, fix_gamma=True,
+                use_global_stats=False, output_mean_var=False, axis=1,
+                cudnn_off=None, _training=False):
+    """Returns (out, mean, var). Running-stat update is done by the caller
+    (gluon.nn.BatchNorm) — aux-state mutation can't live inside a pure op.
+    Reference: src/operator/nn/batch_norm.cc (aux states moving_mean/var)."""
+    x, gamma, beta, mmean, mvar = args
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    rs = lambda a: a.reshape(shape)  # noqa: E731
+    if _training and not use_global_stats:
+        red = tuple(i for i in range(x.ndim) if i != axis)
+        mean = jnp.mean(x, axis=red)
+        var = jnp.mean(jnp.square(x - rs(mean)), axis=red)
+    else:
+        mean, var = mmean, mvar
+    inv = lax.rsqrt(var + eps)
+    out = (x - rs(mean)) * rs(inv * gamma) + rs(beta)
+    return out, mean, var
+
+
+_REGISTRY["BatchNorm"] = Operator("BatchNorm", _batch_norm, nout=3,
+                                  needs_train=True)
+alias("batch_norm", "BatchNorm")
+
+
+def _layer_norm(x, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+_reg("LayerNorm", _layer_norm)
+alias("layer_norm", "LayerNorm")
+
+
+def _group_norm(x, gamma, beta, num_groups=1, eps=1e-5,
+                output_mean_var=False):
+    b, c = x.shape[:2]
+    g = num_groups
+    xg = x.reshape((b, g, c // g) + x.shape[2:])
+    red = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=red, keepdims=True)
+    out = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+_reg("GroupNorm", _group_norm)
+
+
+def _instance_norm(x, gamma, beta, eps=1e-3):
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=red, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+_reg("InstanceNorm", _instance_norm)
+
+
+def _l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        red = (1,)
+    else:  # spatial
+        red = tuple(range(2, x.ndim))
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + eps)
+    return x / n
+
+
+_reg("L2Normalization", _l2_normalization)
+
+
+def _lrn(x, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    sq = jnp.square(x)
+    half = nsize // 2
+    s = lax.reduce_window(sq, jnp.asarray(0, x.dtype), lax.add,
+                          (1, nsize, 1, 1), (1, 1, 1, 1),
+                          [(0, 0), (half, half), (0, 0), (0, 0)])
+    return x / jnp.power(knorm + alpha * s / nsize, beta)
+
+
+_reg("LRN", _lrn)
+
+
+# ------------------------------------------------------------ softmax ------
+
+def _softmax(x, axis=-1, temperature=None, length=None, use_length=False,
+             dtype=None):
+    if temperature:
+        x = x / temperature
+    if use_length and length is not None:
+        steps = jnp.arange(x.shape[axis])
+        mask = steps[None, :] < length[:, None]
+        x = jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - 2)) if
+                      x.ndim > 2 else mask, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=axis)
+    return out.astype(dtype_np(dtype)) if dtype else out
+
+
+_reg("softmax", _softmax)
+
+
+def _log_softmax(x, axis=-1, temperature=None, dtype=None):
+    if temperature:
+        x = x / temperature
+    out = jax.nn.log_softmax(x, axis=axis)
+    return out.astype(dtype_np(dtype)) if dtype else out
+
+
+_reg("log_softmax", _log_softmax)
+
+
+def _softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+_reg("softmin", _softmin)
+
+
+def _softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1],
+                            dtype=data.dtype)
+    return jnp.sum(-jnp.sum(onehot * logp, axis=-1))
+
+
+_reg("softmax_cross_entropy", _softmax_cross_entropy)
+
+
+@jax.custom_vjp
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _so_fwd(data, label, grad_scale, ignore_label, use_ignore):
+    out = jax.nn.softmax(data, axis=-1)
+    return out, (out, label, grad_scale, ignore_label, use_ignore)
+
+
+def _so_bwd(res, g):
+    # Legacy semantics (reference: src/operator/softmax_output-inl.h):
+    # backward ignores the incoming head grad and emits (p - onehot(label)).
+    out, label, grad_scale, ignore_label, use_ignore = res
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), out.shape[-1],
+                            dtype=out.dtype)
+    grad = (out - onehot) * grad_scale
+    if use_ignore:
+        keep = (label != ignore_label).astype(out.dtype)
+        grad = grad * keep[..., None]
+    return grad, None, None, None, None
+
+
+_softmax_output_core.defvjp(_so_fwd, _so_bwd)
+
+
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    use_ignore=False, multi_output=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    flat = data.reshape(-1, data.shape[-1]) if data.ndim > 2 else data
+    lab = label.reshape(-1) if label.ndim > 1 else label
+    scale = grad_scale
+    if normalization == "batch":
+        scale = grad_scale / flat.shape[0]
+    out = _softmax_output_core(flat, lab, scale, ignore_label, use_ignore)
+    return out.reshape(data.shape)
+
+
+_reg("SoftmaxOutput", _softmax_output)
+alias("softmax_output", "SoftmaxOutput")
+
+
+# --------------------------------------------------------- activation ------
+
+def _activation(x, act_type="relu"):
+    acts = {"relu": lambda v: jnp.maximum(v, 0),
+            "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "softrelu": jax.nn.softplus, "softsign": jax.nn.soft_sign,
+            "log_sigmoid": jax.nn.log_sigmoid,
+            "mish": lambda v: v * jnp.tanh(jax.nn.softplus(v))}
+    return acts[act_type](x)
+
+
+_reg("Activation", _activation)
+alias("activation", "Activation")
+
+
+def _leaky_relu(*args, act_type="leaky", slope=0.25, lower_bound=0.125,
+                upper_bound=0.334, rng=None, _training=False):
+    x = args[0]
+    if act_type == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act_type == "prelu":
+        gamma = args[1]
+        g = gamma.reshape((1, -1) + (1,) * (x.ndim - 2)) if x.ndim > 1 else gamma
+        return jnp.where(x > 0, x, g * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * (jnp.exp(x) - 1))
+    if act_type == "selu":
+        a, s = 1.6732632423543772, 1.0507009873554805
+        return s * jnp.where(x > 0, x, a * (jnp.exp(x) - 1))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "rrelu":
+        if _training and rng is not None:
+            u = jax.random.uniform(rng, x.shape, x.dtype, lower_bound,
+                                   upper_bound)
+        else:
+            u = (lower_bound + upper_bound) / 2
+        return jnp.where(x > 0, x, u * x)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+_REGISTRY["LeakyReLU"] = Operator("LeakyReLU", _leaky_relu, needs_rng=True,
+                                  needs_train=True)
+
+
+# ------------------------------------------------------------ dropout ------
+
+def _dropout(x, rng=None, p=0.5, mode="training", axes=(), cudnn_off=None,
+             _training=False):
+    if p == 0 or (not _training and mode != "always"):
+        return x
+    shape = list(x.shape)
+    for a in (axes or ()):
+        shape[a] = 1
+    keep = jax.random.bernoulli(rng, 1.0 - p, tuple(shape))
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+
+
+_REGISTRY["Dropout"] = Operator("Dropout", _dropout, needs_rng=True,
+                                needs_train=True)
+alias("dropout", "Dropout")
+
+
+# ---------------------------------------------------------- embedding ------
+
+def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+               sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+_reg("Embedding", _embedding)
+alias("embedding", "Embedding")
+
+
+# ---------------------------------------------------------------- ctc ------
+
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first"):
+    """CTC loss via log-domain forward algorithm under lax.scan.
+
+    Reference: src/operator/nn/ctc_loss.cc (warp-ctc). data: (T, B, A)
+    pre-softmax activations; label: (B, L) padded with -1 (or 0 when
+    blank_label='last'). Gradient comes from JAX AD through the scan —
+    no hand-written backward as in warp-ctc.
+    """
+    T, B, A = data.shape
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    blank = 0 if blank_label == "first" else A - 1
+    lab = label.astype(jnp.int32)
+    if blank_label == "last":
+        lab = lab - 0  # labels already 0-based with blank at end
+    L = lab.shape[1]
+    pad_val = -1 if blank_label == "first" else blank
+    if label_lengths is not None and use_label_lengths:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum((lab != pad_val) & (lab != -1), axis=1).astype(jnp.int32)
+    if data_lengths is not None and use_data_lengths:
+        dat_len = data_lengths.astype(jnp.int32)
+    else:
+        dat_len = jnp.full((B,), T, jnp.int32)
+
+    S = 2 * L + 1
+    labels_safe = jnp.where(lab < 0, 0, lab)
+    # extended label sequence: blank, l1, blank, l2, ...
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels_safe)
+    ext_len = 2 * lab_len + 1
+
+    neg_inf = jnp.asarray(-1e30, jnp.float32)
+    pos = jnp.arange(S)
+    same_as_prev2 = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    alpha0 = jnp.where(pos[None, :] < 2,
+                       jnp.take_along_axis(logp[0], ext, axis=1), neg_inf)
+    alpha0 = jnp.where(pos[None, :] == 1, alpha0, jnp.where(pos[None, :] == 0,
+                       alpha0, neg_inf))
+
+    def step(alpha, lp_t):
+        a_prev = alpha
+        a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]],
+                                   axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]],
+                                   axis=1)
+        a_shift2 = jnp.where(same_as_prev2 | (pos[None, :] % 2 == 0),
+                             neg_inf, a_shift2)
+        m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
+        m_safe = jnp.maximum(m, neg_inf)
+        summed = (jnp.exp(a_prev - m_safe) + jnp.exp(a_shift1 - m_safe)
+                  + jnp.exp(a_shift2 - m_safe))
+        new = m_safe + jnp.log(summed) + jnp.take_along_axis(lp_t, ext, axis=1)
+        return new, new
+
+    _, alphas = lax.scan(step, alpha0, logp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T,B,S)
+
+    # pick alpha at t = dat_len-1, s in {ext_len-1, ext_len-2}
+    t_idx = (dat_len - 1)[:, None]
+    alpha_T = jnp.take_along_axis(
+        alphas.transpose(1, 0, 2), t_idx[..., None], axis=1)[:, 0]  # (B,S)
+    end1 = jnp.take_along_axis(alpha_T, (ext_len - 1)[:, None], axis=1)[:, 0]
+    end2 = jnp.take_along_axis(alpha_T,
+                               jnp.maximum(ext_len - 2, 0)[:, None],
+                               axis=1)[:, 0]
+    m = jnp.maximum(end1, end2)
+    ll = m + jnp.log(jnp.exp(end1 - m) + jnp.exp(end2 - m))
+    return (-ll).astype(data.dtype)
+
+
+_reg("CTCLoss", _ctc_loss)
+alias("ctc_loss", "CTCLoss")

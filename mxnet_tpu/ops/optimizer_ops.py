@@ -1,0 +1,225 @@
+"""Fused optimizer update ops.
+
+TPU-native replacement of the reference's in-graph optimizer kernels
+(reference: src/operator/optimizer_op.cc — sgd_update, sgd_mom_update,
+adam_update, …; src/operator/contrib/adamw.cc). The reference fuses each
+update into one CUDA kernel and offers multi-tensor (multi_sgd_*) variants
+to amortize launches; under XLA a whole optimizer step jitted together is
+already one fused program, so each op here is the plain math. The
+``mutates`` registration makes the wrapper rebind the weight/state buffers,
+preserving the reference's in-place (kWriteInplace) API contract.
+
+All ops apply the reference's common pre-processing: grad = rescale_grad *
+grad, optionally clipped to [-clip_gradient, clip_gradient], plus wd.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import _REGISTRY, Operator
+
+
+def _reg(name, fn, nout, mutates):
+    _REGISTRY[name] = Operator(name, fn, nout=nout, differentiable=False,
+                               mutates=mutates)
+
+
+def _prep(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+_reg("sgd_update", _sgd_update, 1, (0,))
+
+
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+_reg("sgd_mom_update", _sgd_mom_update, 2, (0, 2))
+
+
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+_reg("nag_mom_update", _nag_mom_update, 2, (0, 2))
+
+
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+_reg("mp_sgd_update", _mp_sgd_update, 2, (0, 2))
+
+
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+_reg("mp_sgd_mom_update", _mp_sgd_mom_update, 3, (0, 2, 3))
+
+
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    return weight - lr * m / (jnp.sqrt(v) + epsilon), m, v
+
+
+_reg("adam_update", _adam_update, 3, (0, 2, 3))
+
+
+def _adamw_update(weight, grad, mean, var, rescale_grad_arr=None, lr=0.001,
+                  beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                  rescale_grad=1.0, clip_gradient=-1.0):
+    rs = rescale_grad_arr if rescale_grad_arr is not None else rescale_grad
+    g = grad * rs
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    return (weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight),
+            m, v)
+
+
+_REGISTRY["_adamw_update"] = Operator(
+    "_adamw_update", lambda w, g, m, v, r=None, **kw:
+    _adamw_update(w, g, m, v, r, **kw), nout=3, differentiable=False,
+    mutates=(0, 2, 3))
+
+
+def _rmsprop_update(weight, grad, n, lr=0.001, rho=0.9, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = rho * n + (1 - rho) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+_reg("rmsprop_update", _rmsprop_update, 2, (0, 2))
+
+
+def _rmspropalex_update(weight, grad, n, g_avg, delta, lr=0.001, rho=0.9,
+                        momentum=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = rho * n + (1 - rho) * jnp.square(g)
+    new_g = rho * g_avg + (1 - rho) * g
+    new_delta = (momentum * delta
+                 - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon))
+    w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n, new_g, new_delta
+
+
+_reg("rmspropalex_update", _rmspropalex_update, 4, (0, 2, 3, 4))
+
+
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1, jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, new_z, new_n
+
+
+_reg("ftrl_update", _ftrl_update, 3, (0, 2, 3))
+
+
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+_reg("signsgd_update", _signsgd_update, 1, (0,))
+
+
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return w, new_mom
+
+
+_reg("signum_update", _signum_update, 2, (0, 2))
+
+
+def _lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                        epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mhat = m / (1 - beta1 ** t)
+        vhat = v / (1 - beta2 ** t)
+    else:
+        mhat, vhat = m, v
+    return mhat / (jnp.sqrt(vhat) + epsilon) + wd * weight, m, v
+
+
+_REGISTRY["lamb_update_phase1"] = Operator(
+    "lamb_update_phase1",
+    lambda w, g, m, v, **kw: _lamb_update_phase1(w, g, m, v, **kw),
+    nout=3, differentiable=False, mutates=())
+
+
+def _lamb_update_phase2(weight, g, r1, r2, lr=0.01, lower_bound=-1.0,
+                        upper_bound=-1.0):
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2,
+                      jnp.ones_like(r1))
+    if lower_bound is not None and lower_bound > 0:
+        ratio = jnp.maximum(ratio, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        ratio = jnp.minimum(ratio, upper_bound)
+    return weight - lr * ratio * g
+
+
+_reg("lamb_update_phase2", _lamb_update_phase2, 1, (0,))
+
+
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_h = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(new_h) + epsilon), new_h
+
+
+_reg("_adagrad_update", _adagrad_update, 2, (0, 2))

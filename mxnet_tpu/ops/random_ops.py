@@ -1,0 +1,164 @@
+"""Random sampling ops.
+
+TPU-native replacement of the reference's sampler family
+(reference: src/operator/random/sample_op.cc, multisample_op.cc,
+shuffle_op.cc; RNG resource include/mxnet/random_generator.h). The
+reference seeds per-device Philox/MT generators through the resource
+manager; here every op draws a fresh fold of the global counter-based key
+(mxnet_tpu._rng) — deterministic under mx.random.seed, parallel-safe, and
+reproducible across devices.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .registry import _REGISTRY, Operator, alias
+
+
+def _reg(name, fn, nout=1, differentiable=False):
+    _REGISTRY[name] = Operator(name, fn, nout=nout, needs_rng=True,
+                               differentiable=differentiable)
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _uniform(rng=None, low=0.0, high=1.0, shape=None, dtype="float32"):
+    return jax.random.uniform(rng, _shape(shape), dtype_np(dtype), low, high)
+
+
+def _normal(rng=None, loc=0.0, scale=1.0, shape=None, dtype="float32"):
+    return loc + scale * jax.random.normal(rng, _shape(shape), dtype_np(dtype))
+
+
+def _gamma(rng=None, alpha=1.0, beta=1.0, shape=None, dtype="float32"):
+    return beta * jax.random.gamma(rng, alpha, _shape(shape), dtype_np(dtype))
+
+
+def _exponential(rng=None, lam=1.0, shape=None, dtype="float32"):
+    return jax.random.exponential(rng, _shape(shape), dtype_np(dtype)) / lam
+
+
+def _poisson(rng=None, lam=1.0, shape=None, dtype="float32"):
+    return jax.random.poisson(rng, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+def _randint(rng=None, low=0, high=1, shape=None, dtype="int32"):
+    return jax.random.randint(rng, _shape(shape), low, high,
+                              dtype_np(dtype))
+
+
+def _negative_binomial(rng=None, k=1, p=1.0, shape=None, dtype="float32"):
+    lam = jax.random.gamma(rng, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(jax.random.fold_in(rng, 1), lam,
+                              _shape(shape)).astype(dtype_np(dtype))
+
+
+def _gen_negative_binomial(rng=None, mu=1.0, alpha=1.0, shape=None,
+                           dtype="float32"):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    lam = jax.random.gamma(rng, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(jax.random.fold_in(rng, 1), lam,
+                              _shape(shape)).astype(dtype_np(dtype))
+
+
+_reg("_random_uniform", _uniform)
+_reg("_random_normal", _normal)
+_reg("_random_gamma", _gamma)
+_reg("_random_exponential", _exponential)
+_reg("_random_poisson", _poisson)
+_reg("_random_randint", _randint)
+_reg("_random_negative_binomial", _negative_binomial)
+_reg("_random_generalized_negative_binomial", _gen_negative_binomial)
+alias("uniform", "_random_uniform")
+alias("normal", "_random_normal")
+alias("random_gamma", "_random_gamma")
+alias("random_exponential", "_random_exponential")
+alias("random_poisson", "_random_poisson")
+alias("random_randint", "_random_randint")
+
+
+# sample_* variants: per-element distribution parameters as array inputs
+# (reference: src/operator/random/multisample_op.cc)
+
+def _sample_uniform(low, high, rng=None, shape=None, dtype="float32"):
+    s = _shape(shape)
+    out_shape = low.shape + s
+    u = jax.random.uniform(rng, out_shape, dtype_np(dtype))
+    return low.reshape(low.shape + (1,) * len(s)) + u * (
+        (high - low).reshape(low.shape + (1,) * len(s)))
+
+
+def _sample_normal(mu, sigma, rng=None, shape=None, dtype="float32"):
+    s = _shape(shape)
+    n = jax.random.normal(rng, mu.shape + s, dtype_np(dtype))
+    return (mu.reshape(mu.shape + (1,) * len(s))
+            + n * sigma.reshape(sigma.shape + (1,) * len(s)))
+
+
+def _sample_gamma(alpha, beta, rng=None, shape=None, dtype="float32"):
+    s = _shape(shape)
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(rng, jnp.broadcast_to(a, alpha.shape + s),
+                         dtype=dtype_np(dtype))
+    return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+_reg("_sample_uniform", _sample_uniform)
+_reg("_sample_normal", _sample_normal)
+_reg("_sample_gamma", _sample_gamma)
+alias("sample_uniform", "_sample_uniform")
+alias("sample_normal", "_sample_normal")
+alias("sample_gamma", "_sample_gamma")
+
+
+def _sample_multinomial(data, rng=None, shape=None, get_prob=False,
+                        dtype="int32"):
+    # data: (..., K) probabilities (reference: sample_multinomial_op.cc)
+    s = _shape(shape) or ()
+    n = 1
+    for d in s:
+        n *= d
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    flat = logits.reshape(-1, logits.shape[-1])
+    draws = jax.random.categorical(rng, flat[:, None, :].repeat(max(n, 1), 1),
+                                   axis=-1)
+    out = draws.reshape(data.shape[:-1] + (s or ()))
+    out = out.astype(dtype_np(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            flat, draws.reshape(flat.shape[0], -1), axis=1
+        ).reshape(out.shape)
+        return out, lp
+    return out
+
+
+_REGISTRY["_sample_multinomial"] = Operator(
+    "_sample_multinomial", _sample_multinomial, nout=-1, needs_rng=True,
+    differentiable=False)
+alias("sample_multinomial", "_sample_multinomial")
+
+
+def _shuffle(data, rng=None):
+    return jax.random.permutation(rng, data, axis=0)
+
+
+_reg("_shuffle", _shuffle)
+alias("shuffle", "_shuffle")
+
+
+def _bernoulli(rng=None, prob=0.5, shape=None, dtype="float32"):
+    return jax.random.bernoulli(rng, prob, _shape(shape)).astype(
+        dtype_np(dtype))
+
+
+_reg("_sample_bernoulli", _bernoulli)
+alias("bernoulli", "_sample_bernoulli")

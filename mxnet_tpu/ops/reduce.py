@@ -1,0 +1,98 @@
+"""Reduction ops.
+
+TPU-native replacement of the reference's broadcast/reduce family
+(reference: src/operator/tensor/broadcast_reduce_op_value.cc,
+broadcast_reduce_op_index.cc, src/operator/tensor/broadcast_reduce-inl.h).
+The reference hand-tiles reduction kernels; XLA maps these onto the VPU's
+cross-lane reducers and fuses the producer, so each op is one jnp call.
+Reference-specific semantics kept: ``exclude=True`` reduces over all axes
+NOT listed (broadcast_reduce_op.h ReduceAxesParam), comparisons of argmax
+dtype (reference returns float32 indices for nd API).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import _REGISTRY, Operator, alias
+
+
+def _reg(name, fn, differentiable=True):
+    _REGISTRY[name] = Operator(name, fn, differentiable=differentiable)
+
+
+def _axes(axis, ndim, exclude=False):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _make_reduce(jfn):
+    def impl(x, axis=None, keepdims=False, exclude=False):
+        return jfn(x, axis=_axes(axis, x.ndim, exclude), keepdims=keepdims)
+    return impl
+
+
+for _n, _f in {"sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+               "max": jnp.max, "min": jnp.min, "nansum": jnp.nansum,
+               "nanprod": jnp.nanprod}.items():
+    _reg(_n, _make_reduce(_f))
+
+alias("sum_axis", "sum")
+alias("max_axis", "max")
+alias("min_axis", "min")
+
+
+def _norm(x, ord=2, axis=None, keepdims=False):
+    ax = _axes(axis, x.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims))
+
+
+_reg("norm", _norm)
+
+
+def _make_argreduce(jfn):
+    def impl(x, axis=None, keepdims=False):
+        # reference nd.argmax returns float32 (src/operator/tensor/
+        # broadcast_reduce_op_index.cc uses real_t output)
+        return jfn(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+    return impl
+
+
+_reg("argmax", _make_argreduce(jnp.argmax), differentiable=False)
+_reg("argmin", _make_argreduce(jnp.argmin), differentiable=False)
+
+
+def _argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+_reg("argmax_channel", _argmax_channel, differentiable=False)
+
+
+def _moments(x, axes=None, keepdims=False):
+    ax = _axes(axes, x.ndim)
+    mean = jnp.mean(x, axis=ax, keepdims=keepdims)
+    var = jnp.mean(jnp.square(x - jnp.mean(x, axis=ax, keepdims=True)),
+                   axis=ax, keepdims=keepdims)
+    return mean, var
+
+
+_REGISTRY["moments"] = Operator("moments", _moments, nout=2)
+
+
+def _cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x if dtype is None else x.astype(dtype), axis=axis)
+
+
+_reg("cumsum", _cumsum)
+_reg("logsumexp", lambda x, axis=None, keepdims=False:
+     jnp.log(jnp.sum(jnp.exp(x - jnp.max(x, axis=axis, keepdims=True)),
+                     axis=axis, keepdims=keepdims))
+     + (jnp.max(x, axis=axis, keepdims=keepdims)))

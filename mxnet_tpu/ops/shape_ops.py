@@ -1,0 +1,382 @@
+"""Shape manipulation and indexing ops.
+
+TPU-native replacement of the reference's matrix-manipulation and indexing
+families (reference: src/operator/tensor/matrix_op.cc — Reshape/Transpose/
+slice/Concat/stack/tile/repeat/pad/depth_to_space…, indexing_op.cc —
+take/pick/gather_nd/scatter_nd/one_hot, ordering_op.cc — topk/sort/argsort).
+Static shapes are computed in Python at trace time (the analogue of the
+reference's FInferShape functions), so everything stays jit-compatible.
+
+Reference reshape keyword codes are preserved (matrix_op-inl.h
+ReshapeParam): 0 = copy input dim, -1 = infer, -2 = copy all remaining,
+-3 = merge next two dims, -4 = split next dim by the following two values.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import dtype_np
+from .registry import _REGISTRY, Operator, alias
+
+
+def _reg(name, fn, differentiable=True, nout=1, variadic=False):
+    _REGISTRY[name] = Operator(name, fn, nout=nout,
+                               differentiable=differentiable,
+                               variadic=variadic)
+
+
+def infer_reshape(src_shape, target):
+    """Resolve a reference-style reshape spec against a concrete shape."""
+    src = list(src_shape)
+    out = []
+    i = 0  # cursor into src dims
+    t = list(target)
+    k = 0
+    while k < len(t):
+        d = t[k]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            a, b = t[k + 1], t[k + 2]
+            sz = src[i]
+            if a == -1:
+                a = sz // b
+            if b == -1:
+                b = sz // a
+            out.extend([a, b]); i += 1; k += 2
+        else:
+            out.append(d)
+            if i < len(src):
+                i += 1
+        k += 1
+    if out.count(-1):
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src_shape:
+            total *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+def _reshape(x, shape=None, reverse=False):
+    return jnp.reshape(x, infer_reshape(x.shape, shape))
+
+
+_reg("reshape", _reshape)
+alias("Reshape", "reshape")
+_reg("reshape_like", lambda x, y: jnp.reshape(x, y.shape))
+_reg("transpose", lambda x, axes=None: jnp.transpose(x, axes or None))
+_reg("swapaxes", lambda x, dim1=0, dim2=0: jnp.swapaxes(x, dim1, dim2))
+alias("SwapAxis", "swapaxes")
+_reg("flatten", lambda x: jnp.reshape(x, (x.shape[0], -1)))
+alias("Flatten", "flatten")
+_reg("expand_dims", lambda x, axis: jnp.expand_dims(x, axis))
+
+
+def _squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    return jnp.squeeze(x, axis)
+
+
+_reg("squeeze", _squeeze)
+
+
+def _broadcast_to(x, shape):
+    # reference semantics: 0 in target keeps the source dim
+    tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, shape)) \
+        if len(shape) == x.ndim else tuple(shape)
+    return jnp.broadcast_to(x, tgt)
+
+
+_reg("broadcast_to", _broadcast_to)
+_reg("broadcast_like", lambda x, y: jnp.broadcast_to(x, y.shape))
+
+
+def _broadcast_axis(x, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+_reg("broadcast_axis", _broadcast_axis)
+alias("broadcast_axes", "broadcast_axis")
+
+
+def _tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+_reg("tile", _tile)
+_reg("repeat", lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=axis))
+
+
+def _flip(x, axis):
+    return jnp.flip(x, axis)
+
+
+_reg("flip", _flip)
+alias("reverse", "flip")
+
+
+def _pad(x, mode="constant", pad_width=(), constant_value=0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(x.ndim)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(x, pw, mode=jmode)
+
+
+_reg("pad", _pad)
+alias("Pad", "pad")
+
+_reg("concat", lambda xs, dim=1, num_args=None: jnp.concatenate(xs, axis=dim),
+     variadic=True)
+alias("Concat", "concat")
+_reg("stack", lambda xs, axis=0, num_args=None: jnp.stack(xs, axis=axis),
+     variadic=True)
+
+
+def _split(x, num_outputs=None, axis=1, squeeze_axis=False, sections=None):
+    n = num_outputs or sections
+    parts = jnp.split(x, n, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+_REGISTRY["split"] = Operator("split", _split, nout=-1)
+alias("SliceChannel", "split")
+
+
+def _slice(x, begin, end, step=None):
+    idx = []
+    step = step or [None] * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(slice(b, e, s))
+    return x[tuple(idx)]
+
+
+_reg("slice", _slice)
+
+
+def _slice_axis(x, axis, begin, end):
+    idx = [slice(None)] * x.ndim
+    if end is None:
+        end = x.shape[axis]
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+_reg("slice_axis", _slice_axis)
+
+
+def _slice_like(x, y, axes=()):
+    axes = tuple(axes) if axes else tuple(range(min(x.ndim, y.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, y.shape[a])
+    return x[tuple(idx)]
+
+
+_reg("slice_like", _slice_like)
+
+_reg("clip", lambda x, a_min=None, a_max=None: jnp.clip(x, a_min, a_max))
+
+
+def _take(x, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, x.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, x.shape[axis] - 1)
+    return jnp.take(x, idx, axis=axis)
+
+
+_reg("take", _take)
+
+
+def _batch_take(x, indices):
+    return x[jnp.arange(x.shape[0]), indices.astype(jnp.int32)]
+
+
+_reg("batch_take", _batch_take)
+
+
+def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    idx = index.astype(jnp.int32)
+    ax = axis % x.ndim
+    idx = jnp.clip(idx, 0, x.shape[ax] - 1)
+    idxe = jnp.expand_dims(idx, ax)
+    out = jnp.take_along_axis(x, idxe, axis=ax)
+    return out if keepdims else jnp.squeeze(out, ax)
+
+
+_reg("pick", _pick)
+
+
+def _gather_nd(x, indices):
+    ind = indices.astype(jnp.int32)
+    return x[tuple(ind[i] for i in range(ind.shape[0]))]
+
+
+_reg("gather_nd", _gather_nd)
+
+
+def _scatter_nd(data, indices, shape):
+    ind = indices.astype(jnp.int32)
+    out = jnp.zeros(tuple(shape), data.dtype)
+    return out.at[tuple(ind[i] for i in range(ind.shape[0]))].set(data)
+
+
+_reg("scatter_nd", _scatter_nd)
+
+
+def _one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    d = dtype_np(dtype)
+    oh = jnp.equal(jnp.expand_dims(indices.astype(jnp.int32), -1),
+                   jnp.arange(depth))
+    return jnp.where(oh, _np.array(on_value, d), _np.array(off_value, d))
+
+
+_reg("one_hot", _one_hot, differentiable=False)
+
+
+def _sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+_reg("sort", _sort)
+
+
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(dtype_np(dtype))
+
+
+_reg("argsort", _argsort, differentiable=False)
+
+
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    ax = axis % x.ndim
+    xm = jnp.moveaxis(x, ax, -1)
+    if is_ascend:
+        vals, idx = lax.top_k(-xm, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(xm, k)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax).astype(dtype_np(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        raise NotImplementedError("topk ret_typ='mask'")
+
+
+_REGISTRY["topk"] = Operator("topk", _topk, nout=-1, differentiable=False)
+
+_reg("shape_array", lambda x: jnp.array(x.shape, jnp.int32),
+     differentiable=False)
+_reg("size_array", lambda x: jnp.array([x.size], jnp.int32),
+     differentiable=False)
+_reg("cast", lambda x, dtype: x.astype(dtype_np(dtype)))
+alias("Cast", "cast")
+
+
+def _diag(x, k=0):
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+
+
+_reg("diag", _diag)
+
+
+def _depth_to_space(x, block_size):
+    b, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+def _space_to_depth(x, block_size):
+    b, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(b, c, h // bs, bs, w // bs, bs)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+_reg("depth_to_space", _depth_to_space)
+_reg("space_to_depth", _space_to_depth)
+
+
+# --- sequence ops (reference: src/operator/sequence_mask.cc, sequence_last.cc,
+#     sequence_reverse.cc; layout (seq_len, batch, ...)) ---------------------
+
+def _seq_steps(x):
+    return jnp.arange(x.shape[0])[:, None]
+
+
+def _sequence_mask(x, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return x
+    if axis == 1:
+        x = jnp.swapaxes(x, 0, 1)
+    mask = _seq_steps(x) < sequence_length[None, :]
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    out = jnp.where(mask, x, jnp.asarray(value, x.dtype))
+    return jnp.swapaxes(out, 0, 1) if axis == 1 else out
+
+
+def _sequence_last(x, sequence_length=None, use_sequence_length=False, axis=0):
+    if axis == 1:
+        x = jnp.swapaxes(x, 0, 1)
+    if not use_sequence_length or sequence_length is None:
+        return x[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    return jnp.take_along_axis(
+        x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0)[0]
+
+
+def _sequence_reverse(x, sequence_length=None, use_sequence_length=False,
+                      axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(x, axis=0)
+    T = x.shape[0]
+    steps = jnp.arange(T)[:, None]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(steps < lens, lens - 1 - steps, steps)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=0)
+
+
+_reg("SequenceMask", _sequence_mask)
+alias("sequence_mask", "SequenceMask")
+_reg("SequenceLast", _sequence_last)
+alias("sequence_last", "SequenceLast")
+_reg("SequenceReverse", _sequence_reverse)
+alias("sequence_reverse", "SequenceReverse")
