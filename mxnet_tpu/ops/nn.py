@@ -257,7 +257,8 @@ _reg("_contrib_BilinearResize2D", _bilinear_resize2d)
 def _batch_norm(*args, eps=1e-3, momentum=0.9, fix_gamma=True,
                 use_global_stats=False, output_mean_var=False, axis=1,
                 cudnn_off=None, _training=False):
-    """Returns (out, mean, var). Running-stat update is done by the caller
+    """Returns out, or (out, batch_mean, batch_var) when
+    ``output_mean_var=True``. Running-stat update is done by the caller
     (gluon.nn.BatchNorm) — aux-state mutation can't live inside a pure op.
     Reference: src/operator/nn/batch_norm.cc (aux states moving_mean/var)."""
     x, gamma, beta, mmean, mvar = args
@@ -274,10 +275,12 @@ def _batch_norm(*args, eps=1e-3, momentum=0.9, fix_gamma=True,
         mean, var = mmean, mvar
     inv = lax.rsqrt(var + eps)
     out = (x - rs(mean)) * rs(inv * gamma) + rs(beta)
-    return out, mean, var
+    if output_mean_var:
+        return out, mean, var
+    return out
 
 
-_REGISTRY["BatchNorm"] = Operator("BatchNorm", _batch_norm, nout=3,
+_REGISTRY["BatchNorm"] = Operator("BatchNorm", _batch_norm,
                                   needs_train=True)
 alias("batch_norm", "BatchNorm")
 

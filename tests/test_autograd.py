@@ -1,0 +1,107 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * 2).sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * np.exp(x.asnumpy()), atol=1e-5)
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_multiple_uses():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 3
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2 * 2 + 3])
+
+
+def test_pause():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = x * 100  # not recorded
+        w = y + z.detach()
+    w.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_grad_fn():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    g = autograd.grad(y, [x])
+    assert np.allclose(g[0].asnumpy(), 3 * x.asnumpy() ** 2)
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_retain_graph():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    assert np.allclose(g1, [6.0])
+
+
+def test_grad_add_req():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    with autograd.record():
+        y = x * 2
+    y.backward()
+    with autograd.record():
+        y = x * 3
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [5.0])
+
+
+def test_mark_variables():
+    x = nd.array([2.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 5
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [5.0])

@@ -1,0 +1,217 @@
+"""Operator correctness tests (reference: tests/python/unittest/test_operator.py).
+
+Oracle is numpy (SURVEY.md §4: CPU/numpy is the reference implementation
+the accelerator backend is checked against).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_activation_ops():
+    x = nd.array(_rand(3, 4))
+    xn = x.asnumpy()
+    assert np.allclose(nd.relu(x).asnumpy(), np.maximum(xn, 0))
+    assert np.allclose(nd.sigmoid(x).asnumpy(), 1 / (1 + np.exp(-xn)), atol=1e-6)
+    assert np.allclose(nd.tanh(x).asnumpy(), np.tanh(xn), atol=1e-6)
+    assert np.allclose(nd.Activation(x, act_type="relu").asnumpy(),
+                       np.maximum(xn, 0))
+
+
+def test_softmax():
+    x = nd.array(_rand(2, 5))
+    y = nd.softmax(x).asnumpy()
+    assert np.allclose(y.sum(axis=-1), 1.0, atol=1e-5)
+    ref = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(-1, keepdims=True)
+    assert np.allclose(y, ref, atol=1e-5)
+    ls = nd.log_softmax(x).asnumpy()
+    assert np.allclose(ls, np.log(ref), atol=1e-5)
+
+
+def test_fully_connected():
+    x = nd.array(_rand(4, 10))
+    w = nd.array(_rand(6, 10))
+    b = nd.array(_rand(6))
+    out = nd.FullyConnected(x, w, b, num_hidden=6)
+    assert out.shape == (4, 6)
+    assert np.allclose(out.asnumpy(),
+                       x.asnumpy() @ w.asnumpy().T + b.asnumpy(), atol=1e-5)
+
+
+def test_convolution():
+    # NCHW, reference layout (src/operator/nn/convolution.cc)
+    x = nd.array(_rand(2, 3, 8, 8))
+    w = nd.array(_rand(4, 3, 3, 3))
+    b = nd.array(_rand(4))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    assert out.shape == (2, 4, 6, 6)
+    out2 = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4,
+                          pad=(1, 1), stride=(2, 2))
+    assert out2.shape == (2, 4, 4, 4)
+
+
+def test_conv_grad():
+    x = nd.array(_rand(1, 1, 5, 5))
+    w = nd.array(_rand(1, 1, 3, 3))
+    x.attach_grad(); w.attach_grad()
+    with autograd.record():
+        y = nd.Convolution(x, w, kernel=(3, 3), num_filter=1, no_bias=True)
+        loss = y.sum()
+    loss.backward()
+    assert x.grad is not None and w.grad is not None
+    assert x.grad.shape == x.shape and w.grad.shape == w.shape
+    # numeric check on w
+    eps = 1e-2
+    wn = w.asnumpy()
+    num = np.zeros_like(wn)
+    import jax.numpy as jnp
+    for i in range(3):
+        for j in range(3):
+            wp, wm = wn.copy(), wn.copy()
+            wp[0, 0, i, j] += eps
+            wm[0, 0, i, j] -= eps
+            fp = nd.Convolution(x, nd.array(wp), kernel=(3, 3), num_filter=1,
+                                no_bias=True).sum().asscalar()
+            fm = nd.Convolution(x, nd.array(wm), kernel=(3, 3), num_filter=1,
+                                no_bias=True).sum().asscalar()
+            num[0, 0, i, j] = (fp - fm) / (2 * eps)
+    assert np.allclose(w.grad.asnumpy(), num, atol=1e-2)
+
+
+def test_pooling():
+    x = nd.array(_rand(1, 2, 4, 4))
+    y = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert y.shape == (1, 2, 2, 2)
+    ref = x.asnumpy().reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    assert np.allclose(y.asnumpy(), ref, atol=1e-6)
+    ya = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    refa = x.asnumpy().reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert np.allclose(ya.asnumpy(), refa, atol=1e-6)
+    yg = nd.Pooling(x, kernel=(1, 1), global_pool=True, pool_type="avg")
+    assert yg.shape == (1, 2, 1, 1)
+
+
+def test_batchnorm_inference_and_training():
+    x = nd.array(_rand(4, 3, 5, 5))
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mean, var = nd.zeros((3,)), nd.ones((3,))
+    y = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+    assert y.shape == x.shape
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    # predict mode: identity
+    y = nd.Dropout(x, p=0.5)
+    assert np.allclose(y.asnumpy(), 1.0)
+    with autograd.record():
+        yt = nd.Dropout(x, p=0.5)
+    m = yt.asnumpy()
+    frac = (m == 0).mean()
+    assert 0.3 < frac < 0.7  # ~half dropped
+    kept = m[m != 0]
+    assert np.allclose(kept, 2.0, atol=1e-5)  # inverted scaling
+
+
+def test_elemwise_binary():
+    a, b = nd.array(_rand(3, 4)), nd.array(_rand(3, 4))
+    an, bn = a.asnumpy(), b.asnumpy()
+    assert np.allclose(nd.maximum(a, b).asnumpy(), np.maximum(an, bn))
+    assert np.allclose(nd.minimum(a, b).asnumpy(), np.minimum(an, bn))
+    assert np.allclose(nd.hypot(a, b).asnumpy(), np.hypot(an, bn), atol=1e-5)
+
+
+def test_where():
+    cond = nd.array([1.0, 0.0, 1.0])
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([10.0, 20.0, 30.0])
+    assert nd.where(cond, a, b).asnumpy().tolist() == [1.0, 20.0, 3.0]
+
+
+def test_take_gather():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    idx = nd.array([0, 2], dtype="int32")
+    t = nd.take(a, idx)
+    assert t.shape == (2, 4)
+    assert np.allclose(t.asnumpy(), a.asnumpy()[[0, 2]])
+
+
+def test_embedding():
+    data = nd.array([1, 0, 2], dtype="int32")
+    weight = nd.array(_rand(5, 8))
+    out = nd.Embedding(data, weight, input_dim=5, output_dim=8)
+    assert out.shape == (3, 8)
+    assert np.allclose(out.asnumpy(), weight.asnumpy()[[1, 0, 2]])
+
+
+def test_layernorm():
+    x = nd.array(_rand(2, 10))
+    g, b = nd.ones((10,)), nd.zeros((10,))
+    y = nd.LayerNorm(x, g, b)
+    yn = y.asnumpy()
+    assert np.allclose(yn.mean(-1), 0, atol=1e-5)
+    assert np.allclose(yn.std(-1), 1, atol=1e-2)
+
+
+def test_one_hot():
+    x = nd.array([0, 2], dtype="int32")
+    y = nd.one_hot(x, 3)
+    assert np.allclose(y.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_random_ops():
+    u = nd.random.uniform(0, 1, shape=(1000,))
+    un = u.asnumpy()
+    assert 0 <= un.min() and un.max() <= 1
+    assert 0.4 < un.mean() < 0.6
+    n = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(n.asnumpy().mean()) < 0.2
+
+
+def test_random_seed_determinism():
+    mx.ndarray.random.seed(42)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.ndarray.random.seed(42)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    assert np.allclose(a, b)
+
+
+def test_linalg_ops():
+    a_np = _rand(3, 3)
+    spd = a_np @ a_np.T + 3 * np.eye(3, dtype=np.float32)
+    chol = nd.linalg.potrf(nd.array(spd))
+    assert np.allclose(chol.asnumpy() @ chol.asnumpy().T, spd, atol=1e-4)
+    g = nd.linalg.gemm2(nd.array(a_np), nd.array(a_np))
+    assert np.allclose(g.asnumpy(), a_np @ a_np, atol=1e-5)
+
+
+def test_optimizer_update_ops():
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.1])
+    out = nd.sgd_update(w, g, lr=1.0, wd=0.0)
+    assert np.allclose(w.asnumpy(), [0.9, 1.9], atol=1e-6)
+
+
+def test_numeric_gradient_generic():
+    """check_numeric_gradient analogue for a composite expression."""
+    x = nd.array(_rand(4))
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.tanh(x) * nd.sigmoid(x)).sum()
+    y.backward()
+    eps = 1e-3
+    xn = x.asnumpy()
+    num = np.zeros_like(xn)
+    for i in range(4):
+        xp, xm = xn.copy(), xn.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        f = lambda v: (np.tanh(v) * (1 / (1 + np.exp(-v)))).sum()
+        num[i] = (f(xp) - f(xm)) / (2 * eps)
+    assert np.allclose(x.grad.asnumpy(), num, atol=1e-3)
