@@ -1,0 +1,848 @@
+"""Evaluation metrics.
+
+Reference: python/mxnet/metric.py — the EvalMetric zoo (Accuracy,
+TopKAccuracy, F1, MCC, Perplexity, MAE/MSE/RMSE, CrossEntropy, NLL, Pearson,
+Loss, CustomMetric, CompositeEvalMetric) plus the string registry used by
+``Module.fit(eval_metric="acc")``. Metric math runs on host numpy: metric
+updates are per-batch reductions of already-materialized predictions and
+feeding them back through XLA would force extra device syncs.
+"""
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+
+import numpy
+
+from .base import MXNetError
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "NegativeLogLikelihood", "PearsonCorrelation", "Loss",
+           "CustomMetric", "np", "create", "register"]
+
+_METRIC_REGISTRY = {}
+
+
+def register(klass, *names):
+    key_names = names or (klass.__name__,)
+    for name in key_names:
+        _METRIC_REGISTRY[name.lower()] = klass
+    return klass
+
+
+def create(metric, *args, **kwargs):
+    """Create by name / callable / list (reference: metric.py create)."""
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, CompositeEvalMetric):
+        return metric
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite_metric = CompositeEvalMetric()
+        for child_metric in metric:
+            composite_metric.add(create(child_metric, *args, **kwargs))
+        return composite_metric
+    if isinstance(metric, str):
+        try:
+            return _METRIC_REGISTRY[metric.lower()](*args, **kwargs)
+        except KeyError:
+            raise ValueError(f"Metric must be either callable or in registry; "
+                             f"got {metric}")
+    raise TypeError(f"metric should be str/callable/EvalMetric, got "
+                    f"{type(metric)}")
+
+
+def _as_numpy(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else numpy.asarray(x)
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    """Reference: metric.py:36 check_label_shapes."""
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError(f"Shape of labels {label_shape} does not match "
+                         f"shape of predictions {pred_shape}")
+    if wrap:
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+    return labels, preds
+
+
+class EvalMetric:
+    """Base metric (reference: metric.py:59)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+    def get_config(self):
+        config = self._kwargs.copy()
+        config.update({"metric": self.__class__.__name__, "name": self.name,
+                       "output_names": self.output_names,
+                       "label_names": self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names if name in pred]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names if name in label]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.global_sum_metric / self.global_num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def get_global_name_value(self):
+        name, value = self.get_global()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+
+class CompositeEvalMetric(EvalMetric):
+    """Manage multiple metrics as one (reference: metric.py:298)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+        if metrics is None:
+            metrics = []
+        self.metrics = [create(i) for i in metrics]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError(f"Metric index {index} is out of range 0 and "
+                              f"{len(self.metrics)}")
+
+    def update_dict(self, labels, preds):
+        if self.label_names is not None:
+            labels = OrderedDict([i for i in labels.items()
+                                  if i[0] in self.label_names])
+        if self.output_names is not None:
+            preds = OrderedDict([i for i in preds.items()
+                                 if i[0] in self.output_names])
+        for metric in self.metrics:
+            metric.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def reset_local(self):
+        try:
+            for metric in self.metrics:
+                metric.reset_local()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int, numpy.generic)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+    def get_global(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get_global()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int, numpy.generic)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({"metrics": [i.get_config() for i in self.metrics]})
+        return config
+
+
+@register
+class Accuracy(EvalMetric):
+    """Classification accuracy (reference: metric.py:386)."""
+
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, axis=axis, output_names=output_names,
+                         label_names=label_names)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred_label in zip(labels, preds):
+            pred_np = _as_numpy(pred_label)
+            label_np = _as_numpy(label)
+            if pred_np.shape != label_np.shape:
+                pred_np = numpy.argmax(pred_np, axis=self.axis)
+            pred_np = pred_np.astype("int32").flatten()
+            label_np = label_np.astype("int32").flatten()
+            check_label_shapes(label_np, pred_np)
+            num_correct = (pred_np == label_np).sum()
+            self.sum_metric += num_correct
+            self.global_sum_metric += num_correct
+            self.num_inst += len(pred_np)
+            self.global_num_inst += len(pred_np)
+
+
+_METRIC_REGISTRY["acc"] = Accuracy
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    """Top-k accuracy (reference: metric.py:462)."""
+
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, top_k=top_k, output_names=output_names,
+                         label_names=label_names)
+        self.top_k = top_k
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += f"_{self.top_k}"
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred_label in zip(labels, preds):
+            assert len(pred_label.shape) <= 2, \
+                "Predictions should be no more than 2 dims"
+            pred_np = _as_numpy(pred_label).astype("float32")
+            num_dims = len(pred_np.shape)
+            if num_dims == 2:
+                pred_np = numpy.argsort(pred_np, axis=1)
+            label_np = _as_numpy(label).astype("int32")
+            num_samples = pred_np.shape[0]
+            if num_dims == 1:
+                num_correct = (pred_np.flatten() == label_np.flatten()).sum()
+                self.sum_metric += num_correct
+                self.global_sum_metric += num_correct
+            elif num_dims == 2:
+                num_classes = pred_np.shape[1]
+                top_k = min(num_classes, self.top_k)
+                for j in range(top_k):
+                    num_correct = (pred_np[:, num_classes - 1 - j].flatten()
+                                   == label_np.flatten()).sum()
+                    self.sum_metric += num_correct
+                    self.global_sum_metric += num_correct
+            self.num_inst += num_samples
+            self.global_num_inst += num_samples
+
+
+_METRIC_REGISTRY["top_k_accuracy"] = TopKAccuracy
+_METRIC_REGISTRY["top_k_acc"] = TopKAccuracy
+
+
+class _BinaryClassificationMetrics:
+    """Confusion-matrix bookkeeping shared by F1/MCC (reference:
+    metric.py:576)."""
+
+    def __init__(self):
+        self.true_positives = 0
+        self.false_negatives = 0
+        self.false_positives = 0
+        self.true_negatives = 0
+        self.global_true_positives = 0
+        self.global_false_negatives = 0
+        self.global_false_positives = 0
+        self.global_true_negatives = 0
+
+    def update_binary_stats(self, label, pred):
+        pred_np = _as_numpy(pred)
+        label_np = _as_numpy(label).astype("int32")
+        pred_label = numpy.argmax(pred_np, axis=1)
+        check_label_shapes(label_np, pred_np)
+        if len(numpy.unique(label_np)) > 2:
+            raise ValueError("%s currently only supports binary "
+                             "classification." % self.__class__.__name__)
+        pred_true = (pred_label == 1)
+        pred_false = 1 - pred_true
+        label_true = (label_np == 1)
+        label_false = 1 - label_true
+        true_pos = (pred_true * label_true).sum()
+        false_pos = (pred_true * label_false).sum()
+        false_neg = (pred_false * label_true).sum()
+        true_neg = (pred_false * label_false).sum()
+        self.true_positives += true_pos
+        self.global_true_positives += true_pos
+        self.false_positives += false_pos
+        self.global_false_positives += false_pos
+        self.false_negatives += false_neg
+        self.global_false_negatives += false_neg
+        self.true_negatives += true_neg
+        self.global_true_negatives += true_neg
+
+    @property
+    def precision(self):
+        if self.true_positives + self.false_positives > 0:
+            return float(self.true_positives) / (
+                self.true_positives + self.false_positives)
+        return 0.0
+
+    @property
+    def recall(self):
+        if self.true_positives + self.false_negatives > 0:
+            return float(self.true_positives) / (
+                self.true_positives + self.false_negatives)
+        return 0.0
+
+    @property
+    def fscore(self):
+        if self.precision + self.recall > 0:
+            return 2 * self.precision * self.recall / (
+                self.precision + self.recall)
+        return 0.0
+
+    @property
+    def global_fscore(self):
+        if self.global_true_positives + self.global_false_positives > 0:
+            g_precision = float(self.global_true_positives) / (
+                self.global_true_positives + self.global_false_positives)
+        else:
+            g_precision = 0.0
+        if self.global_true_positives + self.global_false_negatives > 0:
+            g_recall = float(self.global_true_positives) / (
+                self.global_true_positives + self.global_false_negatives)
+        else:
+            g_recall = 0.0
+        if g_precision + g_recall > 0:
+            return 2 * g_precision * g_recall / (g_precision + g_recall)
+        return 0.0
+
+    def matthewscc(self, use_global=False):
+        if use_global:
+            if not self.global_total_examples:
+                return 0.0
+            true_pos = float(self.global_true_positives)
+            false_pos = float(self.global_false_positives)
+            false_neg = float(self.global_false_negatives)
+            true_neg = float(self.global_true_negatives)
+        else:
+            if not self.total_examples:
+                return 0.0
+            true_pos = float(self.true_positives)
+            false_pos = float(self.false_positives)
+            false_neg = float(self.false_negatives)
+            true_neg = float(self.true_negatives)
+        terms = [(true_pos + false_pos), (true_pos + false_neg),
+                 (true_neg + false_pos), (true_neg + false_neg)]
+        denom = 1.0
+        for t in filter(lambda t: t != 0.0, terms):
+            denom *= t
+        return ((true_pos * true_neg) - (false_pos * false_neg)) / \
+            math.sqrt(denom)
+
+    @property
+    def total_examples(self):
+        return (self.false_negatives + self.false_positives
+                + self.true_negatives + self.true_positives)
+
+    @property
+    def global_total_examples(self):
+        return (self.global_false_negatives + self.global_false_positives
+                + self.global_true_negatives + self.global_true_positives)
+
+    def reset_stats(self):
+        self.false_positives = 0
+        self.false_negatives = 0
+        self.true_positives = 0
+        self.true_negatives = 0
+
+    def reset(self):
+        self.reset_stats()
+        self.global_false_positives = 0
+        self.global_false_negatives = 0
+        self.global_true_positives = 0
+        self.global_true_negatives = 0
+
+
+@register
+class F1(EvalMetric):
+    """Binary F1 (reference: metric.py:714)."""
+
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self.metrics = _BinaryClassificationMetrics()
+        super().__init__(name=name, output_names=output_names,
+                         label_names=label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self.metrics.update_binary_stats(label, pred)
+        if self.average == "macro":
+            self.sum_metric += self.metrics.fscore
+            self.global_sum_metric += self.metrics.fscore
+            self.num_inst += 1
+            self.global_num_inst += 1
+            self.metrics.reset_stats()
+        else:
+            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
+            self.global_sum_metric = (self.metrics.global_fscore
+                                      * self.metrics.global_total_examples)
+            self.num_inst = self.metrics.total_examples
+            self.global_num_inst = self.metrics.global_total_examples
+
+    def reset(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+        self.metrics.reset()
+
+    def reset_local(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0
+        self.metrics.reset_stats()
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (reference: metric.py:811)."""
+
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        self._average = average
+        self._metrics = _BinaryClassificationMetrics()
+        super().__init__(name=name, output_names=output_names,
+                         label_names=label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self._metrics.update_binary_stats(label, pred)
+        if self._average == "macro":
+            self.sum_metric += self._metrics.matthewscc()
+            self.global_sum_metric += self._metrics.matthewscc(use_global=True)
+            self.num_inst += 1
+            self.global_num_inst += 1
+            self._metrics.reset_stats()
+        else:
+            self.sum_metric = (self._metrics.matthewscc()
+                               * self._metrics.total_examples)
+            self.global_sum_metric = (
+                self._metrics.matthewscc(use_global=True)
+                * self._metrics.global_total_examples)
+            self.num_inst = self._metrics.total_examples
+            self.global_num_inst = self._metrics.global_total_examples
+
+    def reset(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0.0
+        self.global_sum_metric = 0.0
+        self.global_num_inst = 0.0
+        self._metrics.reset()
+
+    def reset_local(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0.0
+        self._metrics.reset_stats()
+
+
+@register
+class Perplexity(EvalMetric):
+    """Perplexity (reference: metric.py:938)."""
+
+    def __init__(self, ignore_label, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, ignore_label=ignore_label,
+                         output_names=output_names, label_names=label_names)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            label_np = _as_numpy(label).astype("int32")
+            pred_np = _as_numpy(pred)
+            assert label_np.size == pred_np.size / pred_np.shape[-1], \
+                f"shape mismatch: {label_np.shape} vs. {pred_np.shape}"
+            label_flat = label_np.reshape((label_np.size,))
+            probs = pred_np.reshape(-1, pred_np.shape[-1])[
+                numpy.arange(label_flat.size), label_flat]
+            if self.ignore_label is not None:
+                ignore = (label_flat == self.ignore_label).astype(probs.dtype)
+                num -= int(ignore.sum())
+                probs = probs * (1 - ignore) + ignore
+            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
+            num += label_flat.size
+        self.sum_metric += loss
+        self.global_sum_metric += loss
+        self.num_inst += num
+        self.global_num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name,
+                math.exp(self.global_sum_metric / self.global_num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    """Mean absolute error (reference: metric.py:1025)."""
+
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _as_numpy(label)
+            pred_np = _as_numpy(pred)
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            if len(pred_np.shape) == 1:
+                pred_np = pred_np.reshape(pred_np.shape[0], 1)
+            mae = numpy.abs(label_np - pred_np).mean()
+            self.sum_metric += mae
+            self.global_sum_metric += mae
+            self.num_inst += 1
+            self.global_num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    """Mean squared error (reference: metric.py:1083)."""
+
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _as_numpy(label)
+            pred_np = _as_numpy(pred)
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            if len(pred_np.shape) == 1:
+                pred_np = pred_np.reshape(pred_np.shape[0], 1)
+            mse = ((label_np - pred_np) ** 2.0).mean()
+            self.sum_metric += mse
+            self.global_sum_metric += mse
+            self.num_inst += 1
+            self.global_num_inst += 1
+
+
+@register
+class RMSE(EvalMetric):
+    """Root mean squared error (reference: metric.py:1141)."""
+
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _as_numpy(label)
+            pred_np = _as_numpy(pred)
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            if len(pred_np.shape) == 1:
+                pred_np = pred_np.reshape(pred_np.shape[0], 1)
+            rmse = numpy.sqrt(((label_np - pred_np) ** 2.0).mean())
+            self.sum_metric += rmse
+            self.global_sum_metric += rmse
+            self.num_inst += 1
+            self.global_num_inst += 1
+
+
+@register
+class CrossEntropy(EvalMetric):
+    """Cross entropy over class probabilities (reference:
+    metric.py:1199)."""
+
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+                 label_names=None):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _as_numpy(label)
+            pred_np = _as_numpy(pred)
+            label_flat = label_np.ravel()
+            assert label_flat.shape[0] == pred_np.shape[0]
+            prob = pred_np[numpy.arange(label_flat.shape[0]),
+                           numpy.int64(label_flat)]
+            cross_entropy = (-numpy.log(prob + self.eps)).sum()
+            self.sum_metric += cross_entropy
+            self.global_sum_metric += cross_entropy
+            self.num_inst += label_flat.shape[0]
+            self.global_num_inst += label_flat.shape[0]
+
+
+_METRIC_REGISTRY["ce"] = CrossEntropy
+
+
+@register
+class NegativeLogLikelihood(EvalMetric):
+    """NLL (reference: metric.py:1265)."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label_np = _as_numpy(label)
+            pred_np = _as_numpy(pred)
+            label_flat = label_np.ravel()
+            num_examples = pred_np.shape[0]
+            assert label_flat.shape[0] == num_examples, \
+                (label_flat.shape, pred_np.shape)
+            prob = pred_np[numpy.arange(num_examples),
+                           numpy.int64(label_flat)]
+            nll = (-numpy.log(prob + self.eps)).sum()
+            self.sum_metric += nll
+            self.global_sum_metric += nll
+            self.num_inst += num_examples
+            self.global_num_inst += num_examples
+
+
+_METRIC_REGISTRY["nll_loss"] = NegativeLogLikelihood
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    """Pearson correlation (reference: metric.py:1330)."""
+
+    def __init__(self, name="pearsonr", output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+        if self.average == "micro":
+            self.reset_micro()
+
+    def reset_micro(self):
+        self._sse_p = 0
+        self._mean_p = 0
+        self._sse_l = 0
+        self._mean_l = 0
+        self._pred_nums = 0
+        self._label_nums = 0
+        self._conv = 0
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+        if getattr(self, "average", None) == "micro":
+            self.reset_micro()
+
+    def update_variance(self, new_values, *aggregate):
+        count = len(new_values)
+        mean = numpy.mean(new_values)
+        variance = numpy.sum((new_values - mean) ** 2)
+        count_a, mean_a, var_a = aggregate
+        delta = mean - mean_a
+        m_a = var_a * (count_a - 1)
+        M2 = m_a + variance + delta ** 2 * count_a * count / (count_a + count)
+        count_a += count
+        mean_a += delta * count / count_a
+        var_a = M2 / (count_a - 1)
+        return count_a, mean_a, var_a
+
+    def update_cov(self, label, pred):
+        self._conv = self._conv + numpy.sum(
+            (label - self._mean_l) * (pred - self._mean_p))
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            check_label_shapes(label, pred, False, True)
+            label_np = _as_numpy(label).ravel().astype(numpy.float64)
+            pred_np = _as_numpy(pred).ravel().astype(numpy.float64)
+            if self.average == "macro":
+                pearson_corr = numpy.corrcoef(pred_np, label_np)[0, 1]
+                self.sum_metric += pearson_corr
+                self.global_sum_metric += pearson_corr
+                self.num_inst += 1
+                self.global_num_inst += 1
+            else:
+                self.global_num_inst += 1
+                self.num_inst += 1
+                self._label_nums, self._mean_l, self._sse_l = \
+                    self.update_variance(label_np, self._label_nums,
+                                         self._mean_l, self._sse_l)
+                self.update_cov(label_np, pred_np)
+                self._pred_nums, self._mean_p, self._sse_p = \
+                    self.update_variance(pred_np, self._pred_nums,
+                                         self._mean_p, self._sse_p)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        if self.average == "macro":
+            return (self.name, self.sum_metric / self.num_inst)
+        n = self._label_nums
+        numerator = self._conv
+        denominator = (numpy.sqrt(self._sse_p * (n - 1))
+                       * numpy.sqrt(self._sse_l * (n - 1)))
+        pearson = numerator / denominator if denominator != 0 else float("nan")
+        return (self.name, pearson)
+
+
+_METRIC_REGISTRY["pcc"] = PearsonCorrelation
+
+
+@register
+class Loss(EvalMetric):
+    """Dummy metric averaging a pre-computed loss output (reference:
+    metric.py:1477)."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+
+    def update(self, _, preds):
+        if isinstance(preds, list) and len(preds) > 0 \
+                and not hasattr(preds[0], "asnumpy") \
+                and not isinstance(preds[0], numpy.ndarray):
+            preds = [preds]
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+        for pred in preds:
+            loss = _as_numpy(pred).sum()
+            self.sum_metric += loss
+            self.global_sum_metric += loss
+            n = 1
+            for s in numpy.shape(_as_numpy(pred)):
+                n *= s
+            self.num_inst += n
+            self.global_num_inst += n
+
+
+@register
+class CustomMetric(EvalMetric):
+    """Wrap a ``feval(label, pred)`` function (reference: metric.py:1549)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = f"custom({name})"
+        super().__init__(name, feval=feval,
+                         allow_extra_outputs=allow_extra_outputs,
+                         output_names=output_names, label_names=label_names)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            labels, preds = check_label_shapes(labels, preds, True)
+        for pred, label in zip(preds, labels):
+            label_np = _as_numpy(label)
+            pred_np = _as_numpy(pred)
+            reval = self._feval(label_np, pred_np)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self.sum_metric += sum_metric
+                self.global_sum_metric += sum_metric
+                self.num_inst += num_inst
+                self.global_num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.global_sum_metric += reval
+                self.num_inst += 1
+                self.global_num_inst += 1
+
+    def get_config(self):
+        raise NotImplementedError("CustomMetric cannot be serialized")
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Create CustomMetric from a numpy feval (reference:
+    metric.py:1625)."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -491,6 +491,13 @@ class NDArray:
                                         "constant_value": constant_value})
 
     # --------------------------------------------------------------- misc --
+    def __reduce__(self):
+        # pickling: used by Updater.get_states / DataLoader worker IPC.
+        # Context is intentionally not pickled (a checkpoint restored on a
+        # different host lands on its default device, like the reference's
+        # save/load default-ctx behavior).
+        return (NDArray, (self.asnumpy(),))
+
     def as_np_ndarray(self):
         from ..numpy import ndarray as np_ndarray
         out = np_ndarray(self._data)

@@ -1,0 +1,11 @@
+"""Optimizer zoo (reference: python/mxnet/optimizer/)."""
+from .optimizer import (Optimizer, register, create, SGD, NAG, Adam, AdamW,
+                        AdaGrad, AdaDelta, Adamax, Nadam, RMSProp, FTML,
+                        Ftrl, LAMB, LARS, DCASGD, SGLD, Signum, SignSGD,
+                        LBSGD, GroupAdaGrad, Test)
+from .updater import Updater, get_updater
+
+__all__ = ["Optimizer", "register", "create", "Updater", "get_updater",
+           "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta", "Adamax",
+           "Nadam", "RMSProp", "FTML", "Ftrl", "LAMB", "LARS", "DCASGD",
+           "SGLD", "Signum", "SignSGD", "LBSGD", "GroupAdaGrad", "Test"]

@@ -1,0 +1,779 @@
+"""Optimizers.
+
+TPU-native reimplementation of the reference optimizer zoo
+(reference: python/mxnet/optimizer/optimizer.py — 18 optimizers dispatching
+to fused update ops in src/operator/optimizer_op.cc). Each ``update``
+invokes a registered update op (ops/optimizer_ops.py); under ``jit`` a whole
+multi-parameter step fuses into one XLA program, which subsumes the
+reference's multi-tensor (``multi_sgd_*``) and aggregation machinery —
+there is no kernel-launch overhead to amortize on TPU.
+
+API parity: ``Optimizer.create_optimizer/register``, per-parameter lr/wd
+multipliers (``set_lr_mult/set_wd_mult``), ``rescale_grad``,
+``clip_gradient``, lr_scheduler hookup, ``multi_precision`` master weights.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import zeros, zeros_like, NDArray
+from ..ops.invoke import apply_op
+
+__all__ = ["Optimizer", "register", "create"]
+
+
+class Optimizer:
+    """Base optimizer (reference: python/mxnet/optimizer/optimizer.py:36)."""
+
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if self.lr_scheduler is not None:
+            if learning_rate is not None:
+                self.lr_scheduler.base_lr = learning_rate
+            self.lr = self.lr_scheduler.base_lr
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = ()
+        self.param_dict = param_dict if param_dict else {}
+
+    @staticmethod
+    def register(klass):
+        """Register under lowercased class name (reference:
+        optimizer.py:119)."""
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError(f"Cannot find optimizer {name}")
+
+    def create_state(self, index, weight):
+        """Return the aux-state pytree for one parameter (momentum etc.)."""
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy wrapping for low-precision weights (reference:
+        optimizer.py:286)."""
+        if self.multi_precision and weight.dtype in (_np.float16,
+                                                     _np.dtype("bfloat16")):
+            weight_master_copy = weight.astype("float32")
+            return (self.create_state(index, weight_master_copy),
+                    weight_master_copy)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype in (_np.float16,
+                                                     _np.dtype("bfloat16")):
+            weight32 = state[1]
+            grad32 = grad.astype("float32")
+            self.update(index, weight32, grad32, state[0])
+            weight[:] = weight32.astype(weight.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _common(self, index):
+    """(lr, wd, common kwargs) for one parameter update."""
+    self._update_count(index)
+    lr = self._get_lr(index)
+    wd = self._get_wd(index)
+    kwargs = {"rescale_grad": self.rescale_grad}
+    if self.clip_gradient is not None:
+        kwargs["clip_gradient"] = self.clip_gradient
+    return lr, wd, kwargs
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference: optimizer.py SGD →
+    src/operator/optimizer_op.cc sgd_update/sgd_mom_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, learning_rate=0.01,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros_like(weight)
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (_np.float16,
+                                                     _np.dtype("bfloat16")):
+            weight32 = weight.astype("float32")
+            return (self.create_state(index, weight32), weight32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        if state is not None:
+            apply_op("sgd_mom_update", [weight, grad, state],
+                     dict(lr=lr, wd=wd, momentum=self.momentum, **kwargs))
+        else:
+            apply_op("sgd_update", [weight, grad], dict(lr=lr, wd=wd, **kwargs))
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and weight.dtype in (
+            _np.float16, _np.dtype("bfloat16"))
+        if not use_mp:
+            return self.update(index, weight, grad, state)
+        lr, wd, kwargs = _common(self, index)
+        mom, weight32 = state
+        if mom is not None:
+            apply_op("mp_sgd_mom_update", [weight, grad, mom, weight32],
+                     dict(lr=lr, wd=wd, momentum=self.momentum, **kwargs))
+        else:
+            apply_op("mp_sgd_update", [weight, grad, weight32],
+                     dict(lr=lr, wd=wd, **kwargs))
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference: optimizer.py NAG)."""
+
+    def __init__(self, momentum=0.0, learning_rate=0.1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros_like(weight)
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        if state is not None:
+            apply_op("nag_mom_update", [weight, grad, state],
+                     dict(lr=lr, wd=wd, momentum=self.momentum, **kwargs))
+        else:
+            apply_op("sgd_update", [weight, grad], dict(lr=lr, wd=wd, **kwargs))
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference: optimizer.py Adam → adam_update). lr is
+    bias-corrected on host like the reference (coef computed in Python)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight), zeros_like(weight))  # mean, var
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        apply_op("adam_update", [weight, grad, mean, var],
+                 dict(lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
+                      epsilon=self.epsilon, **kwargs))
+
+
+@register
+class AdamW(Optimizer):
+    """AdamW with decoupled weight decay (reference:
+    src/operator/contrib/adamw.cc, python/mxnet/optimizer contrib adamw)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.eta = eta
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight), zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        apply_op("_adamw_update", [weight, grad, mean, var],
+                 dict(lr=lr, wd=wd, eta=self.eta, beta1=self.beta1,
+                      beta2=self.beta2, epsilon=self.epsilon, **kwargs))
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference: optimizer.py AdaGrad)."""
+
+    def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros_like(weight)  # history
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        apply_op("_adagrad_update", [weight, grad, state],
+                 dict(lr=lr, wd=wd, epsilon=self.float_stable_eps, **kwargs))
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference: optimizer.py AdaDelta — pure python update in
+    the reference too)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight), zeros_like(weight))  # E[g^2], E[dx^2]
+
+    def update(self, index, weight, grad, state):
+        _, wd, _ = _common(self, index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        grad = grad + wd * weight
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1. - self.rho) * grad * grad
+        current_delta = ((acc_delta + self.epsilon).sqrt()
+                         / (acc_g + self.epsilon).sqrt() * grad)
+        acc_delta[:] = (self.rho * acc_delta
+                        + (1. - self.rho) * current_delta * current_delta)
+        weight[:] = weight - current_delta
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax, infinite-norm Adam variant (reference: optimizer.py
+    Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight), zeros_like(weight))  # mean, u(inf-norm)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        t = self._index_update_count[index]
+        lr /= (1. - self.beta1 ** t)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        m_t[:] = self.beta1 * m_t + (1. - self.beta1) * grad
+        from ..ndarray import maximum, abs as nd_abs
+        u_t[:] = maximum(self.beta2 * u_t, nd_abs(grad))
+        weight[:] = weight - lr * m_t / u_t
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference: optimizer.py Nadam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight), zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1. - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1. - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t[:] = self.beta1 * m_t + (1. - self.beta1) * grad
+        v_t[:] = self.beta2 * v_t + (1. - self.beta2) * grad * grad
+        grad_prime = grad / (1. - self.m_schedule)
+        m_t_prime = m_t / (1. - m_schedule_next)
+        v_t_prime = v_t / (1. - self.beta2 ** t)
+        m_t_bar = ((1. - momentum_t) * grad_prime
+                   + momentum_t_1 * m_t_prime)
+        weight[:] = weight - lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered (Alex Graves) or plain (reference: optimizer.py
+    RMSProp → rmsprop_update/rmspropalex_update)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho = rho
+        self.momentum = momentum
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros_like(weight), zeros_like(weight),
+                    zeros_like(weight))  # n, g, delta
+        return (zeros_like(weight),)  # n
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        kwargs.update(rho=self.rho, epsilon=self.epsilon)
+        if self.centered:
+            kwargs["momentum"] = self.momentum
+        if self.clip_weights:
+            kwargs["clip_weights"] = self.clip_weights
+        if not self.centered:
+            (n,) = state
+            apply_op("rmsprop_update", [weight, grad, n],
+                     dict(lr=lr, wd=wd, **kwargs))
+        else:
+            n, g, delta = state
+            apply_op("rmspropalex_update", [weight, grad, n, g, delta],
+                     dict(lr=lr, wd=wd, **kwargs))
+
+
+@register
+class FTML(Optimizer):
+    """FTML (reference: optimizer.py FTML → ftml_update)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight), zeros_like(weight), zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        prev_d, prev_v, prev_z = state
+        prev_v[:] = self.beta2 * prev_v + (1. - self.beta2) * grad * grad
+        d_t = ((1. - self.beta1 ** t) / lr
+               * ((prev_v / (1. - self.beta2 ** t)).sqrt() + self.epsilon))
+        sigma_t = d_t - self.beta1 * prev_d
+        prev_z[:] = self.beta1 * prev_z + (1. - self.beta1) * grad \
+            - sigma_t * weight
+        weight[:] = -prev_z / d_t
+        prev_d[:] = d_t
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL (reference: optimizer.py Ftrl → ftrl_update)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight), zeros_like(weight))  # z, n
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        z, n = state
+        apply_op("ftrl_update", [weight, grad, z, n],
+                 dict(lr=lr, wd=wd, lamda1=self.lamda1, beta=self.beta,
+                      **kwargs))
+
+
+@register
+class LAMB(Optimizer):
+    """LAMB layer-wise adaptive large-batch optimizer (reference:
+    optimizer.py LAMB → lamb_update_phase1/2)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight), zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g = apply_op("lamb_update_phase1", [weight, grad, mean, var],
+                     dict(beta1=self.beta1, beta2=self.beta2,
+                          epsilon=self.epsilon, t=t,
+                          bias_correction=self.bias_correction, wd=wd,
+                          **kwargs))
+        g, new_mean, new_var = g
+        mean[:] = new_mean
+        var[:] = new_var
+        r1 = weight.norm()
+        r2 = g.norm()
+        phase2_kw = dict(lr=lr)
+        if self.lower_bound:
+            phase2_kw["lower_bound"] = self.lower_bound
+        if self.upper_bound:
+            phase2_kw["upper_bound"] = self.upper_bound
+        apply_op("lamb_update_phase2", [weight, g, r1, r2], phase2_kw)
+
+
+@register
+class LARS(Optimizer):
+    """LARS layer-wise adaptive rate scaling (reference: optimizer.py
+    LARS)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.0, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros_like(weight)
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        w_norm = float(weight.norm().asscalar())
+        g_norm = float((grad * self.rescale_grad).norm().asscalar())
+        if w_norm > 0.0 and g_norm > 0.0:
+            lars_trust = self.eta * w_norm / (g_norm + wd * w_norm
+                                              + self.epsilon)
+        else:
+            lars_trust = 1.0
+        lr = lr * lars_trust
+        if state is not None:
+            apply_op("sgd_mom_update", [weight, grad, state],
+                     dict(lr=lr, wd=wd, momentum=self.momentum, **kwargs))
+        else:
+            apply_op("sgd_update", [weight, grad], dict(lr=lr, wd=wd, **kwargs))
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros_like(weight), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        delta = (-lr * (grad + wd * weight
+                        + self.lamda * grad * grad * (weight - previous_weight)))
+        if mom is not None:
+            mom[:] = self.momentum * mom + delta
+            step = mom
+        else:
+            step = delta
+        previous_weight[:] = weight
+        weight[:] = weight + step
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference: optimizer.py
+    SGLD)."""
+
+    def __init__(self, learning_rate=0.1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        from ..ndarray import random as nd_random
+        noise = nd_random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 dtype=str(weight.dtype))
+        weight[:] = weight - lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class Signum(Optimizer):
+    """Signum: sign of momentum (reference: optimizer.py Signum →
+    signum_update/signsgd_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros_like(weight)
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        if state is not None:
+            apply_op("signum_update", [weight, grad, state],
+                     dict(lr=lr, wd=wd, momentum=self.momentum,
+                          wd_lh=self.wd_lh, **kwargs))
+        else:
+            apply_op("signsgd_update", [weight, grad],
+                     dict(lr=lr, wd=wd, **kwargs))
+
+
+@register
+class SignSGD(Signum):
+    """Momentum-free Signum alias."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, momentum=0.0, **kwargs)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with LARS-style warmup strategies (reference:
+    optimizer.py LBSGD). The adaptive-rate logic is kept; the reference's
+    warmup strategies linear/power are reproduced."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.lbmult = 1.0
+        self.cumgrads = {}
+        self.adaptive = False
+        self.admult = 1.0
+
+    def create_state(self, index, weight):
+        return zeros_like(weight) if self.momentum != 0.0 else None
+
+    def _get_lbmult(self, nup):
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        strategy = self.warmup_strategy
+        maxmult = float(self.batch_scale)
+        if nup >= nwup:
+            mult = maxmult
+        elif nwup <= 1:
+            mult = 1.0
+        else:
+            if strategy == "linear":
+                mult = 1.0 + (maxmult - 1) * nup / nwup
+            elif strategy == "power2":
+                mult = 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
+            elif strategy == "sqrt":
+                mult = 1.0 + (maxmult - 1) * math.sqrt(float(nup) / nwup)
+            else:
+                mult = 1.0
+        return mult
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        if self.warmup_strategy == "lars":
+            w_norm = float(weight.norm().asscalar())
+            g_norm = float((grad * self.rescale_grad).norm().asscalar())
+            if w_norm > 0 and g_norm > 0:
+                lbmult = w_norm / (g_norm + wd * w_norm + 1e-9)
+            else:
+                lbmult = 1.0
+            lr = lr * lbmult
+        else:
+            lr = lr * self._get_lbmult(self.num_update)
+        if state is not None:
+            apply_op("sgd_mom_update", [weight, grad, state],
+                     dict(lr=lr, wd=wd, momentum=self.momentum, **kwargs))
+        else:
+            apply_op("sgd_update", [weight, grad], dict(lr=lr, wd=wd, **kwargs))
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with per-row (group) accumulation (reference:
+    src/operator/contrib/optimizer_op.cc _contrib_group_adagrad_update)."""
+
+    def __init__(self, learning_rate=0.01, eps=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros((weight.shape[0],) + (1,) * (len(weight.shape) - 1),
+                     dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, kwargs = _common(self, index)
+        assert wd == 0, "Weight decay is not supported for GroupAdaGrad"
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        axes = tuple(range(1, len(grad.shape)))
+        state[:] = state + (grad * grad).mean(axis=axes, keepdims=True)
+        weight[:] = weight - lr * grad / ((state + self.float_stable_eps).sqrt())
+
+
+@register
+class Test(Optimizer):
+    """Reference's test optimizer: w += -lr*rescale*grad + wd*w (reference:
+    optimizer.py Test)."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def create_state(self, index, weight):
+        return zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        weight[:] = weight - self.lr * (grad * self.rescale_grad
+                                        + self.wd * weight)

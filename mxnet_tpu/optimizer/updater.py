@@ -1,0 +1,68 @@
+"""Updater: the callable kvstore applies server-side.
+
+Reference: python/mxnet/optimizer/updater.py — wraps an Optimizer, keeps the
+per-key state dict, and is picklable so the distributed kvstore can ship it
+to servers (here: so that checkpointing optimizer state works the same way).
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as _np
+
+from ..ndarray import NDArray
+
+__all__ = ["Updater", "get_updater"]
+
+
+class Updater:
+    """Per-key optimizer state holder (reference: updater.py:28)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            indices = [index]
+            grads = [grad]
+            weights = [weight]
+        else:
+            indices, grads, weights = list(index), list(grad), list(weight)
+        for i, idx in enumerate(indices):
+            if idx not in self.states:
+                self.states[idx] = \
+                    self.optimizer.create_state_multi_precision(idx, weights[i])
+                self.states_synced[idx] = True
+            elif not self.states_synced[idx]:
+                self.states[idx] = self.sync_state_context(
+                    self.states[idx], weights[i].context)
+                self.states_synced[idx] = True
+            self.optimizer.update_multi_precision(idx, weights[i], grads[i],
+                                                  self.states[idx])
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, (tuple, list)):
+            synced = [self.sync_state_context(i, context) for i in state]
+            return tuple(synced) if isinstance(state, tuple) else synced
+        return state
+
+    def set_states(self, states):
+        """Load pickled state (reference: updater.py set_states)."""
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,226 @@
+"""Optimizer/lr_scheduler/initializer/metric tests.
+
+Modeled on the reference's tests/python/unittest/test_optimizer.py: each
+optimizer step is checked against a pure-numpy reimplementation of the
+update rule.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _setup(shape=(4, 7), dtype="float32", seed_w=1.0):
+    w_np = np.random.uniform(-1, 1, shape).astype(dtype) * seed_w
+    g_np = np.random.uniform(-1, 1, shape).astype(dtype)
+    return w_np, g_np
+
+
+def _run_steps(optimizer, w_np, g_np, nsteps=3):
+    w = mx.nd.array(w_np)
+    state = optimizer.create_state(0, w)
+    for _ in range(nsteps):
+        optimizer.update(0, w, mx.nd.array(g_np), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w_np, g_np = _setup()
+    lr, wd, mom = 0.1, 0.01, 0.9
+    got = _run_steps(opt.SGD(learning_rate=lr, wd=wd, momentum=mom),
+                     w_np, g_np)
+    w, m = w_np.copy(), np.zeros_like(w_np)
+    for _ in range(3):
+        m = mom * m - lr * (g_np + wd * w)
+        w = w + m
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_no_momentum():
+    w_np, g_np = _setup()
+    got = _run_steps(opt.SGD(learning_rate=0.1, wd=0.0), w_np, g_np, 1)
+    np.testing.assert_allclose(got, w_np - 0.1 * g_np, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w_np, g_np = _setup()
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    got = _run_steps(opt.Adam(learning_rate=lr, beta1=b1, beta2=b2,
+                              epsilon=eps), w_np, g_np)
+    w = w_np.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t in range(1, 4):
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g_np
+        v = b2 * v + (1 - b2) * g_np ** 2
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_rmsprop():
+    w_np, g_np = _setup()
+    lr, rho, eps = 0.01, 0.9, 1e-8
+    got = _run_steps(opt.RMSProp(learning_rate=lr, rho=rho, epsilon=eps),
+                     w_np, g_np, 2)
+    w = w_np.copy()
+    n = np.zeros_like(w)
+    for _ in range(2):
+        n = rho * n + (1 - rho) * g_np ** 2
+        w = w - lr * g_np / np.sqrt(n + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adagrad():
+    w_np, g_np = _setup()
+    got = _run_steps(opt.AdaGrad(learning_rate=0.1, eps=1e-7), w_np, g_np, 2)
+    w = w_np.copy()
+    h = np.zeros_like(w)
+    for _ in range(2):
+        h += g_np ** 2
+        w = w - 0.1 * g_np / (np.sqrt(h) + 1e-7)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["sgd", "nag", "adam", "adamw", "adagrad",
+                                  "adadelta", "adamax", "nadam", "rmsprop",
+                                  "ftml", "ftrl", "lamb", "lars", "dcasgd",
+                                  "sgld", "signum", "signsgd", "lbsgd",
+                                  "groupadagrad", "test"])
+def test_all_optimizers_step(name):
+    """Every registered optimizer makes a finite update step."""
+    w_np, g_np = _setup()
+    kwargs = {"wd": 0.0} if name == "groupadagrad" else {}
+    o = opt.create(name, **kwargs)
+    w = mx.nd.array(w_np)
+    state = o.create_state(0, w)
+    o.update(0, w, mx.nd.array(g_np), state)
+    out = w.asnumpy()
+    assert np.all(np.isfinite(out))
+    assert not np.allclose(out, w_np)  # something changed
+
+
+def test_multi_precision_sgd():
+    w_np, g_np = _setup(dtype="float16")
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w = mx.nd.array(w_np, dtype="float16")
+    state = o.create_state_multi_precision(0, w)
+    o.update_multi_precision(0, w, mx.nd.array(g_np, dtype="float16"), state)
+    assert w.dtype == np.float16
+    assert state[1].dtype == np.float32  # master weights
+
+
+def test_updater_state_roundtrip():
+    w_np, g_np = _setup()
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(o)
+    w = mx.nd.array(w_np)
+    upd(0, mx.nd.array(g_np), w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    assert 0 in upd2.states
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+
+def test_lr_scheduler_warmup():
+    from mxnet_tpu.lr_scheduler import PolyScheduler
+    s = PolyScheduler(max_update=100, base_lr=1.0, pwr=1, warmup_steps=10)
+    assert s(0) == 0.0
+    assert abs(s(5) - 0.5) < 1e-9
+    v50 = s(50)
+    assert 0 < v50 < 1.0
+
+
+def test_lr_scheduler_in_optimizer():
+    from mxnet_tpu.lr_scheduler import MultiFactorScheduler
+    sched = MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = mx.nd.array(np.ones((2, 2), np.float32))
+    g = mx.nd.array(np.zeros((2, 2), np.float32))
+    for _ in range(3):
+        o.update(0, w, g, None)
+    assert o._get_lr(0) == 1.0
+
+
+def test_initializers():
+    import mxnet_tpu.initializer as init
+    for name, cls in [("xavier", init.Xavier), ("normal", init.Normal),
+                      ("uniform", init.Uniform), ("zeros", init.Zero),
+                      ("ones", init.One), ("orthogonal", init.Orthogonal),
+                      ("msraprelu", init.MSRAPrelu)]:
+        arr = np.empty((8, 4), np.float32)
+        i = init.create(name)
+        assert isinstance(i, cls)
+        i("fc1_weight", arr)
+        assert np.all(np.isfinite(arr))
+    arr = np.empty((8,), np.float32)
+    init.Xavier()("fc1_bias", arr)  # bias branch → zeros
+    np.testing.assert_allclose(arr, 0)
+
+
+def test_initializer_orthogonal_is_orthogonal():
+    import mxnet_tpu.initializer as init
+    arr = np.empty((16, 16), np.float32)
+    init.Orthogonal(scale=1.0)("q_weight", arr)
+    np.testing.assert_allclose(arr @ arr.T, np.eye(16), atol=1e-5)
+
+
+def test_metric_accuracy():
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-9
+
+
+def test_metric_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    label = mx.nd.array([1, 1])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 1.0) < 1e-9
+
+
+def test_metric_mse_f1_composite():
+    comp = mx.metric.create(["mse", "mae"])
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[1.5], [2.5]])
+    comp.update([label], [pred])
+    names, values = comp.get()
+    assert "mse" in names and "mae" in names
+    assert abs(values[names.index("mse")] - 0.25) < 1e-6
+    assert abs(values[names.index("mae")] - 0.5) < 1e-6
+
+
+def test_metric_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    _, ppl = m.get()
+    expected = np.exp(-(np.log(0.75) + np.log(0.5)) / 2)
+    assert abs(ppl - expected) < 1e-5
+
+
+def test_metric_custom():
+    @mx.metric.np
+    def zero_one(label, pred):
+        return float((label == pred.argmax(axis=1)).mean())
+
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1]])
+    label = mx.nd.array([1, 1])
+    zero_one.update([label], [pred])
+    _, v = zero_one.get()
+    assert abs(v - 0.5) < 1e-9
