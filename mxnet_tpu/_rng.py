@@ -30,6 +30,27 @@ def seed(seed_state: int, ctx=None):
 
 def next_key():
     global _base_key
+    if _trace_state is not None:
+        key, counter = _trace_state
+        return jax.random.fold_in(key, next(counter))
     if _base_key is None:
         seed(0)
     return jax.random.fold_in(_base_key, next(_counter))
+
+
+# Trace override: while a CachedOp/hybridized block is being traced into
+# jit, next_key() must derive from a traced input key (a concrete key would
+# bake the dropout mask into the compiled program as a constant).
+_trace_state = None
+
+
+def push_trace_key(key):
+    global _trace_state
+    old = _trace_state
+    _trace_state = (key, itertools.count())
+    return old
+
+
+def pop_trace_key(old):
+    global _trace_state
+    _trace_state = old

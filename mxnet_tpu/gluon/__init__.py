@@ -1,0 +1,19 @@
+"""Gluon: imperative/hybrid neural network API
+(reference: python/mxnet/gluon/)."""
+from .parameter import (Parameter, Constant, ParameterDict,
+                        DeferredInitializationError)  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock, CachedOp  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import utils  # noqa: F401
+
+
+def __getattr__(name):
+    # heavy/cyclic subpackages load lazily
+    if name in ("rnn", "data", "model_zoo", "contrib"):
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

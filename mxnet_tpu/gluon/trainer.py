@@ -1,0 +1,194 @@
+"""Gluon Trainer.
+
+Reference: python/mxnet/gluon/trainer.py:29 — applies an Optimizer to a set
+of Parameters, wiring gradient aggregation through a KVStore. TPU-native
+differences: on one host "allreduce over contexts" is a plain sum (no
+NCCL/P2P machinery needed — XLA handles device placement), and the
+multi-device path of record is sharding via mxnet_tpu.parallel; the kvstore
+seam is kept so reference training loops run unmodified.
+"""
+from __future__ import annotations
+
+from ..ndarray import NDArray
+from .. import optimizer as opt
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    """Optimizer driver over a ParameterDict
+    (reference: gluon/trainer.py:29)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        param_list = []
+        if isinstance(params, (dict,)) or hasattr(params, "items"):
+            for key in sorted(list(params.keys())):
+                param_list.append(params[key])
+            params = param_list
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._contains_sparse_weight = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        """Create the kvstore lazily (reference: trainer.py:183). With a
+        single context the 'device'/'local' stores reduce to direct
+        updates; 'dist' maps to the collective tpu backend."""
+        config = self._kvstore_params
+        kv = config["kvstore"]
+        if kv is None or kv in ("", "nullkv"):
+            self._kvstore = None
+            self._update_on_kvstore = False
+        elif isinstance(kv, str):
+            from .. import kvstore as kvs
+            ctxs = self._params[0].list_ctx() if self._params else []
+            if kv in ("local", "device") and len(ctxs) <= 1:
+                # single device: kvstore adds nothing, update in place
+                self._kvstore = None
+                self._update_on_kvstore = False
+            else:
+                self._kvstore = kvs.create(kv)
+                self._update_on_kvstore = (
+                    config["update_on_kvstore"]
+                    if config["update_on_kvstore"] is not None
+                    else self._kvstore.is_capable("optimizer"))
+                if self._update_on_kvstore:
+                    self._kvstore.set_optimizer(self._optimizer)
+        else:
+            self._kvstore = kv
+            self._update_on_kvstore = bool(config["update_on_kvstore"])
+        if self._kvstore is not None:
+            for i, param in enumerate(self._params):
+                self._kvstore.init(i, param.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + optimizer update (reference: trainer.py:329)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise AssertionError(
+                "allreduce_grads() when parameters are updated on kvstore "
+                "is not supported. Try setting `update_on_kvstore` to False "
+                "when creating trainer.")
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if self._kvstore is not None:
+                self._kvstore.push(i, param.list_grad(), priority=-i)
+                if not self._update_on_kvstore:
+                    self._kvstore.pull(i, param.list_grad(), priority=-i)
+            else:
+                grads = param.list_grad()
+                if len(grads) > 1:
+                    # sum over contexts then broadcast (reference
+                    # Comm*::Reduce, src/kvstore/comm.h:122)
+                    total = grads[0]
+                    for g in grads[1:]:
+                        total = total + g.as_in_context(total.context)
+                    for g in grads:
+                        g[:] = total.as_in_context(g.context)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        assert not self._update_on_kvstore, \
+            "update() when parameters are updated on kvstore is not " \
+            "supported. Try setting `update_on_kvstore` to False when " \
+            "creating trainer."
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null":
+                    continue
+                self._kvstore.pull(i, param.list_data(), priority=-i)
+            return
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                continue
+            for w, g in zip(param.list_data(), param.list_grad()):
+                updater(i, g, w)
+
+    def save_states(self, fname):
+        """Save optimizer/updater states (reference: trainer.py:470)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            states = f.read()
+        self._updaters[0].set_states(states)
+        self._updaters[0].optimizer = self._optimizer \
+            if self._updaters[0].optimizer is None \
+            else self._updaters[0].optimizer
+        self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {
+            i: param for i, param in enumerate(self._params)}

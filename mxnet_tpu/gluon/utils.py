@@ -1,0 +1,109 @@
+"""Gluon utilities.
+
+Reference: python/mxnet/gluon/utils.py — split_data/split_and_load (the
+data-parallel batch slicer feeding per-GPU executors), clip_global_norm,
+check_sha1, download. ``split_and_load`` is kept for reference-code parity;
+the TPU-idiomatic path is a single sharded array over a Mesh
+(mxnet_tpu.parallel.shard_batch).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as _np
+
+from ..context import Context
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice slices
+    (reference: gluon/utils.py:37)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch "
+            f"size that's multiple of {num_slice} or set even_split=False "
+            "to allow uneven partitioning of data.")
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and load slices onto contexts (reference: gluon/utils.py:95)."""
+    if not isinstance(data, NDArray):
+        data = nd_array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the 2-norm of the concatenation is at most
+    max_norm (reference: gluon/utils.py:132)."""
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total = None
+    for arr in arrays:
+        n = (arr.as_in_context(ctx) * arr.as_in_context(ctx)).sum()
+        total = n if total is None else total + n
+    total_norm = total.sqrt()
+    if check_isfinite:
+        tn = float(total_norm.asscalar())
+        if not _np.isfinite(tn):
+            import warnings
+            warnings.warn("nan or inf is detected. Clipping results will "
+                          "be undefined.", stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    one = nd_array(_np.ones(1, dtype="float32"), ctx=ctx)
+    scale = (scale < 1.0) * scale + (scale >= 1.0) * one
+    for arr in arrays:
+        arr *= scale.as_in_context(arr.context)
+    if check_isfinite:
+        return tn
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Check file sha1 (reference: gluon/utils.py:185)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Download a file (reference: gluon/utils.py:205). This build runs in
+    a zero-egress environment; the function exists for API parity and
+    raises unless the file is already present locally."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        f"download of {url} unavailable: no network egress in this "
+        f"environment. Place the file at {fname} manually.")

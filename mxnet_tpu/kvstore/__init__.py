@@ -1,0 +1,15 @@
+"""KVStore: key-value parameter synchronization.
+
+Reference: src/kvstore/ (local/device/tree reducers, NCCL, ps-lite
+dist_sync/dist_async, P3) + python/mxnet/kvstore/ (KVStoreBase plugin
+registry, Horovod backend). TPU-native redesign (SURVEY.md §2.4): there is
+no parameter server and no NCCL — gradients are reduced by XLA collectives
+(psum over ICI/DCN) inside compiled programs, so the kvstore here is
+(a) an API-parity in-process store for reference training loops
+('local'/'device'), and (b) a 'tpu'/'dist' backend whose push/pull map to
+jax collectives across the process mesh (multi-host via
+jax.distributed.initialize).
+"""
+from .base import KVStoreBase, KVStoreLocal, create  # noqa: F401
+from .kvstore import KVStore  # noqa: F401
+from .tpu import KVStoreTPU  # noqa: F401

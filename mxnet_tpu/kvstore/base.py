@@ -1,0 +1,215 @@
+"""KVStore base + plugin registry.
+
+Reference: python/mxnet/kvstore/base.py:74 ``KVStoreBase.register`` — the
+pluggable backend seam (the reference registers 'MXNET' and 'Horovod'
+backends through it). Kept as the extension point for alternative
+reducers.
+"""
+from __future__ import annotations
+
+import pickle
+
+from ..ndarray import NDArray
+
+__all__ = ["KVStoreBase", "KVStoreLocal", "create"]
+
+
+class KVStoreBase:
+    """Abstract key-value store interface
+    (reference: python/mxnet/kvstore/base.py:220)."""
+
+    kv_registry = {}
+
+    OPTIMIZER = "optimizer"
+
+    @staticmethod
+    def register(klass):
+        """Register a backend under its class name (reference:
+        base.py:404)."""
+        name = klass.__name__.lower()
+        KVStoreBase.kv_registry[name] = klass
+        return klass
+
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability):
+        raise NotImplementedError
+
+    @property
+    def type(self):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        raise NotImplementedError
+
+    @property
+    def num_workers(self):
+        raise NotImplementedError
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+
+class KVStoreLocal(KVStoreBase):
+    """Single-process store: reduce = sum over per-ctx replicas.
+
+    Reference: src/kvstore/kvstore_local.h + comm.h CommCPU/CommDevice.
+    The reduce runs on the values' device via XLA — there is no
+    tree/P2P topology to manage on TPU (ICI is all-to-all within a pod
+    slice and XLA owns the schedule).
+    """
+
+    def __init__(self):
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._str_keys = False
+
+    # --- classic API (reference include/mxnet/kvstore.h) ---------------
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                self._store[k] = v.copy() if isinstance(v, NDArray) else \
+                    NDArray(v)
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        for k, vlist in _group(keys, values):
+            reduced = vlist[0]
+            if len(vlist) > 1:
+                reduced = vlist[0].copy()
+                for v in vlist[1:]:
+                    reduced += v.as_in_context(reduced.context)
+            if self._updater is not None:
+                self._updater(k if not isinstance(k, str) else
+                              _str2int(k), reduced, self._store[k])
+            else:
+                self._store[k] = reduced.copy()
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _key_value(key, out)
+        for k, olist in _group(keys, outs):
+            src = self._store[k]
+            for o in olist:
+                src.copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # sparse is emulated densely on TPU (SURVEY.md §7 hard parts)
+        self.pull(key, out, priority)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        from ..optimizer import get_updater
+        self._optimizer = optimizer
+        self.set_updater(get_updater(optimizer))
+
+    def set_gradient_compression(self, compression_params):
+        # 2-bit compression (reference gradient_compression.h) is a
+        # wire-bandwidth optimization for PS/ethernet; a no-op on ICI.
+        pass
+
+    @staticmethod
+    def is_capable(capability):
+        return capability == KVStoreBase.OPTIMIZER
+
+    @property
+    def type(self):
+        return "local"
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "updater is not set"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "updater is not set"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _str2int(k):
+    try:
+        return int(k)
+    except ValueError:
+        return k
+
+
+def _key_value(key, value):
+    if isinstance(key, (list, tuple)):
+        keys, values = [], []
+        for k, v in zip(key, value):
+            if isinstance(v, (list, tuple)):
+                keys.extend([k] * len(v))
+                values.extend(v)
+            else:
+                keys.append(k)
+                values.append(v)
+        return keys, values
+    if isinstance(value, (list, tuple)):
+        return [key] * len(value), list(value)
+    return [key], [value]
+
+
+def _group(keys, values):
+    seen = {}
+    order = []
+    for k, v in zip(keys, values):
+        if k not in seen:
+            seen[k] = []
+            order.append(k)
+        seen[k].append(v)
+    return [(k, seen[k]) for k in order]
+
+
+def create(name="local"):
+    """Create a store by type string (reference:
+    src/kvstore/kvstore.cc:41 KVStore::Create; python kvstore/base.py).
+
+    local / device  → in-process reducer
+    nccl            → alias of device (no NCCL on TPU; XLA collectives)
+    dist* / tpu / horovod → collective store over the jax process group
+    """
+    name = name.lower()
+    if name in ("local", "device", "local_allreduce_cpu",
+                "local_allreduce_device", "nccl"):
+        from .kvstore import KVStore
+        return KVStore()
+    if name in ("tpu", "dist", "dist_sync", "dist_device_sync", "dist_async",
+                "horovod", "p3"):
+        from .tpu import KVStoreTPU
+        return KVStoreTPU(mode=name)
+    if name in KVStoreBase.kv_registry:
+        return KVStoreBase.kv_registry[name]()
+    raise ValueError(f"unknown KVStore type {name!r}")

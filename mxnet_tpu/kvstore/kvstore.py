@@ -1,0 +1,27 @@
+"""Single-process KVStore ('local'/'device').
+
+Reference: python/mxnet/kvstore/kvstore.py (the ctypes wrapper over
+src/kvstore/kvstore_local.h). Here the local store IS the implementation —
+no C layer needed; reduction compiles to one XLA program per key group.
+"""
+from __future__ import annotations
+
+from .base import KVStoreBase, KVStoreLocal
+
+__all__ = ["KVStore"]
+
+
+class KVStore(KVStoreLocal):
+    """The default single-process store (type 'local'/'device').
+
+    Adds the string-command surface of the reference KVStore
+    (set_optimizer pickles the optimizer like SendCommandToServers did)."""
+
+    @property
+    def type(self):
+        return "device"
+
+    def send_command_to_servers(self, head, body):
+        # single process: commands are applied locally (reference:
+        # kvstore.py _send_command_to_servers → server controller loop)
+        pass

@@ -179,13 +179,15 @@ def _pooling(x, kernel=None, pool_type="max", global_pool=False, stride=None,
     pads = [(0, 0), (0, 0)] + _pool_pads(x, kernel, stride, pad,
                                          pooling_convention)
     if pool_type == "max":
+        # init must be a scalar literal: a traced/asarray init defeats
+        # JAX's max-monoid recognition and reverse-mode AD of
+        # reduce_window fails
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
-            jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
-                                 window, strides, pads)
+            int(jnp.iinfo(x.dtype).min)
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
-                              window, strides, pads)
+        s = lax.reduce_window(x, 0.0 if jnp.issubdtype(x.dtype, jnp.floating)
+                              else 0, lax.add, window, strides, pads)
         if pool_type == "sum":
             return s
         if count_include_pad:
@@ -194,13 +196,12 @@ def _pooling(x, kernel=None, pool_type="max", global_pool=False, stride=None,
                 denom *= k
             return s / jnp.asarray(denom, x.dtype)
         ones = jnp.ones_like(x)
-        cnt = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add,
-                                window, strides, pads)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
         return s / cnt
     if pool_type == "lp":
         p = p_value or 2
-        s = lax.reduce_window(jnp.abs(x) ** p, jnp.asarray(0, x.dtype),
-                              lax.add, window, strides, pads)
+        s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add,
+                              window, strides, pads)
         return s ** (1.0 / p)
     raise ValueError(f"unknown pool_type {pool_type}")
 
@@ -343,7 +344,7 @@ _reg("L2Normalization", _l2_normalization)
 def _lrn(x, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
     sq = jnp.square(x)
     half = nsize // 2
-    s = lax.reduce_window(sq, jnp.asarray(0, x.dtype), lax.add,
+    s = lax.reduce_window(sq, 0.0, lax.add,
                           (1, nsize, 1, 1), (1, 1, 1, 1),
                           [(0, 0), (half, half), (0, 0), (0, 0)])
     return x / jnp.power(knorm + alpha * s / nsize, beta)

@@ -100,6 +100,13 @@ class Optimizer:
         else:
             self.update(index, weight, grad, state)
 
+    @property
+    def learning_rate(self):
+        """Current base lr (reference: optimizer.py learning_rate prop)."""
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
             raise UserWarning("LRScheduler of the optimizer has already been "

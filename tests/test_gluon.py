@@ -1,0 +1,317 @@
+"""Gluon Block/HybridBlock/Trainer tests.
+
+Modeled on the reference's tests/python/unittest/test_gluon.py: parameter
+handling, layer correctness, hybridize consistency, trainer updates,
+save/load round trips.
+"""
+import os
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=mx.cpu())
+    assert p.name == "weight"
+    assert p.shape == (10, 10)
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu())
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10,
+                     flatten=False, prefix="test_")
+    inputs = mx.nd.zeros((2, 3, 10))
+    model.initialize()
+    outputs = model(inputs)
+    assert {p.name for p in model.collect_params().values()} == \
+        {"test_weight", "test_bias"}
+    assert outputs.shape == (2, 3, 128)
+
+    model = nn.Dense(128, activation="relu", in_units=30, flatten=True,
+                     prefix="test2_")
+    inputs = mx.nd.zeros((17, 2, 5, 3))
+    model.initialize()
+    outputs = model(inputs)
+    assert outputs.shape == (17, 128)
+
+
+def test_dense_deferred_shape():
+    model = nn.Dense(8)
+    model.initialize()
+    out = model(mx.nd.ones((4, 6)))
+    assert model.weight.shape == (8, 6)
+    assert out.shape == (4, 8)
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_sequential_training_decreases_loss(hybridize):
+    np.random.seed(42)
+    mx.random.seed(42)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    x = mx.nd.array(np.random.randn(16, 8).astype(np.float32))
+    y = mx.nd.array((np.random.randn(16) > 0).astype(np.float32))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(10):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(16)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_hybrid_matches_eager():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.MaxPool2D(2), nn.Flatten(),
+                nn.Dense(6))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 8, 8).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_running_stats_update_hybrid():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.BatchNorm(in_channels=3))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.randn(4, 3, 5, 5).astype(np.float32) + 2.0)
+    before = net[0].running_mean.data().asnumpy().copy()
+    with mx.autograd.record():
+        net(x)
+    after = net[0].running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+    # eval mode must use (not update) running stats
+    before = after.copy()
+    net(x)
+    after = net[0].running_mean.data().asnumpy()
+    np.testing.assert_allclose(before, after)
+
+
+def test_dropout_active_only_in_training():
+    net = nn.Dropout(0.5)
+    net.initialize()
+    x = mx.nd.ones((100, 100))
+    out = net(x)  # predict mode: identity
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    with mx.autograd.record():
+        out = net(x)
+    assert (out.asnumpy() == 0).mean() > 0.3
+
+
+def test_conv_layers_shapes():
+    x1 = mx.nd.ones((2, 3, 16))
+    x2 = mx.nd.ones((2, 3, 16, 16))
+    x3 = mx.nd.ones((2, 3, 8, 8, 8))
+    cases = [
+        (nn.Conv1D(4, 3, padding=1), x1, (2, 4, 16)),
+        (nn.Conv2D(4, 3, strides=2, padding=1), x2, (2, 4, 8, 8)),
+        (nn.Conv3D(4, 3, padding=1), x3, (2, 4, 8, 8, 8)),
+        (nn.Conv2DTranspose(4, 2, strides=2), x2, (2, 4, 32, 32)),
+        (nn.MaxPool2D(2), x2, (2, 3, 8, 8)),
+        (nn.AvgPool2D(2), x2, (2, 3, 8, 8)),
+        (nn.GlobalAvgPool2D(), x2, (2, 3, 1, 1)),
+        (nn.GlobalMaxPool2D(), x2, (2, 3, 1, 1)),
+    ]
+    for layer, x, want in cases:
+        layer.initialize()
+        got = layer(x).shape
+        assert got == want, f"{layer}: {got} != {want}"
+
+
+def test_norm_layers():
+    x = mx.nd.array(np.random.randn(2, 6, 4, 4).astype(np.float32))
+    for layer in (nn.LayerNorm(), nn.InstanceNorm(), nn.GroupNorm(2),
+                  nn.BatchNorm()):
+        layer.initialize()
+        out = layer(x)
+        assert out.shape == x.shape
+
+
+def test_activations_layers():
+    x = mx.nd.array(np.random.randn(3, 4).astype(np.float32))
+    for layer in (nn.LeakyReLU(0.1), nn.ELU(), nn.SELU(), nn.Swish(),
+                  nn.GELU(), nn.PReLU()):
+        layer.initialize()
+        assert layer(x).shape == x.shape
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 4)
+    layer.initialize()
+    x = mx.nd.array([0, 2, 5])
+    out = layer(x)
+    assert out.shape == (3, 4)
+    with mx.autograd.record():
+        loss = layer(x).sum()
+    loss.backward()
+    g = layer.weight.grad().asnumpy()
+    assert g[0].sum() != 0 and g[1].sum() == 0
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+    net.initialize()
+    x = mx.nd.ones((2, 8))
+    out1 = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+    net2.load_parameters(f)
+    out2 = net2(x).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_losses():
+    pred = mx.nd.array(np.random.randn(8, 4).astype(np.float32))
+    label_sparse = mx.nd.array(np.random.randint(0, 4, (8,)))
+    label_dense = mx.nd.array(np.abs(np.random.randn(8, 4)).astype(np.float32))
+    sign = mx.nd.array(np.sign(np.random.randn(8, 4)).astype(np.float32))
+    cases = [
+        (gluon.loss.L2Loss(), (pred, label_dense)),
+        (gluon.loss.L1Loss(), (pred, label_dense)),
+        (gluon.loss.SoftmaxCrossEntropyLoss(), (pred, label_sparse)),
+        (gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False),
+         (pred, label_dense)),
+        (gluon.loss.SigmoidBinaryCrossEntropyLoss(),
+         (pred, (sign + 1) / 2)),
+        (gluon.loss.KLDivLoss(), (mx.nd.log_softmax(pred, axis=-1),
+                                  mx.nd.softmax(label_dense, axis=-1))),
+        (gluon.loss.HuberLoss(), (pred, label_dense)),
+        (gluon.loss.HingeLoss(), (pred, sign)),
+        (gluon.loss.SquaredHingeLoss(), (pred, sign)),
+        (gluon.loss.LogisticLoss(), (pred[:, 0], sign[:, 0])),
+        (gluon.loss.PoissonNLLLoss(), (pred, label_dense)),
+        (gluon.loss.TripletLoss(), (pred, label_dense, label_dense + 1)),
+    ]
+    for loss_fn, args in cases:
+        out = loss_fn(*args)
+        v = out.asnumpy()
+        assert np.isfinite(v).all(), f"{loss_fn} produced non-finite loss"
+
+
+def test_softmax_ce_loss_value():
+    # uniform logits -> loss == log(C)
+    pred = mx.nd.zeros((4, 10))
+    label = mx.nd.array([1, 3, 5, 7])
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    np.testing.assert_allclose(loss.asnumpy(),
+                               np.full(4, np.log(10)), rtol=1e-5)
+
+
+def test_trainer_lr():
+    p = gluon.Parameter("w", shape=(2,))
+    p.initialize(ctx=mx.cpu())
+    tr = gluon.Trainer({"w": p}, "sgd", {"learning_rate": 0.5})
+    assert tr.learning_rate == 0.5
+    tr.set_learning_rate(0.1)
+    assert tr.learning_rate == 0.1
+
+
+def test_trainer_sgd_step_math():
+    p = gluon.Parameter("w", shape=(3,), init="zeros")
+    p.initialize(ctx=mx.cpu())
+    tr = gluon.Trainer({"w": p}, "sgd",
+                       {"learning_rate": 1.0, "wd": 0.0})
+    with mx.autograd.record():
+        loss = (p.data() * mx.nd.array([1.0, 2.0, 3.0])).sum()
+    loss.backward()
+    tr.step(1)
+    np.testing.assert_allclose(p.data().asnumpy(),
+                               [-1.0, -2.0, -3.0], rtol=1e-6)
+
+
+def test_trainer_save_load_states(tmp_path):
+    p = gluon.Parameter("w", shape=(3,), init="ones")
+    p.initialize(ctx=mx.cpu())
+    tr = gluon.Trainer({"w": p}, "adam", {"learning_rate": 0.1})
+    with mx.autograd.record():
+        loss = (p.data() ** 2).sum()
+    loss.backward()
+    tr.step(1)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr2 = gluon.Trainer({"w": p}, "adam", {"learning_rate": 0.1})
+    tr2.load_states(f)
+    assert tr2._updaters[0].states
+
+
+def test_block_naming():
+    d1 = nn.Dense(4)
+    d2 = nn.Dense(4)
+    assert d1.prefix != d2.prefix
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(4))
+    names = list(net.collect_params().keys())
+    assert all(n.startswith("model_dense") for n in names)
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="m_")
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.BatchNorm())
+    net.initialize()
+    net(mx.nd.ones((2, 3)))
+    w = net.collect_params(".*weight")
+    assert all("weight" in k for k in w.keys())
+    assert len(list(w.keys())) == 1
+
+
+def test_hybrid_rng_varies_per_call():
+    # dropout mask must differ call-to-call under jit (rng is a traced
+    # input, not a baked constant)
+    net = nn.Dropout(0.5)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((64, 64))
+    with mx.autograd.record():
+        a = net(x).asnumpy()
+        b = net(x).asnumpy()
+    assert not np.allclose(a, b)
+
+
+def test_lambda_blocks():
+    add3 = nn.Lambda(lambda x: x + 3)
+    assert float(add3(mx.nd.zeros((1,))).asnumpy()[0]) == 3.0
+    hl = nn.HybridLambda("relu")
+    assert float(hl(mx.nd.array([-1.0])).asnumpy()[0]) == 0.0
