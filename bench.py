@@ -1,0 +1,70 @@
+"""Benchmark: ResNet-50 inference throughput on one chip.
+
+Mirrors the reference's benchmark_score.py protocol
+(example/image-classification/benchmark_score.py: symbol bind, dry runs,
+then timed forward passes). Baseline (BASELINE.md / perf.md:185-198):
+ResNet-50 inference, batch 128, fp32 on V100 = 1233.15 img/s.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 1233.15  # ResNet-50 bs=128 fp32 V100 (perf.md:185-198)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import functional_call, extract_params
+
+    batch = int(os.environ.get("BENCH_BATCH", 128))
+    dtype = os.environ.get("BENCH_DTYPE", "float32")
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1()
+    net.initialize(init=mx.initializer.Xavier())
+    import mxnet_tpu.autograd as ag
+    with ag.pause():
+        net(mx.nd.NDArray(jnp.ones((1, 3, 224, 224), jnp.float32)))
+    if dtype != "float32":
+        net.cast(dtype)
+    params = extract_params(net)
+
+    def fwd(params, x):
+        out, _ = functional_call(net, params, x, training=False)
+        return out
+
+    jfwd = jax.jit(fwd)
+    x = jnp.ones((batch, 3, 224, 224), jnp.dtype(dtype))
+
+    # dry runs: compile + warm caches (reference: benchmark_score.py
+    # dry_run iterations)
+    for _ in range(3):
+        jfwd(params, x).block_until_ready()
+
+    iters = int(os.environ.get("BENCH_ITERS", 20))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jfwd(params, x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": f"resnet50_v1_infer_bs{batch}_{dtype}",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

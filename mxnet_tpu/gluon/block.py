@@ -308,6 +308,8 @@ class Block:
                                  else v.dtype)
                 if param._data is None:
                     param.shape = v.shape
+                    if ctx is not None and isinstance(ctx, Context):
+                        ctx = [ctx]
                     if not param._deferred_init:
                         param._deferred_init = (None,
                                                 ctx or [current_context()],
@@ -457,9 +459,15 @@ class CachedOp:
         # on signature change
         self._jits = {}
         self._meta = {}
+        # snapshot once (reference CachedOp captures params at build time,
+        # src/imperative/cached_op.cc); hybridize()/cast() rebuild me
+        self._params_snapshot = None
 
     def _trace_params(self):
-        return [p for _, p in sorted(self._block.collect_params().items())]
+        if self._params_snapshot is None:
+            self._params_snapshot = [
+                p for _, p in sorted(self._block.collect_params().items())]
+        return self._params_snapshot
 
     def _make_pure(self, training, in_fmt, flags, opaque, cache_key):
         def pure(key, pvals, xvals):
@@ -641,6 +649,10 @@ class HybridBlock(Block):
         return super().__call__(*args)
 
     def forward(self, x, *args):
+        if self._active and not _TRACE_STACK:
+            # cached op resolves deferred init itself; don't touch params
+            # on the hot path
+            return self._get_cached_op()(x, *args)
         try:
             params = {k: v.data() for k, v in self._reg_params.items()}
         except DeferredInitializationError:
@@ -648,8 +660,6 @@ class HybridBlock(Block):
             for _, p in self._reg_params.items():
                 p._finish_deferred_init()
             params = {k: v.data() for k, v in self._reg_params.items()}
-        if self._active and not _TRACE_STACK:
-            return self._get_cached_op()(x, *args)
         from .. import ndarray as F
         return self.hybrid_forward(F, x, *args, **params)
 

@@ -120,9 +120,7 @@ class DataLoader:
                 # can deadlock the multithreaded parent. Spawn requires a
                 # picklable dataset; fall back to a thread pool otherwise
                 # (decode/augment work on numpy releases the GIL anyway).
-                import pickle
                 try:
-                    pickle.dumps(self._dataset)
                     ctx = multiprocessing.get_context("spawn")
                     self._pool = ctx.Pool(
                         self._num_workers,

@@ -518,10 +518,13 @@ class ParameterDict:
                              else v.dtype)
             if param._data is None:
                 param.shape = v.shape
+                if isinstance(ctx, Context):
+                    ctx = [ctx]
                 param._deferred_init = param._deferred_init or \
                     (None, ctx or [current_context()], None, None)
                 init, pctx, dinit, _ = param._deferred_init
-                param._deferred_init = (init, pctx, dinit, v.asnumpy())
+                param._deferred_init = (init, ctx or pctx, dinit,
+                                        v.asnumpy())
                 param._finish_deferred_init()
             else:
                 param.set_data(v)

@@ -177,8 +177,12 @@ class Trainer:
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
+        # dump the optimizer itself only on the update-on-kvstore path
+        # (reference trainer.py:470) — with param_dict pointing at live
+        # Parameters, dump_optimizer would embed every weight in the file
         with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=True))
+            f.write(self._updaters[0].get_states(
+                dump_optimizer=bool(self._update_on_kvstore)))
 
     def load_states(self, fname):
         if not self._kv_initialized:

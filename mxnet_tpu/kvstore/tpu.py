@@ -52,8 +52,6 @@ class KVStoreTPU(KVStoreLocal):
         self._mode = mode
         init_process_group()
         self._devices = jax.devices()
-        # mean-allreduce compiled once per shape
-        self._allreduce = jax.jit(lambda x: x)  # placeholder; see _reduce
 
     def _reduce_across_processes(self, value):
         """Cross-host reduce. With one process this is the identity; with

@@ -1,0 +1,71 @@
+"""Functional bridge: run a Gluon block as a pure function of its params.
+
+The sharded/pjit training path needs ``f(params, x) -> y`` purity; Gluon
+blocks hold parameters internally. This bridge reuses the trace machinery
+of gluon.block.CachedOp: parameter reads are redirected to caller-supplied
+arrays, aux-state writes (BatchNorm running stats) are captured and
+returned (reference aux states are engine-mutated in place,
+src/operator/nn/batch_norm.cc; here they thread functionally).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+
+from .. import autograd, _rng
+from ..ndarray import NDArray
+from ..gluon.parameter import _TRACE_STACK
+from ..gluon.block import _suspend_hybridization
+
+__all__ = ["functional_call", "extract_params", "load_params"]
+
+
+def extract_params(block) -> Dict[str, jax.Array]:
+    """Pull the block's parameter values as a flat {name: jax.Array}."""
+    out = {}
+    for name, p in block.collect_params().items():
+        p._finish_deferred_init()
+        out[name] = p.data()._data
+    return out
+
+
+def load_params(block, params: Dict[str, jax.Array]):
+    """Write arrays back into the block's parameters (post-training)."""
+    for name, p in block.collect_params().items():
+        if name in params:
+            p.set_data(NDArray(params[name]))
+
+
+def functional_call(block, params: Dict[str, jax.Array], *inputs,
+                    training: bool = False, rng=None):
+    """Run ``block(*inputs)`` with parameter values taken from ``params``.
+
+    Returns ``(outputs, new_aux)`` where new_aux holds updated aux states
+    ({name: array}, empty unless training touches BatchNorm-style state).
+    Pure w.r.t. (params, inputs, rng) — safe under jit/grad/shard_map.
+    """
+    plist = block.collect_params()
+    aux_writes = {}
+    _TRACE_STACK.append(aux_writes)
+    old_rng = _rng.push_trace_key(
+        rng if rng is not None else jax.random.key(0))
+    try:
+        for name, p in plist.items():
+            p._trace_data = NDArray(params[name])
+        with autograd.pause(train_mode=training):
+            with _suspend_hybridization(block):
+                out = block(*[NDArray(x) if not isinstance(x, NDArray)
+                              else x for x in inputs])
+    finally:
+        for p in plist.values():
+            p._trace_data = None
+        _TRACE_STACK.pop()
+        _rng.pop_trace_key(old_rng)
+    new_aux = {p.name: v._data for p, v in aux_writes.items()}
+    if isinstance(out, (list, tuple)):
+        raw = type(out)(o._data if isinstance(o, NDArray) else o
+                        for o in out)
+    else:
+        raw = out._data if isinstance(out, NDArray) else out
+    return raw, new_aux

@@ -1,0 +1,52 @@
+"""Device mesh construction.
+
+Reference analogue: the kvstore/comm topology machinery
+(src/kvstore/comm_tree.h:50 ComputeTrees builds reduction trees from the
+PCIe/NVLink link matrix). On TPU none of that exists: the ICI fabric is a
+torus XLA already knows; we only pick logical axis sizes.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as _np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "local_mesh", "data_parallel_spec"]
+
+
+def make_mesh(dp: Optional[int] = None, tp: int = 1, pp: int = 1,
+              sp: int = 1, ep: int = 1, devices=None) -> Mesh:
+    """Build a Mesh with named axes (dp, tp, pp, sp, ep); ``dp=None``
+    absorbs all remaining devices.
+
+    The axis order places dp outermost so data-parallel allreduce rides
+    the widest rings, with tp innermost (finest-grained collectives on
+    nearest neighbors) — the standard ICI layout recipe."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    fixed = tp * pp * sp * ep
+    if dp is None:
+        assert n % fixed == 0, \
+            f"{n} devices not divisible by tp*pp*sp*ep={fixed}"
+        dp = n // fixed
+    total = dp * fixed
+    assert total <= n, f"requested {total} devices, have {n}"
+    arr = _np.array(devices[:total]).reshape(dp, pp, sp, ep, tp)
+    return Mesh(arr, ("dp", "pp", "sp", "ep", "tp"))
+
+
+def local_mesh(n: Optional[int] = None) -> Mesh:
+    """1-axis dp mesh over local devices — the moral equivalent of
+    kvstore 'device' (single-host data parallel)."""
+    devices = jax.devices()
+    if n is not None:
+        devices = devices[:n]
+    return Mesh(_np.array(devices), ("dp",))
+
+
+def data_parallel_spec(ndim: int) -> PartitionSpec:
+    """PartitionSpec sharding axis0 (batch) on dp, rest replicated."""
+    return PartitionSpec("dp", *([None] * (ndim - 1)))

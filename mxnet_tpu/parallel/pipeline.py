@@ -1,0 +1,66 @@
+"""Pipeline parallelism: microbatched GPipe stage loop.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3); provided as a
+TPU-native capability. GPipe forward schedule expressed inside
+``shard_map`` over the 'pp' mesh axis: each rank holds one stage's params
+and an activation register; every tick it applies its stage and passes
+the activation to the next rank via ``ppermute`` — XLA overlaps the ICI
+hop with the next tick's compute.
+
+Constraint of this schedule: all stages map activations of one shape to
+the same shape (pad stage widths or wrap uneven stages accordingly).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+__all__ = ["pipeline_stage_loop"]
+
+
+def pipeline_stage_loop(stage_fn, n_microbatches: int, mesh: Mesh,
+                        axis_name: str = "pp"):
+    """Build ``f(stage_params, microbatches) -> outputs``.
+
+    - ``stage_params``: pytree whose leaves carry a leading pp-sharded
+      stage axis (leaf shape (n_stages, ...)); rank i uses slice i.
+    - ``microbatches``: (n_microbatches, mb, ...) replicated input; rank 0
+      feeds them into the pipe in order.
+    - returns (n_microbatches, mb, ...) — the last stage's outputs,
+      broadcast to all ranks.
+    """
+    n_stages = mesh.shape[axis_name]
+    ticks = n_stages + n_microbatches - 1
+
+    def local(params, mbs):
+        # shard_map hands each rank its stage slice with leading dim 1
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        rank = lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        reg = lax.pvary(jnp.zeros_like(mbs[0]), (axis_name,))
+        out = lax.pvary(jnp.zeros_like(mbs), (axis_name,))
+
+        def body(t, carry):
+            reg, out = carry
+            feed_idx = jnp.clip(t, 0, n_microbatches - 1)
+            inp = jnp.where(rank == 0, mbs[feed_idx], reg)
+            y = stage_fn(params, inp)
+            # rank n-1 finishes microbatch t-(n_stages-1) at tick t
+            done_idx = t - (n_stages - 1)
+            valid = (done_idx >= 0) & (rank == n_stages - 1)
+            slot = jnp.clip(done_idx, 0, n_microbatches - 1)
+            out = out.at[slot].set(jnp.where(valid, y, out[slot]))
+            reg = lax.ppermute(y, axis_name, perm)
+            return reg, out
+
+        reg, out = lax.fori_loop(0, ticks, body, (reg, out))
+        # broadcast last rank's outputs to everyone
+        out = jnp.where(rank == n_stages - 1, out, jnp.zeros_like(out))
+        return lax.psum(out, axis_name)
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=(P(axis_name), P()),
+                     out_specs=P())

@@ -1,0 +1,182 @@
+"""ShardedTrainer: one jitted SPMD train step over a Mesh.
+
+TPU-native replacement for the reference's data-parallel training loop
+(reference: python/mxnet/module/executor_group.py:144 per-GPU executors +
+kvstore push/pull per weight, python/mxnet/gluon/trainer.py:329). The
+whole step — forward, backward, gradient allreduce, optimizer update — is
+ONE compiled XLA program: gradients never materialize per-replica; XLA
+lowers the mean over 'dp' to a psum on ICI and fuses the optimizer update
+into it. Buffers are donated, so weights update in place in HBM (the
+reference needed kWriteInplace optimizer kernels for this).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, Optional
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import optimizer as opt_mod
+from ..ndarray import NDArray
+from .functional import functional_call, extract_params, load_params
+from .mesh import local_mesh
+
+__all__ = ["ShardedTrainer", "shard_batch"]
+
+
+def shard_batch(x, mesh: Mesh, axis: str = "dp"):
+    """Place a host batch as one global array sharded on the batch dim
+    (≙ gluon.utils.split_and_load, reference gluon/utils.py:95 — but one
+    array, not per-device copies)."""
+    arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    spec = P(axis, *([None] * (arr.ndim - 1)))
+    return NDArray(jax.device_put(arr, NamedSharding(mesh, spec)))
+
+
+class ShardedTrainer:
+    """Train a Gluon block under pjit-style sharding.
+
+    Parameters
+    ----------
+    block : initialized (possibly un-hybridized) gluon Block
+    loss_fn : gluon loss block or callable (pred, label) -> per-sample loss
+    optimizer : name or Optimizer instance (the same zoo Trainer uses)
+    mesh : jax Mesh (default: 1-axis dp mesh over all devices)
+    param_spec : optional callable (name, shape) -> PartitionSpec for
+        tensor-parallel weight sharding; default replicates params.
+
+    Notes
+    -----
+    The optimizer's hyperparameters are baked per compilation; changing
+    lr triggers a cheap retrace (XLA caches by step signature). The
+    reference pays a kernel launch per parameter per step instead.
+    """
+
+    def __init__(self, block, loss_fn, optimizer="sgd",
+                 optimizer_params=None, mesh: Optional[Mesh] = None,
+                 param_spec: Optional[Callable] = None, donate=True):
+        self._block = block
+        self._loss_fn = loss_fn
+        self._mesh = mesh if mesh is not None else local_mesh()
+        if isinstance(optimizer, str):
+            self._optimizer = opt_mod.create(optimizer,
+                                             **(optimizer_params or {}))
+        else:
+            self._optimizer = optimizer
+        self._param_spec = param_spec
+        self._donate = donate
+        self._step_jit = None
+        self._step_count = 0
+        self._rngkey = jax.random.key(0)
+        self._params = None
+
+    def _ensure_init(self, x):
+        if self._params is not None:
+            return
+        block = self._block
+        plist = block.collect_params()
+        if any(p._data is None and (p.shape is None or 0 in p.shape)
+               for p in plist.values()):
+            # one eager predict pass resolves deferred shapes
+            from .. import autograd
+            with autograd.pause(train_mode=False):
+                block(NDArray(jnp.asarray(x)[:1]))
+        params = extract_params(block)
+        self._names = sorted(params)
+        self._trainable = [
+            n for n in self._names
+            if block.collect_params()[n].grad_req != "null"]
+        # shard/replicate parameters onto the mesh
+        self._params = {}
+        for n in self._names:
+            spec = (self._param_spec(n, params[n].shape)
+                    if self._param_spec else P())
+            self._params[n] = jax.device_put(
+                params[n], NamedSharding(self._mesh, spec))
+        # optimizer states live with their parameter, same sharding
+        self._opt_states = {}
+        for i, n in enumerate(self._trainable):
+            st = self._optimizer.create_state(i, NDArray(self._params[n]))
+            self._opt_states[n] = jax.tree_util.tree_map(
+                lambda a: jax.device_put(
+                    a._data if isinstance(a, NDArray) else a,
+                    self._params[n].sharding), st,
+                is_leaf=lambda a: isinstance(a, NDArray))
+
+    @property
+    def params(self):
+        return self._params
+
+    def _build_step(self):
+        block, loss_fn, optimizer = self._block, self._loss_fn, \
+            self._optimizer
+        trainable = self._trainable
+
+        def step(params, opt_states, rng, x, y):
+            def objective(trn_params):
+                full = dict(params)
+                full.update(trn_params)
+                out, aux = functional_call(block, full, x, training=True,
+                                           rng=rng)
+                loss = loss_fn(NDArray(out), NDArray(y))
+                return loss._data.mean(), aux
+
+            (loss, aux), grads = jax.value_and_grad(
+                objective, has_aux=True)({n: params[n] for n in trainable})
+
+            new_params = dict(params)
+            new_states = {}
+            for i, n in enumerate(trainable):
+                w = NDArray(params[n])
+                g = NDArray(grads[n])
+                st = jax.tree_util.tree_map(NDArray, opt_states[n])
+                optimizer.update_multi_precision(i, w, g, st)
+                new_params[n] = w._data
+                new_states[n] = jax.tree_util.tree_map(
+                    lambda a: a._data if isinstance(a, NDArray) else a, st,
+                    is_leaf=lambda a: isinstance(a, NDArray))
+            # aux states (BN running stats) ride along, replicated
+            for n, v in aux.items():
+                new_params[n] = v
+            return new_params, new_states, loss
+
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def step(self, x, y):
+        """One SPMD training step; returns the (replicated) scalar loss."""
+        self._ensure_init(x)
+        if self._step_jit is None:
+            self._step_jit = self._build_step()
+        xb = shard_batch(x, self._mesh)._data if not (
+            isinstance(x, NDArray) and _is_sharded(x._data)) else x._data
+        yb = shard_batch(y, self._mesh)._data if not (
+            isinstance(y, NDArray) and _is_sharded(y._data)) else y._data
+        self._rngkey, sub = jax.random.split(self._rngkey)
+        self._params, self._opt_states, loss = self._step_jit(
+            self._params, self._opt_states, sub, xb, yb)
+        self._step_count += 1
+        self._optimizer._index_update_count = {}  # host counts unused here
+        return NDArray(loss)
+
+    def forward(self, x, training=False):
+        """Sharded inference through the current parameters."""
+        self._ensure_init(x)
+        xb = shard_batch(x, self._mesh)._data
+        out, _ = functional_call(self._block, self._params, xb,
+                                 training=training)
+        return NDArray(out)
+
+    def sync_block(self):
+        """Write trained parameters back into the Gluon block."""
+        load_params(self._block, self._params)
+
+
+def _is_sharded(arr):
+    try:
+        return len(arr.devices()) > 1
+    except Exception:
+        return False
