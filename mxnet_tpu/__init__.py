@@ -39,7 +39,7 @@ _LAZY_MODULES = ("numpy", "numpy_extension", "symbol", "gluon", "module",
                  "optimizer", "metric", "initializer", "io", "kvstore",
                  "image", "parallel", "models", "profiler", "lr_scheduler",
                  "callback", "test_utils", "util", "runtime", "amp",
-                 "recordio", "executor", "monitor")
+                 "recordio", "executor", "monitor", "model")
 
 _ALIAS = {"np": "numpy", "npx": "numpy_extension", "sym": "symbol",
           "mod": "module", "kv": "kvstore"}

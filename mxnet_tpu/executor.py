@@ -1,0 +1,201 @@
+"""Executor: bound symbolic graph.
+
+Reference: python/mxnet/executor.py + src/executor/graph_executor.cc. The
+reference's bind pipeline (gradient graph, CSE, fusion, memory planning,
+op caching/bulking — graph_executor.cc:1004-1364) is replaced wholesale
+by ``jax.jit``: forward is the jitted DAG trace; backward is a jitted
+vjp that REMATERIALIZES the forward (recompute-over-store — the TPU
+recipe for trading FLOPs for HBM; the reference's analogue was
+MXNET_BACKWARD_DO_MIRROR). Aux states (BatchNorm running stats) come
+back as extra outputs and are written into the aux arrays after each
+training forward.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import _rng
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    """Holds bound arrays + compiled forward/backward for a Symbol."""
+
+    def __init__(self, symbol, ctx, args: Dict[str, NDArray],
+                 args_grad: Optional[Dict[str, NDArray]], grad_req,
+                 aux_states: Dict[str, NDArray]):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.input_names = symbol.list_inputs()
+        self.arg_dict = dict(args)
+        self.aux_dict = dict(aux_states)
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self.arg_names}
+        self.grad_req = grad_req
+        self.grad_dict = dict(args_grad) if args_grad else {}
+        self.outputs: List[NDArray] = []
+        self._jit_fwd = None
+        self._jit_bwd = None
+        self._last = None  # (rng, arrays) of the last training forward
+        self._monitor_callback = None
+
+    # ------------------------------------------------------- array views --
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    def _build(self):
+        if self._jit_fwd is not None:
+            return
+        sym = self._symbol
+        names = self.input_names
+        wrt = [n for n in self.arg_names
+               if self.grad_req.get(n, "null") != "null"]
+        idx = {n: i for i, n in enumerate(names)}
+        wrt_idx = [idx[n] for n in wrt]
+
+        def make_fwd(training):
+            raw = sym._build_fn(names, collect_aux=True,
+                                is_train=training, rng_from_input=True)
+
+            def fwd(rng, *arrays):
+                out, aux = raw(rng, *arrays)
+                outs = out if isinstance(out, tuple) else (out,)
+                return tuple(outs), aux
+            return jax.jit(fwd)
+
+        self._jit_fwd = {True: make_fwd(True), False: make_fwd(False)}
+        raw_t = sym._build_fn(names, collect_aux=True, is_train=True,
+                              rng_from_input=True)
+
+        def bwd(rng, arrays, cots):
+            def f(wrt_vals):
+                full = list(arrays)
+                for i, v in zip(wrt_idx, wrt_vals):
+                    full[i] = v
+                out, _aux = raw_t(rng, *full)
+                outs = out if isinstance(out, tuple) else (out,)
+                return tuple(outs)
+
+            _, vjp_fn = jax.vjp(f, tuple(arrays[i] for i in wrt_idx))
+            return vjp_fn(tuple(cots))[0]
+
+        self._jit_bwd = jax.jit(bwd)
+        self._wrt = wrt
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward (reference: executor.py forward). kwargs update
+        bound input arrays by name."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict and k not in self.aux_dict:
+                raise MXNetError(f"unknown input {k!r}")
+            tgt = self.arg_dict.get(k, self.aux_dict.get(k))
+            src = v if isinstance(v, NDArray) else NDArray(v)
+            tgt._data = jnp.asarray(src._data, dtype=tgt.dtype)
+        self._build()
+        arrays = []
+        for n in self.input_names:
+            a = self.arg_dict.get(n, self.aux_dict.get(n))
+            if a is None:
+                raise MXNetError(f"input {n!r} was not bound")
+            arrays.append(a._data)
+        rng = _rng.next_key()
+        outs, aux = self._jit_fwd[bool(is_train)](rng, *arrays)
+        self.outputs = [NDArray(o) for o in outs]
+        if is_train:
+            self._last = (rng, arrays)
+            for n, v in aux.items():
+                if n in self.aux_dict:
+                    self.aux_dict[n]._data = v
+        if self._monitor_callback is not None:
+            for name, o in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, o)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Accumulate gradients into grad arrays. The backward program
+        recomputes the forward under jit (rematerialization) using the
+        saved rng, so dropout masks match the forward pass."""
+        if self._last is None:
+            raise MXNetError("call forward(is_train=True) before backward")
+        rng, arrays = self._last
+        if out_grads is None:
+            cots = [jnp.ones_like(o._data) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                    for g in out_grads]
+        gwrt = self._jit_bwd(rng, tuple(arrays), tuple(cots))
+        for n, g in zip(self._wrt, gwrt):
+            req = self.grad_req.get(n, "null")
+            if req == "null":
+                continue
+            buf = self.grad_dict.get(n)
+            if buf is None:
+                buf = NDArray(jnp.zeros_like(g))
+                self.grad_dict[n] = buf
+            if req == "add":
+                buf._data = buf._data + g
+            else:
+                buf._data = g
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Return a new executor bound at new shapes (XLA retraces per
+        shape, so this is just a rebind; reference: executor.py:reshape)."""
+        args = {}
+        for n in self.arg_names:
+            old = self.arg_dict[n]
+            if n in kwargs:
+                args[n] = NDArray(jnp.zeros(kwargs[n], old.dtype))
+            else:
+                args[n] = old
+        grads = {n: NDArray(jnp.zeros_like(a._data))
+                 for n, a in args.items()
+                 if self.grad_req.get(n, "null") != "null"} \
+            if self.grad_dict else None
+        return Executor(self._symbol, self._ctx, args, grads,
+                        self.grad_req, dict(self.aux_dict))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """Load parameter values (reference: executor.py
+        copy_params_from)."""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                array.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise ValueError(f"Found name \"{name}\" that is not in "
+                                 "the arguments")
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    array.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise ValueError(f"Found name \"{name}\" that is not "
+                                     "in the auxiliary states")
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))

@@ -697,7 +697,9 @@ class SymbolBlock(HybridBlock):
     """Construct a block from a Symbol graph (reference: block.py:1403)."""
 
     def __init__(self, outputs, inputs, params=None):
-        super().__init__(prefix=None, params=params)
+        # empty prefix: symbol argument names ARE the parameter names
+        # (a generated prefix would break forward()'s eval bindings)
+        super().__init__(prefix="", params=params)
         from ..symbol import Symbol
         if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
             outputs = outputs[0]
@@ -729,15 +731,33 @@ class SymbolBlock(HybridBlock):
         return ret
 
     def forward(self, x, *args):
+        input_names = [i.name for i in self._inputs]
         arg_arrays = {}
         for name, p in self.collect_params().items():
             try:
                 arg_arrays[name] = p.data()
             except DeferredInitializationError:
-                raise RuntimeError(
-                    f"Parameter {name} of SymbolBlock not initialized — "
-                    "load params or initialize() first")
-        bindings = dict(zip([i.name for i in self._inputs], (x,) + args))
+                # infer parameter shapes from the input shapes via the
+                # symbol's shape solver, then materialize
+                known = {n: v.shape for n, v in
+                         zip(input_names, (x,) + args)}
+                shape_of, _ = self._outputs._solve_shapes(known,
+                                                          partial=True)
+                for pname, pp in self.collect_params().items():
+                    if pname in shape_of and pp._data is None:
+                        pp.shape = shape_of[pname]
+                        pp._finish_deferred_init()
+                try:
+                    arg_arrays = {n: pp.data() for n, pp in
+                                  self.collect_params().items()}
+                except DeferredInitializationError:
+                    raise RuntimeError(
+                        f"Parameter {name} of SymbolBlock could not be "
+                        "shape-inferred from the inputs — load params or "
+                        "initialize() with explicit shapes first"
+                    ) from None
+                break
+        bindings = dict(zip(input_names, (x,) + args))
         bindings.update(arg_arrays)
         return self._outputs.eval_dict(bindings)
 

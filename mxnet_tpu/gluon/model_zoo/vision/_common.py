@@ -1,0 +1,15 @@
+"""Shared pretrained-weight loading for the vision zoo."""
+
+
+def load_pretrained(net, pretrained, params_file, ctx=None):
+    """Load local pretrained weights or fail with an actionable error
+    (this environment has no network egress — reference get_model_file
+    downloaded from the model store)."""
+    if not pretrained:
+        return net
+    if not params_file:
+        raise RuntimeError(
+            "pretrained weights require a local params_file= path "
+            "(no network egress in this environment)")
+    net.load_parameters(params_file, ctx=ctx)
+    return net

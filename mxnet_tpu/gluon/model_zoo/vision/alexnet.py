@@ -42,8 +42,6 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, ctx=None, **kwargs):
-    net = AlexNet(**{k: v for k, v in kwargs.items()
-                     if k != "params_file"})
-    if pretrained:
-        net.load_parameters(kwargs["params_file"], ctx=ctx)
-    return net
+    from ._common import load_pretrained
+    pf = kwargs.pop("params_file", None)
+    return load_pretrained(AlexNet(**kwargs), pretrained, pf, ctx)

@@ -92,11 +92,9 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
 
 def get_densenet(num_layers, pretrained=False, ctx=None, **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
+    from ._common import load_pretrained
     pf = kwargs.pop("params_file", None)
-    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
-    if pretrained:
-        net.load_parameters(pf, ctx=ctx)
-    return net
+    return load_pretrained(DenseNet(num_init_features, growth_rate, block_config, **kwargs), pretrained, pf, ctx)
 
 
 def densenet121(**kwargs):

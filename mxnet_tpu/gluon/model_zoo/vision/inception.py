@@ -152,8 +152,6 @@ class Inception3(HybridBlock):
 
 
 def inception_v3(pretrained=False, ctx=None, **kwargs):
+    from ._common import load_pretrained
     pf = kwargs.pop("params_file", None)
-    net = Inception3(**kwargs)
-    if pretrained:
-        net.load_parameters(pf, ctx=ctx)
-    return net
+    return load_pretrained(Inception3(**kwargs), pretrained, pf, ctx)

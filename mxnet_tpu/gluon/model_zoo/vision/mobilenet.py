@@ -119,19 +119,15 @@ class MobileNetV2(HybridBlock):
 
 
 def get_mobilenet(multiplier, pretrained=False, ctx=None, **kwargs):
+    from ._common import load_pretrained
     pf = kwargs.pop("params_file", None)
-    net = MobileNet(multiplier, **kwargs)
-    if pretrained:
-        net.load_parameters(pf, ctx=ctx)
-    return net
+    return load_pretrained(MobileNet(multiplier, **kwargs), pretrained, pf, ctx)
 
 
 def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, **kwargs):
+    from ._common import load_pretrained
     pf = kwargs.pop("params_file", None)
-    net = MobileNetV2(multiplier, **kwargs)
-    if pretrained:
-        net.load_parameters(pf, ctx=ctx)
-    return net
+    return load_pretrained(MobileNetV2(multiplier, **kwargs), pretrained, pf, ctx)
 
 
 def mobilenet1_0(**kwargs):

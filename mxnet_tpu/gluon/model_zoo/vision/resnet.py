@@ -264,15 +264,10 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
         f"Invalid resnet version: {version}. Options are 1 and 2."
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
+    from ._common import load_pretrained
+    pf = kwargs.pop("params_file", None)
     net = resnet_class(block_class, layers, channels, **kwargs)
-    if pretrained:
-        pf = kwargs.get("params_file")
-        if not pf:
-            raise RuntimeError(
-                "pretrained weights require a local params_file= path "
-                "(no network egress in this environment)")
-        net.load_parameters(pf, ctx=ctx)
-    return net
+    return load_pretrained(net, pretrained, pf, ctx)
 
 
 def resnet18_v1(**kwargs):

@@ -81,11 +81,9 @@ class SqueezeNet(HybridBlock):
 
 
 def get_squeezenet(version, pretrained=False, ctx=None, **kwargs):
+    from ._common import load_pretrained
     pf = kwargs.pop("params_file", None)
-    net = SqueezeNet(version, **kwargs)
-    if pretrained:
-        net.load_parameters(pf, ctx=ctx)
-    return net
+    return load_pretrained(SqueezeNet(version, **kwargs), pretrained, pf, ctx)
 
 
 def squeezenet1_0(**kwargs):

@@ -56,11 +56,9 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
 
 def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
+    from ._common import load_pretrained
     pf = kwargs.pop("params_file", None)
-    net = VGG(layers, filters, **kwargs)
-    if pretrained:
-        net.load_parameters(pf, ctx=ctx)
-    return net
+    return load_pretrained(VGG(layers, filters, **kwargs), pretrained, pf, ctx)
 
 
 def vgg11(**kwargs):

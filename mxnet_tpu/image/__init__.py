@@ -1,0 +1,3 @@
+"""Image I/O + augmentation (reference: python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403

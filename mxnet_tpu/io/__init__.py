@@ -1,0 +1,3 @@
+"""Data iterators (reference: python/mxnet/io/)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter, MXDataIter)  # noqa: F401

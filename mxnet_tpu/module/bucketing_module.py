@@ -1,0 +1,144 @@
+"""BucketingModule: variable-length sequence training.
+
+Reference: python/mxnet/module/bucketing_module.py — one Module per
+bucket key, shared parameters. On TPU each bucket is its own XLA
+program (jit cache per shape), which is exactly what the reference's
+bucketing emulated by re-binding executors per bucket.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    """Bucketed modules with shared params (reference:
+    bucketing_module.py:39)."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._default_bucket_key = default_bucket_key
+        self._sym_gen = sym_gen
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._init_args = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    def _gen_module(self, bucket_key):
+        if bucket_key in self._buckets:
+            return self._buckets[bucket_key]
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        mod = Module(sym, data_names, label_names, logger=self.logger,
+                     context=self._context,
+                     fixed_param_names=self._fixed_param_names)
+        self._buckets[bucket_key] = mod
+        return mod
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        mod = self._gen_module(self._default_bucket_key)
+        mod.bind(data_shapes, label_shapes, for_training,
+                 inputs_need_grad, force_rebind=False, grad_req=grad_req)
+        self._curr_module = mod
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Switch current bucket (reference: bucketing_module.py:404)."""
+        assert self.binded, "call bind before switching bucket"
+        mod = self._gen_module(bucket_key)
+        if not mod.binded:
+            mod.bind(data_shapes, label_shapes, self.for_training)
+            if self.params_initialized:
+                arg_p, aux_p = self._curr_module.get_params()
+                mod.init_params(arg_params=arg_p, aux_params=aux_p,
+                                allow_missing=False, force_init=True)
+            if self._curr_module.optimizer_initialized:
+                mod._optimizer = self._curr_module._optimizer
+                mod._updater = self._curr_module._updater
+                mod.optimizer_initialized = True
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        self._curr_module.init_params(initializer, arg_params, aux_params,
+                                      allow_missing, force_init,
+                                      allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        # sync the default module with the latest trained params
+        return self._curr_module.get_params()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self._curr_module.init_optimizer(kvstore, optimizer,
+                                         optimizer_params, force_init)
+        for mod in self._buckets.values():
+            if mod is not self._curr_module and mod.binded:
+                mod._optimizer = self._curr_module._optimizer
+                mod._updater = self._curr_module._updater
+                mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded
+        key = data_batch.bucket_key
+        if key is None:
+            key = self._default_bucket_key
+        if key != self._curr_bucket_key:
+            # params live in the previous bucket's executor; carry over
+            prev = self._curr_module
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+            if prev is not self._curr_module and \
+                    self.params_initialized:
+                arg_p, aux_p = prev.get_params()
+                self._curr_module.init_params(
+                    arg_params=arg_p, aux_params=aux_p, force_init=True)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        for mod in self._buckets.values():
+            if mod.binded:
+                mod.install_monitor(mon)

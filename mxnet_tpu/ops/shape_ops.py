@@ -380,3 +380,42 @@ _reg("SequenceLast", _sequence_last)
 alias("sequence_last", "SequenceLast")
 _reg("SequenceReverse", _sequence_reverse)
 alias("sequence_reverse", "SequenceReverse")
+
+
+# ------------------------------------------------------- creation ops ------
+# (registered so the Symbol API can carry creation nodes in its DAG;
+# reference: src/operator/tensor/init_op.cc _zeros/_ones/_arange/_eye)
+
+def _creation_reg(name, fn):
+    _REGISTRY[name] = Operator(name, fn, differentiable=False)
+
+
+def _zeros_impl(shape=(), dtype="float32", ctx=None):
+    return jnp.zeros(tuple(shape), _np.dtype(dtype))
+
+
+def _ones_impl(shape=(), dtype="float32", ctx=None):
+    return jnp.ones(tuple(shape), _np.dtype(dtype))
+
+
+def _full_impl(shape=(), value=0.0, dtype="float32", ctx=None):
+    return jnp.full(tuple(shape), value, _np.dtype(dtype))
+
+
+def _arange_impl(start=0.0, stop=None, step=1.0, repeat=1, ctx=None,
+                 dtype="float32"):
+    out = jnp.arange(start, stop, step, _np.dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+def _eye_impl(N=0, M=0, k=0, dtype="float32", ctx=None):
+    return jnp.eye(int(N), int(M) or None, int(k), dtype=_np.dtype(dtype))
+
+
+_creation_reg("_zeros", _zeros_impl)
+_creation_reg("_ones", _ones_impl)
+_creation_reg("_full", _full_impl)
+_creation_reg("_arange", _arange_impl)
+_creation_reg("_eye", _eye_impl)

@@ -138,8 +138,13 @@ class Optimizer:
             if idx not in self._index_update_count:
                 self._index_update_count[idx] = self.begin_num_update
             self._index_update_count[idx] += 1
-            self.num_update = max(self._index_update_count[idx],
-                                  self.num_update)
+            cnt = self._index_update_count[idx]
+            if isinstance(cnt, (int, float)):
+                self.num_update = max(cnt, self.num_update)
+            else:
+                # traced step counter (parallel.ShardedTrainer seeds it so
+                # Adam-family bias correction stays correct under jit)
+                self.num_update = cnt
 
     def _get_lrs(self, indices):
         if self.lr_scheduler is not None:
@@ -285,7 +290,7 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         coef1 = 1. - self.beta1 ** t
         coef2 = 1. - self.beta2 ** t
-        lr *= math.sqrt(coef2) / coef1
+        lr *= coef2 ** 0.5 / coef1  # tracer-safe (no math.sqrt)
         mean, var = state
         apply_op("adam_update", [weight, grad, mean, var],
                  dict(lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
@@ -313,7 +318,7 @@ class AdamW(Optimizer):
         t = self._index_update_count[index]
         coef1 = 1. - self.beta1 ** t
         coef2 = 1. - self.beta2 ** t
-        lr *= math.sqrt(coef2) / coef1
+        lr *= coef2 ** 0.5 / coef1  # tracer-safe (no math.sqrt)
         mean, var = state
         apply_op("_adamw_update", [weight, grad, mean, var],
                  dict(lr=lr, wd=wd, eta=self.eta, beta1=self.beta1,

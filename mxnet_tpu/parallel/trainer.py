@@ -65,7 +65,12 @@ class ShardedTrainer:
             self._optimizer = opt_mod.create(optimizer,
                                              **(optimizer_params or {}))
         else:
-            self._optimizer = optimizer
+            # private copy: the traced step counter seeded into
+            # _index_update_count must not leak into an eager Trainer
+            # sharing the same instance
+            import copy
+            self._optimizer = copy.copy(optimizer)
+            self._optimizer._index_update_count = {}
         self._param_spec = param_spec
         self._donate = donate
         self._step_jit = None
@@ -115,7 +120,7 @@ class ShardedTrainer:
             self._optimizer
         trainable = self._trainable
 
-        def step(params, opt_states, rng, x, y):
+        def step(params, opt_states, rng, t, x, y):
             def objective(trn_params):
                 full = dict(params)
                 full.update(trn_params)
@@ -133,6 +138,10 @@ class ShardedTrainer:
                 w = NDArray(params[n])
                 g = NDArray(grads[n])
                 st = jax.tree_util.tree_map(NDArray, opt_states[n])
+                # seed the update count with the TRACED step so Adam-family
+                # bias correction uses the true t under jit (the Python
+                # counter would bake t=1 into the compiled program)
+                optimizer._index_update_count[i] = t - 1
                 optimizer.update_multi_precision(i, w, g, st)
                 new_params[n] = w._data
                 new_states[n] = jax.tree_util.tree_map(
@@ -156,10 +165,10 @@ class ShardedTrainer:
         yb = shard_batch(y, self._mesh)._data if not (
             isinstance(y, NDArray) and _is_sharded(y._data)) else y._data
         self._rngkey, sub = jax.random.split(self._rngkey)
+        t = jnp.asarray(self._step_count + 1, jnp.float32)
         self._params, self._opt_states, loss = self._step_jit(
-            self._params, self._opt_states, sub, xb, yb)
+            self._params, self._opt_states, sub, t, xb, yb)
         self._step_count += 1
-        self._optimizer._index_update_count = {}  # host counts unused here
         return NDArray(loss)
 
     def forward(self, x, training=False):

@@ -1,0 +1,706 @@
+"""Symbol: the legacy lazy-graph API.
+
+Reference: python/mxnet/symbol/symbol.py (Symbol over nnvm graph handles,
+compose/infer_shape/bind/simple_bind, JSON save/load). TPU-native
+re-design: a Symbol is a lightweight Python DAG node over the SAME op
+registry the eager API uses; "binding" traces the DAG into one jax
+function and jits it — the executor's whole bind pipeline (gradient pass,
+memory planning, fusion, CSE: src/executor/graph_executor.cc:1004-1364)
+collapses into XLA compilation. Shape/type inference runs the DAG under
+``jax.eval_shape`` (abstract values only, no FLOPs).
+"""
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, dtype_np, dtype_name
+from ..context import current_context
+from ..ops.registry import get as get_op
+from ..ops import registry as _registry
+from .. import _rng, autograd
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones", "arange"]
+
+# aux-state parameter name suffixes (reference: BatchNorm aux states are
+# moving_mean/moving_var; executors separate arg vs aux arrays)
+_AUX_SUFFIXES = ("_moving_mean", "_moving_var", "_running_mean",
+                 "_running_var")
+
+
+class Symbol:
+    """A node in the symbolic graph."""
+
+    __slots__ = ("_op", "_params", "_inputs", "_name", "_attr", "_nout",
+                 "_out_index", "_shape_hint", "_dtype_hint")
+
+    def __init__(self, op, params, inputs, name, nout=1, out_index=None,
+                 attr=None):
+        self._op = op              # op name string, or None for variables
+        self._params = params or {}
+        self._inputs = list(inputs)
+        self._name = name
+        self._nout = nout
+        self._out_index = out_index  # select one output of a multi-out op
+        self._attr = dict(attr or {})
+        self._shape_hint = None
+        self._dtype_hint = None
+
+    # ------------------------------------------------------------- intro --
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        return self._attr.get(key)
+
+    def list_attr(self):
+        return dict(self._attr)
+
+    def _is_var(self):
+        return self._op is None and self._out_index is None
+
+    def _is_group(self):
+        return self._op == "_group"
+
+    def _topo(self):
+        """Post-order DAG traversal (deduped)."""
+        seen = {}
+        order = []
+
+        def visit(s):
+            if id(s) in seen:
+                return
+            seen[id(s)] = s
+            for i in s._inputs:
+                visit(i)
+            order.append(s)
+        visit(self)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        """All variable names, in graph order, excluding aux states
+        (reference: symbol.py list_arguments)."""
+        return [s._name for s in self._topo()
+                if s._is_var() and not s._name.endswith(_AUX_SUFFIXES)]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [s._name for s in self._topo()
+                if s._is_var() and s._name.endswith(_AUX_SUFFIXES)]
+
+    def list_inputs(self):
+        return [s._name for s in self._topo() if s._is_var()]
+
+    def list_outputs(self) -> List[str]:
+        if self._is_group():
+            out = []
+            for i in self._inputs:
+                out.extend(i.list_outputs())
+            return out
+        base = self._name
+        if self._nout == 1 or self._out_index is not None:
+            return [base + "_output"]
+        return [f"{base}_output{i}" for i in range(self._nout)]
+
+    @property
+    def num_outputs(self):
+        if self._is_group():
+            return sum(i.num_outputs for i in self._inputs)
+        return 1 if self._out_index is not None else self._nout
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            index = names.index(index)
+        if self._is_group():
+            return self._inputs[index]
+        if self._nout == 1:
+            if index != 0:
+                raise IndexError(f"index {index} out of range")
+            return self
+        return Symbol(self._op, self._params, self._inputs,
+                      self._name, nout=self._nout, out_index=index,
+                      attr=self._attr)
+
+    def __iter__(self):
+        return (self[i] for i in range(self.num_outputs))
+
+    def __len__(self):
+        return self.num_outputs
+
+    def get_internals(self):
+        """Group of every node's outputs (reference: symbol.py
+        get_internals) — used to cut feature extractors."""
+        nodes = [s for s in self._topo() if not s._is_group()]
+        return Group(nodes)
+
+    def get_children(self):
+        return Group(self._inputs) if self._inputs else None
+
+    def __repr__(self):
+        if self._is_var():
+            return f"<Symbol variable {self._name}>"
+        return f"<Symbol {self._name}>"
+
+    # ------------------------------------------------------- composition --
+    def __call__(self, *args, **kwargs):
+        """Compose: substitute this graph's free variables with other
+        symbols (reference: symbol.py __call__/_compose)."""
+        s = self._deepcopy({})
+        s._compose(*args, **kwargs)
+        return s
+
+    def _deepcopy(self, memo):
+        if id(self) in memo:
+            return memo[id(self)]
+        cp = Symbol(self._op, dict(self._params),
+                    [i._deepcopy(memo) for i in self._inputs],
+                    self._name, nout=self._nout,
+                    out_index=self._out_index, attr=self._attr)
+        cp._shape_hint = self._shape_hint
+        cp._dtype_hint = self._dtype_hint
+        memo[id(self)] = cp
+        return cp
+
+    def _compose(self, *args, **kwargs):
+        if args and kwargs:
+            raise TypeError(
+                "compose accepts positional or keyword, not both")
+        variables = [s for s in self._topo() if s._is_var()]
+        if args:
+            if len(args) > len(variables):
+                raise ValueError("too many positional arguments")
+            mapping = dict(zip([v._name for v in variables], args))
+        else:
+            mapping = kwargs
+        for node in self._topo():
+            node._inputs = [
+                mapping.get(i._name, i) if i._is_var() else i
+                for i in node._inputs]
+
+    # ---------------------------------------------------------- evaluate --
+    def _build_fn(self, input_names: List[str], collect_aux=False,
+                  is_train=None, rng_from_input=False):
+        """Trace the DAG into fn(*arrays) following input_names order.
+
+        collect_aux: additionally return {aux_var_name: new_value} for
+        BatchNorm-style running-stat updates (the reference's executors
+        mutate aux arrays inside the op, src/operator/nn/batch_norm.cc;
+        here they thread functionally so the whole graph stays jittable).
+        rng_from_input: the first array is a PRNG key (jit-friendly
+        dropout — keys must be traced inputs, not baked constants)."""
+        order = self._topo()
+
+        def fn(*arrays):
+            if rng_from_input:
+                rngkey, arrays = arrays[0], arrays[1:]
+                rngcount = [0]
+            env = dict(zip(input_names, arrays))
+            training = (autograd.is_training() if is_train is None
+                        else is_train)
+            aux_updates = {}
+            vals: Dict[int, object] = {}
+            for node in order:
+                if node._is_var():
+                    if node._name not in env:
+                        raise MXNetError(
+                            f"unbound symbol variable {node._name!r}")
+                    vals[id(node)] = env[node._name]
+                elif node._is_group():
+                    outs = []
+                    for i in node._inputs:
+                        v = vals[id(i)]
+                        outs.extend(v if isinstance(v, tuple) else [v])
+                    vals[id(node)] = tuple(outs)
+                else:
+                    op = get_op(node._op)
+                    ins = []
+                    for i in node._inputs:
+                        v = vals[id(i)]
+                        if i._out_index is not None and \
+                                isinstance(v, tuple):
+                            v = v[i._out_index]
+                        elif isinstance(v, tuple) and not i._is_group():
+                            v = v[0]
+                        ins.append(v)
+                    params = dict(node._params)
+                    if op.needs_rng and "rng" not in params:
+                        if rng_from_input:
+                            params["rng"] = jax.random.fold_in(
+                                rngkey, rngcount[0])
+                            rngcount[0] += 1
+                        else:
+                            params["rng"] = _rng.next_key()
+                    if op.needs_train and "_training" not in params:
+                        params["_training"] = training
+                    if collect_aux and node._op in ("BatchNorm",
+                                                    "batch_norm") and \
+                            training and not params.get(
+                                "use_global_stats", False):
+                        params["output_mean_var"] = True
+                        out, bmean, bvar = op.impl(*ins, **params)
+                        mom = params.get("momentum", 0.9)
+                        mvar_sym = node._inputs[4]
+                        mmean_sym = node._inputs[3]
+                        aux_updates[mmean_sym._name] = \
+                            ins[3] * mom + bmean * (1 - mom)
+                        aux_updates[mvar_sym._name] = \
+                            ins[4] * mom + bvar * (1 - mom)
+                        vals[id(node)] = out
+                        continue
+                    if op.variadic:
+                        out = op.impl(list(ins), **params)
+                    else:
+                        out = op.impl(*ins, **params)
+                    vals[id(node)] = tuple(out) if isinstance(
+                        out, (list, tuple)) else out
+            root = vals[id(self)]
+            if self._out_index is not None and isinstance(root, tuple):
+                root = root[self._out_index]
+            if collect_aux:
+                return root, aux_updates
+            return root
+
+        return fn
+
+    def eval_dict(self, bindings):
+        """Evaluate with {name: NDArray} bindings; returns NDArray or
+        list (reference: symbol.py eval)."""
+        from ..ndarray import NDArray
+        names = self.list_inputs()
+        arrays = []
+        for n in names:
+            if n not in bindings:
+                raise MXNetError(f"missing binding for {n}")
+            v = bindings[n]
+            arrays.append(v._data if isinstance(v, NDArray) else
+                          jnp.asarray(v))
+        out = self._build_fn(names)(*arrays)
+        if isinstance(out, tuple):
+            return [NDArray(o) for o in out]
+        return NDArray(out)
+
+    def eval(self, ctx=None, **kwargs):
+        out = self.eval_dict(kwargs)
+        return out if isinstance(out, list) else [out]
+
+    # ------------------------------------------------------------- infer --
+    def infer_shape(self, *args, **kwargs):
+        """(arg_shapes, out_shapes, aux_shapes) via jax.eval_shape
+        (reference: symbol.py infer_shape → MXSymbolInferShape)."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except Exception:
+            return None, None, None
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        names = self.list_inputs()
+        known = {k: tuple(v) for k, v in kwargs.items() if v is not None}
+        if args:
+            known.update({k: tuple(v) for k, v in
+                          zip(self.list_arguments(), args)
+                          if v is not None})
+        for n in names:
+            if n not in known:
+                hint = self._find_var(n)._shape_hint
+                if hint:
+                    known[n] = tuple(hint)
+        shape_of, out_shapes = self._solve_shapes(known, partial)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        if not partial:
+            missing = [n for n in names if n not in shape_of]
+            if missing:
+                raise MXNetError(f"unknown shape for inputs {missing}")
+        return ([shape_of.get(n) for n in arg_names],
+                out_shapes,
+                [shape_of.get(n) for n in aux_names])
+
+    def _solve_shapes(self, known, partial=False):
+        """Topo-order shape propagation with parameter-shape deduction
+        (the reference's bidirectional infer pass,
+        src/executor/infer_graph_attr_pass.cc: weight shapes are deduced
+        from data shapes + op attrs)."""
+        shape_of = dict(known)
+        node_out: Dict[int, object] = {}
+        for node in self._topo():
+            if node._is_var():
+                if node._name in shape_of:
+                    node_out[id(node)] = shape_of[node._name]
+                continue
+            if node._is_group():
+                outs = []
+                ok = True
+                for i in node._inputs:
+                    s = node_out.get(id(i))
+                    if s is None:
+                        ok = False
+                        break
+                    outs.extend(s if isinstance(s, list) else [s])
+                if ok:
+                    node_out[id(node)] = outs
+                continue
+            # deduce unknown parameter-variable inputs from data shape
+            _deduce_param_shapes(node, node_out, shape_of)
+            ins = []
+            ok = True
+            for i in node._inputs:
+                s = node_out.get(id(i))
+                if s is None and i._is_var():
+                    s = shape_of.get(i._name)
+                if s is None:
+                    ok = False
+                    break
+                if isinstance(s, list):
+                    s = s[i._out_index or 0]
+                ins.append(tuple(s))
+            if not ok:
+                if partial:
+                    continue
+                raise MXNetError(
+                    f"shape inference stuck at node {node._name!r} "
+                    f"(op {node._op})")
+            op = get_op(node._op)
+            params = dict(node._params)
+            if op.needs_rng:
+                params["rng"] = jax.random.key(0)
+            if op.needs_train:
+                params["_training"] = False
+            specs = [jax.ShapeDtypeStruct(s, jnp.float32) for s in ins]
+            if op.variadic:
+                out = jax.eval_shape(
+                    lambda *xs: op.impl(list(xs), **params), *specs)
+            else:
+                out = jax.eval_shape(
+                    lambda *xs: op.impl(*xs, **params), *specs)
+            if isinstance(out, (tuple, list)):
+                node_out[id(node)] = [tuple(o.shape) for o in out]
+            else:
+                node_out[id(node)] = tuple(out.shape)
+        root = node_out.get(id(self))
+        if root is None:
+            out_shapes = None
+        elif isinstance(root, list):
+            if self._out_index is not None:
+                out_shapes = [root[self._out_index]]
+            else:
+                out_shapes = list(root)
+        else:
+            out_shapes = [root]
+        return shape_of, out_shapes
+
+    def infer_type(self, *args, **kwargs):
+        names = self.list_inputs()
+        arg_names = self.list_arguments()
+        known = dict(kwargs)
+        if args:
+            known.update(dict(zip(arg_names, args)))
+        # need shapes to eval; use hints or (1,)*4
+        dummy = []
+        for n in names:
+            hint = self._find_var(n)._shape_hint or (1,)
+            dt = known.get(n, self._find_var(n)._dtype_hint or "float32")
+            dummy.append(jax.ShapeDtypeStruct(tuple(hint), dtype_np(dt)))
+        try:
+            out = jax.eval_shape(self._build_fn(names), *dummy)
+        except Exception:
+            return None, None, None
+        outs = out if isinstance(out, tuple) else (out,)
+        aux_names = self.list_auxiliary_states()
+        dt_of = dict(zip(names, [d.dtype for d in dummy]))
+        return ([_np.dtype(dt_of[n]) for n in arg_names],
+                [_np.dtype(o.dtype) for o in outs],
+                [_np.dtype(dt_of[n]) for n in aux_names])
+
+    def _find_var(self, name):
+        for s in self._topo():
+            if s._is_var() and s._name == name:
+                return s
+        raise KeyError(name)
+
+    # -------------------------------------------------------------- bind --
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Allocate arrays from inferred shapes and bind
+        (reference: symbol.py simple_bind → GraphExecutor::Init)."""
+        from ..executor import Executor
+        arg_shapes, _, aux_shapes = self._infer_shape_impl(False, **kwargs)
+        if arg_shapes is None:
+            raise MXNetError("cannot infer shapes for simple_bind")
+        from ..ndarray import NDArray
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        args = {n: NDArray(jnp.zeros(s, jnp.float32))
+                for n, s in zip(arg_names, arg_shapes)}
+        args_grad = None
+        if grad_req != "null":
+            args_grad = {n: NDArray(jnp.zeros(s, jnp.float32))
+                         for n, s in zip(arg_names, arg_shapes)}
+        aux = {n: NDArray(jnp.zeros(s, jnp.float32))
+               for n, s in zip(aux_names, aux_shapes)}
+        return Executor(self, ctx, args, args_grad, grad_req, aux)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        """Bind with explicit arrays (reference: symbol.py bind)."""
+        from ..executor import Executor
+        arg_names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        aux_names = self.list_auxiliary_states()
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        return Executor(self, ctx, args or {}, args_grad, grad_req,
+                        aux_states or {})
+
+    # ------------------------------------------------------------ grad ----
+    def gradient(self, wrt):
+        raise NotImplementedError(
+            "symbol.gradient: use Executor.backward (jax.vjp underneath)")
+
+    # ----------------------------------------------------------- save/load -
+    def tojson(self):
+        """Serialize the DAG to JSON (reference format has nodes/heads;
+        this carries the same structure so graphs round-trip)."""
+        order = self._topo()
+        idx = {id(s): i for i, s in enumerate(order)}
+        nodes = []
+        for s in order:
+            nodes.append({
+                "op": s._op or "null",
+                "name": s._name,
+                "attrs": {k: json.dumps(v) for k, v in s._params.items()},
+                "inputs": [[idx[id(i)], i._out_index or 0, 0]
+                           for i in s._inputs],
+                "nout": s._nout,
+            })
+        return json.dumps({"nodes": nodes,
+                           "heads": [[idx[id(self)],
+                                      self._out_index or 0, 0]],
+                           "mxnet_tpu_version": 1}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ------------------------------------------------------- operators ----
+    def _binop(self, other, opname, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            ins = [other, self] if reverse else [self, other]
+            return _make_node(opname, ins, {})
+        params = {"scalar": float(other)}
+        return _make_node(scalar_op, [self], params)
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binop(o, "broadcast_sub", "_rminus_scalar",
+                           reverse=True) if isinstance(o, Symbol) else \
+            _make_node("_rminus_scalar", [self], {"scalar": float(o)})
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        if isinstance(o, Symbol):
+            return self._binop(o, "broadcast_div", "_rdiv_scalar",
+                               reverse=True)
+        return _make_node("_rdiv_scalar", [self], {"scalar": float(o)})
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return _make_node("negative", [self], {})
+
+    # method mirrors used by legacy model code
+    def reshape(self, shape):
+        return _make_node("reshape", [self], {"shape": tuple(shape)})
+
+    def transpose(self, *axes):
+        return _make_node("transpose", [self],
+                          {"axes": axes or None})
+
+    def sum(self, axis=None, keepdims=False):
+        return _make_node("sum", [self], {"axis": axis,
+                                          "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _make_node("mean", [self], {"axis": axis,
+                                           "keepdims": keepdims})
+
+
+def _deduce_param_shapes(node, node_out, shape_of):
+    """Fill unknown parameter-variable shapes of one node from its data
+    input's shape (reference: each op's shape function, e.g.
+    src/operator/nn/fully_connected.cc FullyConnectedShape)."""
+    ins = node._inputs
+    if not ins or not ins[0]._is_var() and id(ins[0]) not in node_out:
+        pass
+    data_shape = None
+    if ins:
+        d = ins[0]
+        data_shape = node_out.get(id(d)) or (
+            shape_of.get(d._name) if d._is_var() else None)
+        if isinstance(data_shape, list):
+            data_shape = data_shape[d._out_index or 0]
+    if data_shape is None:
+        return
+    p = node._params
+
+    def put(i, shape):
+        if i < len(ins) and ins[i]._is_var() and \
+                ins[i]._name not in shape_of:
+            shape_of[ins[i]._name] = tuple(shape)
+            node_out[id(ins[i])] = tuple(shape)
+
+    op = node._op
+    import functools
+    import operator as _op_mod
+    if op == "FullyConnected":
+        nh = p.get("num_hidden", 0)
+        if p.get("flatten", True):
+            in_units = functools.reduce(_op_mod.mul, data_shape[1:], 1)
+        else:
+            in_units = data_shape[-1]
+        put(1, (nh, in_units))
+        put(2, (nh,))
+    elif op in ("Convolution", "Deconvolution"):
+        kernel = tuple(p.get("kernel") or ())
+        nf = p.get("num_filter", 0)
+        ng = p.get("num_group", 1)
+        c = data_shape[1]
+        if op == "Convolution":
+            put(1, (nf, c // ng) + kernel)
+        else:
+            put(1, (c, nf // ng) + kernel)
+        put(2, (nf,))
+    elif op in ("BatchNorm", "batch_norm"):
+        c = data_shape[p.get("axis", 1)]
+        for i in range(1, 5):
+            put(i, (c,))
+    elif op in ("LayerNorm", "layer_norm"):
+        c = data_shape[p.get("axis", -1)]
+        put(1, (c,))
+        put(2, (c,))
+    elif op in ("InstanceNorm", "GroupNorm"):
+        c = data_shape[1]
+        put(1, (c,))
+        put(2, (c,))
+    elif op == "Embedding":
+        put(1, (p.get("input_dim", 0), p.get("output_dim", 0)))
+    elif op in ("SoftmaxOutput", "softmax_cross_entropy"):
+        put(1, data_shape[:-1])
+    elif op in ("LinearRegressionOutput", "LogisticRegressionOutput",
+                "MAERegressionOutput"):
+        put(1, data_shape)
+    elif op == "LeakyReLU" and p.get("act_type") == "prelu":
+        put(1, (data_shape[1],))
+
+
+_NAME_COUNTER: Dict[str, int] = {}
+
+
+def _auto_name(hint):
+    n = _NAME_COUNTER.get(hint, 0)
+    _NAME_COUNTER[hint] = n + 1
+    return f"{hint}{n}"
+
+
+def _make_node(opname, inputs, params, name=None, nout=1):
+    op = get_op(opname)
+    return Symbol(opname, params, inputs,
+                  name or _auto_name(opname.lower().lstrip("_")),
+                  nout=op.nout)
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+        dtype=None, init=None, stype=None, **kwargs):
+    """Create a symbolic variable (reference: symbol.py var)."""
+    s = Symbol(None, None, [], name, attr=attr)
+    if shape is not None:
+        s._shape_hint = tuple(shape)
+    if dtype is not None:
+        s._dtype_hint = dtype
+    return s
+
+
+Variable = var
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol
+    (reference: symbol.py Group)."""
+    symbols = list(symbols)
+    g = Symbol("_group", None, symbols, _auto_name("group"),
+               nout=len(symbols))
+    return g
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = data["nodes"]
+    built: List[Symbol] = []
+    for nd_ in nodes:
+        if nd_["op"] == "null":
+            s = var(nd_["name"])
+        else:
+            ins = []
+            for (i, oi, _) in nd_["inputs"]:
+                src = built[i]
+                ins.append(src[oi] if src.num_outputs > 1 else src)
+            params = {k: json.loads(v) for k, v in
+                      nd_.get("attrs", {}).items()}
+            # JSON round-trips tuples as lists; normalize
+            params = {k: tuple(v) if isinstance(v, list) else v
+                      for k, v in params.items()}
+            s = _make_node(nd_["op"], ins, params, name=nd_["name"])
+        built.append(s)
+    head_idx, head_out, _ = data["heads"][0]
+    head = built[head_idx]
+    if head.num_outputs > 1 and head_out:
+        head = head[head_out]
+    return head
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _make_node("_zeros", [], {"shape": tuple(shape),
+                                     "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _make_node("_ones", [], {"shape": tuple(shape),
+                                    "dtype": dtype})
+
+
+def arange(start, stop=None, step=1.0, **kwargs):
+    return _make_node("_arange", [], {"start": start, "stop": stop,
+                                      "step": step})

@@ -193,3 +193,44 @@ def test_kvstore_updater():
     out = mx.nd.zeros((4,))
     kv.pull("w", out=out)
     np.testing.assert_allclose(out.asnumpy(), np.full(4, -0.1), rtol=1e-6)
+
+
+def test_sharded_trainer_adam_matches_eager():
+    # Adam bias correction must track the true step count under jit
+    # (regression: t was baked at 1 into the compiled step)
+    _require_devices(8)
+    np.random.seed(3)
+    x = np.random.randn(16, 5).astype(np.float32)
+    y = np.random.randint(0, 2, 16).astype(np.float32)
+
+    def make_net(seed):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(6, activation="tanh", in_units=5),
+                    nn.Dense(2, in_units=6))
+        net.initialize()
+        return net
+
+    netA, netB = make_net(11), make_net(11)
+    L = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(netA.collect_params(), "adam",
+                               {"learning_rate": 0.05})
+    for _ in range(5):
+        with mx.autograd.record():
+            loss = L(netA(mx.nd.array(x)), mx.nd.array(y))
+        loss.backward()
+        trainer.step(x.shape[0])
+
+    tr = parallel.ShardedTrainer(netB, L, "adam",
+                                 {"learning_rate": 0.05},
+                                 mesh=parallel.local_mesh())
+    for _ in range(5):
+        tr.step(x, y)
+    tr.sync_block()
+    for (ka, va), (kb, vb) in zip(sorted(netA.collect_params().items()),
+                                  sorted(netB.collect_params().items())):
+        np.testing.assert_allclose(va.data().asnumpy(),
+                                   vb.data().asnumpy(), rtol=2e-3,
+                                   atol=1e-5), ka

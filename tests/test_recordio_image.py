@@ -1,0 +1,127 @@
+"""RecordIO / image pipeline tests (reference: tests/python/unittest/
+test_recordio.py, test_image.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def test_recordio_roundtrip(tmp_path):
+    f = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(f, "w")
+    for i in range(5):
+        w.write(f"record{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(f, "r")
+    for i in range(5):
+        assert r.read() == f"record{i}".encode()
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    f = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx, f, "w")
+    for i in range(5):
+        w.write_idx(i, f"rec{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, f, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+    assert r.keys == [0, 1, 2, 3, 4]
+    r.close()
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 7.0, 123, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.label == 7.0
+    assert h2.id == 123
+    # multi-label
+    h = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 5, 0)
+    s = recordio.pack(h, b"x")
+    h2, payload = recordio.unpack(s)
+    np.testing.assert_array_equal(h2.label, [1, 2, 3])
+
+
+def test_pack_unpack_img(tmp_path):
+    img = (np.random.RandomState(0).rand(32, 32, 3) * 255).astype(np.uint8)
+    h = recordio.IRHeader(0, 2.0, 1, 0)
+    s = recordio.pack_img(h, img, quality=100, img_fmt=".png")
+    h2, img2 = recordio.unpack_img(s)
+    assert h2.label == 2.0
+    np.testing.assert_array_equal(img, img2)  # png is lossless
+
+
+def test_image_imdecode_resize():
+    import cv2
+    img = (np.random.RandomState(1).rand(40, 60, 3) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".png", cv2.cvtColor(img, cv2.COLOR_RGB2BGR))
+    out = mx.image.imdecode(buf.tobytes())
+    np.testing.assert_array_equal(out.asnumpy(), img)
+    r = mx.image.imresize(out, 30, 20)
+    assert r.shape == (20, 30, 3)
+    rs = mx.image.resize_short(out, 20)
+    assert min(rs.shape[:2]) == 20
+
+
+def test_image_iter_from_rec(tmp_path):
+    f = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, f, "w")
+    rng = np.random.RandomState(0)
+    for i in range(10):
+        img = (rng.rand(36, 36, 3) * 255).astype(np.uint8)
+        h = recordio.IRHeader(0, float(i % 3), i, 0)
+        w.write_idx(i, recordio.pack_img(h, img, img_fmt=".png"))
+    w.close()
+
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imgrec=f)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4,)
+
+
+def test_record_file_dataset(tmp_path):
+    f = str(tmp_path / "ds.rec")
+    idx = str(tmp_path / "ds.idx")
+    w = recordio.MXIndexedRecordIO(idx, f, "w")
+    for i in range(6):
+        w.write_idx(i, f"sample{i}".encode())
+    w.close()
+    ds = mx.gluon.data.RecordFileDataset(f)
+    assert len(ds) == 6
+    assert ds[2] == b"sample2"
+
+
+def test_recordio_payload_containing_magic(tmp_path):
+    # dmlc-core multipart framing: payloads containing the magic word are
+    # split on write and rejoined on read
+    import struct
+    magic = struct.pack("<I", 0xced7230a)
+    payloads = [b"head" + magic + b"tail",
+                magic + b"x", b"y" + magic, magic * 3, b"plain"]
+    f = str(tmp_path / "magic.rec")
+    w = recordio.MXRecordIO(f, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(f, "r")
+    for p in payloads:
+        assert r.read() == p
+    r.close()
+
+
+def test_ndarray_iter_discard():
+    x = np.arange(20).reshape(10, 2).astype(np.float32)
+    it = mx.io.NDArrayIter(x, np.arange(10), batch_size=4,
+                           last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 2
+    for b in batches:
+        assert b.data[0].shape == (4, 2)

@@ -1,0 +1,171 @@
+"""Symbol / Executor / Module tests.
+
+Modeled on the reference's tests/python/unittest/test_symbol.py,
+test_module.py, test_executor.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym_mod
+
+
+def _mlp_symbol():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=4)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_symbol_compose_and_arguments():
+    net = _mlp_symbol()
+    args = net.list_arguments()
+    assert "data" in args
+    assert "fc1_weight" in args and "fc2_bias" in args
+    assert "softmax_label" in args
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_symbol_infer_shape():
+    net = _mlp_symbol()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(
+        data=(8, 10), softmax_label=(8,), fc1_weight=(16, 10),
+        fc1_bias=(16,), fc2_weight=(4, 16), fc2_bias=(4,))
+    assert out_shapes == [(8, 4)]
+    assert aux_shapes == []
+
+
+def test_symbol_eval():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = (a + b) * 2
+    out = c.eval_dict({"a": mx.nd.ones((2, 2)),
+                       "b": mx.nd.ones((2, 2)) * 3})
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 8.0))
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    net = _mlp_symbol()
+    f = str(tmp_path / "net-symbol.json")
+    net.save(f)
+    net2 = sym_mod.load(f)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+
+
+def test_symbol_getitem_multi_output():
+    x = mx.sym.var("x")
+    g = sym_mod.Group([x * 2, x + 1])
+    assert g.num_outputs == 2
+    outs = g.eval_dict({"x": mx.nd.ones((2,))})
+    np.testing.assert_allclose(outs[0].asnumpy(), [2, 2])
+    np.testing.assert_allclose(outs[1].asnumpy(), [2, 2])
+
+
+def test_executor_forward_backward():
+    x = mx.sym.var("x")
+    w = mx.sym.var("w")
+    y = mx.sym.sum(x * w)
+    ex = y.bind(args={"x": mx.nd.array([1.0, 2.0, 3.0]),
+                      "w": mx.nd.array([4.0, 5.0, 6.0])},
+                args_grad={"x": mx.nd.zeros((3,)),
+                           "w": mx.nd.zeros((3,))})
+    out = ex.forward(is_train=True)[0]
+    assert float(out.asscalar()) == 32.0
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [4, 5, 6])
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), [1, 2, 3])
+
+
+def test_executor_simple_bind():
+    net = _mlp_symbol()
+    ex = net.simple_bind(data=(8, 10), softmax_label=(8,))
+    assert ex.arg_dict["fc1_weight"].shape == (16, 10)
+    out = ex.forward(is_train=False, data=mx.nd.ones((8, 10)))[0]
+    assert out.shape == (8, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1),
+                               np.ones(8), rtol=1e-5)
+
+
+def test_module_fit_convergence():
+    np.random.seed(0)
+    mx.random.seed(0)
+    n = 512
+    x = np.random.randn(n, 16).astype(np.float32)
+    y = (x[:, :8].sum(axis=1) > x[:, 8:].sum(axis=1)).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(x, y, batch_size=32)
+
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train, eval_data=val, num_epoch=6,
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    np.random.seed(0)
+    net = _mlp_symbol()
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    prefix = str(tmp_path / "chk")
+    mod.save_checkpoint(prefix, 3)
+
+    mod2 = mx.mod.Module.load(prefix, 3)
+    mod2.bind(data_shapes=[("data", (4, 10))],
+              label_shapes=[("softmax_label", (4,))])
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 10))],
+                            label=[mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               mod2.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_ndarray_iter_pad_and_shuffle():
+    x = np.arange(20).reshape(10, 2).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+
+    it2 = mx.io.NDArrayIter(x, y, batch_size=5, shuffle=True)
+    seen = np.sort(np.concatenate(
+        [b.label[0].asnumpy() for b in it2]))
+    np.testing.assert_array_equal(seen, np.arange(10))
+
+
+def test_bucketing_module():
+    np.random.seed(0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        fc = mx.sym.FullyConnected(data, name="fc_shared", num_hidden=4)
+        out = mx.sym.SoftmaxOutput(fc, label, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind(data_shapes=[("data", (2, 8))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    for key, shape in ((8, (2, 8)), (8, (2, 8))):
+        batch = mx.io.DataBatch(
+            data=[mx.nd.ones(shape)], label=[mx.nd.zeros((2,))],
+            bucket_key=key,
+            provide_data=[mx.io.DataDesc("data", shape)],
+            provide_label=[mx.io.DataDesc("softmax_label", (2,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert mod.get_outputs()[0].shape == (2, 4)
