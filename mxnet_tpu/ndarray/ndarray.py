@@ -123,6 +123,19 @@ class NDArray:
         for i in range(len(self)):
             yield self[i]
 
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __index__(self):
+        v = self.asscalar()
+        if isinstance(v, (bool, _np.bool_)) or \
+                not isinstance(v, (int, _np.integer)):
+            raise TypeError("only integer arrays can be used as an index")
+        return int(v)
+
     # ------------------------------------------------------- sync points --
     def asnumpy(self) -> _np.ndarray:
         """Blocking device→host copy (reference: NDArray::SyncCopyToCPU)."""

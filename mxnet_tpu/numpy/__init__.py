@@ -1,0 +1,101 @@
+"""mx.np — NumPy-compatible frontend (reference: python/mxnet/numpy/).
+
+The namespace is generated from ``jax.numpy``: every listed function is the
+jnp implementation routed through the autograd/boxing bridge in
+``multiarray.dispatch``. See multiarray.py for the design rationale.
+"""
+import numpy as _onp
+import jax.numpy as _jnp
+
+from .multiarray import *  # noqa: F401,F403
+from .multiarray import ndarray, make_np_func, __all__ as _ma_all
+from . import random  # noqa: F401
+from . import linalg  # noqa: F401
+
+# --------------------------------------------------------------- constants --
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+PZERO, NZERO = 0.0, -0.0
+
+# dtype objects (the reference re-exports stock numpy dtypes; bfloat16 is
+# the TPU-native addition, taken from ml_dtypes via jnp)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+bfloat16 = _jnp.bfloat16
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+bool = _onp.bool_
+integer = _onp.integer
+floating = _onp.floating
+dtype = _onp.dtype
+_np_version = _onp.__version__
+
+# ------------------------------------------------- generated op namespace --
+# The mx.np function surface (reference: python/mxnet/numpy/multiarray.py
+# __all__ + fallback.py __all__), realized as jnp bridges. Names absent
+# from the installed jax version are simply skipped.
+_FROM_JNP = [
+    "abs", "absolute", "add", "all", "any", "append", "arccos", "arccosh",
+    "arcsin", "arcsinh", "arctan", "arctan2", "arctanh", "argmax", "argmin",
+    "argsort", "argwhere", "around", "array_split", "atleast_1d",
+    "atleast_2d", "atleast_3d", "average", "bincount", "bitwise_and",
+    "bitwise_not", "bitwise_or", "bitwise_xor", "blackman", "broadcast_to",
+    "broadcast_arrays", "cbrt", "ceil", "clip", "column_stack",
+    "concatenate", "copysign", "cos", "cosh", "count_nonzero", "cross",
+    "cumsum", "cumprod", "deg2rad", "degrees", "delete", "diag",
+    "diag_indices_from", "diagflat", "diagonal", "diff", "divide", "dot",
+    "dsplit", "dstack", "ediff1d", "einsum", "equal", "exp", "expand_dims",
+    "expm1", "fabs", "fill_diagonal", "flatnonzero", "flip",
+    "fliplr", "flipud", "floor", "floor_divide", "fmax", "fmin", "fmod",
+    "gcd", "greater", "greater_equal", "hamming", "hanning", "histogram",
+    "hsplit", "hstack", "hypot", "indices", "inner", "insert", "interp",
+    "invert", "isfinite", "isinf", "isnan", "isneginf", "isposinf", "kron",
+    "lcm", "ldexp", "less", "less_equal", "log", "log10", "log1p", "log2",
+    "logaddexp", "logical_and", "logical_not", "logical_or", "logical_xor",
+    "matmul", "maximum", "mean", "median", "min", "max", "minimum", "mod",
+    "moveaxis", "multiply", "nan_to_num", "nanmax", "nanmean", "nanmin",
+    "nanstd", "nansum", "nanvar", "negative", "nonzero", "not_equal",
+    "outer", "pad", "percentile", "polyval", "positive", "power", "prod",
+    "ptp", "quantile", "rad2deg", "radians", "ravel", "reciprocal",
+    "remainder", "repeat", "reshape", "resize", "rint", "roll", "rollaxis",
+    "rot90", "round", "round_", "searchsorted", "sign", "sin", "sinh",
+    "sort", "split", "sqrt", "square", "squeeze", "stack", "std",
+    "subtract", "sum", "swapaxes", "take", "take_along_axis", "tan", "tanh",
+    "tensordot", "tile", "trace", "transpose", "tril", "tril_indices",
+    "triu", "true_divide", "trunc", "unique", "unravel_index", "var",
+    "vdot", "vsplit", "vstack", "where",
+]
+
+_generated = []
+for _name in _FROM_JNP:
+    _jfn = getattr(_jnp, _name, None)
+    if _jfn is None:
+        continue
+    if _name not in globals():
+        globals()[_name] = make_np_func(_name, _jfn)
+    _generated.append(_name)
+
+# aliases the reference exposes
+row_stack = vstack          # noqa: F821
+bitwise_not = invert        # noqa: F821
+degrees = rad2deg           # noqa: F821
+radians = deg2rad           # noqa: F821
+fix = make_np_func("fix", _jnp.trunc)  # jnp.fix deprecated; trunc ≡ fix
+
+__all__ = list(_ma_all) + _generated + [
+    "pi", "e", "inf", "nan", "newaxis", "euler_gamma", "random", "linalg",
+    "float16", "float32", "float64", "bfloat16", "int8", "int16", "int32",
+    "int64", "uint8", "bool_", "dtype",
+]

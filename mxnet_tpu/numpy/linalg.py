@@ -1,0 +1,21 @@
+"""mx.np.linalg — NumPy-semantics linear algebra.
+
+Reference: python/mxnet/numpy/linalg.py (backed by src/operator/numpy/
+linalg/). Each function is the jax.numpy.linalg implementation routed
+through the autograd bridge, so decompositions are differentiable where
+jax defines VJPs.
+"""
+import jax.numpy as _jnp
+
+from .multiarray import make_np_func
+
+__all__ = ["norm", "svd", "cholesky", "qr", "inv", "pinv", "det",
+           "slogdet", "solve", "lstsq", "eig", "eigh", "eigvals",
+           "eigvalsh", "matrix_rank", "matrix_power", "multi_dot",
+           "tensorinv", "tensorsolve"]
+
+for _name in __all__:
+    _jfn = getattr(_jnp.linalg, _name, None)
+    if _jfn is not None:
+        globals()[_name] = make_np_func(_name, _jfn)
+del _name, _jfn

@@ -1,0 +1,539 @@
+"""mx.np ndarray and the generic numpy-op bridge.
+
+TPU-native re-design of the reference numpy frontend
+(reference: python/mxnet/numpy/multiarray.py, backed there by hand-written
+``_npi_*`` C++ kernels under src/operator/numpy/ — ~26k LoC). Here the
+entire op surface is one generic bridge: ``jax.numpy`` already implements
+NumPy semantics (zero-dim shapes, broadcasting, promotion) as traced XLA
+programs, so each ``mx.np`` function is the corresponding ``jnp`` function
+routed through ``ops.invoke.apply_fn`` for autograd taping and NDArray
+boxing. Shape/dtype semantics therefore come from the compiler stack, not
+from a per-op reimplementation.
+
+``ndarray`` subclasses the classic NDArray (same buffer, same autograd
+slots) and differs only in frontend semantics: comparisons return bool
+arrays, scalars promote numpy-style, indexing follows numpy, and the
+NEP-13/NEP-18 dispatch protocols route stock-numpy calls here (reference:
+python/mxnet/numpy_dispatch_protocol.py).
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from ..ops.invoke import apply_fn
+from ..util import is_np_default_dtype
+
+__all__ = ["ndarray", "array", "empty", "empty_like", "zeros", "ones",
+           "zeros_like", "ones_like",
+           "full", "full_like", "arange", "linspace", "logspace", "eye",
+           "identity", "meshgrid", "shape", "ndim", "size",
+           "may_share_memory", "shares_memory", "asarray", "from_numpy",
+           "copy", "save", "load"]
+
+# Ops whose outputs must never land on the autograd tape (integer/bool
+# outputs; reference marks these MakeZeroGradNodes).
+NONDIFF = frozenset({
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
+    "isfinite", "isinf", "isnan", "isneginf", "isposinf", "iscomplex",
+    "isreal", "argmax", "argmin", "argsort", "argwhere", "nonzero",
+    "flatnonzero", "searchsorted", "bincount", "unique", "sign",
+    "unravel_index", "diag_indices_from", "floor", "ceil", "trunc", "fix",
+    "rint", "around", "round", "round_", "all", "any", "lcm", "gcd",
+    "digitize", "count_nonzero",
+})
+
+
+def _default_float():
+    return onp.float64 if is_np_default_dtype() else onp.float32
+
+
+def _is_leaf(x):
+    return isinstance(x, NDArray)
+
+
+def _box(o):
+    """Wrap raw jax output(s) as mx.np ndarray(s)."""
+    if isinstance(o, (list, tuple)):
+        return type(o)(_box(v) for v in o)
+    return ndarray(o)
+
+
+def to_np(out):
+    """Convert apply_fn results (classic NDArray) to mx.np ndarray,
+    carrying autograd slots across."""
+    if isinstance(out, (list, tuple)):
+        return type(out)(to_np(o) for o in out)
+    if isinstance(out, NDArray) and not isinstance(out, ndarray):
+        return out.as_np_ndarray()
+    return out
+
+
+def dispatch(jfn, args, kwargs, differentiable=True, out=None):
+    """Run a jax.numpy function over mixed NDArray/array-like arguments
+    with autograd taping.
+
+    Array leaves (NDArray, jax.Array, tracers) anywhere in the argument
+    pytree become op inputs; everything else stays static. This is the
+    single chokepoint of the whole mx.np namespace — the analogue of the
+    reference's per-op ``_npi_*`` FFI shims (src/api/operator/**).
+    """
+    flat, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                               is_leaf=_is_leaf)
+    idx, arrs = [], []
+    for i, x in enumerate(flat):
+        if isinstance(x, NDArray):
+            idx.append(i)
+            arrs.append(x)
+        elif isinstance(x, jax.Array) or isinstance(x, jax.core.Tracer):
+            idx.append(i)
+            arrs.append(NDArray(x))
+    if not idx:
+        return _box(jfn(*args, **kwargs))
+
+    def fn(*xs):
+        cur = list(flat)
+        for j, x in zip(idx, xs):
+            cur[j] = x
+        a, kw = jax.tree_util.tree_unflatten(treedef, cur)
+        return jfn(*a, **kw)
+
+    return to_np(apply_fn(fn, arrs, differentiable=differentiable, out=out))
+
+
+def make_np_func(name, jfn):
+    """Build one mx.np namespace function from its jax.numpy counterpart."""
+    differentiable = name not in NONDIFF
+
+    def fn(*args, out=None, **kwargs):
+        return dispatch(jfn, args, kwargs, differentiable=differentiable,
+                        out=out)
+
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = (f"mx.np.{name}: NumPy-semantics op "
+                  f"(see numpy.{name}; autograd-aware, jit-traceable).")
+    return fn
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array (reference: mxnet.numpy.ndarray).
+
+    Shares buffer representation and autograd machinery with the classic
+    NDArray; only the frontend dialect differs.
+    """
+
+    __slots__ = ()
+
+    # ------------------------------------------------------ conversions --
+    def as_np_ndarray(self):
+        return self
+
+    def as_nd_ndarray(self):
+        out = NDArray(self._data)
+        out._ag_slot = self._ag_slot
+        out._grad = self._grad
+        return out
+
+    def __repr__(self):
+        if isinstance(self._data, jax.core.Tracer):
+            return f"<np.ndarray tracer {self.shape} {self.dtype}>"
+        return f"array({onp.array2string(self.asnumpy(), separator=', ')})"
+
+    # ---------------------------------------------------------- protocol --
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__":
+            return NotImplemented
+        import mxnet_tpu.numpy as _mod
+        f = getattr(_mod, ufunc.__name__, None)
+        if f is None:
+            return NotImplemented
+        out = kwargs.pop("out", None)
+        if out is not None:
+            out = out[0] if isinstance(out, tuple) and len(out) == 1 else out
+            kwargs["out"] = out
+        kwargs = {k: v for k, v in kwargs.items() if v is not None}
+        return f(*inputs, **kwargs)
+
+    def __array_function__(self, func, types, args, kwargs):
+        import mxnet_tpu.numpy as _mod
+        f = getattr(_mod, func.__name__, None)
+        if f is None:
+            return NotImplemented
+        return f(*args, **kwargs)
+
+    # ---------------------------------------------------------- indexing --
+    def __getitem__(self, key):
+        return to_np(super().__getitem__(key))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -------------------------------------------------------- arithmetic --
+    def _np_binop(self, other, jfn, differentiable=True, reverse=False):
+        a, b = (other, self) if reverse else (self, other)
+        return dispatch(jfn, (a, b), {}, differentiable=differentiable)
+
+    def __add__(self, o):
+        return self._np_binop(o, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._np_binop(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._np_binop(o, jnp.subtract, reverse=True)
+
+    def __mul__(self, o):
+        return self._np_binop(o, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._np_binop(o, jnp.true_divide)
+
+    def __rtruediv__(self, o):
+        return self._np_binop(o, jnp.true_divide, reverse=True)
+
+    def __floordiv__(self, o):
+        return self._np_binop(o, jnp.floor_divide, differentiable=False)
+
+    def __rfloordiv__(self, o):
+        return self._np_binop(o, jnp.floor_divide, differentiable=False,
+                              reverse=True)
+
+    def __mod__(self, o):
+        return self._np_binop(o, jnp.mod)
+
+    def __rmod__(self, o):
+        return self._np_binop(o, jnp.mod, reverse=True)
+
+    def __pow__(self, o):
+        return self._np_binop(o, jnp.power)
+
+    def __rpow__(self, o):
+        return self._np_binop(o, jnp.power, reverse=True)
+
+    def __matmul__(self, o):
+        return self._np_binop(o, jnp.matmul)
+
+    def __rmatmul__(self, o):
+        return self._np_binop(o, jnp.matmul, reverse=True)
+
+    def __neg__(self):
+        return dispatch(jnp.negative, (self,), {})
+
+    def __abs__(self):
+        return dispatch(jnp.abs, (self,), {})
+
+    def __invert__(self):
+        return dispatch(jnp.invert, (self,), {}, differentiable=False)
+
+    def __and__(self, o):
+        return self._np_binop(o, jnp.bitwise_and, differentiable=False)
+
+    def __or__(self, o):
+        return self._np_binop(o, jnp.bitwise_or, differentiable=False)
+
+    def __xor__(self, o):
+        return self._np_binop(o, jnp.bitwise_xor, differentiable=False)
+
+    # ------------------------------------------------------- comparisons --
+    def __eq__(self, o):  # noqa: D105 — elementwise, bool dtype
+        if o is None:
+            return False
+        return self._np_binop(o, jnp.equal, differentiable=False)
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._np_binop(o, jnp.not_equal, differentiable=False)
+
+    def __gt__(self, o):
+        return self._np_binop(o, jnp.greater, differentiable=False)
+
+    def __ge__(self, o):
+        return self._np_binop(o, jnp.greater_equal, differentiable=False)
+
+    def __lt__(self, o):
+        return self._np_binop(o, jnp.less, differentiable=False)
+
+    def __le__(self, o):
+        return self._np_binop(o, jnp.less_equal, differentiable=False)
+
+    __hash__ = object.__hash__
+
+    # ---------------------------------------------------------- methods --
+    def _m(self, jfn, *args, differentiable=True, **kwargs):
+        return dispatch(jfn, (self,) + args, kwargs,
+                        differentiable=differentiable)
+
+    def astype(self, dtype, copy=True):
+        d = dtype_np(dtype)
+        if not copy and self.dtype == d:
+            return self
+        return self._m(lambda x: x.astype(d))
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = tuple(kwargs.get("shape", shape))
+        return self._m(lambda x: jnp.reshape(x, shape))
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return self._m(lambda x: jnp.transpose(x, axes or None))
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def flatten(self, order="C"):
+        return self._m(lambda x: jnp.ravel(x, order=order))
+
+    def ravel(self, order="C"):
+        return self.flatten(order)
+
+    def squeeze(self, axis=None):
+        return self._m(lambda x: jnp.squeeze(x, axis))
+
+    def copy(self):
+        return self._m(jnp.copy)
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        return self._m(jnp.sum, axis=axis, dtype=dtype, keepdims=keepdims)
+
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        return self._m(jnp.mean, axis=axis, dtype=dtype, keepdims=keepdims)
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return self._m(jnp.std, axis=axis, ddof=ddof, keepdims=keepdims)
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return self._m(jnp.var, axis=axis, ddof=ddof, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._m(jnp.prod, axis=axis, keepdims=keepdims)
+
+    def cumsum(self, axis=None, dtype=None):
+        return self._m(jnp.cumsum, axis=axis, dtype=dtype)
+
+    def max(self, axis=None, keepdims=False):
+        return self._m(jnp.max, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._m(jnp.min, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None):
+        return self._m(jnp.argmax, axis=axis, differentiable=False)
+
+    def argmin(self, axis=None):
+        return self._m(jnp.argmin, axis=axis, differentiable=False)
+
+    def argsort(self, axis=-1):
+        return self._m(jnp.argsort, axis=axis, differentiable=False)
+
+    def sort(self, axis=-1):
+        return self._m(jnp.sort, axis=axis)
+
+    def clip(self, min=None, max=None):
+        return self._m(jnp.clip, min, max)
+
+    def round(self, decimals=0):
+        return self._m(jnp.round, decimals, differentiable=False)
+
+    def take(self, indices, axis=None, mode="clip"):
+        return dispatch(jnp.take, (self, indices),
+                        {"axis": axis, "mode": mode})
+
+    def repeat(self, repeats, axis=None):
+        return self._m(jnp.repeat, repeats, axis=axis)
+
+    def dot(self, b):
+        return dispatch(jnp.dot, (self, b), {})
+
+    def swapaxes(self, a1, a2):
+        return self._m(jnp.swapaxes, a1, a2)
+
+    def all(self, axis=None, keepdims=False):
+        return self._m(jnp.all, axis=axis, keepdims=keepdims,
+                       differentiable=False)
+
+    def any(self, axis=None, keepdims=False):
+        return self._m(jnp.any, axis=axis, keepdims=keepdims,
+                       differentiable=False)
+
+    def nonzero(self):
+        return self._m(jnp.nonzero, differentiable=False)
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise ValueError("mx.np arrays are always dense")
+        return self
+
+
+# ------------------------------------------------------------- creation ----
+def array(object, dtype=None, ctx=None):
+    """Create an mx.np array. Default dtype is float32 when building from
+    python lists/scalars (reference convention), source dtype otherwise."""
+    if isinstance(object, NDArray):
+        data = object._data
+        if dtype is not None:
+            data = data.astype(dtype_np(dtype))
+        return ndarray(data, ctx=ctx)
+    if dtype is None:
+        # reference convention: keep the source dtype for array inputs,
+        # default float32 (float64 under set_np_default_dtype) otherwise
+        dtype = getattr(object, "dtype", None) or _default_float()
+    return ndarray(jnp.asarray(object, dtype=dtype_np(dtype)), ctx=ctx)
+
+
+def asarray(obj, dtype=None):
+    if isinstance(obj, ndarray) and dtype is None:
+        return obj
+    return array(obj, dtype=dtype)
+
+
+def from_numpy(a, zero_copy=False):
+    return ndarray(jnp.asarray(a))
+
+
+def copy(a):
+    return asarray(a).copy()
+
+
+def zeros(shape, dtype=None, order="C", ctx=None):
+    return ndarray(jnp.zeros(shape, dtype_np(dtype or _default_float())),
+                   ctx=ctx)
+
+
+def ones(shape, dtype=None, order="C", ctx=None):
+    return ndarray(jnp.ones(shape, dtype_np(dtype or _default_float())),
+                   ctx=ctx)
+
+
+empty = zeros  # XLA buffers are always defined; empty == zeros here
+
+
+def empty_like(prototype, dtype=None, order="C"):
+    return zeros_like(prototype, dtype=dtype)
+
+
+def zeros_like(a, dtype=None, order="C", ctx=None):
+    return dispatch(jnp.zeros_like, (a,), {"dtype": dtype and dtype_np(dtype)},
+                    differentiable=False)
+
+
+def ones_like(a, dtype=None, order="C", ctx=None):
+    return dispatch(jnp.ones_like, (a,), {"dtype": dtype and dtype_np(dtype)},
+                    differentiable=False)
+
+
+def full(shape, fill_value, dtype=None, ctx=None, out=None):
+    if dtype is None:
+        dtype = _default_float() if isinstance(fill_value, float) else None
+    return dispatch(jnp.full, (shape, fill_value),
+                    {"dtype": dtype and dtype_np(dtype)}, out=out)
+
+
+def full_like(a, fill_value, dtype=None, ctx=None):
+    return dispatch(jnp.full_like, (a, fill_value),
+                    {"dtype": dtype and dtype_np(dtype)})
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    """Default dtype float32 (reference: mx.np.arange doc)."""
+    return ndarray(jnp.arange(start, stop, step,
+                              dtype_np(dtype or _default_float())))
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    out = dispatch(jnp.linspace, (start, stop, num),
+                   {"endpoint": endpoint, "retstep": retstep,
+                    "dtype": dtype_np(dtype or _default_float()),
+                    "axis": axis})
+    return out
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None):
+    return dispatch(jnp.logspace, (start, stop, num),
+                    {"endpoint": endpoint, "base": base,
+                     "dtype": dtype_np(dtype or _default_float()),
+                     "axis": axis})
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None):
+    return ndarray(jnp.eye(N, M, k, dtype_np(dtype or _default_float())))
+
+
+def identity(n, dtype=None, ctx=None):
+    return eye(n, dtype=dtype)
+
+
+def meshgrid(*xi, **kwargs):
+    return dispatch(jnp.meshgrid, xi, kwargs)
+
+
+# ------------------------------------------------------------- structure ---
+def shape(a):
+    return tuple(onp.shape(a._data if isinstance(a, NDArray) else a))
+
+
+def ndim(a):
+    return len(shape(a))
+
+
+def size(a, axis=None):
+    s = shape(a)
+    if axis is None:
+        n = 1
+        for d in s:
+            n *= d
+        return n
+    return s[axis]
+
+
+def may_share_memory(a, b, max_work=None):
+    """jax.Arrays are immutable; aliasing is invisible to the frontend."""
+    return False
+
+
+shares_memory = may_share_memory
+
+
+# ------------------------------------------------------------ save/load ----
+def save(file, arr):
+    """Save np array(s) in the framework container format
+    (mirrors mx.nd.save; reference: python/mxnet/numpy/utils.py save)."""
+    from .. import ndarray as _nd
+    if isinstance(arr, ndarray):
+        arr = [arr]
+    if isinstance(arr, dict):
+        _nd.save(file, {k: v.as_nd_ndarray() for k, v in arr.items()})
+    else:
+        _nd.save(file, [v.as_nd_ndarray() for v in arr])
+
+
+def load(file):
+    from .. import ndarray as _nd
+    out = _nd.load(file)
+    if isinstance(out, dict):
+        return {k: v.as_np_ndarray() for k, v in out.items()}
+    return [v.as_np_ndarray() for v in out]

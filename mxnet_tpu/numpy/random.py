@@ -1,0 +1,204 @@
+"""mx.np.random — NumPy-semantics sampling over the global PRNG.
+
+Reference: python/mxnet/numpy/random.py (backed by src/operator/numpy/
+random/). TPU-native design: every sampler is a direct ``jax.random`` call
+keyed from the process-global counter-based key (`mxnet_tpu._rng`), so
+eager calls are deterministic under `mx.random.seed` and traced calls
+(inside hybridized blocks) derive from the traced key.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from .. import _rng
+from ..base import dtype_np
+from .multiarray import ndarray, to_np
+from ..ops.invoke import apply_fn
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
+           "choice", "shuffle", "permutation", "multinomial", "beta",
+           "gamma", "exponential", "laplace", "logistic", "gumbel",
+           "lognormal", "pareto", "power", "rayleigh", "weibull",
+           "multivariate_normal", "binomial", "poisson", "chisquare"]
+
+
+def seed(seed_state):
+    _rng.seed(seed_state)
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _sample(fn, *ndarray_args, **static):
+    """Run a jax.random sampler with a fresh key; taped so samplers with
+    array parameters (e.g. normal(loc=arr)) backprop to those parameters
+    via the reparameterized form."""
+    key = _rng.next_key()
+    if ndarray_args:
+        return to_np(apply_fn(lambda *xs: fn(key, *xs, **static),
+                              list(ndarray_args)))
+    return ndarray(fn(key, **static))
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, out=None):
+    d = dtype_np(dtype or onp.float32)
+    if hasattr(low, "shape") or hasattr(high, "shape"):
+        def f(k, lo, hi):
+            sh = _shape(size) or jnp.broadcast_shapes(
+                jnp.shape(lo), jnp.shape(hi))
+            return jax.random.uniform(k, sh, d) * (hi - lo) + lo
+        return _sample(f, low, high)
+    return _sample(lambda k: jax.random.uniform(
+        k, _shape(size), d, minval=low, maxval=high))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    d = dtype_np(dtype or onp.float32)
+    if hasattr(loc, "shape") or hasattr(scale, "shape"):
+        def f(k, mu, sig):
+            sh = _shape(size) or jnp.broadcast_shapes(
+                jnp.shape(mu), jnp.shape(sig))
+            return jax.random.normal(k, sh, d) * sig + mu
+        return _sample(f, loc, scale)
+    return _sample(lambda k: jax.random.normal(k, _shape(size), d)
+                   * scale + loc)
+
+
+def randn(*size):
+    return normal(size=size or None)
+
+
+def rand(*size):
+    return uniform(size=size or None)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, out=None):
+    if high is None:
+        low, high = 0, low
+    d = dtype_np(dtype or onp.int32)
+    return _sample(lambda k: jax.random.randint(
+        k, _shape(size), low, high, dtype=d))
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    key = _rng.next_key()
+    arr = a._data if isinstance(a, ndarray) else jnp.asarray(a)
+    pr = p._data if hasattr(p, "_data") else p
+    if pr is not None:
+        pr = jnp.asarray(pr)
+    return ndarray(jax.random.choice(key, arr, _shape(size),
+                                     replace=replace, p=pr))
+
+
+def shuffle(x):
+    """In-place permutation along the first axis (mx.np semantics)."""
+    key = _rng.next_key()
+    x._data = jax.random.permutation(key, x._data, axis=0)
+
+
+def permutation(x):
+    key = _rng.next_key()
+    if isinstance(x, int):
+        return ndarray(jax.random.permutation(key, x))
+    arr = x._data if isinstance(x, ndarray) else jnp.asarray(x)
+    return ndarray(jax.random.permutation(key, arr, axis=0))
+
+
+def multinomial(n, pvals, size=None):
+    key = _rng.next_key()
+    p = pvals._data if hasattr(pvals, "_data") else jnp.asarray(pvals)
+    sh = _shape(size)
+    draws = jax.random.categorical(key, jnp.log(p), shape=sh + (n,))
+    counts = jax.vmap(lambda d: jnp.bincount(d, length=p.shape[-1]))(
+        draws.reshape(-1, n)) if sh else jnp.bincount(draws,
+                                                      length=p.shape[-1])
+    return ndarray(counts.reshape(sh + (p.shape[-1],)))
+
+
+def beta(a, b, size=None, dtype=None, ctx=None):
+    d = dtype_np(dtype or onp.float32)
+    return _sample(lambda k: jax.random.beta(
+        k, jnp.asarray(a, d), jnp.asarray(b, d), _shape(size) or None))
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    d = dtype_np(dtype or onp.float32)
+    return _sample(lambda k: jax.random.gamma(
+        k, jnp.asarray(shape, d), _shape(size) or jnp.shape(shape)) * scale)
+
+
+def exponential(scale=1.0, size=None, ctx=None, out=None):
+    return _sample(lambda k: jax.random.exponential(
+        k, _shape(size)) * scale)
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    return _sample(lambda k: jax.random.laplace(
+        k, _shape(size)) * scale + loc)
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    return _sample(lambda k: jax.random.logistic(
+        k, _shape(size)) * scale + loc)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    return _sample(lambda k: jax.random.gumbel(
+        k, _shape(size)) * scale + loc)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None, out=None):
+    return _sample(lambda k: jnp.exp(
+        jax.random.normal(k, _shape(size)) * sigma + mean))
+
+
+def pareto(a, size=None, ctx=None, out=None):
+    return _sample(lambda k: jax.random.pareto(
+        k, jnp.asarray(a, jnp.float32), _shape(size) or None) - 1.0)
+
+
+def power(a, size=None, ctx=None, out=None):
+    # X = U^(1/a): standard power distribution on [0, 1]
+    return _sample(lambda k: jax.random.uniform(
+        k, _shape(size)) ** (1.0 / jnp.asarray(a, jnp.float32)))
+
+
+def rayleigh(scale=1.0, size=None, ctx=None, out=None):
+    return _sample(lambda k: scale * jnp.sqrt(
+        -2.0 * jnp.log1p(-jax.random.uniform(k, _shape(size)))))
+
+
+def weibull(a, size=None, ctx=None, out=None):
+    return _sample(lambda k: jax.random.weibull_min(
+        k, 1.0, jnp.asarray(a, jnp.float32), _shape(size) or None))
+
+
+def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):
+    key = _rng.next_key()
+    m = mean._data if hasattr(mean, "_data") else jnp.asarray(mean)
+    c = cov._data if hasattr(cov, "_data") else jnp.asarray(cov)
+    return ndarray(jax.random.multivariate_normal(
+        key, m, c, _shape(size) or None))
+
+
+def binomial(n, p, size=None, dtype=None, ctx=None, out=None):
+    return _sample(lambda k: jax.random.binomial(
+        k, n, p, shape=_shape(size) or None))
+
+
+def poisson(lam=1.0, size=None, dtype=None, ctx=None, out=None):
+    return _sample(lambda k: jax.random.poisson(
+        k, lam, shape=_shape(size) or None))
+
+
+def chisquare(df, size=None, dtype=None, ctx=None):
+    d = dtype_np(dtype or onp.float32)
+    return _sample(lambda k: 2.0 * jax.random.gamma(
+        k, jnp.asarray(df, d) / 2.0, _shape(size) or None))

@@ -1,0 +1,70 @@
+"""mx.npx — operator extensions beyond the NumPy standard.
+
+Reference: python/mxnet/numpy_extension/__init__.py. Carries (a) the
+numpy-semantics switches (set_np family, re-exported from util), (b) the
+framework op surface that stock NumPy has no name for (convolution,
+batch_norm, softmax, embedding, pooling, sequence ops, ...), generated
+from the op registry with np-ndarray outputs, and (c) device/session
+helpers (cpu/gpu/num_gpus/waitall/seed).
+"""
+from __future__ import annotations
+
+import functools
+
+from ..util import (set_np, reset_np, set_np_shape, set_np_array,
+                    is_np_shape, is_np_array, is_np_default_dtype,
+                    set_np_default_dtype, np_shape, np_array, use_np,
+                    use_np_shape, use_np_array)
+from ..context import cpu, gpu, tpu, num_gpus, num_tpus, current_context
+from ..ops.registry import _REGISTRY
+from ..ndarray.register import make_op_func
+from ..numpy.multiarray import to_np, ndarray
+from ..numpy import random as _np_random
+from .. import _rng
+
+__all__ = ["set_np", "reset_np", "set_np_shape", "set_np_array",
+           "is_np_shape", "is_np_array", "is_np_default_dtype",
+           "set_np_default_dtype", "np_shape", "np_array", "use_np",
+           "use_np_shape", "use_np_array", "cpu", "gpu", "tpu",
+           "num_gpus", "num_tpus", "current_context", "current_device",
+           "seed", "waitall", "save", "load"]
+
+current_device = current_context
+
+
+def seed(seed_state):
+    _rng.seed(seed_state)
+
+
+def waitall():
+    from .. import ndarray as _nd
+    _nd.waitall()
+
+
+def save(file, arr):
+    from .. import numpy as _np_mod
+    _np_mod.save(file, arr)
+
+
+def load(file):
+    from .. import numpy as _np_mod
+    return _np_mod.load(file)
+
+
+def _npx_func(opfn):
+    @functools.wraps(opfn)
+    def fn(*args, **kwargs):
+        return to_np(opfn(*args, **kwargs))
+    return fn
+
+
+# Generate the op surface from the registry (the same source that feeds
+# mx.nd), wrapped to return mx.np ndarrays. Internal/underscore ops are
+# omitted, matching the reference's public npx namespace.
+for _name, _op in list(_REGISTRY.items()):
+    if _name.startswith("_") or _name in globals():
+        continue
+    globals()[_name] = _npx_func(make_op_func(_op))
+    __all__.append(_name)
+
+del _name, _op

@@ -1,0 +1,194 @@
+"""Utility scopes and decorators: numpy-semantics switches.
+
+TPU-native counterpart of the reference's ``python/mxnet/util.py``
+(``set_np``/``use_np`` family, util.py:52,99). The reference flags flip C
+globals (``MXSetIsNumpyShape``) that change shape-inference semantics for
+zero-dim/zero-size arrays; on this stack jax handles those shapes natively,
+so the flags only gate frontend behavior: whether Gluon blocks and
+parameters present ``mx.np.ndarray`` values (np_array) and whether strict
+numpy shape semantics are advertised (np_shape).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+__all__ = [
+    "getenv", "set_np", "reset_np", "set_np_shape", "set_np_array",
+    "is_np_shape", "is_np_array", "is_np_default_dtype", "np_shape",
+    "np_array", "use_np", "use_np_shape", "use_np_array",
+    "set_np_default_dtype", "np_ufunc_legal_option", "default_array",
+]
+
+_state = threading.local()
+
+
+def getenv(name, default=None):
+    """Read an MXNET_* environment variable (reference: dmlc::GetEnv)."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    if isinstance(default, bool):
+        return v.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(v)
+    return v
+
+
+def _flags():
+    if not hasattr(_state, "np_shape"):
+        _state.np_shape = False
+        _state.np_array = False
+        _state.np_default_dtype = False
+    return _state
+
+
+# ------------------------------------------------------------ raw setters --
+def set_np_shape(active):
+    """Enable/disable numpy shape semantics; returns the previous value."""
+    st = _flags()
+    prev, st.np_shape = st.np_shape, bool(active)
+    return prev
+
+
+def set_np_array(active):
+    """Enable/disable numpy-array mode (Gluon surfaces mx.np.ndarray);
+    returns the previous value."""
+    st = _flags()
+    prev, st.np_array = st.np_array, bool(active)
+    return prev
+
+
+def set_np_default_dtype(active=True):
+    """When active, creation ops default to float64 like stock numpy
+    (reference: util.py set_np_default_dtype); returns previous value."""
+    st = _flags()
+    prev, st.np_default_dtype = st.np_default_dtype, bool(active)
+    return prev
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Turn numpy semantics on (reference: mx.npx.set_np). array=True
+    requires shape=True, mirroring the reference's constraint."""
+    if array and not shape:
+        raise ValueError("np_array semantics require np_shape semantics")
+    set_np_shape(shape)
+    set_np_array(array)
+    set_np_default_dtype(dtype)
+
+
+def reset_np():
+    """Back to classic (mx.nd) semantics (reference: mx.npx.reset_np)."""
+    set_np(shape=False, array=False, dtype=False)
+
+
+def is_np_shape():
+    return _flags().np_shape
+
+
+def is_np_array():
+    return _flags().np_array
+
+
+def is_np_default_dtype():
+    return _flags().np_default_dtype
+
+
+# ------------------------------------------------------------ scopes -------
+class _Scope:
+    def __init__(self, setter, active):
+        self._setter = setter
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = self._setter(self._active)
+        return self
+
+    def __exit__(self, *exc):
+        self._setter(self._prev)
+
+
+def np_shape(active=True):
+    """Context manager scoping numpy shape semantics."""
+    return _Scope(set_np_shape, active)
+
+
+def np_array(active=True):
+    """Context manager scoping numpy array semantics."""
+    return _Scope(set_np_array, active)
+
+
+def _wrap_with(fn, shape, array):
+    """shape/array: True activates the flag for the call; None leaves the
+    ambient value untouched (so use_np_shape does not clobber np_array)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        prev_s = set_np_shape(shape) if shape is not None else None
+        prev_a = set_np_array(array) if array is not None else None
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            if prev_a is not None:
+                set_np_array(prev_a)
+            if prev_s is not None:
+                set_np_shape(prev_s)
+    return wrapper
+
+
+def _decorate(obj, shape, array):
+    if isinstance(obj, type):
+        # decorate every directly-defined method of the class, preserving
+        # descriptor kinds (staticmethod/classmethod)
+        for name, member in vars(obj).copy().items():
+            if isinstance(member, staticmethod):
+                setattr(obj, name,
+                        staticmethod(_wrap_with(member.__func__,
+                                                shape, array)))
+            elif isinstance(member, classmethod):
+                setattr(obj, name,
+                        classmethod(_wrap_with(member.__func__,
+                                               shape, array)))
+            elif callable(member) and not isinstance(member, type):
+                setattr(obj, name, _wrap_with(member, shape, array))
+        return obj
+    return _wrap_with(obj, shape, array)
+
+
+def use_np_shape(obj):
+    """Decorator activating np_shape inside a function or class
+    (reference: util.py:52 use_np_shape)."""
+    return _decorate(obj, True, None)
+
+
+def use_np_array(obj):
+    return _decorate(obj, None, True)
+
+
+def use_np(obj):
+    """Decorator activating full numpy semantics inside a function/class
+    (reference: util.py:99 use_np)."""
+    return _decorate(obj, True, True)
+
+
+def np_ufunc_legal_option(key, value):
+    """Reference helper: which ufunc kwargs the dispatch protocol honors."""
+    if key == "where":
+        return value is True
+    if key == "casting":
+        return value == "same_kind"
+    if key == "order":
+        return value in ("K", "C")
+    if key in ("dtype", "out", "subok"):
+        return True
+    return False
+
+
+def default_array(source, ctx=None, dtype=None):
+    """Create an nd or np array matching the active semantics mode."""
+    if is_np_array():
+        from . import numpy as _np_mod
+        return _np_mod.array(source, dtype=dtype, ctx=ctx)
+    from .ndarray import NDArray
+    return NDArray(source, ctx=ctx, dtype=dtype)

@@ -1,0 +1,245 @@
+"""mx.np / mx.npx frontend tests.
+
+Models the reference's numpy-frontend suites
+(tests/python/unittest/test_numpy_ndarray.py, test_numpy_op.py,
+test_numpy_interoperability.py — dispatch-protocol coverage).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.autograd as ag
+
+np = mx.np
+npx = mx.npx
+
+
+class TestCreation:
+    def test_array_default_dtype(self):
+        assert np.array([1, 2, 3]).dtype == onp.float32
+        # TPU-first policy: 64-bit dtypes narrow to 32-bit (x64 disabled;
+        # matches XLA/TPU-native widths, unlike the reference's int64)
+        assert np.array(onp.arange(3, dtype=onp.int64)).dtype == onp.int32
+        assert np.array(onp.arange(3, dtype=onp.int32)).dtype == onp.int32
+
+    def test_creation_ops(self):
+        assert np.zeros((2, 3)).dtype == onp.float32
+        assert np.ones((2, 3)).shape == (2, 3)
+        assert np.arange(5).dtype == onp.float32
+        assert np.full((2,), 7.0).asnumpy().tolist() == [7.0, 7.0]
+        assert np.eye(3).asnumpy()[1, 1] == 1.0
+        onp.testing.assert_allclose(
+            np.linspace(0, 1, 5).asnumpy(), onp.linspace(0, 1, 5),
+            rtol=1e-6)
+
+    def test_zero_dim_and_zero_size(self):
+        # numpy shape semantics: 0-dim and 0-size arrays are first-class
+        s = np.array(3.0)
+        assert s.shape == () and float(s) == 3.0
+        z = np.zeros((0, 4))
+        assert z.shape == (0, 4) and z.size == 0
+
+    def test_empty_like_and_full_like(self):
+        a = np.ones((2, 2))
+        assert np.empty_like(a).shape == (2, 2)
+        assert np.full_like(a, 5).asnumpy()[0, 0] == 5
+
+
+class TestSemantics:
+    def test_comparison_returns_bool(self):
+        a = np.array([1, 2, 3])
+        assert (a > 1).dtype == onp.bool_
+        assert (a == 2).asnumpy().tolist() == [False, True, False]
+
+    def test_true_divide_promotes(self):
+        a = np.array([1, 2], dtype=np.int32)
+        assert (a / 2).dtype.kind == "f"
+
+    def test_matmul_operator(self):
+        a = np.arange(6).reshape(2, 3)
+        b = np.arange(6).reshape(3, 2)
+        onp.testing.assert_allclose((a @ b).asnumpy(),
+                                    a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+    def test_indexing_numpy_style(self):
+        x = np.arange(12).reshape(3, 4)
+        assert x[1].shape == (4,)          # integer index drops the dim
+        assert x[:, 1:3].shape == (3, 2)
+        assert x[x > 5].shape == (6,)      # boolean mask
+        assert float(x[2, 3]) == 11.0
+
+    def test_scalar_mixing(self):
+        a = np.array([1.0, 2.0])
+        onp.testing.assert_allclose((3 - a).asnumpy(), [2.0, 1.0])
+        onp.testing.assert_allclose((2 ** a).asnumpy(), [2.0, 4.0])
+
+
+class TestOps:
+    def test_reductions(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]])
+        assert float(x.sum()) == 10.0
+        assert float(x.mean(axis=0)[1]) == 3.0
+        onp.testing.assert_allclose(np.std(x).asnumpy(),
+                                    onp.std(x.asnumpy()), rtol=1e-6)
+
+    def test_manipulation(self):
+        x = np.arange(12).reshape(3, 4)
+        assert np.concatenate([x, x], axis=0).shape == (6, 4)
+        assert np.stack([x, x], axis=1).shape == (3, 2, 4)
+        assert [s.shape for s in np.split(x, 2, axis=1)] == \
+            [(3, 2), (3, 2)]
+        assert np.swapaxes(x, 0, 1).shape == (4, 3)
+        assert np.expand_dims(x, -1).shape == (3, 4, 1)
+        assert np.tile(x, (2, 1)).shape == (6, 4)
+
+    def test_einsum(self):
+        a = np.arange(6).reshape(2, 3)
+        onp.testing.assert_allclose(
+            np.einsum("ij,kj->ik", a, a).asnumpy(),
+            onp.einsum("ij,kj->ik", a.asnumpy(), a.asnumpy()), rtol=1e-5)
+
+    def test_where_unique_nonzero(self):
+        x = np.array([0.0, 1.0, 0.0, 2.0, 1.0])
+        assert np.where(x > 0, x, np.zeros_like(x)).asnumpy().sum() == 4.0
+        assert np.unique(x).shape == (3,)
+        assert np.nonzero(x)[0].shape == (3,)
+
+    def test_linalg(self):
+        a = onp.array([[2.0, 0.0], [1.0, 3.0]], dtype=onp.float32)
+        x = np.array(a)
+        onp.testing.assert_allclose(np.linalg.inv(x).asnumpy(),
+                                    onp.linalg.inv(a), rtol=1e-5)
+        onp.testing.assert_allclose(
+            float(np.linalg.norm(x)), onp.linalg.norm(a), rtol=1e-5)
+        b = onp.array([1.0, 2.0], dtype=onp.float32)
+        onp.testing.assert_allclose(
+            np.linalg.solve(x, np.array(b)).asnumpy(),
+            onp.linalg.solve(a, b), rtol=1e-5)
+
+
+class TestAutograd:
+    def test_backward_through_np_ops(self):
+        x = np.array([1.0, 2.0, 3.0])
+        x.attach_grad()
+        with ag.record():
+            y = np.sum(x ** 2)
+        y.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0],
+                                    rtol=1e-6)
+
+    def test_backward_mixed_nd_np(self):
+        # slots survive as_np/as_nd conversion
+        x = mx.nd.array([2.0])
+        x.attach_grad()
+        with ag.record():
+            y = (x.as_np_ndarray() * 3).as_nd_ndarray()
+        y.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(), [3.0])
+
+    def test_grad_through_linalg(self):
+        x = np.array([[3.0]])
+        x.attach_grad()
+        with ag.record():
+            y = np.linalg.det(x)
+        y.backward()
+        onp.testing.assert_allclose(x.grad.asnumpy(), [[1.0]], rtol=1e-6)
+
+
+class TestInterop:
+    def test_ufunc_protocol(self):
+        a = np.array([0.0, 1.0])
+        out = onp.sin(a)
+        assert isinstance(out, np.ndarray)
+        onp.testing.assert_allclose(out.asnumpy(), onp.sin([0.0, 1.0]),
+                                    rtol=1e-6)
+
+    def test_array_function_protocol(self):
+        a = np.array([1.0, 2.0])
+        out = onp.concatenate([a, a])
+        assert isinstance(out, np.ndarray) and out.shape == (4,)
+        out2 = onp.stack([a, a])
+        assert isinstance(out2, np.ndarray) and out2.shape == (2, 2)
+
+    def test_conversion_roundtrip(self):
+        a = mx.nd.array([1.0, 2.0])
+        b = a.as_np_ndarray()
+        assert isinstance(b, np.ndarray)
+        c = b.as_nd_ndarray()
+        assert type(c) is mx.nd.NDArray
+        onp.testing.assert_allclose(c.asnumpy(), a.asnumpy())
+
+
+class TestRandom:
+    def test_determinism(self):
+        np.random.seed(42)
+        a = np.random.uniform(size=(4,)).asnumpy()
+        np.random.seed(42)
+        b = np.random.uniform(size=(4,)).asnumpy()
+        onp.testing.assert_array_equal(a, b)
+
+    def test_shapes_and_ranges(self):
+        u = np.random.uniform(low=2.0, high=3.0, size=(100,))
+        assert u.shape == (100,)
+        assert float(u.min()) >= 2.0 and float(u.max()) <= 3.0
+        n = np.random.normal(loc=0.0, scale=1.0, size=(50, 2))
+        assert n.shape == (50, 2)
+        r = np.random.randint(0, 10, size=(20,))
+        assert r.dtype.kind == "i"
+        assert int(r.max()) < 10
+
+    def test_choice_permutation(self):
+        p = np.random.permutation(5)
+        assert sorted(p.asnumpy().tolist()) == [0, 1, 2, 3, 4]
+        c = np.random.choice(np.arange(5), size=(3,))
+        assert c.shape == (3,)
+
+
+class TestNpx:
+    def test_scoping(self):
+        assert not npx.is_np_array()
+        npx.set_np()
+        assert npx.is_np_array() and npx.is_np_shape()
+        npx.reset_np()
+        assert not npx.is_np_array()
+
+    def test_scope_managers(self):
+        with mx.util.np_array(True):
+            assert npx.is_np_array()
+        assert not npx.is_np_array()
+
+    def test_use_np_decorator(self):
+        @npx.use_np
+        def f():
+            return npx.is_np_array(), npx.is_np_shape()
+
+        assert f() == (True, True)
+        assert not npx.is_np_array()
+
+    def test_npx_ops_return_np(self):
+        out = npx.softmax(np.array([1.0, 2.0, 3.0]))
+        assert isinstance(out, np.ndarray)
+        onp.testing.assert_allclose(float(out.sum()), 1.0, rtol=1e-6)
+        oh = npx.one_hot(np.array([0, 2]), 3)
+        assert oh.shape == (2, 3)
+
+    def test_npx_save_load(self, tmp_path):
+        f = str(tmp_path / "arrs.npz.mx")
+        npx.save(f, {"w": np.arange(4)})
+        out = npx.load(f)
+        assert isinstance(out["w"], np.ndarray)
+        onp.testing.assert_allclose(out["w"].asnumpy(),
+                                    onp.arange(4, dtype=onp.float32))
+
+
+class TestJitTransparency:
+    def test_np_ops_inside_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(a):
+            return np.mean(np.tanh(a) ** 2)._data
+
+        out = jax.jit(f)(jnp.ones((4,)))
+        onp.testing.assert_allclose(
+            float(out), float(onp.mean(onp.tanh(onp.ones(4)) ** 2)),
+            rtol=1e-6)
