@@ -5,6 +5,7 @@ from .parameter import (Parameter, Constant, ParameterDict,
 from .block import Block, HybridBlock, SymbolBlock, CachedOp  # noqa: F401
 from .trainer import Trainer  # noqa: F401
 from . import nn  # noqa: F401
+from . import rnn  # noqa: F401
 from . import loss  # noqa: F401
 from . import utils  # noqa: F401
 

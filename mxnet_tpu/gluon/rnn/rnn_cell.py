@@ -1,0 +1,406 @@
+"""Recurrent cells: single-step building blocks + unrolling.
+
+Reference: python/mxnet/gluon/rnn/rnn_cell.py. Cells hold per-gate
+parameters with the reference's naming (i2h_weight/h2h_weight/...) and
+gate order (LSTM [i,f,g,o], GRU [r,z,n]) so layer/cell checkpoints
+interchange with the fused op (ops/rnn.py). ``unroll`` is a trace-time
+Python loop — under hybridize it compiles to one XLA program; the fused
+layers (rnn_layer.py) use ``lax.scan`` instead and are the fast path.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ...ndarray import NDArray
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _format_sequence(length, inputs, layout, merge):
+    """Normalize inputs to a list of (N, C) steps; returns
+    (steps, axis, batch_size)."""
+    assert layout in ("TNC", "NTC")
+    axis = layout.find("T")
+    if isinstance(inputs, (list, tuple)):
+        steps = list(inputs)
+    else:
+        if axis == 1:
+            inputs = inputs.swapaxes(0, 1)
+        length = length or inputs.shape[0]
+        steps = [inputs[t] for t in range(length)]
+    return steps, axis, steps[0].shape[0]
+
+
+def _merge_outputs(outputs, axis):
+    from ... import ndarray as F
+    stacked = F.stack(list(outputs), axis=0)
+    return stacked.swapaxes(0, 1) if axis == 1 else stacked
+
+
+class RecurrentCell(HybridBlock):
+    """Base recurrent cell (reference: rnn_cell.py:81).
+
+    A cell maps ``(input_t, states) -> (output_t, new_states)``.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Zero (or ``func``-built) initial states."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called " \
+            "directly. Call the modifier cell instead."
+        from ... import ndarray as F
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"]
+            if func is None:
+                states.append(F.zeros(shape, **kwargs))
+            else:
+                states.append(func(shape=shape, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell ``length`` steps (reference: rnn_cell.py:186)."""
+        self.reset()
+        steps, axis, batch = _format_sequence(length, inputs, layout, None)
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch)
+        states = begin_state
+        outputs = []
+        step_states = []  # per-step states, for SequenceLast on valid_length
+        for t in range(length):
+            out, states = self(steps[t], states)
+            outputs.append(out)
+            if valid_length is not None:
+                step_states.append(states)
+        if valid_length is not None:
+            from ... import ndarray as F
+            stacked = F.stack(outputs, axis=0)
+            masked = F.SequenceMask(stacked, valid_length,
+                                    use_sequence_length=True)
+            outputs = [masked[t] for t in range(length)]
+            # final states come from each sample's LAST VALID step, not the
+            # last padded step (reference: rnn_cell.py unroll SequenceLast)
+            states = [
+                F.SequenceLast(F.stack([s[i] for s in step_states], axis=0),
+                               valid_length, use_sequence_length=True)
+                for i in range(len(states))]
+        if merge_outputs is None or merge_outputs:
+            return _merge_outputs(outputs, axis), states
+        return outputs, states
+
+    def forward(self, x, *args):
+        self._counter += 1
+        return super().forward(x, *args)
+
+
+class HybridRecurrentCell(RecurrentCell):
+    """Alias tier kept for API parity (all cells here are hybrid)."""
+
+
+class _GatedCell(HybridRecurrentCell):
+    """Shared parameter layout for RNN/LSTM/GRU cells."""
+
+    def __init__(self, hidden_size, gates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._gates = gates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(gates * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(gates * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(gates * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(gates * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def _infer_param_shapes(self, x, *args):
+        self.i2h_weight.shape = (self._gates * self._hidden_size,
+                                 x.shape[-1])
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+
+class RNNCell(_GatedCell):
+    """Elman cell: ``h' = act(W_x x + b_x + W_h h + b_h)``
+    (reference: rnn_cell.py:344)."""
+
+    def __init__(self, hidden_size, activation="tanh", **kwargs):
+        super().__init__(hidden_size, gates=1, **kwargs)
+        self._activation = activation
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F, x, states, i2h_weight=None, h2h_weight=None,
+                       i2h_bias=None, h2h_bias=None):
+        h = self._hidden_size
+        pre = (F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=h)
+               + F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                                  num_hidden=h))
+        out = F.Activation(pre, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_GatedCell):
+    """LSTM cell, gate order [i, f, g, o] (reference: rnn_cell.py:439)."""
+
+    def __init__(self, hidden_size, **kwargs):
+        super().__init__(hidden_size, gates=4, **kwargs)
+
+    def _alias(self):
+        return "lstm"
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight=None, h2h_weight=None,
+                       i2h_bias=None, h2h_bias=None):
+        h = self._hidden_size
+        gates = (F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=4 * h)
+                 + F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                                    num_hidden=4 * h))
+        i, f, g, o = F.split(gates, num_outputs=4, axis=-1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.tanh(g)
+        c = f * states[1] + i * g
+        out = o * F.tanh(c)
+        return out, [out, c]
+
+
+class GRUCell(_GatedCell):
+    """GRU cell, gate order [r, z, n] (reference: rnn_cell.py:565)."""
+
+    def __init__(self, hidden_size, **kwargs):
+        super().__init__(hidden_size, gates=3, **kwargs)
+
+    def _alias(self):
+        return "gru"
+
+    def hybrid_forward(self, F, x, states, i2h_weight=None, h2h_weight=None,
+                       i2h_bias=None, h2h_bias=None):
+        h = self._hidden_size
+        xp = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=3 * h)
+        hp = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                              num_hidden=3 * h)
+        xr, xz, xn = F.split(xp, num_outputs=3, axis=-1)
+        hr, hz, hn = F.split(hp, num_outputs=3, axis=-1)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        n = F.tanh(xn + r * hn)
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells applied in sequence each step
+    (reference: rnn_cell.py:646)."""
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def forward(self, x, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, s = cell(x, states[p:p + n])
+            p += n
+            next_states.extend(s)
+        return x, next_states
+
+    def hybrid_forward(self, F, x, states):  # pragma: no cover - forward()
+        raise RuntimeError("SequentialRNNCell dispatches in forward()")
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+class DropoutCell(RecurrentCell):
+    """Applies dropout to the input each step (reference: rnn_cell.py:741)."""
+
+    def __init__(self, rate, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, x, states):
+        if self._rate > 0:
+            x = F.Dropout(x, p=self._rate)
+        return x, states
+
+
+class ModifierCell(RecurrentCell):
+    """Base for cells wrapping another cell (reference: rnn_cell.py:790)."""
+
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + "mod_")
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference: rnn_cell.py:849)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def forward(self, x, states):
+        from ... import ndarray as F
+        from ... import autograd
+        out, next_states = self.base_cell(x, states)
+        if autograd.is_training():
+            if self._zo > 0:
+                prev = self._prev_output
+                if prev is None:
+                    prev = F.zeros_like(out)
+                # Dropout of ones -> 0 where zoned out, keep prev there
+                keep = F.Dropout(F.ones_like(out), p=self._zo)
+                out = F.where(keep, out, prev)
+            if self._zs > 0:
+                next_states = [
+                    F.where(F.Dropout(F.ones_like(ns), p=self._zs), ns, s)
+                    for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+    def hybrid_forward(self, F, x, states):  # pragma: no cover
+        raise RuntimeError("ZoneoutCell dispatches in forward()")
+
+
+class ResidualCell(ModifierCell):
+    """Adds the input to the cell output (reference: rnn_cell.py:914)."""
+
+    def hybrid_forward(self, F, x, states):
+        out, states = self.base_cell(x, states)
+        return out + x, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Runs two cells over the sequence in opposite directions; only
+    usable through ``unroll`` (reference: rnn_cell.py:957)."""
+
+    def __init__(self, l_cell, r_cell):
+        super().__init__(prefix="bi_")
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        steps, axis, batch = _format_sequence(length, inputs, layout, None)
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch)
+        l_cell, r_cell = self._children.values()
+        nl = len(l_cell.state_info())
+        from ... import ndarray as F
+        if valid_length is None:
+            rev_steps = list(reversed(steps))
+        else:
+            # per-sample reversal that keeps padding at the tail, so the
+            # reverse cell sees real tokens first (plain reversed() would
+            # feed it padding)
+            rev = F.SequenceReverse(F.stack(steps, axis=0), valid_length,
+                                    use_sequence_length=True)
+            rev_steps = [rev[t] for t in range(length)]
+        l_out, l_states = l_cell.unroll(
+            length, steps, begin_state[:nl], layout="TNC",
+            merge_outputs=False, valid_length=valid_length)
+        r_out, r_states = r_cell.unroll(
+            length, rev_steps, begin_state[nl:], layout="TNC",
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            r_out = list(reversed(r_out))
+        else:
+            rback = F.SequenceReverse(F.stack(r_out, axis=0), valid_length,
+                                      use_sequence_length=True)
+            r_out = [rback[t] for t in range(length)]
+        outputs = [F.concat([lo, ro], dim=-1)
+                   for lo, ro in zip(l_out, r_out)]
+        out = _merge_outputs(outputs, axis) if merge_outputs in (None, True) \
+            else outputs
+        return out, l_states + r_states

@@ -1,0 +1,161 @@
+"""Fused recurrent layers: RNN / LSTM / GRU.
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py (thin wrappers over the
+fused RNN op, src/operator/rnn-inl.h). Parameters are held per
+layer/direction with the reference's names ({l,r}{i}_i2h_weight, ...) and
+packed into the fused op's flat vector at trace time — the packing is pure
+reshape/concat, free under XLA, so checkpoints stay interchangeable while
+the compute path is the lax.scan program in ops/rnn.py.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("TNC", "NTC"), \
+            f"Invalid layout {layout}; must be TNC or NTC"
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = _GATES[mode]
+        with self.name_scope():
+            for layer in range(num_layers):
+                in_sz = input_size if layer == 0 else \
+                    hidden_size * self._dir
+                for d, tag in enumerate(["l", "r"][:self._dir]):
+                    g = self._gates * hidden_size
+                    setattr(self, f"{tag}{layer}_i2h_weight",
+                            self.params.get(
+                                f"{tag}{layer}_i2h_weight",
+                                shape=(g, in_sz),
+                                init=i2h_weight_initializer,
+                                allow_deferred_init=True))
+                    setattr(self, f"{tag}{layer}_h2h_weight",
+                            self.params.get(
+                                f"{tag}{layer}_h2h_weight",
+                                shape=(g, hidden_size),
+                                init=h2h_weight_initializer,
+                                allow_deferred_init=True))
+                    setattr(self, f"{tag}{layer}_i2h_bias",
+                            self.params.get(
+                                f"{tag}{layer}_i2h_bias", shape=(g,),
+                                init=i2h_bias_initializer,
+                                allow_deferred_init=True))
+                    setattr(self, f"{tag}{layer}_h2h_bias",
+                            self.params.get(
+                                f"{tag}{layer}_h2h_bias", shape=(g,),
+                                init=h2h_bias_initializer,
+                                allow_deferred_init=True))
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_size or None} -> "
+                f"{self._hidden_size}, {self._layout}, "
+                f"num_layers={self._num_layers}"
+                f"{', bidirectional' if self._dir == 2 else ''})")
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size,
+                 self._hidden_size)
+        if self._mode == "lstm":
+            return [{"shape": shape, "__layout__": "LNC"},
+                    {"shape": shape, "__layout__": "LNC"}]
+        return [{"shape": shape, "__layout__": "LNC"}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        states = []
+        for info in self.state_info(batch_size):
+            if func is None:
+                states.append(F.zeros(info["shape"], **kwargs))
+            else:
+                states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def _infer_param_shapes(self, x, *args):
+        in_sz = x.shape[2] if self._layout == "TNC" else x.shape[-1]
+        g = self._gates * self._hidden_size
+        for tag in ["l", "r"][:self._dir]:
+            getattr(self, f"{tag}0_i2h_weight").shape = (g, in_sz)
+
+    def _flat_params(self, F, kwargs):
+        """Pack per-layer params into the fused op's flat vector
+        (weights first, then biases; layer-major, direction-minor —
+        reference: rnn-inl.h GetRnnParamSize ordering)."""
+        chunks = []
+        for layer in range(self._num_layers):
+            for tag in ["l", "r"][:self._dir]:
+                chunks.append(kwargs[f"{tag}{layer}_i2h_weight"]
+                              .reshape((-1,)))
+                chunks.append(kwargs[f"{tag}{layer}_h2h_weight"]
+                              .reshape((-1,)))
+        for layer in range(self._num_layers):
+            for tag in ["l", "r"][:self._dir]:
+                chunks.append(kwargs[f"{tag}{layer}_i2h_bias"])
+                chunks.append(kwargs[f"{tag}{layer}_h2h_bias"])
+        return F.concat(chunks, dim=0)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        states = args[0] if args else None
+        skip_states = states is None
+        if skip_states:
+            batch = x.shape[0] if self._layout == "NTC" else x.shape[1]
+            states = self.begin_state(batch, dtype=x.dtype)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        if self._layout == "NTC":
+            x = x.swapaxes(0, 1)
+        params = self._flat_params(F, kwargs)
+        h0 = states[0]
+        c0 = states[1] if self._mode == "lstm" else None
+        rnn_args = [x, params, h0] + ([c0] if c0 is not None else [])
+        out, hT, cT = F.RNN(*rnn_args, state_size=self._hidden_size,
+                            num_layers=self._num_layers, mode=self._mode,
+                            bidirectional=self._dir == 2, p=self._dropout,
+                            state_outputs=True)
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        out_states = [hT, cT] if self._mode == "lstm" else [hT]
+        return out if skip_states else (out, out_states)
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN with tanh/relu (reference: rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers,
+                         layout, dropout, bidirectional,
+                         input_size=input_size, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference: rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size=input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (reference: rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size=input_size, **kwargs)

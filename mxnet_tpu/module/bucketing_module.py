@@ -67,19 +67,36 @@ class BucketingModule(BaseModule):
         self.binded = True
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
-        """Switch current bucket (reference: bucketing_module.py:404)."""
+        """Switch current bucket (reference: bucketing_module.py:404).
+
+        Parameter STORAGE is shared: the new bucket's executor aliases the
+        default module's parameter NDArray objects (updates rebind the
+        shared object's buffer), reproducing the reference's
+        ``shared_module`` bind semantics without copies."""
         assert self.binded, "call bind before switching bucket"
         mod = self._gen_module(bucket_key)
         if not mod.binded:
             mod.bind(data_shapes, label_shapes, self.for_training)
-            if self.params_initialized:
-                arg_p, aux_p = self._curr_module.get_params()
-                mod.init_params(arg_params=arg_p, aux_params=aux_p,
-                                allow_missing=False, force_init=True)
+            owner = self._buckets[self._default_bucket_key]
+            for name in mod._param_names:
+                if name not in owner._exec.arg_dict:
+                    raise RuntimeError(
+                        f"Parameter '{name}' of bucket {bucket_key!r} is "
+                        "not present in the default bucket's symbol; the "
+                        "default_bucket_key symbol must carry the full "
+                        "parameter set (reference: bucketing_module.py "
+                        "shared_module bind)")
+                mod._exec.arg_dict[name] = owner._exec.arg_dict[name]
+            for name in mod._aux_names:
+                if name in owner._exec.aux_dict:
+                    mod._exec.aux_dict[name] = owner._exec.aux_dict[name]
             if self._curr_module.optimizer_initialized:
                 mod._optimizer = self._curr_module._optimizer
                 mod._updater = self._curr_module._updater
                 mod.optimizer_initialized = True
+        # flag sync every switch: init_params() may have run since this
+        # bucket was bound (storage is aliased, so the arrays are current)
+        mod.params_initialized = self.params_initialized
         self._curr_module = mod
         self._curr_bucket_key = bucket_key
 
@@ -88,10 +105,15 @@ class BucketingModule(BaseModule):
                     force_init=False, allow_extra=False):
         if self.params_initialized and not force_init:
             return
-        self._curr_module.init_params(initializer, arg_params, aux_params,
-                                      allow_missing, force_init,
-                                      allow_extra)
+        # initialize through the default bucket (the storage owner); all
+        # other bound buckets alias the same arrays — just sync their flags
+        owner = self._buckets[self._default_bucket_key]
+        owner.init_params(initializer, arg_params, aux_params,
+                          allow_missing, force_init, allow_extra)
         self.params_initialized = True
+        for mod in self._buckets.values():
+            if mod.binded:
+                mod.params_initialized = True
 
     def get_params(self):
         # sync the default module with the latest trained params
@@ -115,15 +137,10 @@ class BucketingModule(BaseModule):
         if key is None:
             key = self._default_bucket_key
         if key != self._curr_bucket_key:
-            # params live in the previous bucket's executor; carry over
-            prev = self._curr_module
+            # param storage is aliased across buckets (switch_bucket), so
+            # no carry-over copy is needed
             self.switch_bucket(key, data_batch.provide_data,
                                data_batch.provide_label)
-            if prev is not self._curr_module and \
-                    self.params_initialized:
-                arg_p, aux_p = prev.get_params()
-                self._curr_module.init_params(
-                    arg_params=arg_p, aux_params=aux_p, force_init=True)
         self._curr_module.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):

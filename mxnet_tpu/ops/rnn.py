@@ -1,0 +1,198 @@
+"""Fused RNN operator: LSTM / GRU / vanilla RNN via ``lax.scan``.
+
+TPU-native replacement of the reference's fused RNN op
+(reference: src/operator/rnn-inl.h:419-481 — cuDNN-backed on GPU, native
+CPU kernels otherwise). Design:
+
+- The input projection ``x_t @ Wx^T + bx`` for ALL timesteps is hoisted out
+  of the recurrence into one (T*N, I) x (I, G*H) matmul — a single large
+  MXU-friendly contraction — so the ``lax.scan`` body carries only the
+  unavoidable sequential part ``h_{t-1} @ Wh^T``.
+- Multi-layer and bidirectional composition is a Python loop at trace time
+  (static ``num_layers``/``bidirectional``), producing one fused XLA
+  program, with inter-layer dropout like cuDNN (vertical connections only).
+- Gate order matches the reference/cuDNN convention so packed parameter
+  vectors interchange: LSTM [i, f, g, o]; GRU [r, z, n].
+
+The registered ``RNN`` op takes the reference's flat parameter vector
+(layer-major, direction-minor: all [Wx, Wh] blocks first, then all
+[bx, bh] blocks — src/operator/rnn-inl.h GetRnnParamSize) and unpacks it
+at trace time (pure reshape/slice: free under XLA).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+__all__ = ["rnn_param_size", "rnn_cell_step", "rnn_layer_scan"]
+
+
+def rnn_param_size(input_size, state_size, num_layers, mode,
+                   bidirectional=False, projection_size=None):
+    """Total flat parameter count (reference: rnn-inl.h GetRnnParamSize)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        size += d * (g * state_size * (in_sz + state_size)      # Wx, Wh
+                     + 2 * g * state_size)                      # bx, bh
+    return size
+
+
+def _unpack_params(params, input_size, state_size, num_layers, mode,
+                   bidirectional):
+    """Flat parameter vector -> per-layer/direction weight dicts."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    weights, biases = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * d
+        lw = []
+        for _ in range(d):
+            wx = params[off:off + g * h * in_sz].reshape(g * h, in_sz)
+            off += g * h * in_sz
+            wh = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            lw.append({"wx": wx, "wh": wh})
+        weights.append(lw)
+    for layer in range(num_layers):
+        lb = []
+        for _ in range(d):
+            bx = params[off:off + g * h]
+            off += g * h
+            bh = params[off:off + g * h]
+            off += g * h
+            lb.append({"bx": bx, "bh": bh})
+        biases.append(lb)
+    for layer in range(num_layers):
+        for dd in range(d):
+            weights[layer][dd].update(biases[layer][dd])
+    return weights
+
+
+def rnn_cell_step(mode, xproj, h, c, wh, bh):
+    """One recurrence step for lstm/rnn_relu/rnn_tanh (GRU needs the
+    reset-gated candidate form — see _gru_layer_scan). ``xproj`` is the
+    precomputed input projection (N, G*H); returns (out, new_h, new_c)."""
+    gates = xproj + h @ wh.T + bh
+    if mode == "lstm":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        new_c = f * c + i * g
+        new_h = o * jnp.tanh(new_c)
+        return new_h, new_h, new_c
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+    new_h = act(gates)
+    return new_h, new_h, c
+
+
+def rnn_layer_scan(mode, x, h0, c0, w, reverse=False):
+    """Scan one direction of one layer over time (non-GRU modes).
+
+    x: (T, N, I); h0/c0: (N, H); w: dict(wx, wh, bx, bh).
+    Returns (out (T, N, H), hT, cT).
+    """
+    T, N, _ = x.shape
+    # hoisted input projection: one big matmul over all timesteps
+    xproj = (x.reshape(T * N, -1) @ w["wx"].T + w["bx"]).reshape(T, N, -1)
+    if reverse:
+        xproj = jnp.flip(xproj, axis=0)
+
+    def step(carry, xp):
+        h, c = carry
+        out, nh, nc = rnn_cell_step(mode, xp, h, c, w["wh"], w["bh"])
+        return (nh, nc), out
+
+    (hT, cT), out = lax.scan(step, (h0, c0), xproj)
+    if reverse:
+        out = jnp.flip(out, axis=0)
+    return out, hT, cT
+
+
+def _gru_layer_scan(x, h0, w, reverse=False):
+    """GRU direction scan with the reset-gated candidate recurrence."""
+    T, N, _ = x.shape
+    xproj = (x.reshape(T * N, -1) @ w["wx"].T + w["bx"]).reshape(T, N, -1)
+    if reverse:
+        xproj = jnp.flip(xproj, axis=0)
+
+    def step(h, xp):
+        xr, xz, xn = jnp.split(xp, 3, axis=-1)
+        hr, hz, hn = jnp.split(h @ w["wh"].T + w["bh"], 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        nh = (1 - z) * n + z * h
+        return nh, nh
+
+    hT, out = lax.scan(step, h0, xproj)
+    if reverse:
+        out = jnp.flip(out, axis=0)
+    return out, hT
+
+
+def rnn_forward(data, params_flat, h0, c0, mode, state_size, num_layers,
+                bidirectional=False, p=0.0, training=False, rng=None):
+    """Full fused-RNN forward. data: (T, N, I); h0: (L*D, N, H).
+
+    Returns (out (T, N, D*H), hT (L*D, N, H), cT or None).
+    """
+    d = 2 if bidirectional else 1
+    w = _unpack_params(params_flat, data.shape[-1], state_size,
+                       num_layers, mode, bidirectional)
+    x = data
+    hTs, cTs = [], []
+    for layer in range(num_layers):
+        outs = []
+        for di in range(d):
+            h_init = h0[layer * d + di]
+            c_init = (c0[layer * d + di] if c0 is not None
+                      else jnp.zeros_like(h_init))
+            if mode == "gru":
+                out, hT = _gru_layer_scan(x, h_init, w[layer][di],
+                                          reverse=(di == 1))
+                cT = c_init
+            else:
+                out, hT, cT = rnn_layer_scan(mode, x, h_init, c_init,
+                                             w[layer][di],
+                                             reverse=(di == 1))
+            outs.append(out)
+            hTs.append(hT)
+            cTs.append(cT)
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and training and layer < num_layers - 1 and rng is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(rng, layer), 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0.0)
+    hT = jnp.stack(hTs)
+    cT = jnp.stack(cTs) if mode == "lstm" else None
+    return x, hT, cT
+
+
+@register("RNN", nout=3, needs_rng=True, needs_train=True)
+def _rnn_op(data, parameters, state, state_cell=None, *, state_size,
+            num_layers, mode="lstm", bidirectional=False, p=0.0,
+            state_outputs=True, projection_size=None, rng=None,
+            _training=False):
+    """Fused RNN op (reference: src/operator/rnn-inl.h:419; op docs
+    src/operator/rnn.cc). data is TNC; states are (L*D, N, H)."""
+    if projection_size is not None:
+        raise NotImplementedError("projection_size is not supported")
+    out, hT, cT = rnn_forward(
+        data, parameters, state, state_cell, mode, state_size, num_layers,
+        bidirectional=bidirectional, p=p, training=_training, rng=rng)
+    if cT is None:
+        cT = jnp.zeros_like(hT)
+    return out, hT, cT
+
+
+alias("rnn", "RNN")

@@ -1,0 +1,319 @@
+"""RNN tests: fused op oracle checks, gluon.rnn layers/cells, LSTM and
+CTC convergence (the BASELINE.md LSTM/CTC north-star config), bucketing.
+
+Models the reference's tests/python/unittest/test_gluon_rnn.py and
+tests/python/train/test_bucketing.py.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.autograd as ag
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import rnn, nn
+
+
+def _np_lstm_ref(x, h0, c0, wx, wh, bx, bh):
+    """Plain-numpy single-layer LSTM oracle, gate order [i, f, g, o]."""
+    def sig(v):
+        return 1.0 / (1.0 + onp.exp(-v))
+
+    T, N, _ = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    outs = []
+    for t in range(T):
+        gates = x[t] @ wx.T + bx + h @ wh.T + bh
+        i, f, g, o = onp.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * onp.tanh(g)
+        h = sig(o) * onp.tanh(c)
+        outs.append(h)
+    return onp.stack(outs), h, c
+
+
+class TestFusedRNNOracle:
+    def test_lstm_matches_numpy(self):
+        T, N, I, H = 4, 3, 5, 6
+        rng = onp.random.RandomState(0)
+        x = rng.randn(T, N, I).astype("f")
+        wx = rng.randn(4 * H, I).astype("f") * 0.3
+        wh = rng.randn(4 * H, H).astype("f") * 0.3
+        bx = rng.randn(4 * H).astype("f") * 0.1
+        bh = rng.randn(4 * H).astype("f") * 0.1
+        h0 = onp.zeros((1, N, H), "f")
+        c0 = onp.zeros((1, N, H), "f")
+        flat = onp.concatenate([wx.ravel(), wh.ravel(), bx, bh])
+
+        out, hT, cT = mx.nd.RNN(
+            mx.nd.array(x), mx.nd.array(flat), mx.nd.array(h0),
+            mx.nd.array(c0), state_size=H, num_layers=1, mode="lstm")
+        ref_out, ref_h, ref_c = _np_lstm_ref(x, h0[0], c0[0], wx, wh, bx, bh)
+        onp.testing.assert_allclose(out.asnumpy(), ref_out, rtol=1e-4,
+                                    atol=1e-5)
+        onp.testing.assert_allclose(hT.asnumpy()[0], ref_h, rtol=1e-4,
+                                    atol=1e-5)
+        onp.testing.assert_allclose(cT.asnumpy()[0], ref_c, rtol=1e-4,
+                                    atol=1e-5)
+
+    def test_layer_matches_cell_unroll(self):
+        """Fused LSTM layer == LSTMCell.unroll with identical params —
+        validates gate order and flat packing consistency."""
+        T, N, I, H = 5, 2, 4, 8
+        layer = rnn.LSTM(H, input_size=I)
+        layer.initialize()
+        cell = rnn.LSTMCell(H, input_size=I)
+        cell.initialize()
+        cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+        cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+        cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+        cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+        x = mx.nd.array(onp.random.randn(T, N, I).astype("f"))
+        out_layer = layer(x)
+        out_cell, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+        onp.testing.assert_allclose(out_layer.asnumpy(),
+                                    out_cell.asnumpy(), rtol=1e-4,
+                                    atol=1e-5)
+
+    def test_gru_layer_matches_cell_unroll(self):
+        T, N, I, H = 5, 2, 4, 8
+        layer = rnn.GRU(H, input_size=I)
+        layer.initialize()
+        cell = rnn.GRUCell(H, input_size=I)
+        cell.initialize()
+        cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+        cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+        cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+        cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+        x = mx.nd.array(onp.random.randn(T, N, I).astype("f"))
+        onp.testing.assert_allclose(
+            layer(x).asnumpy(),
+            cell.unroll(T, x, layout="TNC", merge_outputs=True)[0].asnumpy(),
+            rtol=1e-4, atol=1e-5)
+
+
+class TestRNNLayers:
+    def test_shapes_all_modes(self):
+        x = mx.nd.array(onp.random.randn(6, 2, 3).astype("f"))
+        for cls, h in [(rnn.LSTM, 5), (rnn.GRU, 5), (rnn.RNN, 5)]:
+            net = cls(h, num_layers=2, bidirectional=True)
+            net.initialize()
+            assert net(x).shape == (6, 2, 2 * h)
+
+    def test_ntc_layout(self):
+        net = rnn.LSTM(4, layout="NTC")
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(2, 7, 3).astype("f"))
+        assert net(x).shape == (2, 7, 4)
+
+    def test_explicit_states(self):
+        net = rnn.LSTM(4, num_layers=2)
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(3, 2, 5).astype("f"))
+        states = net.begin_state(2)
+        out, new_states = net(x, states)
+        assert out.shape == (3, 2, 4)
+        assert [s.shape for s in new_states] == [(2, 2, 4), (2, 2, 4)]
+
+    def test_gradients_flow(self):
+        net = rnn.GRU(4, num_layers=2, bidirectional=True)
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(3, 2, 5).astype("f"))
+        net(x)  # resolve shapes
+        params = net.collect_params()
+        with ag.record():
+            loss = net(x).sum()
+        loss.backward()
+        for name, p in params.items():
+            g = p.grad()
+            assert onp.abs(g.asnumpy()).sum() > 0, f"zero grad for {name}"
+
+    def test_hybridize(self):
+        net = rnn.LSTM(4)
+        net.initialize()
+        x = mx.nd.array(onp.random.randn(3, 2, 5).astype("f"))
+        eager = net(x).asnumpy()
+        net.hybridize()
+        onp.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-5,
+                                    atol=1e-6)
+        onp.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-5,
+                                    atol=1e-6)  # second call: cache hit
+
+
+class TestCells:
+    def test_residual_and_dropout_cells(self):
+        base = rnn.GRUCell(6, input_size=6)
+        cell = rnn.ResidualCell(base)
+        cell.initialize()
+        x = mx.nd.array(onp.random.randn(2, 4, 6).astype("f"))
+        out, _ = cell.unroll(4, x, layout="NTC")
+        assert out.shape == (2, 4, 6)
+        d = rnn.DropoutCell(0.5)
+        out, _ = d.unroll(4, x, layout="NTC")
+        assert out.shape == (2, 4, 6)
+
+    def test_unroll_valid_length_states(self):
+        """States returned from unroll(valid_length=...) come from each
+        sample's last VALID step, not the padded tail."""
+        cell = rnn.LSTMCell(6, input_size=3)
+        cell.initialize()
+        T = 5
+        x = onp.random.randn(2, T, 3).astype("f")
+        vl = onp.array([3.0, 5.0], "f")
+        out, states = cell.unroll(T, mx.nd.array(x), layout="NTC",
+                                  valid_length=mx.nd.array(vl))
+        # sample 0: states must equal an unroll truncated at t=3
+        out3, states3 = cell.unroll(3, mx.nd.array(x[:, :3]), layout="NTC")
+        onp.testing.assert_allclose(states[0].asnumpy()[0],
+                                    states3[0].asnumpy()[0], rtol=1e-5,
+                                    atol=1e-6)
+        # masked outputs beyond valid_length are zero
+        assert onp.abs(out.asnumpy()[0, 3:]).sum() == 0
+
+    def test_bidirectional_valid_length(self):
+        """Reverse direction must consume real tokens first under
+        valid_length (SequenceReverse semantics)."""
+        l, r = rnn.LSTMCell(4, input_size=3), rnn.LSTMCell(4, input_size=3)
+        bi = rnn.BidirectionalCell(l, r)
+        bi.initialize()
+        T = 4
+        x = onp.random.randn(2, T, 3).astype("f")
+        vl = onp.array([2.0, 4.0], "f")
+        out, _ = bi.unroll(T, mx.nd.array(x), layout="NTC",
+                           valid_length=mx.nd.array(vl))
+        # sample 0 truncated to its valid length must reproduce the
+        # variable-length result on the valid prefix
+        out_trunc, _ = bi.unroll(2, mx.nd.array(x[:1, :2]), layout="NTC")
+        onp.testing.assert_allclose(out.asnumpy()[0, :2],
+                                    out_trunc.asnumpy()[0], rtol=1e-5,
+                                    atol=1e-6)
+        assert onp.abs(out.asnumpy()[0, 2:]).sum() == 0
+
+    def test_zoneout_cell_train_mode(self):
+        cell = rnn.ZoneoutCell(rnn.LSTMCell(5), zoneout_outputs=0.3,
+                               zoneout_states=0.3)
+        cell.initialize()
+        x = mx.nd.array(onp.random.randn(2, 4, 3).astype("f"))
+        with ag.record():
+            out, _ = cell.unroll(4, x, layout="NTC")
+        assert out.shape == (2, 4, 5)
+
+
+class TestConvergence:
+    def test_char_lstm_learns_pattern(self):
+        """Char-level LSTM on a deterministic cyclic sequence — the
+        LSTM/CTC north-star config's recurrent half."""
+        vocab, T, B, H = 7, 12, 8, 32
+        seq = onp.arange(vocab * 6) % vocab  # cyclic pattern
+        rng = onp.random.RandomState(0)
+        starts = rng.randint(0, len(seq) - T - 1, size=(64,))
+        xs = onp.stack([seq[s:s + T] for s in starts])
+        ys = onp.stack([seq[s + 1:s + T + 1] for s in starts])
+
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Embedding(vocab, 16))
+        lstm = rnn.LSTM(H, layout="NTC")
+        dense = nn.Dense(vocab, flatten=False)
+        mx.random.seed(0)
+        net.initialize()
+        lstm.initialize()
+        dense.initialize()
+        params = {}
+        for blk in (net, lstm, dense):
+            params.update(blk.collect_params())
+        trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.01})
+        L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        first = last = None
+        for step in range(60):
+            bi = rng.randint(0, 64, size=(B,))
+            x = mx.nd.array(xs[bi].astype("f"))
+            y = mx.nd.array(ys[bi].astype("f"))
+            with ag.record():
+                out = dense(lstm(net(x)))
+                loss = L(out.reshape((-1, vocab)), y.reshape((-1,))).mean()
+            loss.backward()
+            trainer.step(1)
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+        assert last < 0.5 * first, (first, last)
+
+    def test_ctc_head_converges(self):
+        """LSTM + CTC head trained to decreasing loss (north-star
+        LSTM/CTC config; reference: example OCR pipelines)."""
+        T, B, A, H = 16, 4, 6, 24  # A includes blank=0
+        rng = onp.random.RandomState(1)
+        x_np = rng.randn(T, B, 8).astype("f")
+        labels = onp.tile(onp.array([[1, 2, 3, 4]], "f"), (B, 1))
+
+        lstm = rnn.LSTM(H)
+        head = nn.Dense(A, flatten=False)
+        mx.random.seed(1)
+        lstm.initialize()
+        head.initialize()
+        params = dict(lstm.collect_params())
+        params.update(head.collect_params())
+        trainer = gluon.Trainer(params, "adam", {"learning_rate": 0.02})
+        L = gluon.loss.CTCLoss(layout="TNC")
+
+        x = mx.nd.array(x_np)
+        y = mx.nd.array(labels)
+        first = last = None
+        for step in range(40):
+            with ag.record():
+                out = head(lstm(x))  # (T, B, A)
+                loss = L(out, y).mean()
+            loss.backward()
+            trainer.step(1)
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+        assert onp.isfinite(last)
+        assert last < 0.5 * first, (first, last)
+
+
+class TestBucketing:
+    def test_bucketing_module_shares_params(self):
+        """BucketingModule trains across variable-length buckets with
+        shared parameters (reference: tests/python/train/test_bucketing.py)."""
+        import logging
+
+        def sym_gen(seq_len):
+            data = mx.sym.var("data")
+            label = mx.sym.var("softmax_label")
+            pooled = mx.sym.mean(data, axis=1)  # (N, C): length-invariant
+            fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
+            out = mx.sym.SoftmaxOutput(fc, label, name="softmax")
+            return out, ("data",), ("softmax_label",)
+
+        mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10)
+        from mxnet_tpu.io import DataBatch
+        rng = onp.random.RandomState(0)
+
+        def batch(T):
+            x = rng.randn(8, T, 6).astype("f")
+            y = (rng.rand(8) * 4).astype("f")
+            return DataBatch(data=[mx.nd.array(x)],
+                             label=[mx.nd.array(y)],
+                             bucket_key=T,
+                             provide_data=[("data", (8, T, 6))],
+                             provide_label=[("softmax_label", (8,))])
+
+        mod.bind(data_shapes=[("data", (8, 10, 6))],
+                 label_shapes=[("softmax_label", (8,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        losses = []
+        for i in range(12):
+            b = batch([6, 10, 14][i % 3])
+            mod.forward(b)
+            mod.backward()
+            mod.update()
+            out = mod.get_outputs()[0].asnumpy()
+        # parameters are shared: all buckets see the same fc weight
+        assert len(mod._buckets) == 3
+        w0 = mod._buckets[6].get_params()[0]["fc_weight"].asnumpy()
+        w1 = mod._buckets[14].get_params()[0]["fc_weight"].asnumpy()
+        onp.testing.assert_allclose(w0, w1)
