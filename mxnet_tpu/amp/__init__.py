@@ -1,0 +1,121 @@
+"""mx.amp — automatic mixed precision.
+
+Reference: python/mxnet/contrib/amp/amp.py. The reference rewrites the
+graph, inserting ``amp_cast``/``amp_multicast`` around whitelisted ops; the
+TPU-native design casts at the single op-invoke chokepoint
+(ops/invoke.py:_AMP) instead — same semantics, no namespace patching, and
+under ``jit`` XLA folds the casts into the surrounding fusions so bf16
+matmuls hit the MXU at full rate while master weights stay float32.
+
+Usage (mirrors the reference):
+    amp.init()                       # bf16-first policy
+    amp.init_trainer(trainer)
+    with amp.scale_loss(loss, trainer) as scaled:
+        scaled.backward()
+    trainer.step(batch_size)         # unscales, skips on overflow
+"""
+from __future__ import annotations
+
+import contextlib
+import warnings
+
+from ..base import dtype_np
+from ..ops import invoke as _invoke
+from .lists import LP_OPS, F32_OPS
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "uninit", "init_trainer", "scale_loss",
+           "convert_hybrid_block", "convert_model", "LossScaler"]
+
+_initialized = False
+_target_dtype = None
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Activate mixed precision (reference: amp.py:283 ``init``).
+
+    target_dtype: 'bfloat16' (TPU-native default) or 'float16'.
+    Extra op lists extend the built-in classification.
+    """
+    global _initialized, _target_dtype
+    d = dtype_np(target_dtype)
+    lp = set(LP_OPS) | set(target_precision_ops or ())
+    f32 = set(F32_OPS) | set(fp32_ops or ())
+    if conditional_fp32_ops:
+        f32 |= {name for name, _cond, _vals in conditional_fp32_ops}
+    _invoke._AMP.update(active=True, dtype=d, lp_ops=frozenset(lp),
+                        f32_ops=frozenset(f32))
+    _initialized = True
+    _target_dtype = target_dtype
+
+
+def uninit():
+    """Deactivate mixed precision casting."""
+    global _initialized
+    _invoke._AMP.update(active=False)
+    _initialized = False
+
+
+def init_trainer(trainer, loss_scaler=None):
+    """Attach dynamic loss scaling to a Trainer (reference: amp.py
+    init_trainer). Wraps ``trainer.step`` to unscale gradients and skip
+    the update on overflow."""
+    if getattr(trainer, "_amp_original_step", None) is not None:
+        return trainer
+    scaler = loss_scaler or LossScaler(
+        target_dtype=_target_dtype or "bfloat16")
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_step = trainer.step
+
+    def amp_step(batch_size, ignore_stale_grad=False):
+        if scaler.loss_scale != 1.0 and scaler.has_overflow(
+                trainer._params):
+            scaler.update_scale(overflow=True)
+            warnings.warn(
+                f"AMP: gradient overflow, skipping update and reducing "
+                f"loss scale to {scaler.loss_scale}", stacklevel=2)
+            return
+        prev = trainer._scale
+        trainer._scale = prev / scaler.loss_scale
+        try:
+            trainer._amp_original_step(batch_size, ignore_stale_grad)
+        finally:
+            trainer._scale = prev
+        scaler.update_scale(overflow=False)
+
+    trainer.step = amp_step
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Yield the loss multiplied by the current loss scale
+    (reference: amp.py scale_loss)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        yield loss
+        return
+    if isinstance(loss, (list, tuple)):
+        yield type(loss)(l * scaler.loss_scale for l in loss)
+    else:
+        yield loss * scaler.loss_scale
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16"):
+    """Cast a HybridBlock for low-precision inference
+    (reference: amp.py convert_hybrid_block)."""
+    block.cast(target_dtype)
+    return block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16"):
+    """Cast a symbolic model's parameters (reference: amp.py
+    convert_model). The symbol itself is dtype-agnostic here — dtypes
+    flow from the bound arrays."""
+    d = dtype_np(target_dtype)
+    cast_args = {k: v.astype(d) if v.dtype.kind == "f" else v
+                 for k, v in arg_params.items()}
+    cast_aux = {k: v.astype(d) if v.dtype.kind == "f" else v
+                for k, v in aux_params.items()}
+    return sym, cast_args, cast_aux

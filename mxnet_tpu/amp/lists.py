@@ -1,0 +1,27 @@
+"""AMP op classification lists.
+
+Reference: python/mxnet/contrib/amp/lists/symbol_fp16.py (FP16_FUNCS /
+FP32_FUNCS / WIDEST_TYPE_CASTS). TPU policy is bfloat16-first: matmul/conv
+class ops run in bf16 on the MXU; numerically-sensitive reductions,
+normalizations, softmaxes and losses stay float32. Ops in neither list run
+in whatever dtype their inputs carry (the reference's "widest type" bucket
+degenerates to this because bf16 and f32 share the exponent range — no
+cast needed for safety, only for speed).
+"""
+
+# run in low precision (bf16): MXU-bound contractions
+LP_OPS = frozenset({
+    "FullyConnected", "fully_connected", "Convolution", "convolution",
+    "Deconvolution", "dot", "batch_dot", "linalg_gemm", "linalg_gemm2",
+    "RNN", "rnn", "scaled_dot_product_attention", "Embedding", "embedding",
+})
+
+# forced to float32: softmax/norm/loss numerics
+F32_OPS = frozenset({
+    "softmax", "log_softmax", "softmin", "Softmax", "SoftmaxOutput",
+    "softmax_output", "softmax_cross_entropy", "CTCLoss", "ctc_loss",
+    "BatchNorm", "batch_norm", "LayerNorm", "layer_norm", "InstanceNorm",
+    "GroupNorm", "L2Normalization", "LRN", "norm", "logsumexp",
+    "exp", "log", "log1p", "expm1", "mean", "sum", "nansum", "nanprod",
+    "erf", "erfinv", "gamma", "gammaln", "smooth_l1", "moments",
+})

@@ -1,0 +1,50 @@
+"""Dynamic loss scaling.
+
+Reference: python/mxnet/contrib/amp/loss_scaler.py — multiply the loss by
+a scale before backward so small gradients survive reduced precision,
+check gradients for overflow, halve the scale on overflow (skipping the
+update) and double it after ``scale_window`` clean steps. On TPU the
+low-precision format is bfloat16, whose exponent range equals float32's,
+so the default scale is 1.0 and scaling only engages for float16 runs —
+the machinery is kept for parity and for float16 inference/export paths.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax.numpy as jnp
+
+
+class LossScaler:
+    def __init__(self, init_scale=None, scale_factor=2.0,
+                 scale_window=2000, target_dtype="bfloat16"):
+        if init_scale is None:
+            init_scale = 1.0 if target_dtype == "bfloat16" else 2.0 ** 16
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (reference:
+        loss_scaler.py has_overflow — there a fused multi-tensor kernel,
+        here one jnp.isfinite reduction per grad, fused by XLA)."""
+        for p in params:
+            if p.grad_req == "null":
+                continue
+            g = p.grad()
+            if g is None:
+                continue
+            if not bool(jnp.isfinite(g._data).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale = min(self.loss_scale * self._scale_factor,
+                                      2.0 ** 24)
+                self._unskipped = 0

@@ -2,4 +2,5 @@
 from .basic_layers import *  # noqa: F401,F403
 from .conv_layers import *  # noqa: F401,F403
 from .activations import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
 from ..block import Block, HybridBlock, SymbolBlock  # noqa: F401

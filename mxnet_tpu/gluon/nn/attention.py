@@ -1,0 +1,83 @@
+"""Attention layers.
+
+The reference has no attention kernel (SURVEY §5.7) — attention appears
+only as model-level example code. This layer family is the TPU-native
+fused-attention surface backing the BERT north-star config: projections
+are plain MXU matmuls and the core is the registered
+``scaled_dot_product_attention`` op (Pallas flash kernel on TPU,
+ops/flash_attention.py).
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .basic_layers import Dense, Dropout
+
+__all__ = ["MultiHeadAttention"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head scaled-dot-product attention.
+
+    Inputs: query (B, Tq, units); optional key/value default to query
+    (self-attention); optional ``mask`` is an additive row (B, Tk)
+    (0 = attend, large negative = drop) — the padding-mask form BERT uses.
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 causal=False, flash=True, weight_initializer=None,
+                 bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads != 0:
+            raise ValueError(
+                f"units ({units}) must be divisible by num_heads "
+                f"({num_heads})")
+        self._units = units
+        self._num_heads = num_heads
+        self._causal = causal
+        self._flash = flash
+        with self.name_scope():
+            common = dict(flatten=False, use_bias=use_bias,
+                          weight_initializer=weight_initializer,
+                          bias_initializer=bias_initializer)
+            self.query_proj = Dense(units, prefix="query_", **common)
+            self.key_proj = Dense(units, prefix="key_", **common)
+            self.value_proj = Dense(units, prefix="value_", **common)
+            self.out_proj = Dense(units, prefix="out_", **common)
+            self.dropout_layer = Dropout(dropout) if dropout else None
+
+    def _split_heads(self, x):
+        # (B, T, U) -> (B, H, T, D)
+        b, t, _ = x.shape
+        return x.reshape((b, t, self._num_heads, -1)).transpose(
+            (0, 2, 1, 3))
+
+    def _merge_heads(self, x):
+        b, h, t, d = x.shape
+        return x.transpose((0, 2, 1, 3)).reshape((b, t, h * d))
+
+    def forward(self, query, key=None, value=None, mask=None):
+        from ... import ndarray as F
+        if key is None:
+            key = query
+        if value is None:
+            value = key
+        q = self._split_heads(self.query_proj(query))
+        k = self._split_heads(self.key_proj(key))
+        v = self._split_heads(self.value_proj(value))
+        if mask is not None:
+            out = F.scaled_dot_product_attention(
+                q, k, v, mask, causal=self._causal, flash=self._flash)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, causal=self._causal, flash=self._flash)
+        out = self.out_proj(self._merge_heads(out))
+        if self.dropout_layer is not None:
+            out = self.dropout_layer(out)
+        return out
+
+    def hybrid_forward(self, F, x, *args, **kwargs):  # pragma: no cover
+        raise RuntimeError("MultiHeadAttention dispatches in forward()")
+
+    def __repr__(self):
+        return (f"MultiHeadAttention(units={self._units}, "
+                f"heads={self._num_heads}, causal={self._causal})")

@@ -1,0 +1,258 @@
+"""Pallas flash attention for TPU.
+
+The reference framework has no fused attention kernel at all (SURVEY §5.7:
+attention exists only as model-level example code), but the BERT-base
+north-star config names "fused attention + AMP" — this module provides it
+the TPU way: an online-softmax (flash) kernel in Pallas that never
+materializes the (T, T) score matrix in HBM.
+
+Design (see /opt/skills/guides/pallas_guide.md):
+- grid = (B*H, T/BLOCK_Q); each program owns one query block in VMEM and
+  streams key/value blocks, maintaining running max/denominator (the
+  standard flash recurrence) in f32 scratch. Matmuls hit the MXU with
+  ``preferred_element_type=float32``.
+- causal masking skips fully-masked key blocks; padding is handled with an
+  optional additive bias row (B, T) loaded per key block.
+- backward: ``jax.custom_vjp`` recomputes attention blockwise with the
+  lax reference implementation and differentiates that — O(T) memory
+  forward, standard-precision backward. (A hand-written Pallas backward is
+  a further optimization, not a semantic change.)
+- off-TPU (CPU tests, virtual meshes) the same kernel runs in interpret
+  mode; ``attention_reference`` is the oracle.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .registry import register
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, bias=None, causal=False, scale=None):
+    """Plain XLA attention, numerically the oracle for the kernel.
+
+    q/k/v: (B, H, T, D); bias: (B, Tk) additive (0 keep / -inf drop).
+    """
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * s
+    if bias is not None:
+        logits = logits + bias[:, None, None, :].astype(jnp.float32)
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, causal, scale, block_k,
+                  seq_k):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[0]
+
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[...].astype(jnp.float32) * scale
+    num_k = pl.cdiv(seq_k, block_k)
+
+    def body(ki, _):
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (bq, bk)
+        if bias_ref is not None:
+            s = s + bias_ref[0, pl.ds(ki * block_k, block_k)][None, :]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+        return 0
+
+    if causal:
+        # skip key blocks strictly above the diagonal of this query block
+        last = jnp.minimum(
+            pl.cdiv((qi + 1) * block_q, block_k), num_k)
+        jax.lax.fori_loop(0, last, body, 0)
+    else:
+        jax.lax.fori_loop(0, num_k, body, 0)
+
+    o_ref[...] = (acc_ref[...] /
+                  jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x, size
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths), size
+
+
+def _flash_forward(q, k, v, bias, causal, scale, block_q, block_k,
+                   interpret):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    s = scale if scale is not None else float(1.0 / (D ** 0.5))
+
+    q, _ = _pad_to(q, 2, block_q)
+    k, _ = _pad_to(k, 2, block_k)
+    v, _ = _pad_to(v, 2, block_k)
+    Tq_p, Tk_p = q.shape[2], k.shape[2]
+    # padded keys must never receive weight: extend the bias row
+    if Tk_p != Tk or bias is not None:
+        if bias is None:
+            bias = jnp.zeros((B, Tk), q.dtype)
+        bias = jnp.pad(bias.astype(jnp.float32), ((0, 0), (0, Tk_p - Tk)),
+                       constant_values=_NEG_INF)
+
+    qf = q.reshape(B * H, Tq_p, D)
+    kf = k.reshape(B * H, Tk_p, D)
+    vf = v.reshape(B * H, Tk_p, D)
+
+    grid = (B * H, Tq_p // block_q)
+    in_specs = [
+        pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((None, Tk_p, D), lambda b, i: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((None, Tk_p, D), lambda b, i: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [qf, kf, vf]
+    if bias is not None:
+        # one bias row per batch entry, shared across its H heads
+        in_specs.append(pl.BlockSpec(
+            (1, Tk_p), lambda b, i: (b // H, 0),
+            memory_space=pltpu.VMEM))
+        args.append(bias)
+
+        def kfn(qr, kr, vr, br, orf, acc, m, l):
+            _flash_kernel(qr, kr, vr, br, orf, acc, m, l, causal=causal,
+                          scale=s, block_k=block_k, seq_k=Tk_p)
+    else:
+        def kfn(qr, kr, vr, orf, acc, m, l):
+            _flash_kernel(qr, kr, vr, None, orf, acc, m, l, causal=causal,
+                          scale=s, block_k=block_k, seq_k=Tk_p)
+
+    out = pl.pallas_call(
+        kfn,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out.reshape(B, H, Tq_p, D)[:, :, :Tq, :]
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu" or \
+            jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, None, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, None, causal, scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention_reference(q, k, v, None, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_bias(q, k, v, bias, causal, scale, block_q, block_k,
+                          interpret):
+    return _flash_forward(q, k, v, bias, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _fab_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, bias, causal, scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v, bias)
+
+
+def _fab_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, bias = res
+    _, vjp = jax.vjp(
+        lambda q, k, v, b: attention_reference(q, k, v, b, causal, scale),
+        q, k, v, bias)
+    return vjp(g)
+
+
+_flash_attention_bias.defvjp(_fab_fwd, _fab_bwd)
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
+    """Flash attention entry point. q/k/v: (B, H, T, D); bias: (B, Tk)
+    additive row (0 = keep, large-negative = drop)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    block_q = min(block_q, max(q.shape[2], 8))
+    block_k = min(block_k, max(k.shape[2], 8))
+    if bias is None:
+        return _flash_attention(q, k, v, causal, scale, block_q, block_k,
+                                interpret)
+    return _flash_attention_bias(q, k, v, bias, causal, scale, block_q,
+                                 block_k, interpret)
+
+
+@register("scaled_dot_product_attention")
+def _sdpa_op(q, k, v, bias=None, *, causal=False, scale=None,
+             flash=True):
+    """Registered attention op: flash kernel on TPU, interpret/XLA
+    reference elsewhere. Inputs (B, H, T, D)."""
+    if not flash:
+        return attention_reference(q, k, v, bias, causal, scale)
+    return flash_attention(q, k, v, bias=bias, causal=causal, scale=scale)

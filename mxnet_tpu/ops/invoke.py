@@ -23,6 +23,32 @@ from .registry import Operator, get as get_op
 
 __all__ = ["apply_op", "apply_fn", "wrap_out", "as_jax"]
 
+# AMP hook state, mutated by mxnet_tpu.amp (the TPU-native analogue of the
+# reference's amp_cast graph-rewrite insertion, python/mxnet/contrib/amp/
+# amp.py:283 — here the cast happens at the op-invoke chokepoint instead
+# of by patching every generated namespace function).
+_AMP = {"active": False, "dtype": None, "lp_ops": frozenset(),
+        "f32_ops": frozenset()}
+
+
+def _amp_cast_inputs(op_name, inputs):
+    import numpy as _onp
+    NDArray = _ndarray_cls()
+    if op_name in _AMP["lp_ops"]:
+        target = _AMP["dtype"]
+    elif op_name in _AMP["f32_ops"]:
+        target = _onp.float32
+    else:
+        return inputs
+    out = []
+    for x in inputs:
+        if isinstance(x, NDArray) and x.dtype in (_onp.float32,
+                                                  _AMP["dtype"]) \
+                and x.dtype != target:
+            x = x.astype(target)
+        out.append(x)
+    return out
+
 
 def _ndarray_cls():
     from ..ndarray.ndarray import NDArray
@@ -100,6 +126,9 @@ def apply_op(op, inputs: Sequence, params: Optional[dict] = None, out=None):
     if not isinstance(op, Operator):
         op = get_op(op)
     params = dict(params) if params else {}
+
+    if _AMP["active"]:
+        inputs = _amp_cast_inputs(op.name, inputs)
 
     if op.needs_rng and "rng" not in params:
         params["rng"] = _rng.next_key()

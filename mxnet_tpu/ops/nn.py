@@ -447,6 +447,8 @@ def _activation(x, act_type="relu"):
             "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
             "softrelu": jax.nn.softplus, "softsign": jax.nn.soft_sign,
             "log_sigmoid": jax.nn.log_sigmoid,
+            "gelu": lambda v: jax.nn.gelu(v, approximate=False),
+            "silu": jax.nn.silu,
             "mish": lambda v: v * jnp.tanh(jax.nn.softplus(v))}
     return acts[act_type](x)
 
