@@ -45,46 +45,42 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """samples/sec logger (reference: callback.py:117)."""
+    """samples/sec logger, same call contract as the reference
+    (callback.py:117): a batch-end callback logging throughput (and the
+    current metric values) every ``frequent`` batches.
+
+    Implementation is a simple window timer: remember the monotonic clock
+    at the start of each reporting window; when the window closes, report
+    ``window_batches * batch_size / elapsed`` and start the next window.
+    A batch counter going backwards (new epoch) resets the window.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._window_start = None   # (monotonic time, batch count)
 
     def __call__(self, param):
         count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / \
-                        (time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float("inf")
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset_local()
-                    msg = "Epoch[%d] Batch [%d-%d]\tSpeed: %.2f " \
-                          "samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count - self.frequent,
-                                 count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        if self._window_start is None or count < self._window_start[1]:
+            self._window_start = (time.monotonic(), count)
+            return
+        t0, c0 = self._window_start
+        if count % self.frequent != 0 or count == c0:
+            return
+        elapsed = time.monotonic() - t0
+        speed = ((count - c0) * self.batch_size / elapsed
+                 if elapsed > 0 else float("inf"))
+        parts = [f"Epoch[{param.epoch}] Batch [{c0}-{count}]",
+                 f"Speed: {speed:.2f} samples/sec"]
+        if param.eval_metric is not None:
+            for name, value in param.eval_metric.get_name_value():
+                parts.append(f"{name}={value:f}")
+            if self.auto_reset:
+                param.eval_metric.reset_local()
+        logging.info("\t".join(parts))
+        self._window_start = (time.monotonic(), count)
 
 
 class ProgressBar:

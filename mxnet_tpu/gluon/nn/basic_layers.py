@@ -108,7 +108,8 @@ class HybridSequential(HybridBlock):
 class Dense(HybridBlock):
     """Fully-connected layer: ``act(dot(x, W^T) + b)``
     (reference: basic_layers.py:162 → FullyConnected op). The weight layout
-    (units, in_units) matches the reference so checkpoints interchange."""
+    (units, in_units) and param names match the reference; note the .params
+    file container is this repo's own format (see mxnet_tpu/model.py)."""
 
     def __init__(self, units, activation=None, use_bias=True, flatten=True,
                  dtype="float32", weight_initializer=None,

@@ -1,9 +1,12 @@
 """Checkpoint helpers + legacy FeedForward.
 
 Reference: python/mxnet/model.py (save_checkpoint :403, load_checkpoint
-:452, FeedForward). Checkpoints keep the reference's on-disk layout:
-``prefix-symbol.json`` + ``prefix-NNNN.params`` with ``arg:``/``aux:``
-key prefixes, so models interchange at the file level.
+:452, FeedForward). Checkpoints keep the reference's file naming and key
+conventions (``prefix-symbol.json`` + ``prefix-NNNN.params`` with
+``arg:``/``aux:`` key prefixes), but the .params container itself is this
+repo's MXTPU1 binary format (ndarray/__init__.py), NOT the reference's
+C++ NDArray serialisation — reference-produced .params files cannot be
+loaded directly and vice versa.
 """
 from __future__ import annotations
 

@@ -1,0 +1,155 @@
+"""Layout selection (NCHW vs NHWC) must be numerically transparent.
+
+The reference supports layout selection on conv/pool
+(src/operator/nn/convolution.cc:395-507); here layout='NHWC' keeps
+activations channels-last end-to-end (the fast path on TPU) with weights
+in OHWI. These tests pin NHWC == NCHW up to dtype round-off, at the op
+level and through the full ResNet zoo models.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops.registry import _REGISTRY
+import mxnet_tpu.autograd as ag
+
+
+def _op(name, *args, **kw):
+    import jax.numpy as jnp
+    arrays = [jnp.asarray(a) for a in args]
+    return np.asarray(_REGISTRY[name].impl(*arrays, **kw))
+
+
+def test_convolution_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 8, 10, 12).astype(np.float32)   # NCHW
+    w = rng.randn(16, 8, 3, 3).astype(np.float32)    # OIHW
+    b = rng.randn(16).astype(np.float32)
+    ref = _op("Convolution", x, w, b, kernel=(3, 3), stride=(2, 2),
+              pad=(1, 1), num_filter=16)
+    out = _op("Convolution", x.transpose(0, 2, 3, 1),
+              w.transpose(0, 2, 3, 1), b, kernel=(3, 3), stride=(2, 2),
+              pad=(1, 1), num_filter=16, layout="NHWC")
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_convolution_nhwc():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 8, 9, 9).astype(np.float32)
+    w = rng.randn(8, 2, 3, 3).astype(np.float32)
+    ref = _op("Convolution", x, w, kernel=(3, 3), num_filter=8,
+              num_group=4, no_bias=True)
+    out = _op("Convolution", x.transpose(0, 2, 3, 1),
+              w.transpose(0, 2, 3, 1), kernel=(3, 3), num_filter=8,
+              num_group=4, no_bias=True, layout="NHWC")
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_deconvolution_nhwc_matches_nchw():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 6, 7, 7).astype(np.float32)
+    w = rng.randn(6, 4, 4, 4).astype(np.float32)     # IOHW
+    ref = _op("Deconvolution", x, w, kernel=(4, 4), stride=(2, 2),
+              pad=(1, 1), num_filter=4)
+    out = _op("Deconvolution", x.transpose(0, 2, 3, 1),
+              w.transpose(0, 2, 3, 1), kernel=(4, 4), stride=(2, 2),
+              pad=(1, 1), num_filter=4, layout="NHWC")
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+@pytest.mark.parametrize("convention", ["valid", "full"])
+def test_pooling_nhwc_matches_nchw(pool_type, convention):
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 5, 11, 13).astype(np.float32)
+    kw = dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+              pool_type=pool_type, pooling_convention=convention)
+    ref = _op("Pooling", x, **kw)
+    out = _op("Pooling", x.transpose(0, 2, 3, 1), layout="NHWC", **kw)
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_global_pooling_nhwc():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 5, 6, 7).astype(np.float32)
+    ref = _op("Pooling", x, pool_type="avg", global_pool=True)
+    out = _op("Pooling", x.transpose(0, 2, 3, 1), pool_type="avg",
+              global_pool=True, layout="NHWC")
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_conv1d_nwc():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 4, 9).astype(np.float32)        # NCW
+    w = rng.randn(6, 4, 3).astype(np.float32)        # OIW
+    ref = _op("Convolution", x, w, kernel=(3,), num_filter=6, no_bias=True)
+    out = _op("Convolution", x.transpose(0, 2, 1), w.transpose(0, 2, 1),
+              kernel=(3,), num_filter=6, no_bias=True, layout="NWC")
+    np.testing.assert_allclose(out.transpose(0, 2, 1), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def _copy_params_permuted(src_net, dst_net):
+    p1, p2 = src_net.collect_params(), dst_net.collect_params()
+    for ka, kb in zip(sorted(p1), sorted(p2)):
+        v = p1[ka].data().asnumpy()
+        if v.ndim == 4:  # OIHW -> OHWI
+            v = v.transpose(0, 2, 3, 1)
+        p2[kb].set_data(mx.nd.array(v))
+
+
+@pytest.mark.parametrize("factory,version", [("resnet18_v1", 1),
+                                             ("resnet18_v2", 2)])
+def test_resnet_nhwc_matches_nchw(factory, version):
+    from mxnet_tpu.gluon.model_zoo import vision
+    import jax.numpy as jnp
+
+    mx.random.seed(0)
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+    n1 = getattr(vision, factory)(thumbnail=True)
+    n1.initialize(init=mx.initializer.Xavier())
+    with ag.pause():
+        o1 = n1(nd.NDArray(jnp.asarray(x)))
+
+    n2 = getattr(vision, factory)(thumbnail=True, layout="NHWC")
+    n2.initialize(init=mx.initializer.Xavier())
+    xt = x.transpose(0, 2, 3, 1)
+    with ag.pause():
+        n2(nd.NDArray(jnp.asarray(xt)))  # shape warmup
+    _copy_params_permuted(n1, n2)
+    with ag.pause():
+        o2 = n2(nd.NDArray(jnp.asarray(xt)))
+    np.testing.assert_allclose(o2.asnumpy(), o1.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_nhwc_training_step_grads():
+    """Gradients must flow through the NHWC path (conv+pool+BN train mode)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, layout="NHWC"))
+    net.add(nn.BatchNorm(axis=3))
+    net.add(nn.Activation("relu"))
+    net.add(nn.MaxPool2D(2, layout="NHWC"))
+    net.add(nn.GlobalAvgPool2D(layout="NHWC"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 8, 8, 3))
+    y = mx.nd.array(np.array([0, 1, 2, 3]))
+    with ag.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(4)
+    g = net[0].weight.grad().asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
