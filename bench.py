@@ -216,6 +216,7 @@ def main():
     suspect = False
     notes = []
     flops = r["train_flops"]
+    flops_source = "xla_cost_analysis"
     if flops:
         ratio = flops / (ANALYTIC_TRAIN_FLOPS_IMG * batch)
         if not (0.5 <= ratio <= 2.0):
@@ -226,6 +227,7 @@ def main():
         notes.append("no XLA cost analysis available; MFU from analytic "
                      "FLOP estimate")
         flops = ANALYTIC_TRAIN_FLOPS_IMG * batch
+        flops_source = "analytic_estimate"
     implied = flops / r["train_dt"]
     if peak and implied > 1.15 * peak:
         suspect = True
@@ -241,7 +243,7 @@ def main():
         "infer_vs_baseline": round(r["infer_img_s"] / INFER_BASELINE_IMG_S,
                                    3),
         "dtype": dtype, "layout": layout,
-        "xla_flops_per_step": flops,
+        "flops_per_step": flops, "flops_source": flops_source,
         "implied_tflops": round(implied / 1e12, 2),
         "device_kind": getattr(dev, "device_kind", str(dev)),
         "final_loss": round(r["final_loss"], 4),

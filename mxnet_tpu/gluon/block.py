@@ -330,15 +330,18 @@ class Block:
         return ret
 
     # ------------------------------------------------------------ compute --
-    def __call__(self, *args):
+    def __call__(self, *args, **kwargs):
+        # hooks see every input: keyword inputs (e.g. mask=, valid_length=)
+        # are appended as a dict when present
+        hook_args = args + (kwargs,) if kwargs else args
         for hook in self._forward_pre_hooks.values():
-            hook(self, args)
-        out = self.forward(*args)
+            hook(self, hook_args)
+        out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks.values():
-            hook(self, args, out)
+            hook(self, hook_args, out)
         return out
 
-    def forward(self, *args):
+    def forward(self, *args, **kwargs):
         raise NotImplementedError
 
     def hybridize(self, active=True, **kwargs):
@@ -645,8 +648,8 @@ class HybridBlock(Block):
         self._cached_op = None
         super().cast(dtype)
 
-    def __call__(self, *args):
-        return super().__call__(*args)
+    def __call__(self, *args, **kwargs):
+        return super().__call__(*args, **kwargs)
 
     def forward(self, x, *args):
         if self._active and not _TRACE_STACK:

@@ -1,0 +1,158 @@
+"""Numeric-testing harness — the reference's test_utils surface, TPU-way.
+
+Reference: python/mxnet/test_utils.py (assert_almost_equal :561,
+check_numeric_gradient :987, check_symbolic_forward :1130,
+check_consistency, rand_ndarray :388, default_context :57). The reference
+checks symbolic executors' hand-written backward kernels against finite
+differences; here every gradient comes from one AD engine (jax.vjp via
+the autograd tape), so the same harness instead pins the *framework
+path* — registered op -> invoke chokepoint -> tape -> backward — against
+central finite differences of the eager forward, and "consistency" means
+eager vs jit-compiled execution of the same op (the TPU analogue of the
+reference's cpu-vs-gpu check_consistency).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+
+
+__all__ = ["default_context", "rand_ndarray", "assert_almost_equal",
+           "numeric_grad", "check_numeric_gradient",
+           "check_eager_jit_consistency", "same", "almost_equal"]
+
+
+def default_context():
+    from .context import current_context
+    return current_context()
+
+
+def _to_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return np.asarray(a)
+
+
+def rand_ndarray(shape, dtype=np.float32, scale=1.0, rng=None):
+    rng = rng or np.random
+    return nd.array((rng.standard_normal(size=shape) * scale).astype(dtype))
+
+
+def same(a, b):
+    return np.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    return np.allclose(_to_np(a), _to_np(b), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    """Same contract as the reference's assert_almost_equal
+    (test_utils.py:561): elementwise closeness with named operands in the
+    failure message."""
+    a_np, b_np = _to_np(a), _to_np(b)
+    if a_np.shape != b_np.shape:
+        raise AssertionError(
+            f"shape mismatch: {names[0]}{a_np.shape} vs "
+            f"{names[1]}{b_np.shape}")
+    if np.allclose(a_np, b_np, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    err = np.abs(a_np - b_np)
+    denom = np.maximum(np.abs(b_np), 1e-30)
+    idx = np.unravel_index(np.argmax(err / (atol + rtol * denom)),
+                           err.shape)
+    raise AssertionError(
+        f"{names[0]} and {names[1]} differ beyond rtol={rtol}, "
+        f"atol={atol}: max violation at {tuple(int(i) for i in idx)}: "
+        f"{a_np[idx]!r} vs {b_np[idx]!r} (|diff|={err[idx]:.3g})")
+
+
+def numeric_grad(f, inputs, eps=1e-4):
+    """Central finite differences of scalar-valued ``f`` over a list of
+    numpy arrays (reference: test_utils.py numeric_grad inside
+    check_numeric_gradient)."""
+    grads = []
+    for i, x in enumerate(inputs):
+        g = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        gflat = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = f(*inputs)
+            flat[j] = orig - eps
+            fm = f(*inputs)
+            flat[j] = orig
+            gflat[j] = (fp - fm) / (2 * eps)
+        grads.append(g.astype(x.dtype))
+    return grads
+
+
+def check_numeric_gradient(op_name, inputs, kwargs=None, rtol=1e-2,
+                           atol=1e-3, eps=1e-3, rng=None,
+                           grad_inputs=None):
+    """Pin the autograd gradient of a registered op against central
+    finite differences (reference: test_utils.py:987).
+
+    op_name: name in the op registry (or a callable taking NDArrays).
+    inputs: list of numpy float arrays (keep them small — numeric diff is
+    O(size) forward evaluations).
+    grad_inputs: indices of inputs to check (default: all).
+    """
+    kwargs = kwargs or {}
+    rng = rng or np.random.RandomState(0)
+    op = getattr(nd, op_name) if isinstance(op_name, str) else op_name
+    inputs = [np.asarray(x, dtype=np.float64).astype(np.float32)
+              for x in inputs]
+    if grad_inputs is None:
+        grad_inputs = range(len(inputs))
+
+    # random fixed projection makes the output scalar without zeroing
+    # any gradient component
+    with autograd.pause():
+        probe = op(*[nd.array(x) for x in inputs], **kwargs)
+    proj = rng.standard_normal(size=probe.shape).astype(np.float32)
+
+    def scalar_f(*xs):
+        with autograd.pause():
+            out = op(*[nd.array(x) for x in xs], **kwargs)
+        return float((out * nd.array(proj)).sum().asnumpy())
+
+    arrs = [nd.array(x) for x in inputs]
+    for i in grad_inputs:
+        arrs[i].attach_grad()
+    with autograd.record():
+        out = op(*arrs, **kwargs)
+        loss = (out * nd.array(proj)).sum()
+    loss.backward()
+
+    expected = numeric_grad(scalar_f, [x.copy() for x in inputs], eps=eps)
+    for i in grad_inputs:
+        assert_almost_equal(
+            arrs[i].grad, expected[i], rtol=rtol, atol=atol,
+            names=(f"autograd_d{op_name if isinstance(op_name, str) else 'f'}"
+                   f"/dx{i}", "numeric"))
+
+
+def check_eager_jit_consistency(op_name, inputs, kwargs=None, rtol=1e-5,
+                                atol=1e-6):
+    """Eager vs jit-compiled execution of a registered op must agree —
+    the TPU analogue of the reference's cpu-vs-gpu check_consistency."""
+    import jax
+    import jax.numpy as jnp
+    from .ops.registry import _REGISTRY
+
+    kwargs = kwargs or {}
+    op = _REGISTRY[op_name]
+    xs = [jnp.asarray(x) for x in inputs]
+    eager = op.impl(*xs, **kwargs)
+    jitted = jax.jit(lambda *a: op.impl(*a, **kwargs))(*xs)
+    for e, j in ([(eager, jitted)] if not isinstance(eager, (tuple, list))
+                 else zip(eager, jitted)):
+        assert_almost_equal(np.asarray(j), np.asarray(e), rtol=rtol,
+                            atol=atol, names=("jit", "eager"))

@@ -1,0 +1,126 @@
+"""AMP (automatic mixed precision) end-to-end tests.
+
+Covers the chokepoint casting (ops/invoke.py), LossScaler overflow-skip,
+and a bf16 LeNet convergence run — the pieces VERDICT round 2 flagged as
+untested. Reference behavior: python/mxnet/contrib/amp/.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, gluon, nd
+from mxnet_tpu.gluon import nn
+import mxnet_tpu.autograd as ag
+
+
+@pytest.fixture(autouse=True)
+def _amp_cleanup():
+    yield
+    amp.uninit()
+
+
+def test_amp_casts_lp_ops_to_bf16():
+    amp.init()
+    a = nd.array(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    w = nd.array(np.random.RandomState(1).randn(16, 8).astype(np.float32))
+    out = nd.FullyConnected(a, w, no_bias=True, num_hidden=16)
+    assert out.dtype == np.dtype("bfloat16"), out.dtype
+    # f32-forced ops stay f32 even on bf16 inputs
+    s = nd.softmax(out)
+    assert s.dtype == np.dtype("float32"), s.dtype
+
+
+def test_amp_inactive_after_uninit():
+    amp.init()
+    amp.uninit()
+    a = nd.array(np.ones((2, 4), np.float32))
+    w = nd.array(np.ones((3, 4), np.float32))
+    out = nd.FullyConnected(a, w, no_bias=True, num_hidden=3)
+    assert out.dtype == np.dtype("float32")
+
+
+def test_loss_scaler_overflow_skips_update_and_halves_scale():
+    amp.init(target_dtype="float16")
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    scale0 = scaler.loss_scale
+    assert scale0 > 1.0  # float16 engages real scaling
+
+    x = nd.array(np.ones((2, 4), np.float32))
+    w_before = net.weight.data().asnumpy().copy()
+
+    # poison the gradient with inf -> step must be skipped, scale halved
+    with ag.record():
+        loss = net(x).sum()
+    loss.backward()
+    net.weight.grad()._data = (net.weight.grad()._data * np.inf)
+    with pytest.warns(UserWarning, match="overflow"):
+        trainer.step(2)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_before)
+    assert scaler.loss_scale == scale0 / 2
+
+    # clean step updates params and counts toward the growth window
+    with ag.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)
+    assert not np.array_equal(net.weight.data().asnumpy(), w_before)
+
+
+def test_scale_loss_context_multiplies_by_scale():
+    amp.init(target_dtype="float16")
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.0})
+    amp.init_trainer(trainer)
+    scale = trainer._amp_loss_scaler.loss_scale
+    loss = nd.array(np.array([1.5]))
+    with amp.scale_loss(loss, trainer) as scaled:
+        np.testing.assert_allclose(scaled.asnumpy(), [1.5 * scale])
+
+
+def test_bf16_lenet_convergence():
+    """LeNet under amp.init() must train on a toy problem: the AMP
+    chokepoint casts conv/dense to bf16 while softmax/loss stay f32."""
+    amp.init()
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"))
+    net.add(nn.MaxPool2D(2))
+    net.add(nn.Conv2D(16, 3, padding=1, activation="relu"))
+    net.add(nn.GlobalAvgPool2D())
+    net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.3, "momentum": 0.9})
+    amp.init_trainer(trainer)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    # 4 classes distinguished by which quadrant carries signal
+    x_np = rng.randn(32, 1, 8, 8).astype(np.float32) * 0.1
+    y_np = np.arange(32) % 4
+    for i, c in enumerate(y_np):
+        x_np[i, 0, (c // 2) * 4:(c // 2) * 4 + 4,
+             (c % 2) * 4:(c % 2) * 4 + 4] += 1.0
+    x, y = nd.array(x_np), nd.array(y_np.astype(np.float32))
+
+    losses = []
+    for _ in range(40):
+        with ag.record():
+            out = net(x)
+            loss = loss_fn(out, y).mean()
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+    # the conv compute really ran in bf16 under AMP
+    with ag.pause():
+        feat = net[0](x)
+    assert feat.dtype == np.dtype("bfloat16")

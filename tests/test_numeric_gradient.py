@@ -1,0 +1,177 @@
+"""Numeric-gradient sweep over the op registry.
+
+The reference sweeps its hand-written backward kernels with
+check_numeric_gradient (tests/python/unittest/test_operator.py uses
+python/mxnet/test_utils.py:987 pervasively); here the same harness pins
+the framework path (op -> invoke -> tape -> jax.vjp) against central
+finite differences, op family by op family, plus eager-vs-jit
+consistency (the TPU analogue of check_consistency).
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_eager_jit_consistency,
+                                  check_numeric_gradient)
+
+
+def _r(*shape, seed=0, scale=1.0, shift=0.0):
+    return np.random.RandomState(seed).randn(*shape) * scale + shift
+
+
+# (op, inputs, kwargs) — inputs kept tiny: numeric diff is O(size) evals.
+UNARY_SMOOTH = [
+    ("exp", [_r(3, 4, scale=0.5)], {}),
+    ("log", [np.abs(_r(3, 4)) + 0.5], {}),
+    ("log10", [np.abs(_r(3, 4)) + 0.5], {}),
+    ("log2", [np.abs(_r(3, 4)) + 0.5], {}),
+    ("log1p", [np.abs(_r(3, 4))], {}),
+    ("expm1", [_r(3, 4, scale=0.5)], {}),
+    ("sqrt", [np.abs(_r(3, 4)) + 0.5], {}),
+    ("rsqrt", [np.abs(_r(3, 4)) + 0.5], {}),
+    ("cbrt", [np.abs(_r(3, 4)) + 0.5], {}),
+    ("rcbrt", [np.abs(_r(3, 4)) + 0.5], {}),
+    ("square", [_r(3, 4)], {}),
+    ("reciprocal", [np.abs(_r(3, 4)) + 0.5], {}),
+    ("sin", [_r(3, 4)], {}),
+    ("cos", [_r(3, 4)], {}),
+    ("tan", [_r(3, 4, scale=0.3)], {}),
+    ("sinh", [_r(3, 4, scale=0.5)], {}),
+    ("cosh", [_r(3, 4, scale=0.5)], {}),
+    ("tanh", [_r(3, 4)], {}),
+    ("arcsin", [_r(3, 4, scale=0.3)], {}),
+    ("arccos", [_r(3, 4, scale=0.3)], {}),
+    ("arctan", [_r(3, 4)], {}),
+    ("arcsinh", [_r(3, 4)], {}),
+    ("arccosh", [np.abs(_r(3, 4)) + 1.5], {}),
+    ("arctanh", [_r(3, 4, scale=0.3)], {}),
+    ("sigmoid", [_r(3, 4)], {}),
+    ("log_sigmoid", [_r(3, 4)], {}),
+    ("softsign", [_r(3, 4)], {}),
+    ("softrelu", [_r(3, 4)], {}),
+    ("erf", [_r(3, 4, scale=0.5)], {}),
+    ("erfinv", [_r(3, 4, scale=0.2)], {}),
+    ("gamma", [np.abs(_r(3, 4)) + 1.0], {}),
+    ("gammaln", [np.abs(_r(3, 4)) + 1.0], {}),
+    ("gelu", [_r(3, 4)], {}),
+    ("silu", [_r(3, 4)], {}),
+    ("mish", [_r(3, 4)], {}),
+    ("negative", [_r(3, 4)], {}),
+    ("relu", [_r(3, 4, shift=0.3)], {}),   # keep away from the kink
+    ("abs", [_r(3, 4, shift=0.3)], {}),
+    ("smooth_l1", [_r(3, 4, shift=3.0)], {}),
+    ("logsumexp", [_r(3, 4)], {"axis": 1}),
+]
+
+BINARY = [
+    ("elemwise_add", [_r(3, 4), _r(3, 4, seed=1)], {}),
+    ("elemwise_sub", [_r(3, 4), _r(3, 4, seed=1)], {}),
+    ("elemwise_mul", [_r(3, 4), _r(3, 4, seed=1)], {}),
+    ("elemwise_div", [_r(3, 4), np.abs(_r(3, 4, seed=1)) + 0.5], {}),
+    ("broadcast_add", [_r(3, 4), _r(1, 4, seed=1)], {}),
+    ("broadcast_mul", [_r(3, 4), _r(3, 1, seed=1)], {}),
+    ("broadcast_sub", [_r(3, 4), _r(1, 4, seed=1)], {}),
+    ("broadcast_div", [_r(3, 4), np.abs(_r(1, 4, seed=1)) + 0.5], {}),
+    ("broadcast_power", [np.abs(_r(3, 4)) + 0.5,
+                         _r(1, 4, seed=1, scale=0.5)], {}),
+    ("broadcast_hypot", [_r(3, 4, shift=2), _r(1, 4, seed=1, shift=2)], {}),
+    ("broadcast_maximum", [_r(3, 4), _r(3, 4, seed=1) + 0.05], {}),
+    ("broadcast_minimum", [_r(3, 4), _r(3, 4, seed=1) + 0.05], {}),
+    ("arctan2", [_r(3, 4, shift=1.5), _r(3, 4, seed=1, shift=1.5)], {}),
+    ("hypot", [_r(3, 4, shift=2), _r(3, 4, seed=1, shift=2)], {}),
+    ("maximum", [_r(3, 4), _r(3, 4, seed=1) + 0.05], {}),
+    ("minimum", [_r(3, 4), _r(3, 4, seed=1) + 0.05], {}),
+]
+
+REDUCE_SHAPE = [
+    ("sum", [_r(3, 4)], {"axis": 1}),
+    ("mean", [_r(3, 4)], {"axis": 0}),
+    ("prod", [np.abs(_r(3, 3)) + 0.5], {"axis": 1}),
+    ("nansum", [_r(3, 4)], {"axis": 1}),
+    ("max", [_r(3, 4)], {"axis": 1}),
+    ("min", [_r(3, 4)], {"axis": 1}),
+    ("norm", [_r(3, 4, shift=1)], {"ord": 2, "axis": 1}),
+    ("transpose", [_r(3, 4)], {}),
+    ("reshape", [_r(3, 4)], {"shape": (4, 3)}),
+    ("flatten", [_r(2, 3, 4)], {}),
+    ("expand_dims", [_r(3, 4)], {"axis": 1}),
+    ("squeeze", [_r(3, 1, 4)], {}),
+    ("flip", [_r(3, 4)], {"axis": 1}),
+    ("reverse", [_r(3, 4)], {"axis": 1}),
+    ("tile", [_r(2, 3)], {"reps": (2, 2)}),
+    ("repeat", [_r(2, 3)], {"repeats": 2, "axis": 1}),
+    ("pad", [_r(1, 1, 3, 4)], {"mode": "constant",
+                               "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}),
+    ("slice", [_r(4, 5)], {"begin": (1, 0), "end": (3, 4)}),
+    ("slice_axis", [_r(4, 5)], {"axis": 1, "begin": 1, "end": 4}),
+    ("clip", [_r(3, 4, scale=2)], {"a_min": -1.0, "a_max": 1.0}),
+    ("swapaxes", [_r(2, 3, 4)], {"dim1": 0, "dim2": 2}),
+    ("cumsum", [_r(3, 4)], {"axis": 1}),
+    ("diag", [_r(4, 4)], {}),
+    ("where", [np.array([[1.0, 0.0], [0.0, 1.0]]), _r(2, 2),
+               _r(2, 2, seed=1)], {"_numeric_grad_inputs": (1, 2)}),
+]
+
+NN_OPS = [
+    ("softmax", [_r(3, 5)], {}),
+    ("log_softmax", [_r(3, 5)], {}),
+    ("softmin", [_r(3, 5)], {}),
+    ("FullyConnected", [_r(3, 4), _r(5, 4, seed=1), _r(5, seed=2)],
+     {"num_hidden": 5}),
+    ("dot", [_r(3, 4), _r(4, 5, seed=1)], {}),
+    ("batch_dot", [_r(2, 3, 4), _r(2, 4, 5, seed=1)], {}),
+    ("Convolution", [_r(1, 2, 5, 5), _r(3, 2, 3, 3, seed=1, scale=0.5),
+                     _r(3, seed=2)],
+     {"kernel": (3, 3), "num_filter": 3, "pad": (1, 1)}),
+    ("Deconvolution", [_r(1, 2, 4, 4), _r(2, 3, 2, 2, seed=1, scale=0.5)],
+     {"kernel": (2, 2), "stride": (2, 2), "num_filter": 3,
+      "no_bias": True}),
+    ("Pooling", [_r(1, 2, 6, 6)],
+     {"kernel": (2, 2), "stride": (2, 2), "pool_type": "avg"}),
+    ("LayerNorm", [_r(3, 6), np.ones(6, np.float32),
+                   np.zeros(6, np.float32)], {}),
+    ("L2Normalization", [_r(2, 3, 4, shift=1)], {}),
+    ("Activation", [_r(3, 4, shift=0.3)], {"act_type": "tanh"}),
+    ("LeakyReLU", [_r(3, 4, shift=0.3)], {"act_type": "leaky",
+                                          "slope": 0.1}),
+    ("pick", [_r(3, 4), np.array([0.0, 2.0, 1.0])],
+     {"_numeric_grad_inputs": (0,)}),
+    ("take", [_r(4, 3), np.array([0.0, 2.0])],
+     {"_numeric_grad_inputs": (0,)}),
+    ("Embedding", [np.array([0.0, 2.0, 1.0]), _r(4, 3)],
+     {"input_dim": 4, "output_dim": 3, "_numeric_grad_inputs": (1,)}),
+    ("one_hot", [np.array([0.0, 2.0])], {"depth": 4,
+                                         "_numeric_grad_inputs": ()}),
+]
+
+ALL_CASES = UNARY_SMOOTH + BINARY + REDUCE_SHAPE + NN_OPS
+
+
+@pytest.mark.parametrize(
+    "op,inputs,kwargs", ALL_CASES,
+    ids=[f"{c[0]}-{i}" for i, c in enumerate(ALL_CASES)])
+def test_numeric_gradient(op, inputs, kwargs):
+    kwargs = dict(kwargs)
+    grad_inputs = kwargs.pop("_numeric_grad_inputs", None)
+    if grad_inputs == ():
+        pytest.skip("no differentiable inputs")
+    check_numeric_gradient(op, inputs, kwargs, rtol=2e-2, atol=2e-3,
+                           grad_inputs=grad_inputs)
+
+
+@pytest.mark.parametrize(
+    "op,inputs,kwargs", ALL_CASES,
+    ids=[f"{c[0]}-{i}" for i, c in enumerate(ALL_CASES)])
+def test_eager_jit_consistency(op, inputs, kwargs):
+    kwargs = {k: v for k, v in kwargs.items()
+              if k != "_numeric_grad_inputs"}
+    check_eager_jit_consistency(
+        op, [np.asarray(x, np.float32) for x in inputs], kwargs)
+
+
+def test_assert_almost_equal_reports_location():
+    a = np.zeros((2, 2))
+    b = np.zeros((2, 2))
+    b[1, 0] = 1.0
+    with pytest.raises(AssertionError, match=r"\(1, 0\)"):
+        assert_almost_equal(a, b)
