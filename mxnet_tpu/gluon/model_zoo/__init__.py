@@ -1,3 +1,5 @@
 """Model zoo (reference: python/mxnet/gluon/model_zoo/)."""
 from . import vision  # noqa: F401
+from . import ssd  # noqa: F401
 from .vision import get_model  # noqa: F401
+from .ssd import ssd_300_vgg16_reduced, MultiBoxLoss, SSD  # noqa: F401

@@ -1,0 +1,204 @@
+"""SSD: Single Shot MultiBox Detector (VGG16-reduced, 300x300).
+
+Reference: example/ssd/symbol/symbol_builder.py (get_symbol_train /
+get_symbol), example/ssd/symbol/vgg16_reduced.py (the backbone with
+dilated fc6/fc7 convs), example/ssd/train/train_net.py (loss wiring).
+The north-star BASELINE.md names "SSD-300 VGG16" as a required config.
+
+TPU-first notes: the whole detector — backbone, heads, anchor
+generation — is one HybridBlock, so under hybridize it compiles to a
+single XLA program; anchors are constants folded at trace time. The
+MultiBox* ops it drives are the fixed-shape mask-based kernels in
+ops/contrib_det.py.
+"""
+from __future__ import annotations
+
+
+from ..block import HybridBlock
+from ..loss import Loss
+from .. import nn
+
+__all__ = ["SSD", "MultiBoxLoss", "ssd_300_vgg16_reduced", "vgg16_reduced"]
+
+
+class _L2NormScale(HybridBlock):
+    """Channel-wise L2 normalization with a learned per-channel scale
+    (reference: symbol_builder.py uses L2Normalization mode='channel'
+    with an init-20 scale on relu4_3)."""
+
+    def __init__(self, n_channel, initial=20.0, **kwargs):
+        super().__init__(**kwargs)
+        from ...initializer import Constant
+        with self.name_scope():
+            self.scale = self.params.get(
+                "scale", shape=(1, n_channel, 1, 1),
+                init=Constant(initial))
+
+    def hybrid_forward(self, F, x, scale=None):
+        return F.L2Normalization(x, mode="channel") * scale
+
+
+def vgg16_reduced():
+    """VGG16 with pool5 3x3/1 and dilated fc6/fc7 convs
+    (reference: example/ssd/symbol/vgg16_reduced.py). Returns the list of
+    stages; stage outputs feed the SSD heads."""
+    cfg = [(2, 64), (2, 128), (3, 256), (3, 512)]
+    up_to_relu43 = nn.HybridSequential(prefix="")
+    for i, (n, ch) in enumerate(cfg):
+        for _ in range(n):
+            up_to_relu43.add(nn.Conv2D(ch, 3, padding=1,
+                                       activation="relu"))
+        if i < len(cfg) - 1:
+            # caffe-style ceil pooling: 300 -> 150 -> 75 -> 38 (the SSD-300
+            # anchor ledger depends on the 38x38 first feature map)
+            up_to_relu43.add(nn.MaxPool2D(2, 2, ceil_mode=True))
+
+    rest = nn.HybridSequential(prefix="")
+    rest.add(nn.MaxPool2D(2, 2, ceil_mode=True))
+    for _ in range(3):
+        rest.add(nn.Conv2D(512, 3, padding=1, activation="relu"))
+    rest.add(nn.MaxPool2D(3, 1, 1))  # pool5: 3x3 stride 1
+    # fc6: dilated 3x3, fc7: 1x1 (the "reduced" fully-conv fc layers)
+    rest.add(nn.Conv2D(1024, 3, padding=6, dilation=6, activation="relu"))
+    rest.add(nn.Conv2D(1024, 1, activation="relu"))
+    return up_to_relu43, rest
+
+
+def _extra_layers(spec):
+    """Extra feature stages appended after the backbone
+    (reference: symbol_builder.py multi_layer_feature)."""
+    stages = []
+    for mid, out, stride, pad in spec:
+        s = nn.HybridSequential(prefix="")
+        s.add(nn.Conv2D(mid, 1, activation="relu"))
+        s.add(nn.Conv2D(out, 3, strides=stride, padding=pad,
+                        activation="relu"))
+        stages.append(s)
+    return stages
+
+
+class SSD(HybridBlock):
+    """Generic SSD detector.
+
+    stages: list of HybridSequential feature stages applied in sequence;
+    the output of each (from the first onwards) feeds a detection head.
+    sizes/ratios: per-stage anchor parameters (MultiBoxPrior convention).
+    Returns (cls_preds (N, C+1, A), loc_preds (N, A*4), anchors (1, A, 4)).
+    """
+
+    def __init__(self, stages, sizes, ratios, steps, classes,
+                 l2_norm_channels=None, **kwargs):
+        super().__init__(**kwargs)
+        assert len(stages) == len(sizes) == len(ratios) == len(steps)
+        self._num_classes = classes
+        self._sizes = sizes
+        self._ratios = ratios
+        self._steps = steps
+        with self.name_scope():
+            self.stages = nn.HybridSequential(prefix="stages_")
+            for s in stages:
+                self.stages.add(s)
+            self.norm = (_L2NormScale(l2_norm_channels, prefix="l2norm_")
+                         if l2_norm_channels else None)
+            self.cls_heads = nn.HybridSequential(prefix="cls_")
+            self.loc_heads = nn.HybridSequential(prefix="loc_")
+            for sz, rt in zip(sizes, ratios):
+                k = len(sz) + len(rt) - 1
+                self.cls_heads.add(nn.Conv2D(k * (classes + 1), 3,
+                                             padding=1))
+                self.loc_heads.add(nn.Conv2D(k * 4, 3, padding=1))
+
+    def forward(self, x):
+        from ... import ndarray as F
+        cls_preds, loc_preds, anchors = [], [], []
+        feat = x
+        for i, stage in enumerate(self.stages):
+            feat = stage(feat)
+            f = self.norm(feat) if (i == 0 and self.norm is not None) \
+                else feat
+            c = self.cls_heads[i](f)
+            l = self.loc_heads[i](f)
+            n = c.shape[0]
+            # (N, K*(C+1), H, W) -> (N, H*W*K, C+1)
+            c = c.transpose((0, 2, 3, 1)).reshape(
+                (n, -1, self._num_classes + 1))
+            l = l.transpose((0, 2, 3, 1)).reshape((n, -1))
+            cls_preds.append(c)
+            loc_preds.append(l)
+            anchors.append(F._contrib_MultiBoxPrior(
+                f, sizes=self._sizes[i], ratios=self._ratios[i],
+                steps=(self._steps[i], self._steps[i]), clip=False))
+        cls_concat = F.concat(*cls_preds, dim=1).transpose((0, 2, 1))
+        loc_concat = F.concat(*loc_preds, dim=1)
+        anc_concat = F.concat(*anchors, dim=1)
+        return cls_concat, loc_concat, anc_concat
+
+    def hybrid_forward(self, F, x, *args, **kwargs):  # pragma: no cover
+        raise RuntimeError("SSD uses forward()")
+
+    def detect(self, x, nms_threshold=0.45, threshold=0.01, nms_topk=400):
+        """Full inference: forward + softmax + decode + NMS ->
+        (N, A, 6) rows [cls_id, score, x1, y1, x2, y2]."""
+        from ... import ndarray as F
+        cls_preds, loc_preds, anchors = self(x)
+        probs = F.softmax(cls_preds, axis=1)
+        return F._contrib_MultiBoxDetection(
+            probs, loc_preds, anchors, nms_threshold=nms_threshold,
+            threshold=threshold, nms_topk=nms_topk)
+
+
+class MultiBoxLoss(Loss):
+    """SSD training loss (reference: example/ssd/symbol/symbol_builder.py
+    get_symbol_train: SoftmaxOutput w/ ignore + smooth_l1 * loc_mask,
+    negative mining 3:1).
+
+    __call__(cls_preds (N, C+1, A), loc_preds (N, A*4), label (N, G, 6),
+    anchors (1, A, 4)) -> scalar loss per batch element (N,).
+    """
+
+    def __init__(self, negative_mining_ratio=3.0, lambd=1.0,
+                 overlap_threshold=0.5, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._ratio = negative_mining_ratio
+        self._lambd = lambd
+        self._thresh = overlap_threshold
+
+    def hybrid_forward(self, F, cls_preds, loc_preds, label, anchors):
+        loc_t, loc_m, cls_t = F._contrib_MultiBoxTarget(
+            anchors, label, cls_preds,
+            overlap_threshold=self._thresh,
+            negative_mining_ratio=self._ratio,
+            negative_mining_thresh=0.5)
+        # classification: softmax CE over (N, C+1, A), ignore cls_t == -1
+        logits = cls_preds.transpose((0, 2, 1))          # (N, A, C+1)
+        logp = F.log_softmax(logits, axis=-1)
+        tgt = F.maximum(cls_t, F.zeros_like(cls_t))
+        picked = -F.pick(logp, tgt, axis=-1)             # (N, A)
+        keep = cls_t >= 0
+        cls_loss = (picked * keep).sum(axis=-1) / \
+            F.maximum(keep.sum(axis=-1), F.ones_like(keep.sum(axis=-1)))
+        # localization: smooth L1 on positives
+        loc_loss = (F.smooth_l1(loc_preds - loc_t, scalar=1.0) *
+                    loc_m).sum(axis=-1) / \
+            F.maximum(loc_m.sum(axis=-1),
+                      F.ones_like(loc_m.sum(axis=-1)))
+        return cls_loss + self._lambd * loc_loss
+
+
+def ssd_300_vgg16_reduced(classes=20, **kwargs):
+    """SSD-300 with VGG16-reduced backbone (the BASELINE.md config;
+    reference: example/ssd/symbol/symbol_builder.py + vgg16_reduced.py).
+    Anchor sizes/ratios/steps follow the reference's train_net defaults.
+    """
+    base43, base7 = vgg16_reduced()
+    extras = _extra_layers([(256, 512, 2, 1), (128, 256, 2, 1),
+                            (128, 256, 1, 0), (128, 256, 1, 0)])
+    stages = [base43, base7] + extras
+    sizes = [(0.1, 0.141), (0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+             (0.71, 0.79), (0.88, 0.961)]
+    ratios = [(1.0, 2.0, 0.5)] + [(1.0, 2.0, 0.5, 3.0, 1.0 / 3)] * 3 + \
+        [(1.0, 2.0, 0.5)] * 2
+    steps = [8 / 300, 16 / 300, 32 / 300, 64 / 300, 100 / 300, 1.0]
+    return SSD(stages, sizes, ratios, steps, classes,
+               l2_norm_channels=512, **kwargs)

@@ -846,3 +846,140 @@ def np(numpy_feval, name=None, allow_extra_outputs=False):
 
     feval.__name__ = numpy_feval.__name__
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+@register
+class VOCMApMetric(EvalMetric):
+    """Pascal-VOC mean average precision for detection.
+
+    Reference: example/ssd/evaluate/eval_metric.py (MApMetric /
+    VOC07MApMetric). ``update(labels, preds)`` takes ground truth
+    (N, G, >=5) rows [cls, x1, y1, x2, y2, (difficult)] padded with -1,
+    and detections (N, A, 6) rows [cls, score, x1, y1, x2, y2] with
+    suppressed rows cls=-1 (the MultiBoxDetection output convention).
+    AP per class from the precision/recall curve; ``use_07_metric``
+    selects the VOC-2007 11-point interpolation.
+    """
+
+    def __init__(self, iou_thresh=0.5, class_names=None,
+                 use_07_metric=False, name="mAP", **kwargs):
+        self.iou_thresh = iou_thresh
+        self.class_names = class_names
+        self.use_07_metric = use_07_metric
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+        # per-class accumulators: scores, tp flags, gt counts
+        self._records = {}
+        self._gt_counts = {}
+
+    def update(self, labels, preds):
+        import numpy as onp
+
+        for label, pred in zip(labels, preds):
+            lab = label.asnumpy() if hasattr(label, "asnumpy") else \
+                onp.asarray(label)
+            det = pred.asnumpy() if hasattr(pred, "asnumpy") else \
+                onp.asarray(pred)
+            for b in range(lab.shape[0]):
+                self._update_one(lab[b], det[b])
+
+    @staticmethod
+    def _iou_matrix(a, b):
+        """(D, 4) x (G, 4) corner-box IoU via numpy broadcast."""
+        import numpy as onp
+
+        iw = (onp.minimum(a[:, None, 2], b[None, :, 2]) -
+              onp.maximum(a[:, None, 0], b[None, :, 0])).clip(min=0)
+        ih = (onp.minimum(a[:, None, 3], b[None, :, 3]) -
+              onp.maximum(a[:, None, 1], b[None, :, 1])).clip(min=0)
+        inter = iw * ih
+        area_a = ((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]))[:, None]
+        area_b = ((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))[None, :]
+        return inter / onp.maximum(area_a + area_b - inter, 1e-12)
+
+    def _update_one(self, gts, dets):
+        import numpy as onp
+
+        gts = gts[gts[:, 0] >= 0]
+        dets = dets[dets[:, 0] >= 0]
+        # VOC protocol: 'difficult' ground truths (column 5 when present)
+        # count neither toward recall nor as false positives
+        difficult = (gts[:, 5] > 0 if gts.shape[1] > 5
+                     else onp.zeros(len(gts), bool))
+        order = onp.argsort(-dets[:, 1])
+        dets = dets[order]
+        for c in onp.unique(onp.concatenate([gts[:, 0], dets[:, 0]])):
+            sel = gts[:, 0] == c
+            gt_c = gts[sel][:, 1:5]
+            diff_c = difficult[sel]
+            det_c = dets[dets[:, 0] == c]
+            self._gt_counts[c] = self._gt_counts.get(c, 0) + \
+                int((~diff_c).sum())
+            rec = self._records.setdefault(c, [])
+            taken = onp.zeros(len(gt_c), bool)
+            iou = (self._iou_matrix(det_c[:, 2:6], gt_c)
+                   if len(gt_c) and len(det_c) else
+                   onp.zeros((len(det_c), 0)))
+            for di, d in enumerate(det_c):
+                bi = int(onp.argmax(iou[di])) if iou.shape[1] else -1
+                best = iou[di, bi] if bi >= 0 else 0.0
+                if best >= self.iou_thresh and bi >= 0:
+                    if diff_c[bi]:
+                        continue        # matched a difficult gt: ignore
+                    tp = not taken[bi]
+                    taken[bi] = True
+                else:
+                    tp = False
+                rec.append((float(d[1]), bool(tp)))
+
+    def _average_precision(self, rec_list, n_gt):
+        import numpy as onp
+
+        if n_gt == 0:
+            return None
+        if not rec_list:
+            return 0.0
+        rec_list = sorted(rec_list, key=lambda t: -t[0])
+        tp = onp.cumsum([t[1] for t in rec_list])
+        fp = onp.cumsum([not t[1] for t in rec_list])
+        recall = tp / n_gt
+        precision = tp / onp.maximum(tp + fp, 1e-12)
+        if self.use_07_metric:
+            ap = 0.0
+            for t in onp.arange(0.0, 1.1, 0.1):
+                p = precision[recall >= t].max() if (recall >= t).any() \
+                    else 0.0
+                ap += p / 11.0
+            return float(ap)
+        # exact area under the interpolated PR curve
+        mrec = onp.concatenate([[0.0], recall, [1.0]])
+        mpre = onp.concatenate([[0.0], precision, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = onp.where(mrec[1:] != mrec[:-1])[0]
+        return float(((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]).sum())
+
+    def get(self):
+        aps = []
+        for c, n_gt in self._gt_counts.items():
+            ap = self._average_precision(self._records.get(c, []), n_gt)
+            if ap is not None:
+                aps.append(ap)
+        value = float(sum(aps) / len(aps)) if aps else float("nan")
+        return self.name, value
+
+
+@register
+class VOC07MApMetric(VOCMApMetric):
+    """11-point interpolated VOC-2007 mAP (reference:
+    example/ssd/evaluate/eval_metric.py VOC07MApMetric)."""
+
+    def __init__(self, iou_thresh=0.5, class_names=None, name="mAP07",
+                 **kwargs):
+        super().__init__(iou_thresh=iou_thresh, class_names=class_names,
+                         use_07_metric=True, name=name, **kwargs)

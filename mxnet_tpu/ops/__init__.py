@@ -11,6 +11,7 @@ from . import shape_ops     # noqa: F401
 from . import nn            # noqa: F401
 from . import rnn           # noqa: F401
 from . import flash_attention  # noqa: F401
+from . import contrib_det   # noqa: F401
 from . import linalg        # noqa: F401
 from . import random_ops    # noqa: F401
 from . import optimizer_ops  # noqa: F401

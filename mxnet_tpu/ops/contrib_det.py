@@ -1,0 +1,433 @@
+"""Object-detection contrib ops: the SSD/R-CNN op family, TPU-native.
+
+Reference semantics: src/operator/contrib/multibox_prior.cc:28-70 (anchor
+layout and box math), multibox_target.cc:75-280 (bipartite + threshold
+matching, negative mining, target encoding :32-55), multibox_detection.cc
+:46-215 (decode + per-class NMS), roi_align.cc:144-260 (bilinear-sampled
+average pooling), bounding_box.cc (box_iou / box_nms).
+
+TPU redesign: the reference kernels are sequential CPU/CUDA code full of
+data-dependent loops and compaction. Here every op is a fixed-shape,
+mask-based XLA computation so it jits cleanly:
+- the greedy bipartite match runs as a lax.fori_loop over ground-truth
+  slots (G is the static label-pad width) on the full (A, G) IoU matrix;
+- negative mining replaces the sort-and-take-prefix with a rank
+  computation (rank(candidate) < 3*num_pos as a mask);
+- NMS keeps everything length-A, marking suppressed rows class=-1
+  instead of compacting, exactly matching the reference's output
+  convention (it also pads with -1 rows);
+- ROIAlign resolves sample_ratio<=0 ("adaptive") to a static 2x2 grid —
+  the reference's ceil(roi/pooled) grid is data-dependent and cannot be
+  traced; sample_ratio>0 behaves identically to the reference.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_EPS = 1e-12
+
+
+# ------------------------------------------------------------------ IoU ----
+
+def _corner_iou(a, b):
+    """IoU between (..., A, 4) and (..., G, 4) corner boxes -> (..., A, G)."""
+    ax1, ay1, ax2, ay2 = [a[..., :, None, i] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[..., None, :, i] for i in range(4)]
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, _EPS)
+
+
+@register("_contrib_box_iou")
+def _box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference: bounding_box.cc _contrib_box_iou).
+    lhs (..., N, 4), rhs (..., M, 4) -> (..., N, M)."""
+    if format == "center":
+        def to_corner(b):
+            x, y, w, h = (b[..., 0], b[..., 1], b[..., 2], b[..., 3])
+            return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                             axis=-1)
+        lhs, rhs = to_corner(lhs), to_corner(rhs)
+    return _corner_iou(lhs, rhs)
+
+
+# ---------------------------------------------------------- MultiBoxPrior --
+
+@register("_contrib_MultiBoxPrior")
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation (reference: multibox_prior.cc:28-70).
+
+    data: (N, C, H, W) feature map (only H/W used). Returns
+    (1, H*W*(num_sizes+num_ratios-1), 4) corner boxes. Per location the
+    anchor order matches the reference: all sizes at ratios[0], then
+    sizes[0] at ratios[1:]. Note the reference's aspect handling scales
+    w by H/W (anchors square in *pixel* space for ratio 1).
+    """
+    sizes = tuple(float(s) for s in (sizes if hasattr(sizes, "__len__")
+                                     else (sizes,)))
+    ratios = tuple(float(r) for r in (ratios if hasattr(ratios, "__len__")
+                                      else (ratios,)))
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (H,W,2)
+
+    wh = []
+    r0 = ratios[0] ** 0.5
+    for s in sizes:
+        wh.append((s * h / w * r0 / 2, s / r0 / 2))
+    for r in ratios[1:]:
+        rs = r ** 0.5
+        wh.append((sizes[0] * h / w * rs / 2, sizes[0] / rs / 2))
+    wh = jnp.asarray(wh, jnp.float32)                              # (K, 2)
+
+    cxy = cyx[:, :, None, ::-1]                                    # (H,W,1,2)
+    boxes = jnp.concatenate([cxy - wh[None, None], cxy + wh[None, None]],
+                            axis=-1)                               # (H,W,K,4)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.astype(data.dtype)
+
+
+# --------------------------------------------------------- MultiBoxTarget --
+
+def _encode_loc(anchors, gt):
+    """Offset encoding (reference: multibox_target.cc:32-55).
+    anchors (A, 4) corner, gt (A, 4) matched gt corner -> (A, 4)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], _EPS)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], _EPS)
+    gx = (gt[:, 0] + gt[:, 2]) * 0.5
+    gy = (gt[:, 1] + gt[:, 3]) * 0.5
+    return gx, gy, gw, gh, ax, ay, aw, ah
+
+
+def _match_one(anchors, label, cls_pred, overlap_threshold,
+               negative_mining_ratio, negative_mining_thresh,
+               minimum_negative_samples, variances, ignore_label):
+    """One batch element. anchors (A,4); label (G,6) [cls,x1,y1,x2,y2,...];
+    cls_pred (C, A) logits. Returns loc_target (A,4), loc_mask (A,4),
+    cls_target (A,)."""
+    A = anchors.shape[0]
+    G = label.shape[0]
+    valid_gt = label[:, 0] >= 0                                    # (G,)
+    iou = _corner_iou(anchors, label[:, 1:5])                      # (A, G)
+    iou = jnp.where(valid_gt[None, :], iou, -1.0)
+
+    # --- stage 1: greedy bipartite match (one anchor per gt), G rounds ---
+    def body(_, state):
+        matched_gt, anchor_used, gt_used = state
+        m = jnp.where(anchor_used[:, None] | gt_used[None, :], -1.0, iou)
+        flat = jnp.argmax(m)
+        aj, gk = flat // G, flat % G
+        ok = m[aj, gk] > 1e-6
+        matched_gt = jnp.where(ok, matched_gt.at[aj].set(gk), matched_gt)
+        anchor_used = jnp.where(ok, anchor_used.at[aj].set(True),
+                                anchor_used)
+        gt_used = jnp.where(ok, gt_used.at[gk].set(True), gt_used)
+        return matched_gt, anchor_used, gt_used
+
+    matched_gt = jnp.full((A,), -1, jnp.int32)
+    state = (matched_gt, jnp.zeros((A,), bool), jnp.zeros((G,), bool))
+    matched_gt, anchor_pos, _ = lax.fori_loop(0, G, body, state)
+
+    # --- stage 2: threshold match for the rest --------------------------
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)            # (A,)
+    best_iou = jnp.max(iou, axis=1)
+    thr_pos = (~anchor_pos) & (best_iou > overlap_threshold) \
+        if overlap_threshold > 0 else jnp.zeros((A,), bool)
+    positive = anchor_pos | thr_pos
+    matched_gt = jnp.where(anchor_pos, matched_gt, best_gt)
+
+    # --- negative selection ---------------------------------------------
+    num_pos = jnp.sum(positive)
+    if negative_mining_ratio > 0:
+        # hard negatives: lowest background probability first
+        logits = cls_pred.T                                        # (A, C)
+        bg_prob = jax.nn.softmax(logits.astype(jnp.float32),
+                                 axis=-1)[:, 0]
+        candidate = (~positive) & (best_iou < negative_mining_thresh)
+        num_neg = jnp.maximum(
+            (num_pos * negative_mining_ratio).astype(jnp.int32),
+            minimum_negative_samples)
+        num_neg = jnp.minimum(num_neg, A - num_pos)
+        score = jnp.where(candidate, bg_prob, jnp.inf)
+        order = jnp.argsort(score)                # ascending: hardest first
+        rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+        negative = candidate & (rank < num_neg)
+    else:
+        negative = ~positive
+
+    # --- targets ---------------------------------------------------------
+    gt_boxes = label[matched_gt, 1:5]                              # (A, 4)
+    gx, gy, gw, gh, ax, ay, aw, ah = _encode_loc(anchors, gt_boxes)
+    v0, v1, v2, v3 = variances
+    loc = jnp.stack([(gx - ax) / aw / v0, (gy - ay) / ah / v1,
+                     jnp.log(gw / aw) / v2, jnp.log(gh / ah) / v3],
+                    axis=-1)
+    loc_mask = positive[:, None] & jnp.ones((A, 4), bool)
+    loc_target = jnp.where(loc_mask, loc, 0.0)
+
+    gt_cls = label[matched_gt, 0] + 1.0            # 0 = background
+    cls_target = jnp.where(positive, gt_cls,
+                           jnp.where(negative, 0.0, ignore_label))
+    # no valid gt: everything stays at its init value — loc 0, mask 0,
+    # cls ignore_label (reference: multibox_target-inl.h:120-123)
+    any_gt = jnp.any(valid_gt)
+    return (jnp.where(any_gt, loc_target, 0.0),
+            jnp.where(any_gt, loc_mask.astype(anchors.dtype), 0.0),
+            jnp.where(any_gt, cls_target, ignore_label))
+
+
+@register("_contrib_MultiBoxTarget", nout=3, differentiable=False)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5,
+                     minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Training-target assignment (reference: multibox_target.cc:75-280).
+
+    anchor (1, A, 4); label (N, G, >=5) rows [cls, x1, y1, x2, y2, ...]
+    padded with -1; cls_pred (N, C, A). Returns loc_target (N, A*4),
+    loc_mask (N, A*4), cls_target (N, A).
+    """
+    anchors = anchor.reshape(-1, 4)
+    f = partial(_match_one, overlap_threshold=overlap_threshold,
+                negative_mining_ratio=negative_mining_ratio,
+                negative_mining_thresh=negative_mining_thresh,
+                minimum_negative_samples=minimum_negative_samples,
+                variances=tuple(variances), ignore_label=ignore_label)
+    loc_t, loc_m, cls_t = jax.vmap(
+        lambda lb, cp: f(anchors, lb, cp))(label, cls_pred)
+    n = label.shape[0]
+    return (loc_t.reshape(n, -1).astype(anchor.dtype),
+            loc_m.reshape(n, -1).astype(anchor.dtype),
+            cls_t.astype(anchor.dtype))
+
+
+# ------------------------------------------------------ MultiBoxDetection --
+
+def _decode_boxes(anchors, loc_pred, variances, clip):
+    """Offset decoding (reference: multibox_detection.cc:46-72).
+    anchors (A, 4), loc_pred (A, 4) -> corner boxes (A, 4)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    v0, v1, v2, v3 = variances
+    ox = loc_pred[:, 0] * v0 * aw + ax
+    oy = loc_pred[:, 1] * v1 * ah + ay
+    ow = jnp.exp(loc_pred[:, 2] * v2) * aw / 2
+    oh = jnp.exp(loc_pred[:, 3] * v3) * ah / 2
+    boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _nms_mask(cls_ids, boxes, keep_in, nms_threshold, force_suppress):
+    """Sequential suppression on score-sorted entries; O(K) lax steps on
+    the (K, K) IoU matrix."""
+    K = cls_ids.shape[0]
+    iou = _corner_iou(boxes, boxes)
+    idx = jnp.arange(K)
+
+    def body(i, keep):
+        same = jnp.full((K,), True) if force_suppress else \
+            (cls_ids == cls_ids[i])
+        sup = keep[i] & (iou[i] >= nms_threshold) & same & (idx > i)
+        return keep & ~sup
+
+    return lax.fori_loop(0, K, body, keep_in)
+
+
+def _detect_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
+                nms_threshold, force_suppress, nms_topk, background_id):
+    C, A = cls_prob.shape
+    boxes = _decode_boxes(anchors, loc_pred.reshape(A, 4), variances, clip)
+    fg_mask = jnp.arange(C) != background_id
+    fg = jnp.where(fg_mask[:, None], cls_prob, -jnp.inf)          # (C, A)
+    score = jnp.max(fg, axis=0)
+    raw_id = jnp.argmax(fg, axis=0)
+    # reference convention: returned ids are 0-based foreground ids
+    # (background excluded); with background_id=0 that is raw_id - 1
+    cls_id = jnp.where(raw_id > background_id, raw_id - 1,
+                       raw_id).astype(jnp.float32)
+    valid = score >= threshold
+    cls_id = jnp.where(valid, cls_id, -1.0)
+
+    order = jnp.argsort(jnp.where(valid, -score, jnp.inf))
+    cls_s, score_s, boxes_s = cls_id[order], score[order], boxes[order]
+    keep = cls_s >= 0
+    if 0 < nms_threshold <= 1:
+        # nms_topk is static: slice to the top-K candidates so the IoU
+        # matrix is (K, K), not (A, A) — for SSD-300 (A=8732, topk=400)
+        # that is ~475x less memory and ~22x fewer sequential steps
+        k = min(nms_topk, A) if nms_topk > 0 else A
+        keep_k = _nms_mask(cls_s[:k], boxes_s[:k], keep[:k],
+                           nms_threshold, force_suppress)
+        keep = jnp.zeros_like(keep).at[:k].set(keep_k)
+    elif nms_topk > 0:
+        keep = keep & (jnp.arange(A) < nms_topk)
+    cls_s = jnp.where(keep, cls_s, -1.0)
+    return jnp.concatenate([cls_s[:, None], score_s[:, None], boxes_s],
+                           axis=-1)                               # (A, 6)
+
+
+@register("_contrib_MultiBoxDetection", differentiable=False)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0,
+                        nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS (reference: multibox_detection.cc:100-215).
+
+    cls_prob (N, C, A) softmax probs (class 0 = background); loc_pred
+    (N, A*4); anchor (1, A, 4). Returns (N, A, 6) rows
+    [class_id, score, x1, y1, x2, y2], suppressed/empty rows class_id=-1,
+    sorted by score like the reference.
+    """
+    anchors = anchor.reshape(-1, 4)
+    f = partial(_detect_one, anchors=anchors, threshold=threshold,
+                clip=clip, variances=tuple(variances),
+                nms_threshold=nms_threshold,
+                force_suppress=force_suppress, nms_topk=nms_topk,
+                background_id=background_id)
+    return jax.vmap(lambda cp, lp: f(cp, lp))(
+        cls_prob, loc_pred).astype(cls_prob.dtype)
+
+
+# ----------------------------------------------------------------- NMS -----
+
+@register("_contrib_box_nms")
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1,
+             force_suppress=False, in_format="corner",
+             out_format="corner"):
+    """Generic box NMS (reference: bounding_box.cc _contrib_box_nms).
+    data (..., N, K) with score at score_index, boxes at
+    coord_start:coord_start+4, optional class at id_index. Suppressed
+    rows are overwritten with -1 (the reference convention).
+    """
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+
+    def one(d):
+        n = d.shape[0]
+        score = d[:, score_index]
+        boxes = d[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            x, y, w, h = boxes.T
+            boxes = jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                              axis=-1)
+        ids = d[:, id_index] if id_index >= 0 else jnp.zeros((n,))
+        valid = score > valid_thresh
+        order = jnp.argsort(jnp.where(valid, -score, jnp.inf))
+        d_s, boxes_s, ids_s = d[order], boxes[order], ids[order]
+        keep = valid[order]
+        k = min(topk, n) if topk > 0 else n     # bound the IoU matrix
+        keep = keep & (jnp.arange(n) < k)
+        keep_k = _nms_mask(jnp.where(keep[:k], ids_s[:k], -1.0),
+                           boxes_s[:k], keep[:k], overlap_thresh,
+                           force_suppress or id_index < 0)
+        keep = jnp.zeros_like(keep).at[:k].set(keep_k)
+        out = jnp.where(keep[:, None], d_s, -1.0)
+        if out_format != in_format:
+            b = out[:, coord_start:coord_start + 4]
+            if out_format == "center":
+                conv = jnp.stack([(b[:, 0] + b[:, 2]) / 2,
+                                  (b[:, 1] + b[:, 3]) / 2,
+                                  b[:, 2] - b[:, 0],
+                                  b[:, 3] - b[:, 1]], axis=-1)
+            else:  # center -> corner
+                conv = jnp.stack([b[:, 0] - b[:, 2] / 2,
+                                  b[:, 1] - b[:, 3] / 2,
+                                  b[:, 0] + b[:, 2] / 2,
+                                  b[:, 1] + b[:, 3] / 2], axis=-1)
+            out = out.at[:, coord_start:coord_start + 4].set(
+                jnp.where(keep[:, None], conv, -1.0))
+        return out
+
+    return jax.vmap(one)(flat).reshape(shape)
+
+
+# ------------------------------------------------------------- ROIAlign ----
+
+@register("_contrib_ROIAlign")
+def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sample_ratio=-1, position_sensitive=False, aligned=False):
+    """ROI align (reference: roi_align.cc:144-260).
+
+    data (N, C, H, W); rois (R, 5) rows [batch_idx, x1, y1, x2, y2] in
+    image coordinates. Returns (R, C, PH, PW) (or (R, C/(PH*PW), PH, PW)
+    position-sensitive). sample_ratio<=0 resolves to a static 2x2 grid
+    (the reference's adaptive grid is data-dependent; see module doc).
+    Gradients flow to ``data`` through the bilinear gathers.
+    """
+    ph, pw = (pooled_size if hasattr(pooled_size, "__len__")
+              else (pooled_size, pooled_size))
+    sr = sample_ratio if sample_ratio > 0 else 2
+    N, C, H, W = data.shape
+    offset = 0.5 if aligned else 0.0
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:  # legacy: force malformed ROIs to be 1x1
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bh, bw = rh / ph, rw / pw
+        # sample grid: (PH*sr, PW*sr) bilinear points
+        gy = y1 + (jnp.arange(ph * sr) + 0.5) * bh / sr
+        gx = x1 + (jnp.arange(pw * sr) + 0.5) * bw / sr
+
+        img = data[bidx]                                          # (C, H, W)
+
+        def bilinear(y, x):
+            y = jnp.clip(y, 0.0, H - 1.0)
+            x = jnp.clip(x, 0.0, W - 1.0)
+            y0 = jnp.floor(y).astype(jnp.int32)
+            x0 = jnp.floor(x).astype(jnp.int32)
+            y1i = jnp.minimum(y0 + 1, H - 1)
+            x1i = jnp.minimum(x0 + 1, W - 1)
+            wy = y - y0
+            wx = x - x0
+            g = lambda yy, xx: img[:, yy, xx]                     # noqa: E731
+            return ((1 - wy) * (1 - wx))[None] * g(y0, x0) + \
+                ((1 - wy) * wx)[None] * g(y0, x1i) + \
+                (wy * (1 - wx))[None] * g(y1i, x0) + \
+                (wy * wx)[None] * g(y1i, x1i)
+
+        yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+        samples = bilinear(yy.ravel(), xx.ravel())                # (C, P)
+        samples = samples.reshape(C, ph, sr, pw, sr)
+        pooled = samples.mean(axis=(2, 4))                        # (C,PH,PW)
+        if position_sensitive:
+            cc = C // (ph * pw)
+            pooled = pooled.reshape(cc, ph, pw, ph, pw)
+            pooled = pooled[:, jnp.arange(ph)[:, None], jnp.arange(pw)[None,
+                            :], jnp.arange(ph)[:, None],
+                            jnp.arange(pw)[None, :]]
+        return pooled
+
+    return jax.vmap(one)(rois).astype(data.dtype)

@@ -1,0 +1,254 @@
+"""SSD detection path: ops, model, loss, mAP metric.
+
+Oracles are hand-computed box math (reference semantics:
+src/operator/contrib/multibox_prior.cc:28, multibox_target.cc:32,
+multibox_detection.cc:46, roi_align.cc:144).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.autograd as ag
+
+
+def test_multibox_prior_matches_reference_math():
+    x = nd.array(np.zeros((1, 3, 2, 3), np.float32))
+    out = nd._contrib_MultiBoxPrior(x, sizes=(0.5, 0.3), ratios=(1.0, 2.0))
+    a = out.asnumpy()
+    h, w = 2, 3
+    assert a.shape == (1, h * w * 3, 4)
+    cy, cx = 0.5 / h, 0.5 / w
+    # anchor 0: size .5, ratio 1 -> w = s*(h/w)/2, h = s/2
+    w0, h0 = 0.5 * (h / w) / 2, 0.5 / 2
+    np.testing.assert_allclose(a[0, 0], [cx - w0, cy - h0, cx + w0,
+                                         cy + h0], rtol=1e-5)
+    # anchor 1: size .3, ratio 1 (all sizes use ratios[0])
+    w1, h1 = 0.3 * (h / w) / 2, 0.3 / 2
+    np.testing.assert_allclose(a[0, 1], [cx - w1, cy - h1, cx + w1,
+                                         cy + h1], rtol=1e-5)
+    # anchor 2: size .5, ratio 2
+    w2, h2 = 0.5 * (h / w) * np.sqrt(2) / 2, 0.5 / np.sqrt(2) / 2
+    np.testing.assert_allclose(a[0, 2], [cx - w2, cy - h2, cx + w2,
+                                         cy + h2], rtol=1e-5)
+    # clip
+    c = nd._contrib_MultiBoxPrior(x, sizes=(0.9,), clip=True).asnumpy()
+    assert c.min() >= 0 and c.max() <= 1
+
+
+def test_box_iou():
+    a = nd.array(np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32))
+    b = nd.array(np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32))
+    iou = nd._contrib_box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou, [[1.0, 0.0], [1 / 7, 1 / 7]],
+                               rtol=1e-5)
+
+
+def _toy_setup():
+    anchors = nd._contrib_MultiBoxPrior(
+        nd.array(np.zeros((1, 3, 4, 4), np.float32)),
+        sizes=(0.4,), ratios=(1.0, 2.0))
+    A = anchors.shape[1]
+    label = np.full((2, 3, 6), -1.0, np.float32)
+    label[0, 0] = [1, 0.1, 0.1, 0.4, 0.4, 0]
+    label[0, 1] = [0, 0.6, 0.6, 0.9, 0.95, 0]
+    label[1, 0] = [2, 0.3, 0.2, 0.8, 0.7, 0]
+    cls_pred = np.random.RandomState(0).randn(2, 4, A).astype(np.float32)
+    return anchors, A, label, cls_pred
+
+
+def test_multibox_target_assignment():
+    anchors, A, label, cls_pred = _toy_setup()
+    lt, lm, ct = nd._contrib_MultiBoxTarget(
+        anchors, nd.array(label), nd.array(cls_pred),
+        overlap_threshold=0.5, negative_mining_ratio=3.0)
+    assert lt.shape == (2, A * 4) and lm.shape == (2, A * 4)
+    assert ct.shape == (2, A)
+    ctn = ct.asnumpy()
+    # every valid gt gets at least one positive anchor (bipartite stage)
+    assert (ctn[0] > 0).sum() >= 2
+    assert (ctn[1] > 0).sum() >= 1
+    # class ids offset by 1 (0 = background)
+    assert set(np.unique(ctn[0][ctn[0] > 0])) <= {1.0, 2.0}
+    # negative mining keeps ~3x positives as background, rest ignored
+    npos, nneg = (ctn[0] > 0).sum(), (ctn[0] == 0).sum()
+    assert nneg <= 3 * npos
+    assert (ctn[0] == -1).sum() == A - npos - nneg
+    # loc_mask nonzero exactly on positives
+    lmn = lm.asnumpy()[0].reshape(A, 4)
+    np.testing.assert_array_equal(lmn.any(axis=1), ctn[0] > 0)
+
+
+def test_multibox_target_no_mining_all_negatives():
+    anchors, A, label, cls_pred = _toy_setup()
+    _, _, ct = nd._contrib_MultiBoxTarget(
+        anchors, nd.array(label), nd.array(cls_pred),
+        negative_mining_ratio=-1.0)
+    ctn = ct.asnumpy()
+    assert ((ctn == 0) | (ctn > 0)).all()   # nothing ignored
+
+
+def test_multibox_encode_decode_roundtrip():
+    """Targets encoded by MultiBoxTarget, fed to MultiBoxDetection as
+    perfect predictions, must decode back to the ground-truth boxes."""
+    anchors, A, label, cls_pred = _toy_setup()
+    lt, lm, ct = nd._contrib_MultiBoxTarget(
+        anchors, nd.array(label), nd.array(cls_pred),
+        overlap_threshold=0.5, negative_mining_ratio=3.0)
+    ctn = ct.asnumpy()[0]
+    probs = np.zeros((1, 4, A), np.float32)
+    probs[0, 0, :] = 1.0
+    for i in np.where(ctn > 0)[0]:
+        probs[0, int(ctn[i]), i] = 1.0
+        probs[0, 0, i] = 0.0
+    det = nd._contrib_MultiBoxDetection(
+        nd.array(probs), nd.array(lt.asnumpy()[0:1]), anchors,
+        nms_threshold=0.45, threshold=0.2)
+    d = det.asnumpy()[0]
+    kept = d[d[:, 0] >= 0]
+    assert len(kept) >= 2
+    for row in kept:
+        cls, score, x1, y1, x2, y2 = row
+        gt = label[0][label[0][:, 0] == cls][:, 1:5]
+        ious = []
+        for g in gt:
+            iw = min(x2, g[2]) - max(x1, g[0])
+            ih = min(y2, g[3]) - max(y1, g[1])
+            inter = max(iw, 0) * max(ih, 0)
+            union = ((x2 - x1) * (y2 - y1) +
+                     (g[2] - g[0]) * (g[3] - g[1]) - inter)
+            ious.append(inter / union)
+        assert max(ious) > 0.95, row
+    # rows are score-sorted
+    scores = kept[:, 1]
+    assert (np.diff(scores) <= 1e-6).all()
+
+
+def test_box_nms_suppresses_overlaps():
+    data = np.array([[
+        [0, 0.9, 0.1, 0.1, 0.5, 0.5],
+        [0, 0.8, 0.12, 0.12, 0.52, 0.52],   # overlaps row 0 -> suppressed
+        [0, 0.7, 0.6, 0.6, 0.9, 0.9],
+        [1, 0.6, 0.11, 0.11, 0.51, 0.51],   # other class -> kept
+    ]], np.float32)
+    out = nd._contrib_box_nms(nd.array(data), overlap_thresh=0.5,
+                              coord_start=2, score_index=1, id_index=0)
+    o = out.asnumpy()[0]
+    kept_ids = o[o[:, 0] >= 0][:, 0]
+    assert len(kept_ids) == 3
+    # force_suppress kills the cross-class overlap too
+    out2 = nd._contrib_box_nms(nd.array(data), overlap_thresh=0.5,
+                               coord_start=2, score_index=1, id_index=0,
+                               force_suppress=True)
+    o2 = out2.asnumpy()[0]
+    assert (o2[:, 0] >= 0).sum() == 2
+
+
+def test_roi_align_values_and_grad():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import _REGISTRY
+
+    # linear ramp image: bilinear sampling of a linear function is exact
+    H = W = 8
+    ramp = np.arange(W, dtype=np.float32)[None, :].repeat(H, 0)
+    img = np.stack([ramp, ramp.T])[None]          # (1, 2, H, W)
+    rois = np.array([[0, 1, 1, 5, 5]], np.float32)
+    out = nd._contrib_ROIAlign(nd.array(img), nd.array(rois),
+                               pooled_size=(2, 2), spatial_scale=1.0,
+                               sample_ratio=2).asnumpy()
+    # channel 0 varies along x only: bin centers at x = 2, 4
+    np.testing.assert_allclose(out[0, 0], [[2.0, 4.0], [2.0, 4.0]],
+                               atol=1e-5)
+    np.testing.assert_allclose(out[0, 1], [[2.0, 2.0], [4.0, 4.0]],
+                               atol=1e-5)
+
+    g = jax.grad(lambda d: _REGISTRY["_contrib_ROIAlign"].impl(
+        d, jnp.asarray(rois), pooled_size=(2, 2),
+        sample_ratio=2).sum())(jnp.asarray(img))
+    assert float(g.sum()) == pytest.approx(8.0, rel=1e-5)
+
+
+def test_ssd_300_forward_shapes():
+    from mxnet_tpu.gluon.model_zoo import ssd_300_vgg16_reduced
+
+    mx.random.seed(0)
+    net = ssd_300_vgg16_reduced(classes=20)
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(1, 3, 300, 300) * 0.1)
+    with ag.pause():
+        cls_preds, loc_preds, anchors = net(x)
+    # SSD-300 anchor ledger: 38^2*4 + 19^2*6 + 10^2*6 + 5^2*6 + 3^2*4
+    # + 1^2*4 = 8732
+    assert anchors.shape == (1, 8732, 4)
+    assert cls_preds.shape == (1, 21, 8732)
+    assert loc_preds.shape == (1, 8732 * 4)
+    assert np.isfinite(cls_preds.asnumpy()).all()
+
+
+def test_ssd_toy_convergence():
+    """A small SSD must learn to localize a synthetic box task: loss
+    drops and mAP on the train set becomes high."""
+    from mxnet_tpu.gluon.model_zoo.ssd import SSD, MultiBoxLoss
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    stage1 = nn.HybridSequential(prefix="")
+    stage1.add(nn.Conv2D(16, 3, strides=2, padding=1, activation="relu"))
+    stage1.add(nn.Conv2D(16, 3, strides=2, padding=1, activation="relu"))
+    stage2 = nn.HybridSequential(prefix="")
+    stage2.add(nn.Conv2D(16, 3, strides=2, padding=1, activation="relu"))
+    net = SSD([stage1, stage2], sizes=[(0.3,), (0.6,)],
+              ratios=[(1.0, 2.0), (1.0, 2.0)], steps=[-1.0, -1.0],
+              classes=2)
+    net.initialize()
+    loss_fn = MultiBoxLoss(negative_mining_ratio=3.0)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+
+    # synthetic task: one bright square per image; class = quadrant row
+    rng = np.random.RandomState(0)
+    N = 16
+    imgs = rng.randn(N, 3, 32, 32).astype(np.float32) * 0.05
+    labels = np.full((N, 2, 6), -1.0, np.float32)
+    for i in range(N):
+        cx, cy = rng.uniform(0.25, 0.75, 2)
+        s = 0.3
+        x1, y1, x2, y2 = cx - s / 2, cy - s / 2, cx + s / 2, cy + s / 2
+        c = 0 if cx < 0.5 else 1
+        imgs[i, c, int(y1 * 32):int(y2 * 32),
+             int(x1 * 32):int(x2 * 32)] += 1.0
+        labels[i, 0] = [c, x1, y1, x2, y2, 0]
+    x, y = nd.array(imgs), nd.array(labels)
+
+    losses = []
+    for _ in range(60):
+        with ag.record():
+            cls_preds, loc_preds, anchors = net(x)
+            loss = loss_fn(cls_preds, loc_preds, y, anchors).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+    metric = mx.metric.create("vocmapmetric")
+    with ag.pause():
+        det = net.detect(x, nms_threshold=0.45, threshold=0.3)
+    metric.update([y], [det])
+    name, value = metric.get()
+    assert value > 0.5, (name, value)
+
+
+def test_voc_map_metric_known_values():
+    m = mx.metric.create("voc07mapmetric")
+    gt = np.full((1, 2, 6), -1.0, np.float32)
+    gt[0, 0] = [0, 0.1, 0.1, 0.5, 0.5, 0]
+    det = np.full((1, 3, 6), -1.0, np.float32)
+    det[0, 0] = [0, 0.9, 0.1, 0.1, 0.5, 0.5]       # perfect hit
+    m.update([nd.array(gt)], [nd.array(det)])
+    assert m.get()[1] == pytest.approx(1.0)
+    m.reset()
+    det[0, 0] = [0, 0.9, 0.6, 0.6, 0.9, 0.9]       # miss
+    m.update([nd.array(gt)], [nd.array(det)])
+    assert m.get()[1] == pytest.approx(0.0)
