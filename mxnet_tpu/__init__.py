@@ -37,9 +37,9 @@ def _lazy(name):
 # Lazy subpackages (heavy or cyclic): accessed as attributes.
 _LAZY_MODULES = ("numpy", "numpy_extension", "symbol", "gluon", "module",
                  "optimizer", "metric", "initializer", "io", "kvstore",
-                 "image", "parallel", "models", "profiler", "lr_scheduler",
+                 "image", "parallel", "profiler", "lr_scheduler",
                  "callback", "test_utils", "util", "runtime", "amp",
-                 "recordio", "executor", "monitor", "model")
+                 "recordio", "executor", "monitor", "model", "operator")
 
 _ALIAS = {"np": "numpy", "npx": "numpy_extension", "sym": "symbol",
           "mod": "module", "kv": "kvstore"}

@@ -21,11 +21,32 @@ _base_key = None
 
 def seed(seed_state: int, ctx=None):
     """Seed the global RNG (reference: mx.random.seed). ``ctx`` is accepted
-    for API parity; JAX keys are device-independent."""
-    global _seed, _base_key, _counter
+    for API parity; JAX keys are device-independent.
+
+    Also resets the module-private numpy RandomState that host-side init
+    paths (parameter initializers) draw from — so seeded runs produce
+    byte-identical parameters in every process (required for multi-host
+    SPMD, where 'replicated' means replicated) without touching the
+    user's global numpy RNG stream."""
+    global _seed, _base_key, _counter, _host_rng
     _seed = int(seed_state)
     _base_key = jax.random.key(_seed)
     _counter = itertools.count()
+    _host_rng = None
+
+
+_host_rng = None
+
+
+def host_rng():
+    """Module-private numpy RandomState for host-side (non-traced)
+    random draws, seeded by mx.random.seed. Initializers use this
+    instead of numpy's global RNG (which belongs to user code)."""
+    global _host_rng
+    if _host_rng is None:
+        import numpy as _np
+        _host_rng = _np.random.RandomState(_seed & 0xFFFFFFFF)
+    return _host_rng
 
 
 def next_key():

@@ -59,16 +59,26 @@ _TAPE = _Tape()
 
 
 class _Node:
-    """One recorded op application."""
+    """One recorded op application.
 
-    __slots__ = ("seq", "vjp_fn", "in_slots", "out_slots", "out_avals")
+    ``fn``/``xs`` (the primal function and its inputs) are kept so
+    ``create_graph=True`` can re-derive the vjp *differentiably*: the
+    captured ``vjp_fn`` closure bakes its residuals as constants, so
+    taping only cotangent flow would lose d(grad)/d(input); re-running
+    ``jax.vjp(fn, *xs)`` inside a taped application keeps it."""
 
-    def __init__(self, vjp_fn, in_slots, out_slots, out_avals):
+    __slots__ = ("seq", "vjp_fn", "in_slots", "out_slots", "out_avals",
+                 "fn", "xs")
+
+    def __init__(self, vjp_fn, in_slots, out_slots, out_avals, fn=None,
+                 xs=None):
         self.seq = next(_SEQ)
         self.vjp_fn = vjp_fn
         self.in_slots = in_slots      # per input: slot int or None (no grad)
         self.out_slots = out_slots
         self.out_avals = out_avals    # (shape, dtype) per output
+        self.fn = fn
+        self.xs = xs
 
 
 def new_slot() -> int:
@@ -79,8 +89,9 @@ def register_leaf(slot: int, array, grad_req: str):
     _TAPE.leaves[slot] = (weakref.ref(array), grad_req)
 
 
-def record_node(vjp_fn, in_slots, out_slots, out_avals) -> _Node:
-    node = _Node(vjp_fn, in_slots, out_slots, out_avals)
+def record_node(vjp_fn, in_slots, out_slots, out_avals, fn=None,
+                xs=None) -> _Node:
+    node = _Node(vjp_fn, in_slots, out_slots, out_avals, fn=fn, xs=xs)
     _TAPE.nodes.append(node)
     for s in out_slots:
         _TAPE.slot_producer[s] = node
@@ -174,8 +185,14 @@ def _zero_cotangent(shape, dtype):
     return _np.zeros(shape, jax.dtypes.float0)
 
 
-def _run_backward(heads, head_grads, retain_graph):
-    """Reverse-walk the tape from ``heads``; returns {slot: grad}."""
+def _run_backward(heads, head_grads, retain_graph, create_graph=False):
+    """Reverse-walk the tape from ``heads``; returns {slot: grad}.
+
+    With ``create_graph=True`` each vjp application is routed back
+    through the op-invoke chokepoint, so the gradient computation itself
+    lands on the tape and can be differentiated again (the reference
+    builds a differentiable grad graph via the MXGradient pass,
+    src/imperative/imperative.cc:376)."""
     import jax.numpy as jnp
     from .ndarray.ndarray import NDArray  # local import: avoids cycle
 
@@ -187,6 +204,17 @@ def _run_backward(heads, head_grads, retain_graph):
         head_grads = [None] * len(heads)
 
     grads: Dict[int, object] = {}
+
+    def acc(old, new):
+        if old is None:
+            return new
+        if create_graph and (isinstance(old, NDArray)
+                             or isinstance(new, NDArray)):
+            a = old if isinstance(old, NDArray) else NDArray(old)
+            b = new if isinstance(new, NDArray) else NDArray(new)
+            return a + b          # taped add: accumulation differentiable
+        return old + new
+
     roots = []
     for h, hg in zip(heads, head_grads):
         slot = getattr(h, "_ag_slot", None)
@@ -196,7 +224,7 @@ def _run_backward(heads, head_grads, retain_graph):
                 "autograd.record() (reference: Imperative::Backward check)")
         g = (jnp.ones(h.shape, h.dtype) if hg is None
              else (hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)))
-        grads[slot] = grads[slot] + g if slot in grads else g
+        grads[slot] = acc(grads.get(slot), g)
         prod = _TAPE.slot_producer.get(slot)
         if prod is not None:
             roots.append(prod)
@@ -221,16 +249,56 @@ def _run_backward(heads, head_grads, retain_graph):
         cots = tuple(
             grads.get(s) if s in grads else _zero_cotangent(*aval)
             for s, aval in zip(node.out_slots, node.out_avals))
-        in_grads = node.vjp_fn(cots if len(cots) > 1 else cots[0])
+        if create_graph and node.fn is None:
+            raise NotImplementedError(
+                "create_graph=True reached a tape node recorded without "
+                "its primal function; higher-order gradients are not "
+                "available through this op")
+        if create_graph and node.fn is not None:
+            in_grads = _taped_vjp(node, cots)
+        else:
+            cots = tuple(c._data if isinstance(c, NDArray) else c
+                         for c in cots)
+            in_grads = node.vjp_fn(cots if len(cots) > 1 else cots[0])
         for s, g in zip(node.in_slots, in_grads):
             if s is None or g is None or (hasattr(g, "dtype")
                                           and g.dtype == jax.dtypes.float0):
                 continue
-            grads[s] = grads[s] + g if s in grads else g
+            grads[s] = acc(grads.get(s), g)
 
     if not retain_graph:
         _TAPE.drop_nodes(reachable)
     return grads
+
+
+def _taped_vjp(node, cots):
+    """Apply a node's vjp THROUGH the invoke chokepoint so the
+    application is itself recorded. The node's saved primal inputs
+    re-enter with their original slots, so second-order gradients flow
+    to them (the vjp closure's residuals alone would be constants)."""
+    from .ndarray.ndarray import NDArray
+    from .ops.invoke import apply_fn
+
+    n_in = len(node.in_slots)
+    multi = len(node.out_slots) > 1
+
+    def vjp_apply(*args):
+        xs, cs = args[:n_in], args[n_in:]
+        _, vjp = jax.vjp(node.fn, *xs)
+        gs = vjp(tuple(cs) if multi else cs[0])
+        return gs[0] if n_in == 1 else tuple(gs)
+
+    x_nds = []
+    for x, s in zip(node.xs, node.in_slots):
+        nd_x = NDArray(x)
+        if s is not None:
+            nd_x._ag_slot = s
+        x_nds.append(nd_x)
+    cot_args = [c if isinstance(c, NDArray) or not hasattr(c, "shape")
+                else (c if c.dtype == jax.dtypes.float0 else NDArray(c))
+                for c in cots]
+    out = apply_fn(vjp_apply, x_nds + list(cot_args))
+    return (out,) if n_in == 1 else tuple(out)
 
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
@@ -257,19 +325,26 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     """Return gradients of ``heads`` w.r.t. ``variables`` without touching
     ``.grad`` buffers (reference: python/mxnet/autograd.py grad).
 
-    ``create_graph`` (higher-order gradients) is not yet supported — the
-    reference builds a differentiable grad-graph; here that requires taping
-    the vjp application itself (planned: route backward through apply_op).
-    """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order grad) not yet supported; "
-            "use jax.grad composition via mxnet_tpu.npx.grad for now")
+    ``create_graph=True`` records the gradient computation itself, so the
+    returned arrays can be differentiated again — same contract as the
+    reference (python/mxnet/autograd.py:271, used by
+    tests/python/unittest/test_higher_order_grad.py)."""
     single = not isinstance(variables, (list, tuple))
     vars_ = [variables] if single else list(variables)
     if retain_graph is None:
-        retain_graph = False
-    grads = _run_backward(heads, head_grads, retain_graph)
+        retain_graph = create_graph
+    if create_graph:
+        # the vjp applications must land on the tape; the graph is
+        # retained by default (needed for the next-order backward) but an
+        # explicit retain_graph=False is honored
+        prev = set_recording(True)
+        try:
+            grads = _run_backward(heads, head_grads, retain_graph,
+                                  create_graph=True)
+        finally:
+            set_recording(prev)
+    else:
+        grads = _run_backward(heads, head_grads, retain_graph)
     from .ndarray.ndarray import NDArray
     import jax.numpy as jnp
     out = []
@@ -278,7 +353,8 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
         if slot is None or slot not in grads:
             out.append(NDArray(jnp.zeros(v.shape, v.dtype)))
         else:
-            out.append(NDArray(grads[slot]))
+            g = grads[slot]
+            out.append(g if isinstance(g, NDArray) else NDArray(g))
     return out[0] if single else out
 
 

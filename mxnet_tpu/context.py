@@ -64,13 +64,17 @@ class Context:
     @property
     def jax_device(self) -> jax.Device:
         """The backing jax.Device. 'gpu' and 'tpu' both map to the
-        accelerator platform when one is present; cpu maps to host."""
+        accelerator platform when one is present; cpu maps to host.
+        Only process-local devices are candidates: under
+        jax.distributed, jax.devices() spans every process, and eager
+        arrays can only live on addressable ones."""
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = (jax.local_devices(backend="cpu")
+                    if _has_platform("cpu") else jax.local_devices())
         else:
             devs = _accelerator_devices()
             if not devs:  # no accelerator: silently fall back to host
-                devs = jax.devices()
+                devs = jax.local_devices()
         return devs[min(self.device_id, len(devs) - 1)]
 
     # -- scoping -----------------------------------------------------------
@@ -104,8 +108,9 @@ def _has_platform(name: str) -> bool:
 
 
 def _accelerator_devices():
-    """All non-cpu jax devices (TPU under any platform name, incl. tunnels)."""
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    """Process-local non-cpu jax devices (TPU under any platform name,
+    incl. tunnels)."""
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
     return devs
 
 

@@ -25,6 +25,7 @@ import numpy as _np
 import jax
 
 from .. import autograd, _rng
+from .. import profiler as _profiler
 from ..context import Context, current_context
 from ..ndarray import NDArray
 from .parameter import (Parameter, ParameterDict,
@@ -336,7 +337,14 @@ class Block:
         hook_args = args + (kwargs,) if kwargs else args
         for hook in self._forward_pre_hooks.values():
             hook(self, hook_args)
-        out = self.forward(*args, **kwargs)
+        if _profiler.scopes_enabled():
+            # structure the profile: each block forward becomes a named
+            # scope in the trace and in jitted HLO op metadata
+            import jax
+            with jax.named_scope(self.name or self.__class__.__name__):
+                out = self.forward(*args, **kwargs)
+        else:
+            out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks.values():
             hook(self, hook_args, out)
         return out
@@ -574,7 +582,16 @@ class CachedOp:
                 if not isinstance(cots, tuple):
                     cots = (cots,)
                 return _f(cots)
-            autograd.record_node(_vjp, in_slots, out_slots, out_avals)
+
+            def _fn_taped(*a, _fn=fn):
+                # output structure must match the tape's cotangent
+                # convention (bare when single) so create_graph=True can
+                # re-derive this vjp differentiably
+                outs_ = _fn(*a)
+                return outs_[0] if len(outs_) == 1 else outs_
+            autograd.record_node(_vjp, in_slots, out_slots, out_avals,
+                                 fn=_fn_taped,
+                                 xs=(key,) + tuple(pvals) + tuple(xvals))
 
         # write captured aux states (running means etc.) back
         for p, v in zip(aux_params, aux):

@@ -15,6 +15,8 @@ import re
 
 import numpy as _np
 
+from . import _rng
+
 __all__ = ["InitDesc", "Initializer", "register", "create", "Zero", "One",
            "Constant", "Uniform", "Normal", "Orthogonal", "Xavier",
            "MSRAPrelu", "Bilinear", "LSTMBias", "Mixed", "Load"]
@@ -165,7 +167,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, _, arr):
-        arr[:] = _np.random.uniform(-self.scale, self.scale, arr.shape)
+        arr[:] = _rng.host_rng().uniform(-self.scale, self.scale, arr.shape)
 
 
 @register
@@ -177,7 +179,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, _, arr):
-        arr[:] = _np.random.normal(0, self.sigma, arr.shape)
+        arr[:] = _rng.host_rng().normal(0, self.sigma, arr.shape)
 
 
 @register
@@ -194,9 +196,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(_np.prod(arr.shape[1:])) if arr.ndim > 1 else 1
         if self.rand_type == "uniform":
-            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _rng.host_rng().uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _rng.host_rng().normal(0.0, 1.0, (nout, nin))
         u, _, v = _np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         arr[:] = (self.scale * q).reshape(arr.shape)
@@ -235,9 +237,9 @@ class Xavier(Initializer):
             raise ValueError("Incorrect factor type")
         scale = _np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            arr[:] = _np.random.uniform(-scale, scale, arr.shape)
+            arr[:] = _rng.host_rng().uniform(-scale, scale, arr.shape)
         elif self.rnd_type == "gaussian":
-            arr[:] = _np.random.normal(0, scale, arr.shape)
+            arr[:] = _rng.host_rng().normal(0, scale, arr.shape)
         else:
             raise ValueError("Unknown random type")
 

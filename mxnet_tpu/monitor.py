@@ -1,0 +1,82 @@
+"""mx.monitor — training-time tensor inspection.
+
+Reference: python/mxnet/monitor.py:32 (Monitor installs a stat callback
+on every executor output and prints aggregated stats per step). Here the
+same surface rides the Block forward hooks: ``install(block)`` hooks a
+block tree, ``tic()``/``toc()`` bracket a step, and ``toc_print()``
+prints ``(step, name, stat)`` rows. The default stat is the reference's
+|x|/size norm.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as _np
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval=1, stat_func=None, pattern=".*",
+                 sort=False):
+        self.interval = int(interval)
+        self.stat_func = stat_func or (
+            lambda x: _np.abs(x).sum() / x.size)   # reference default
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue = []
+        self._handles = []
+
+    # -- installation ------------------------------------------------------
+    def install(self, block):
+        """Hook a Block (and all children) so forward outputs are
+        recorded while activated (reference: Monitor.install wraps the
+        executor's monitor_callback)."""
+        for name, child in self._walk(block):
+            h = child.register_forward_hook(
+                lambda blk, args, out, _n=name: self._record(_n, out))
+            self._handles.append(h)
+        return self
+
+    def _walk(self, block, prefix=""):
+        yield (prefix + (block.name or block.__class__.__name__), block)
+        for cname, child in getattr(block, "_children", {}).items():
+            yield from self._walk(child, prefix + cname + ".")
+
+    def _record(self, name, out):
+        if not self.activated or not self.re_pattern.match(name):
+            return
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for i, o in enumerate(outs):
+            try:
+                arr = o.asnumpy()
+            except AttributeError:
+                continue
+            key = name if len(outs) == 1 else f"{name}_output{i}"
+            self.queue.append((self.step, key, self.stat_func(arr)))
+
+    # -- step bracketing ---------------------------------------------------
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+
+    def toc(self):
+        """Deactivate and return the collected (step, name, stat) rows."""
+        if not self.activated:
+            self.step += 1
+            return []
+        self.activated = False
+        res = list(self.queue)
+        if self.sort:
+            res.sort(key=lambda r: r[1])
+        self.queue = []
+        self.step += 1
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, stat)

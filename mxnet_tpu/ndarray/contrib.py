@@ -1,0 +1,168 @@
+"""nd.contrib — control-flow operators (+ contrib op aliases).
+
+Reference: src/operator/control_flow.cc (_foreach :1089, _while_loop
+:1150, _cond :1211) exposed through python/mxnet/ndarray/contrib.py
+(foreach :68, while_loop :171, cond :302). There the loop body becomes a
+sub-CachedOp executed by a stateful C++ operator; here the body is
+traced straight into ``lax.scan`` / ``lax.cond`` — the natural XLA
+control flow — and the whole loop lands on the autograd tape as ONE node
+whose backward is jax's scan/cond vjp. Inside ``hybridize``/``jit`` the
+loop compiles instead of unrolling.
+
+TPU-native deviation (documented): ``while_loop`` lowers to a
+fixed-trip masked ``lax.scan`` over ``max_iterations`` — XLA cannot
+reverse-differentiate a dynamic-trip ``lax.while_loop``, and masked
+fixed-trip loops are the standard TPU recipe. Slots after loop exit are
+zero-filled (the reference leaves them undefined).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.invoke import apply_fn
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _aslist(x):
+    if x is None:
+        return [], True
+    if isinstance(x, (list, tuple)):
+        return list(x), False
+    return [x], True
+
+
+def _unwrap(nd_list):
+    return tuple(x._data for x in nd_list)
+
+
+def _ndarray_cls():
+    from . import NDArray
+    return NDArray
+
+
+def foreach(body, data, init_states):
+    """Iterate ``body`` over the leading axis of ``data``
+    (reference: ndarray/contrib.py:68 foreach, control_flow.cc:1089).
+
+    body(data_t, states) -> (outputs_t, new_states); returns
+    (stacked outputs, final states). data/init_states/outputs may each
+    be a single NDArray or a list.
+    """
+    NDArray = _ndarray_cls()
+    datas, data_single = _aslist(data)
+    states0, state_single = _aslist(init_states)
+    nd_, ns_ = len(datas), len(states0)
+    meta = {}
+
+    def pure(*args):
+        ds, ss = args[:nd_], args[nd_:]
+
+        def step(carry, xs):
+            x_nd = [NDArray(x) for x in xs]
+            s_nd = [NDArray(c) for c in carry]
+            outs, new_states = body(x_nd[0] if data_single else x_nd,
+                                    s_nd[0] if state_single else s_nd)
+            outs_l, meta["out_single"] = _aslist(outs)
+            ns_l, _ = _aslist(new_states)
+            meta["nout"] = len(outs_l)
+            return _unwrap(ns_l), _unwrap(outs_l)
+
+        carry, ys = lax.scan(step, tuple(ss), tuple(ds))
+        return tuple(ys) + tuple(carry)
+
+    res = apply_fn(pure, datas + states0)
+    res = (res,) if not isinstance(res, tuple) else tuple(res)
+    outs = list(res[:meta["nout"]])
+    fin = list(res[meta["nout"]:])
+    return (outs[0] if meta["out_single"] else outs,
+            fin[0] if state_single else fin)
+
+
+def while_loop(cond, func, loop_vars, max_iterations):
+    """Bounded while loop (reference: ndarray/contrib.py:171 while_loop,
+    control_flow.cc:1150).
+
+    cond(*loop_vars) -> boolean scalar; func(*loop_vars) ->
+    (step_output(s), new_loop_vars). Returns (stacked outputs, final
+    loop_vars); outputs beyond the exit step are zeros. Runs as a
+    fixed-trip masked scan (see module docstring).
+    """
+    NDArray = _ndarray_cls()
+    lvars, _ = _aslist(loop_vars)
+    nvars = len(lvars)
+    meta = {}
+
+    def pure(*args):
+        def step(carry, _):
+            vars_j, done = carry
+            v_nd = [NDArray(v) for v in vars_j]
+            alive = jnp.logical_and(
+                jnp.logical_not(done),
+                jnp.asarray(cond(*v_nd)._data, bool).reshape(()))
+            outs, new_vars = func(*v_nd)
+            outs_l, meta["out_single"] = _aslist(outs)
+            nv_l, _ = _aslist(new_vars)
+            meta["nout"] = len(outs_l)
+            # masked commit: state/output only advance while alive
+            kept = tuple(jnp.where(alive, nv._data, v)
+                         for nv, v in zip(nv_l, vars_j))
+            ys = tuple(jnp.where(alive, o._data,
+                                 jnp.zeros_like(o._data))
+                       for o in outs_l)
+            return (kept, jnp.logical_not(alive)), ys
+
+        (final_vars, _), ys = lax.scan(
+            step, (tuple(args), jnp.asarray(False)), None,
+            length=max_iterations)
+        return tuple(ys) + tuple(final_vars)
+
+    res = apply_fn(pure, lvars)
+    res = (res,) if not isinstance(res, tuple) else tuple(res)
+    outs = list(res[:meta["nout"]])
+    fin = list(res[meta["nout"]:])
+    return (outs[0] if meta["out_single"] else outs,
+            fin if not isinstance(loop_vars, NDArray) else fin[0])
+
+
+def cond(pred, then_func, else_func, inputs):
+    """Conditional execution (reference: ndarray/contrib.py:302 cond,
+    control_flow.cc:1211): pred(*inputs) picks then_func(*inputs) or
+    else_func(*inputs); both branches are traced (XLA requirement) but
+    only one executes. Branch outputs must match in shape/dtype."""
+    NDArray = _ndarray_cls()
+    ins, _ = _aslist(inputs)
+    meta = {}
+
+    def pure(*args):
+        a_nd = [NDArray(a) for a in args]
+        p = jnp.asarray(pred(*a_nd)._data, bool).reshape(())
+
+        def mk(branch):
+            def run(operands):
+                outs = branch(*[NDArray(o) for o in operands])
+                outs_l, meta["out_single"] = _aslist(outs)
+                return _unwrap(outs_l)
+            return run
+
+        out = lax.cond(p, mk(then_func), mk(else_func), args)
+        # single outputs stay bare: the tape hands single-output nodes a
+        # bare cotangent, which must match this function's output tree
+        return out[0] if len(out) == 1 else out
+
+    res = apply_fn(pure, ins)
+    res = (res,) if not isinstance(res, tuple) else tuple(res)
+    outs = list(res)
+    return outs[0] if meta["out_single"] else outs
+
+
+# contrib-namespaced aliases of registered ops (reference: many
+# _contrib_* ops are reachable as nd.contrib.<name>)
+def __getattr__(name):
+    from .. import ndarray as _nd
+    for target in (f"_contrib_{name}", name):
+        if hasattr(_nd, target):
+            return getattr(_nd, target)
+    raise AttributeError(f"nd.contrib has no attribute {name!r}")

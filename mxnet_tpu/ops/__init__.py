@@ -12,7 +12,11 @@ from . import nn            # noqa: F401
 from . import rnn           # noqa: F401
 from . import flash_attention  # noqa: F401
 from . import contrib_det   # noqa: F401
+from . import extra         # noqa: F401
 from . import linalg        # noqa: F401
 from . import random_ops    # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from .invoke import apply_op, apply_fn  # noqa: F401
+# mx.operator registers the 'Custom' op (user Python ops over
+# jax.pure_callback); import it before the nd namespace is generated
+from .. import operator as _custom_operator  # noqa: F401,E402

@@ -62,7 +62,7 @@ def _box_iou(lhs, rhs, format="corner"):
 
 # ---------------------------------------------------------- MultiBoxPrior --
 
-@register("_contrib_MultiBoxPrior")
+@register("_contrib_MultiBoxPrior", differentiable=False)
 def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
                     steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
     """Anchor generation (reference: multibox_prior.cc:28-70).

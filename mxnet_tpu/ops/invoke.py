@@ -23,6 +23,35 @@ from .registry import Operator, get as get_op
 
 __all__ = ["apply_op", "apply_fn", "wrap_out", "as_jax"]
 
+import numpy as _np
+
+_HOST_CB_DEVICE = "unset"
+
+
+def _host_callback_device():
+    """CPU device to reroute host-callback ops to, or None when the
+    default platform supports callbacks itself. Probed once: some
+    accelerator platforms (tunneled TPUs) reject jax.pure_callback
+    outright; platforms that support it keep native placement."""
+    global _HOST_CB_DEVICE
+    if _HOST_CB_DEVICE != "unset":
+        return _HOST_CB_DEVICE
+    try:
+        if jax.devices()[0].platform == "cpu":
+            _HOST_CB_DEVICE = None
+            return None
+        try:  # probe actual support on the default backend
+            jax.pure_callback(
+                lambda: _np.zeros((), _np.float32),
+                jax.ShapeDtypeStruct((), _np.float32)).block_until_ready()
+            _HOST_CB_DEVICE = None
+        except Exception:
+            cpus = jax.local_devices(backend="cpu")
+            _HOST_CB_DEVICE = cpus[0] if cpus else None
+    except RuntimeError:
+        _HOST_CB_DEVICE = None
+    return _HOST_CB_DEVICE
+
 # AMP hook state, mutated by mxnet_tpu.amp (the TPU-native analogue of the
 # reference's amp_cast graph-rewrite insertion, python/mxnet/contrib/amp/
 # amp.py:283 — here the cast happens at the op-invoke chokepoint instead
@@ -116,7 +145,10 @@ def apply_fn(fn, inputs: Sequence, nout: int = 1, differentiable: bool = True,
         out_avals = [(r.shape, r._data.dtype) for r in results]
         for r, s in zip(results, out_slots):
             r._ag_slot = s
-        autograd.record_node(vjp_fn, in_slots, out_slots, out_avals)
+        # fn/xs allow create_graph=True to re-derive this vjp
+        # differentiably (autograd._taped_vjp)
+        autograd.record_node(vjp_fn, in_slots, out_slots, out_avals,
+                             fn=fn, xs=xs)
 
     return results[0] if single else tuple(results)
 
@@ -148,6 +180,50 @@ def apply_op(op, inputs: Sequence, params: Optional[dict] = None, out=None):
             tgt._data = outs_t[k]
             results.append(tgt)
         return results[0] if len(results) == 1 else tuple(results)
+
+    if op.host_op:
+        reroute = _host_callback_device()
+        concrete = not any(isinstance(getattr(x, "_data", x),
+                                      jax.core.Tracer) for x in inputs)
+        if reroute is not None and concrete:
+            # platform without host-callback support (e.g. tunneled TPU):
+            # run the callback on the CPU backend and device_put the
+            # outputs back — eagerly each primitive executes on its
+            # operands' backend, and device_put's transpose returns
+            # cotangents to the CPU side for the backward callback
+            NDArray = _ndarray_cls()
+            orig_dev = None
+            for x in inputs:
+                if isinstance(x, NDArray):
+                    try:
+                        orig_dev = next(iter(x._data.devices()))
+                        break
+                    except Exception:
+                        pass
+            if orig_dev is None:
+                orig_dev = jax.devices()[0]
+            with jax.default_device(reroute):
+                moved = [NDArray(jax.device_put(_np.asarray(x._data),
+                                                reroute))
+                         if isinstance(x, NDArray) else x
+                         for x in inputs]
+                for m, x in zip(moved, inputs):
+                    if isinstance(x, NDArray):
+                        m._ag_slot = getattr(x, "_ag_slot", None)
+                if op.variadic:
+                    base = lambda *xs: op.impl(list(xs), **params)  # noqa: E731
+                else:
+                    base = lambda *xs: op.impl(*xs, **params)  # noqa: E731
+
+                def fn(*xs):
+                    outs = base(*xs)
+                    if isinstance(outs, (tuple, list)):
+                        return tuple(jax.device_put(o, orig_dev)
+                                     for o in outs)
+                    return jax.device_put(outs, orig_dev)
+
+                return apply_fn(fn, moved, nout=op.nout,
+                                differentiable=op.differentiable, out=out)
 
     if op.variadic:
         arrs = list(inputs)

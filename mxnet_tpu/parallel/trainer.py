@@ -27,13 +27,54 @@ from .mesh import local_mesh
 __all__ = ["ShardedTrainer", "shard_batch"]
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _spans_processes(mesh: Mesh) -> bool:
+    # cached: scanning mesh.devices.flat in Python on every step would
+    # cost thousands of attribute reads per step on big slices
+    pid = jax.process_index()
+    return any(d.process_index != pid for d in mesh.devices.flat)
+
+
+def _to_global(arr, mesh: Mesh, spec: P, host_has: str = "full"):
+    """Place a host array onto a (possibly multi-process) mesh. Within
+    one process this is a plain device_put. Across processes the meaning
+    of the host array matters (``host_has``):
+    - "full": every process holds the whole (global-shape) array —
+      parameters/optimizer state. Replicated specs broadcast rank 0's
+      values (the reference dist_sync init semantics: kvstore_dist.h
+      Init pushes rank-0 weights), so ranks cannot silently train on
+      divergent 'replicated' parameters; sharded specs slice each
+      process's addressable shards out of its full copy
+      (make_array_from_callback) — NOT concatenation.
+    - "local_shard": each process holds only its own piece — batches.
+      The global array is the concatenation of every process's local
+      array along the sharded axis (host_local_array_to_global_array),
+      the reference's dist_sync data layout."""
+    if _spans_processes(mesh):
+        from jax.experimental import multihost_utils
+        arr = _np.asarray(arr)
+        sharding = NamedSharding(mesh, spec)
+        replicated = all(ax is None for ax in (spec or ())) or spec == P()
+        if host_has == "full":
+            if replicated:
+                arr = multihost_utils.broadcast_one_to_all(arr)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
+        return multihost_utils.host_local_array_to_global_array(
+            arr, mesh, spec)
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
 def shard_batch(x, mesh: Mesh, axis: str = "dp"):
     """Place a host batch as one global array sharded on the batch dim
     (≙ gluon.utils.split_and_load, reference gluon/utils.py:95 — but one
     array, not per-device copies)."""
     arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
     spec = P(axis, *([None] * (arr.ndim - 1)))
-    return NDArray(jax.device_put(arr, NamedSharding(mesh, spec)))
+    return NDArray(_to_global(arr, mesh, spec, host_has="local_shard"))
 
 
 class ShardedTrainer:
@@ -95,20 +136,19 @@ class ShardedTrainer:
             n for n in self._names
             if block.collect_params()[n].grad_req != "null"]
         # shard/replicate parameters onto the mesh
-        self._params = {}
-        for n in self._names:
-            spec = (self._param_spec(n, params[n].shape)
-                    if self._param_spec else P())
-            self._params[n] = jax.device_put(
-                params[n], NamedSharding(self._mesh, spec))
+        specs = {n: (self._param_spec(n, params[n].shape)
+                     if self._param_spec else P())
+                 for n in self._names}
+        self._params = {n: _to_global(params[n], self._mesh, specs[n])
+                        for n in self._names}
         # optimizer states live with their parameter, same sharding
         self._opt_states = {}
         for i, n in enumerate(self._trainable):
-            st = self._optimizer.create_state(i, NDArray(self._params[n]))
+            st = self._optimizer.create_state(i, NDArray(params[n]))
             self._opt_states[n] = jax.tree_util.tree_map(
-                lambda a: jax.device_put(
+                lambda a, s=specs[n]: _to_global(
                     a._data if isinstance(a, NDArray) else a,
-                    self._params[n].sharding), st,
+                    self._mesh, s), st,
                 is_leaf=lambda a: isinstance(a, NDArray))
 
     @property
@@ -169,6 +209,10 @@ class ShardedTrainer:
         self._params, self._opt_states, loss = self._step_jit(
             self._params, self._opt_states, sub, t, xb, yb)
         self._step_count += 1
+        if _spans_processes(self._mesh):
+            # the loss is replicated; hand back this process's copy so
+            # eager reads (asscalar) need no cross-host fetch
+            loss = loss.addressable_data(0)
         return NDArray(loss)
 
     def forward(self, x, training=False):

@@ -1,0 +1,188 @@
+"""mx.profiler — profiling bridge over jax.profiler.
+
+Reference surface: python/mxnet/profiler.py (set_config :40, set_state
+:115, pause/resume :146/:160, dump :173, dumps :194 aggregate stats,
+scope/annotations) backed by src/profiler/profiler.h:251. The TPU-native
+mapping:
+
+- set_state('run'/'stop') starts/stops a jax.profiler trace capturing
+  device (TPU) and host timelines into a TensorBoard/Perfetto-loadable
+  directory (set_config(filename=...)).
+- per-op naming: the engine-level op records of the reference come for
+  free from XLA's HLO names; ``scope(name)``/Block-level scopes add
+  ``jax.named_scope`` annotations so model structure shows up in the
+  trace (enable Block scopes with ``profile_symbolic=True``).
+- dumps() aggregates the captured chrome-trace events into the
+  reference's "aggregate stats" table (per-op total/count/avg device
+  time) by parsing the trace the profiler just wrote.
+- pause/resume: jax traces cannot pause mid-capture; pause() closes the
+  current capture section and resume() opens a new one in the same
+  directory (the viewer shows them as separate captures).
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import time
+from collections import Counter
+
+__all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
+           "scope", "state", "profiler_set_config", "profiler_set_state"]
+
+_config = {
+    "filename": "profile_output",
+    "profile_all": False,
+    "profile_symbolic": True,   # Block-level named scopes
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": True,
+}
+_state = "stop"
+_scopes_enabled = False
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference: profiler.py:40 set_config).
+    ``filename`` names the output directory (the reference wrote one
+    chrome-trace json; jax writes a trace directory loadable by
+    TensorBoard, Perfetto, or dumps() below)."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise ValueError(f"unknown profiler options: {sorted(unknown)}")
+    _config.update(kwargs)
+
+
+profiler_set_config = set_config
+
+
+def _trace_dir():
+    base = _config["filename"]
+    if base.endswith(".json"):
+        base = base[:-5]
+    return base
+
+
+def state():
+    return _state
+
+
+def set_state(state_name="stop"):
+    """'run' starts a capture, 'stop' ends it (reference: profiler.py:115
+    set_state)."""
+    global _state, _scopes_enabled
+    import jax
+
+    if state_name == "run":
+        if _state != "run":
+            os.makedirs(_trace_dir(), exist_ok=True)
+            jax.profiler.start_trace(_trace_dir())
+            _scopes_enabled = bool(_config["profile_symbolic"])
+            _state = "run"
+    elif state_name == "stop":
+        if _state == "run":
+            jax.profiler.stop_trace()
+            _scopes_enabled = False
+            _state = "stop"
+    else:
+        raise ValueError(f"invalid profiler state {state_name!r}")
+
+
+profiler_set_state = set_state
+
+
+def pause(profile_process="worker"):
+    """Close the current capture section (reference: profiler.py:146)."""
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    """Open a new capture section in the same directory (reference:
+    profiler.py:160)."""
+    set_state("run")
+
+
+def dump(finished=True, profile_process="worker"):
+    """Flush the trace to disk (reference: profiler.py:173). jax writes
+    on stop_trace, so this just ensures the capture is stopped."""
+    if finished:
+        set_state("stop")
+
+
+def scopes_enabled():
+    return _scopes_enabled
+
+
+class scope:
+    """Context manager adding a named scope to the trace (and to HLO op
+    metadata under jit). Reference analogue: profiler.Scope /
+    MXNET_PROFILER annotations."""
+
+    def __init__(self, name="<unk>:"):
+        self._name = name
+        self._ctx = None
+
+    def __enter__(self):
+        import jax
+        self._ctx = jax.named_scope(self._name)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+def _load_trace_events():
+    """Read every chrome-trace json the current trace dir holds."""
+    pattern = os.path.join(_trace_dir(), "plugins", "profile", "**",
+                           "*.trace.json.gz")
+    events = []
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        try:
+            data = json.load(gzip.open(path))
+        except Exception:
+            continue
+        events.extend(data.get("traceEvents", []))
+    return events
+
+
+def dumps(reset=False, format_="table"):
+    """Aggregate stats from the captured trace (reference: profiler.py:194
+    dumps): per-op-name total/count/avg device time, sorted by total.
+
+    Must be called after set_state('stop'); returns a printable table
+    (or the raw {name: (total_us, count)} dict with format_='dict').
+    """
+    events = _load_trace_events()
+    pids = {e["pid"]: e["args"].get("name", "")
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    def aggregate(device_only):
+        tot, cnt = Counter(), Counter()
+        for e in events:
+            if e.get("ph") != "X" or "dur" not in e:
+                continue
+            pname = pids.get(e.get("pid"), "")
+            if device_only and "cpu" in pname.lower() \
+                    and "device" not in pname.lower():
+                continue  # host lanes excluded from the op table
+            key = e["name"].split(".")[0]
+            tot[key] += e["dur"]
+            cnt[key] += 1
+        return tot, cnt
+
+    # prefer accelerator lanes; on a CPU-only backend everything runs on
+    # host lanes, so fall back to them
+    tot, cnt = aggregate(device_only=True)
+    if not tot:
+        tot, cnt = aggregate(device_only=False)
+    if format_ == "dict":
+        return {k: (tot[k], cnt[k]) for k in tot}
+    lines = [f"{'Name':<48} {'Total(us)':>12} {'Count':>8} {'Avg(us)':>10}"]
+    lines.append("-" * 80)
+    for name, total in tot.most_common():
+        lines.append(f"{name[:48]:<48} {total:>12.1f} {cnt[name]:>8} "
+                     f"{total / cnt[name]:>10.1f}")
+    return "\n".join(lines)

@@ -1,0 +1,56 @@
+"""mx.runtime — runtime feature detection.
+
+Reference: python/mxnet/runtime.py (Features / feature_list /
+Feature.is_enabled over libinfo's compile-time flags). The TPU build has
+no compile-time feature matrix; features reflect the live jax runtime:
+platform backends, device counts, and library capabilities.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+
+
+def _probe():
+    import jax
+
+    feats = {}
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        platform = "none"
+    feats["TPU"] = platform not in ("cpu", "none")
+    feats["CUDA"] = False            # CUDA never backs this build
+    feats["CPU"] = True
+    feats["INT64_TENSOR_SIZE"] = True
+    feats["F16C"] = True             # bfloat16 native on TPU; emulated CPU
+    feats["DIST_KVSTORE"] = True     # jax.distributed + KVStoreTPU
+    feats["PALLAS"] = True           # flash-attention kernels
+    try:
+        feats["NUM_DEVICES_%d" % jax.device_count()] = True
+    except RuntimeError:
+        pass
+    return feats
+
+
+class Features(dict):
+    """Mapping name -> Feature (reference: runtime.py Features)."""
+
+    def __init__(self):
+        super().__init__({k: Feature(k, v) for k, v in _probe().items()})
+
+    def is_enabled(self, name):
+        name = name.upper()
+        return name in self and self[name].enabled
+
+    def __repr__(self):
+        return "[" + ", ".join(
+            f"{f.name}: {'✔' if f.enabled else '✖'}"
+            for f in self.values()) + "]"
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,190 @@
+"""Control-flow ops: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc (:1089/:1150/:1211) +
+python/mxnet/ndarray/contrib.py; grads are pinned against unrolled
+eager loops, the reference's own test strategy
+(tests/python/unittest/test_contrib_control_flow.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.autograd as ag
+
+
+def test_foreach_matches_unrolled_forward_and_grad():
+    rng = np.random.RandomState(0)
+    data_np = rng.randn(5, 3).astype(np.float32)
+    init_np = rng.randn(3).astype(np.float32)
+
+    def body(x, state):
+        s = state[0] * 0.9 + x * x
+        return s * 2.0, [s]
+
+    # scan path
+    data, init = nd.array(data_np), nd.array(init_np)
+    data.attach_grad()
+    init.attach_grad()
+    with ag.record():
+        outs, final = nd.contrib.foreach(body, data, [init])
+        loss = outs.sum() + final[0].sum()
+    loss.backward()
+    g_data, g_init = data.grad.asnumpy(), init.grad.asnumpy()
+
+    # unrolled oracle
+    data2, init2 = nd.array(data_np), nd.array(init_np)
+    data2.attach_grad()
+    init2.attach_grad()
+    with ag.record():
+        s = init2
+        tot = None
+        for t in range(5):
+            o, (s,) = body(data2[t], [s])
+            tot = o.sum() if tot is None else tot + o.sum()
+        loss2 = tot + s.sum()
+    loss2.backward()
+
+    np.testing.assert_allclose(float(loss.asnumpy()),
+                               float(loss2.asnumpy()), rtol=1e-5)
+    np.testing.assert_allclose(g_data, data2.grad.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(g_init, init2.grad.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+    assert outs.shape == (5, 3)
+
+
+def test_foreach_multiple_data_and_outputs():
+    rng = np.random.RandomState(1)
+    a = nd.array(rng.randn(4, 2).astype(np.float32))
+    b = nd.array(rng.randn(4, 2).astype(np.float32))
+    s0 = nd.array(np.zeros(2, np.float32))
+
+    def body(xs, states):
+        x, y = xs
+        s = states[0] + x * y
+        return [x + y, s * 1.0], [s]
+
+    (o1, o2), [fs] = nd.contrib.foreach(body, [a, b], [s0])
+    an, bn = a.asnumpy(), b.asnumpy()
+    np.testing.assert_allclose(o1.asnumpy(), an + bn, rtol=1e-6)
+    np.testing.assert_allclose(o2.asnumpy(), np.cumsum(an * bn, axis=0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(fs.asnumpy(), (an * bn).sum(0), rtol=1e-5)
+
+
+def test_while_loop_matches_python_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return (s + i), (i + 1, s + i)
+
+    i0 = nd.array(np.array(0.0, np.float32))
+    s0 = nd.array(np.array(1.0, np.float32))
+    outs, (fi, fs) = nd.contrib.while_loop(cond_fn, func, [i0, s0],
+                                           max_iterations=8)
+    # python oracle
+    i, s, ys = 0.0, 1.0, []
+    while i < 5:
+        ys.append(s + i)
+        i, s = i + 1, s + i
+    np.testing.assert_allclose(float(fi.asnumpy()), i)
+    np.testing.assert_allclose(float(fs.asnumpy()), s)
+    o = outs.asnumpy()
+    np.testing.assert_allclose(o[:len(ys)], ys, rtol=1e-6)
+    np.testing.assert_allclose(o[len(ys):], 0.0)   # zero-filled tail
+
+
+def test_while_loop_grads():
+    x0 = nd.array(np.array([2.0, 3.0], np.float32))
+    x0.attach_grad()
+
+    def cond_fn(x, t):
+        return t < 3
+
+    def func(x, t):
+        return x * 0.0, (x * x * 0.1 + x, t + 1)
+
+    with ag.record():
+        _, (xf, _) = nd.contrib.while_loop(
+            cond_fn, func, [x0, nd.array(np.array(0.0, np.float32))],
+            max_iterations=5)
+        loss = xf.sum()
+    loss.backward()
+
+    # numeric gradient oracle
+    def f(v):
+        x = v.copy()
+        for _ in range(3):
+            x = x * x * 0.1 + x
+        return x.sum()
+    eps = 1e-3
+    num = np.zeros(2)
+    base = np.array([2.0, 3.0])
+    for j in range(2):
+        p, m = base.copy(), base.copy()
+        p[j] += eps
+        m[j] -= eps
+        num[j] = (f(p) - f(m)) / (2 * eps)
+    np.testing.assert_allclose(x0.grad.asnumpy(), num, rtol=1e-3)
+
+
+@pytest.mark.parametrize("branch", [True, False])
+def test_cond_forward_and_grad(branch):
+    x = nd.array(np.array([1.0, -2.0], np.float32))
+    x.attach_grad()
+    flag = nd.array(np.array(1.0 if branch else -1.0, np.float32))
+
+    with ag.record():
+        out = nd.contrib.cond(
+            lambda a, f: (f > 0),
+            lambda a, f: a * 3.0,
+            lambda a, f: a * a,
+            [x, flag])
+        loss = out.sum()
+    loss.backward()
+    if branch:
+        np.testing.assert_allclose(out.asnumpy(), [3.0, -6.0])
+        np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0])
+    else:
+        np.testing.assert_allclose(out.asnumpy(), [1.0, 4.0])
+        np.testing.assert_allclose(x.grad.asnumpy(), [2.0, -4.0])
+
+
+def test_foreach_inside_hybridized_block():
+    """Control flow must compile inside a jitted (hybridized) block —
+    the scan stays a scan, not an unrolled trace."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    class ScanNet(nn.HybridSequential):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.proj = nn.Dense(4, flatten=False)
+
+        def forward(self, x):
+            h = self.proj(x)                     # (B, T, 4)
+            ht = h.transpose((1, 0, 2))          # (T, B, 4)
+
+            def body(xt, states):
+                s = states[0] + xt.tanh()
+                return s, [s]
+
+            outs, _ = nd.contrib.foreach(
+                body, ht, [nd.zeros((h.shape[0], 4))])
+            return outs[-1]
+
+    mx.random.seed(0)
+    net = ScanNet()
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 6, 3))
+    with ag.pause():
+        eager = net(x).asnumpy()
+    net.hybridize()
+    with ag.pause():
+        jitted = net(x).asnumpy()
+        jitted2 = net(x).asnumpy()   # second call: cache hit
+    np.testing.assert_allclose(jitted, eager, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(jitted2, eager, rtol=1e-5, atol=1e-6)

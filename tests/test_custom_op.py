@@ -1,0 +1,139 @@
+"""Custom Python operators (reference: python/mxnet/operator.py,
+src/operator/custom/custom.cc; test strategy from
+tests/python/unittest/test_operator.py test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, operator
+import mxnet_tpu.autograd as ag
+
+
+class _Sigmoid(operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], g * y * (1.0 - y))
+
+
+@operator.register("test_sigmoid")
+class _SigmoidProp(operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _Sigmoid()
+
+
+class _ScaledAdd(operator.CustomOp):
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        a, b = in_data[0].asnumpy(), in_data[1].asnumpy()
+        self.assign(out_data[0], req[0], a + self.scale * b)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], g)
+        self.assign(in_grad[1], req[1], self.scale * g)
+
+
+@operator.register("test_scaled_add")
+class _ScaledAddProp(operator.CustomOpProp):
+    def __init__(self, scale="2.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _ScaledAdd(self.scale)
+
+
+def test_custom_forward_and_grad_match_builtin():
+    x_np = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    x = nd.array(x_np)
+    x.attach_grad()
+    with ag.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+        y.sum().backward()
+    gx = x.grad.asnumpy()
+
+    x2 = nd.array(x_np)
+    x2.attach_grad()
+    with ag.record():
+        y2 = nd.sigmoid(x2)
+        y2.sum().backward()
+    np.testing.assert_allclose(y.asnumpy(), y2.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(gx, x2.grad.asnumpy(), rtol=1e-5)
+
+
+def test_custom_multi_input_with_param():
+    a = nd.array(np.ones((2, 2), np.float32))
+    b = nd.array(np.full((2, 2), 3.0, np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        out = nd.Custom(a, b, op_type="test_scaled_add", scale="4.0")
+        out.sum().backward()
+    np.testing.assert_allclose(out.asnumpy(), 1.0 + 4.0 * 3.0)
+    np.testing.assert_allclose(a.grad.asnumpy(), 1.0)
+    np.testing.assert_allclose(b.grad.asnumpy(), 4.0)
+
+
+def test_unregistered_op_type_raises():
+    with pytest.raises(ValueError, match="not registered"):
+        nd.Custom(nd.array(np.ones(2)), op_type="nope_never_registered")
+
+
+def test_custom_op_trains_inside_gluon_net():
+    """The reference's headline custom-op scenario: a Python op embedded
+    in a net, trained end to end — including under hybridize (the
+    callback becomes a host call inside the jitted program)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    class CustomActNet(nn.HybridSequential):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.fc1 = nn.Dense(16)
+                self.fc2 = nn.Dense(2)
+
+        def forward(self, x):
+            h = nd.Custom(self.fc1(x), op_type="test_sigmoid")
+            return self.fc2(h)
+
+    mx.random.seed(0)
+    net = CustomActNet()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(32, 8).astype(np.float32)
+    y_np = (x_np.sum(axis=1) > 0).astype(np.float32)
+    x, y = nd.array(x_np), nd.array(y_np)
+    losses = []
+    for _ in range(60):
+        with ag.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+    # hybridized: pure_callback inside jit
+    net.hybridize()
+    with ag.pause():
+        out_j = net(x).asnumpy()
+    assert np.isfinite(out_j).all()

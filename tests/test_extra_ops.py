@@ -1,0 +1,245 @@
+"""Coverage-tail ops (ops/extra.py): legacy outputs, spatial transformer
+family, im2col/col2im, samplers, multi-tensor optimizer kernels, small
+contribs. Reference provenance in ops/extra.py docstrings."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.autograd as ag
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def test_internal_comparison_and_logical():
+    a = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]]))
+    b = nd.array(np.array([[1.0, 1.0], [5.0, 4.0]]))
+    np.testing.assert_array_equal(nd._equal(a, b).asnumpy(),
+                                  [[1, 0], [0, 1]])
+    np.testing.assert_array_equal(nd._greater(a, b).asnumpy(),
+                                  [[0, 1], [0, 0]])
+    np.testing.assert_array_equal(
+        nd._logical_and(a, nd.array(np.array([[0.0, 1.0], [1.0, 0.0]])))
+        .asnumpy(), [[0, 1], [1, 0]])
+    np.testing.assert_allclose(nd.add_n(a, b, a).asnumpy(),
+                               2 * a.asnumpy() + b.asnumpy())
+
+
+def test_im2col_col2im_adjoint():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(1, 2, 4, 4).astype(np.float32))
+    cols = nd.im2col(x, kernel=(2, 2), stride=(1, 1))
+    assert cols.shape == (1, 8, 9)
+    # col2im(im2col(x)) multiplies each pixel by its patch count
+    back = nd.col2im(cols, output_size=(4, 4), kernel=(2, 2),
+                     stride=(1, 1))
+    counts = np.zeros((4, 4), np.float32)
+    for i in range(3):
+        for j in range(3):
+            counts[i:i + 2, j:j + 2] += 1
+    np.testing.assert_allclose(back.asnumpy(),
+                               x.asnumpy() * counts, rtol=1e-5)
+
+
+def test_legacy_output_layers():
+    d = nd.array(np.array([[2.0]], np.float32))
+    lab = nd.array(np.array([[0.5]], np.float32))
+    d.attach_grad()
+    with ag.record():
+        nd.LinearRegressionOutput(d, lab).backward()
+    np.testing.assert_allclose(d.grad.asnumpy(), [[1.5]])
+
+    d2 = nd.array(np.array([[0.0]], np.float32))
+    d2.attach_grad()
+    with ag.record():
+        out = nd.LogisticRegressionOutput(d2, lab)
+        out.backward()
+    np.testing.assert_allclose(out.asnumpy(), [[0.5]])
+    np.testing.assert_allclose(d2.grad.asnumpy(), [[0.0]], atol=1e-6)
+
+    sm = nd.SoftmaxActivation(nd.array(np.zeros((2, 3), np.float32)))
+    np.testing.assert_allclose(sm.asnumpy(), 1 / 3, rtol=1e-6)
+
+
+def test_spatial_transformer_identity_and_shift():
+    rng = np.random.RandomState(0)
+    img = nd.array(rng.randn(1, 1, 5, 5).astype(np.float32))
+    ident = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    out = nd.SpatialTransformer(img, ident, target_shape=(5, 5))
+    np.testing.assert_allclose(out.asnumpy(), img.asnumpy(), atol=1e-5)
+    # grads flow to both data and the transform
+    img.attach_grad()
+    theta = nd.array(np.array([[1, 0, 0.1, 0, 1, -0.1]], np.float32))
+    theta.attach_grad()
+    with ag.record():
+        o = nd.SpatialTransformer(img, theta, target_shape=(5, 5))
+        o.sum().backward()
+    assert np.abs(img.grad.asnumpy()).sum() > 0
+    assert np.abs(theta.grad.asnumpy()).sum() > 0
+
+
+def test_bilinear_sampler_zero_padding_outside():
+    img = nd.array(np.ones((1, 1, 3, 3), np.float32))
+    # grid entirely outside [-1,1] -> zeros
+    grid = nd.array(np.full((1, 2, 2, 2), 3.0, np.float32))
+    out = nd.BilinearSampler(img, grid)
+    np.testing.assert_allclose(out.asnumpy(), 0.0)
+
+
+def test_roi_pooling_max_semantics():
+    img_np = np.zeros((1, 1, 8, 8), np.float32)
+    img_np[0, 0, 1, 1] = 5.0
+    img_np[0, 0, 6, 6] = 7.0
+    out = nd.ROIPooling(nd.array(img_np),
+                        nd.array(np.array([[0, 0, 0, 7, 7]], np.float32)),
+                        pooled_size=(2, 2), spatial_scale=1.0)
+    o = out.asnumpy()[0, 0]
+    assert o[0, 0] == 5.0 and o[1, 1] == 7.0
+
+
+def test_crop():
+    x = nd.array(np.arange(16.0).reshape(1, 1, 4, 4))
+    out = nd.Crop(x, offset=(1, 1), h_w=(2, 2))
+    np.testing.assert_allclose(out.asnumpy()[0, 0], [[5, 6], [9, 10]])
+    like = nd.array(np.zeros((1, 1, 2, 2), np.float32))
+    out2 = nd.Crop(x, like, center_crop=True, num_args=2)
+    np.testing.assert_allclose(out2.asnumpy()[0, 0], [[5, 6], [9, 10]])
+
+
+def test_samplers_row_per_distribution():
+    mx.random.seed(0)
+    lam = nd.array(np.array([1.0, 20.0]))
+    s = nd._sample_poisson(lam, shape=(800,))
+    assert s.shape == (2, 800)
+    means = s.asnumpy().mean(axis=1)
+    assert abs(means[0] - 1.0) < 0.2 and abs(means[1] - 20.0) < 1.0
+    e = nd._sample_exponential(lam, shape=(800,))
+    em = e.asnumpy().mean(axis=1)
+    assert abs(em[0] - 1.0) < 0.2 and abs(em[1] - 0.05) < 0.02
+    k = nd.array(np.array([5.0]))
+    p = nd.array(np.array([0.5]))
+    nb = nd._sample_negative_binomial(k, p, shape=(2000,))
+    assert abs(nb.asnumpy().mean() - 5.0) < 0.5   # mean k(1-p)/p = 5
+
+
+def test_ftml_and_adamw_updates():
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.full(4, 0.1, np.float32))
+    d = nd.array(np.zeros(4, np.float32))
+    v = nd.array(np.zeros(4, np.float32))
+    z = nd.array(np.zeros(4, np.float32))
+    nd.ftml_update(w, g, d, v, z, lr=0.1, t=1)
+    # t=1: v=(1-b2)g^2; d_t=(1-b1)/lr*(sqrt(g^2)+eps); z=(1-b1)g-d_t*w
+    assert np.all(w.asnumpy() < 1.0) and np.isfinite(w.asnumpy()).all()
+
+    w2 = nd.array(np.ones(4, np.float32))
+    m = nd.array(np.zeros(4, np.float32))
+    vv = nd.array(np.zeros(4, np.float32))
+    nd._adamw_update(w2, g, m, vv, rescale_grad=1.0, lr=0.1, wd=0.01,
+                     eta=1.0)
+    expect = 1.0 - (0.1 * (0.1 * 0.1) / (np.sqrt(0.001 * 0.01) + 1e-8)
+                    + 0.01 * 1.0)
+    np.testing.assert_allclose(w2.asnumpy(), expect, rtol=1e-4)
+
+
+def test_multi_tensor_sgd():
+    outs = nd.multi_sgd_update(
+        nd.array(np.ones(2, np.float32)),
+        nd.array(np.full(2, 0.5, np.float32)),
+        nd.array(np.ones(3, np.float32)),
+        nd.array(np.full(3, 0.1, np.float32)),
+        num_weights=2, lrs=(0.1, 0.2), wds=(0.0, 0.0))
+    np.testing.assert_allclose(outs[0].asnumpy(), 0.95, rtol=1e-6)
+    np.testing.assert_allclose(outs[1].asnumpy(), 0.98, rtol=1e-6)
+    # sum-sq + all-finite helpers
+    ss = nd.multi_sum_sq(nd.array(np.ones(3)), nd.array(np.full(2, 2.0)),
+                         num_arrays=2)
+    np.testing.assert_allclose([float(s.asnumpy()[0]) for s in ss],
+                               [3.0, 8.0])
+    fin = nd.all_finite(nd.array(np.array([1.0, np.inf])))
+    assert float(fin.asnumpy()[0]) == 0.0
+
+
+def test_small_contribs():
+    a = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    assert float(nd._contrib_allclose(a, a).asnumpy()[0]) == 1.0
+    np.testing.assert_allclose(
+        nd._contrib_quadratic(a, a=1.0, b=2.0, c=3.0).asnumpy(),
+        a.asnumpy() ** 2 + 2 * a.asnumpy() + 3)
+    np.testing.assert_allclose(
+        nd._contrib_div_sqrt_dim(a).asnumpy(),
+        a.asnumpy() / np.sqrt(2), rtol=1e-6)
+    # gradient multiplier scales only the backward
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        nd._contrib_gradientmultiplier(x, scalar=3.0).backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0])
+    # straight-through round: grad passes unchanged
+    x2 = nd.array(np.array([1.4], np.float32))
+    x2.attach_grad()
+    with ag.record():
+        out = nd._contrib_round_ste(x2)
+        out.backward()
+    np.testing.assert_allclose(out.asnumpy(), [1.0])
+    np.testing.assert_allclose(x2.grad.asnumpy(), [1.0])
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = np.array([[[0.2, 0.2, 0.4, 0.4], [0.5, 0.5, 0.9, 0.8]]],
+                       np.float32)
+    refs = np.array([[[0.25, 0.25, 0.45, 0.5]]], np.float32)
+    matches = np.array([[0, 0]], np.float32)
+    samples = np.array([[1.0, 1.0]], np.float32)
+    enc, mask = nd._contrib_box_encode(
+        nd.array(samples), nd.array(matches), nd.array(anchors),
+        nd.array(refs), means=(0, 0, 0, 0), stds=(0.1, 0.1, 0.2, 0.2))
+    dec = nd._contrib_box_decode(enc, nd.array(anchors),
+                                 std0=0.1, std1=0.1, std2=0.2, std3=0.2)
+    np.testing.assert_allclose(dec.asnumpy()[0, 0], refs[0, 0], atol=1e-5)
+    np.testing.assert_allclose(dec.asnumpy()[0, 1], refs[0, 0], atol=1e-5)
+
+
+def test_fft_ifft_reference_convention():
+    sig = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    f = nd._contrib_fft(nd.array(sig))
+    assert f.shape == (2, 16)
+    rt = nd._contrib_ifft(f)     # reference ifft is unnormalized (x n)
+    np.testing.assert_allclose(rt.asnumpy() / 8, sig, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_numeric_gradients_extra():
+    check_numeric_gradient("_contrib_quadratic",
+                           [np.random.RandomState(0).randn(3, 3)],
+                           {"a": 0.5, "b": -1.0, "c": 2.0})
+    check_numeric_gradient("_contrib_div_sqrt_dim",
+                           [np.random.RandomState(1).randn(2, 4)])
+    check_numeric_gradient("im2col",
+                           [np.random.RandomState(2).randn(1, 2, 4, 4)],
+                           {"kernel": (2, 2), "stride": (1, 1)})
+    check_numeric_gradient("_square_sum",
+                           [np.random.RandomState(3).randn(3, 3)],
+                           {"axis": 1})
+
+
+def test_monitor_and_runtime():
+    from mxnet_tpu import monitor, runtime
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    mon = monitor.Monitor(interval=1, pattern=".*").install(net)
+    x = nd.array(np.ones((2, 3), np.float32))
+    mon.tic()
+    with ag.pause():
+        net(x)
+    rows = mon.toc()
+    assert len(rows) >= 2           # one stat per hooked block forward
+    assert all(np.isfinite(r[2]) for r in rows)
+
+    feats = runtime.Features()
+    assert feats.is_enabled("CPU")
+    assert feats.is_enabled("DIST_KVSTORE")
+    assert any(f.name == "PALLAS" for f in runtime.feature_list())

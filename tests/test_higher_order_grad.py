@@ -1,0 +1,87 @@
+"""Higher-order gradients via autograd.grad(create_graph=True).
+
+Cases ported from the reference's
+tests/python/unittest/test_higher_order_grad.py (sin/log/sigmoid +
+composite polynomials), checked against closed-form derivatives.
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+import mxnet_tpu.autograd as ag
+
+
+def _second_order(fn, x_np, d2_expected, rtol=1e-4):
+    x = nd.array(x_np)
+    x.attach_grad()
+    with ag.record():
+        y = fn(x)
+        dydx = ag.grad(y, x, create_graph=True, retain_graph=True)
+        dydx.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), d2_expected(x_np),
+                               rtol=rtol, atol=1e-5)
+
+
+def test_sin_second_order():
+    x_np = np.random.RandomState(0).rand(3, 4).astype(np.float32) * 2
+    # d2/dx2 sum(sin x) = -sin x
+    _second_order(lambda x: nd.sin(x), x_np, lambda v: -np.sin(v))
+
+
+def test_log_second_order():
+    x_np = (np.random.RandomState(1).rand(3, 4).astype(np.float32) + 0.5)
+    _second_order(lambda x: nd.log(x), x_np, lambda v: -1.0 / v ** 2)
+
+
+def test_sigmoid_second_order():
+    x_np = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+
+    def d2(v):
+        s = 1 / (1 + np.exp(-v))
+        return s * (1 - s) * (1 - 2 * s)
+    _second_order(lambda x: nd.sigmoid(x), x_np, d2)
+
+
+def test_polynomial_second_order():
+    x_np = np.random.RandomState(3).randn(3).astype(np.float32)
+    # y = x^3 + 2x^2 -> y'' = 6x + 4
+    _second_order(lambda x: x * x * x + 2.0 * (x * x), x_np,
+                  lambda v: 6 * v + 4)
+
+
+def test_third_order():
+    x_np = np.array([0.7, -0.3, 1.2], np.float32)
+    x = nd.array(x_np)
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x * x            # y'''' well defined; check y'''
+        g1 = ag.grad(y, x, create_graph=True, retain_graph=True)
+        g2 = ag.grad(g1, x, create_graph=True, retain_graph=True)
+        g2.backward()
+    # y''' = 24x
+    np.testing.assert_allclose(x.grad.asnumpy(), 24 * x_np, rtol=1e-4)
+
+
+def test_first_order_grad_unchanged():
+    """grad() without create_graph still returns plain first-order."""
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        g = ag.grad(y, x)
+    np.testing.assert_allclose(g.asnumpy(), [4.0])
+
+
+def test_grad_of_product_of_grads():
+    """Hessian-vector-ish pattern: loss built FROM a gradient trains."""
+    x_np = np.array([1.0, 2.0], np.float32)
+    x = nd.array(x_np)
+    x.attach_grad()
+    with ag.record():
+        y = (x * x * x).sum()
+        (gx,) = ag.grad(y, [x], create_graph=True, retain_graph=True)
+        penalty = (gx * gx).sum()    # sum (3x^2)^2 = 9x^4
+        penalty.backward()
+    # d/dx 9x^4 = 36 x^3
+    np.testing.assert_allclose(x.grad.asnumpy(), 36 * x_np ** 3,
+                               rtol=1e-4)
