@@ -84,7 +84,8 @@ def _timed_reps(run_n, n, reps=3):
     return sorted(times)[len(times) // 2]
 
 
-def bench_resnet(dtype, layout, batch, train_iters, infer_iters):
+def bench_resnet(dtype, layout, batch, train_iters, infer_iters,
+                 stem_s2d=False):
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
@@ -102,7 +103,7 @@ def bench_resnet(dtype, layout, batch, train_iters, infer_iters):
     in_shape = (1, 3, 224, 224) if layout == "NCHW" else (1, 224, 224, 3)
     with jax.default_device(host):
         mx.random.seed(0)
-        net = vision.resnet50_v1(layout=layout)
+        net = vision.resnet50_v1(layout=layout, stem_s2d=stem_s2d)
         net.initialize(init=mx.initializer.Xavier())
         with ag.pause():
             net(mx.nd.NDArray(jnp.ones(in_shape, jnp.float32)))
@@ -121,24 +122,34 @@ def bench_resnet(dtype, layout, batch, train_iters, infer_iters):
     y = jax.device_put((np.arange(batch) % 1000).astype(np.int32), dev)
 
     # ---- inference ------------------------------------------------------
-    def fwd_chain(params, x):
-        out, _ = functional_call(net, params, x, training=False)
-        # thread a negligible-but-nonzero function of the output back into
-        # the next input so chained calls have a real data dependency
-        x_next = x + (out[0, 0] * 1e-30).astype(x.dtype)
-        return out, x_next
+    # The timed unit is ONE jitted program scanning `infer_iters` batches:
+    # per-step host dispatch (pytree flatten of 100+ params) otherwise
+    # dominates at this step time. Each iteration threads a negligible-but-
+    # nonzero function of its output into the next input, so XLA cannot
+    # sever the chain, and timing ends with a device->host fetch.
+    xs_inf = jax.device_put(
+        np.random.RandomState(1).randn(infer_iters, *x_shape).astype(dtype),
+        dev)
 
-    cinfer = jax.jit(fwd_chain).lower(params, x).compile()
+    def infer_n(params, x0, xs):
+        def body(s, xi):
+            # scalar chain: batch i's input depends on batch i-1's output,
+            # so XLA cannot reorder or elide any iteration
+            out, _ = functional_call(net, params, xi + s, training=False)
+            return (out[0, 0] * 1e-30).astype(xi.dtype), out[0, 0]
+        _, outs = jax.lax.scan(body, jnp.zeros((), dtype), xs)
+        return outs[-1]
+
+    cinfer = jax.jit(infer_n).lower(params, x, xs_inf).compile()
+    # NB: XLA cost analysis counts a while/scan body ONCE, so this is
+    # already the per-iteration figure.
     infer_flops = _cost_flops(cinfer)
 
     def run_infer(n):
-        nonlocal x
-        out = None
-        for _ in range(n):
-            out, x = cinfer(params, x)
-        float(out[0, 0])  # host fetch == real synchronisation
+        out = cinfer(params, x, xs_inf)
+        float(out)  # host fetch == real synchronisation
 
-    run_infer(10)  # warmup past the post-compile slow window
+    run_infer(infer_iters)  # warmup past the post-compile slow window
     infer_dt = _timed_reps(run_infer, infer_iters)
     infer_img_s = batch / infer_dt
 
@@ -165,23 +176,45 @@ def bench_resnet(dtype, layout, batch, train_iters, infer_iters):
 
     mom = jax.device_put({k: np.zeros(v.shape, np.float32)
                           for k, v in params_host.items()}, dev)
-    ctrain = jax.jit(train_step, donate_argnums=(0, 1)).lower(
-        params, mom, x, y).compile()
+
+    # Same scan treatment as inference: `train_iters` optimizer steps in
+    # one program over distinct pre-staged batches. Each step consumes the
+    # previous step's params/momentum (a real dependency chain by
+    # construction), and timing ends with a loss + post-update-param fetch.
+    xs_tr = jax.device_put(
+        np.random.RandomState(2).randn(train_iters, *x_shape).astype(dtype),
+        dev)
+    ys_tr = jax.device_put(
+        np.random.RandomState(3).randint(0, 1000, (train_iters, batch))
+        .astype(np.int32), dev)
+
+    def train_n(params, mom, xs, ys):
+        def body(carry, xy):
+            p, m, _ = carry
+            p, m, loss = train_step(p, m, *xy)
+            return (p, m, loss), None
+        (params, mom, loss), _ = jax.lax.scan(
+            body, (params, mom, jnp.float32(0)), (xs, ys))
+        # one host fetch of `probe` waits for the loss AND the last
+        # param update (the loss of step n only depends on step-(n-1)
+        # params, so it alone would not wait for the final update)
+        probe = loss + (jax.tree.leaves(params)[0].ravel()[0]
+                        .astype(jnp.float32) * 1e-30)
+        return params, mom, loss, probe
+
+    ctrain = jax.jit(train_n, donate_argnums=(0, 1)).lower(
+        params, mom, xs_tr, ys_tr).compile()
+    # XLA cost analysis counts the scan body once == per-step flops.
     train_flops = _cost_flops(ctrain)
 
     loss = None
 
     def run_train(n):
         nonlocal params, mom, loss
-        for _ in range(n):
-            params, mom, loss = ctrain(params, mom, x, y)
-        # fetch the loss AND a post-update param element: the loss of step
-        # n only depends on the step-(n-1) params, so it alone would not
-        # wait for the final update
-        float(loss)
-        float(jax.tree.leaves(params)[0].ravel()[0])
+        params, mom, loss, probe = ctrain(params, mom, xs_tr, ys_tr)
+        float(probe)  # single host fetch == real synchronisation
 
-    run_train(25)  # warmup
+    run_train(train_iters)  # warmup
     train_dt = _timed_reps(run_train, train_iters)
     train_img_s = batch / train_dt
     final_loss = float(loss)
@@ -205,10 +238,14 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", 128))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
-    infer_iters = int(os.environ.get("BENCH_ITERS", 30))
-    train_iters = int(os.environ.get("BENCH_TRAIN_ITERS", 25))
+    infer_iters = int(os.environ.get("BENCH_ITERS", 50))
+    train_iters = int(os.environ.get("BENCH_TRAIN_ITERS", 50))
+    # MLPerf-style space-to-depth stem (numerically identical to the plain
+    # 7x7/s2 stem — tests/test_layout.py); BENCH_S2D=0 opts out.
+    stem_s2d = os.environ.get("BENCH_S2D", "1") != "0" and layout == "NHWC"
 
-    r = bench_resnet(dtype, layout, batch, train_iters, infer_iters)
+    r = bench_resnet(dtype, layout, batch, train_iters, infer_iters,
+                     stem_s2d=stem_s2d)
     dev = r["dev"]
     peak = _peak_flops(dev)
 
@@ -242,7 +279,7 @@ def main():
         "infer_img_s": round(r["infer_img_s"], 2),
         "infer_vs_baseline": round(r["infer_img_s"] / INFER_BASELINE_IMG_S,
                                    3),
-        "dtype": dtype, "layout": layout,
+        "dtype": dtype, "layout": layout, "stem_s2d": stem_s2d,
         "flops_per_step": flops, "flops_source": flops_source,
         "implied_tflops": round(implied / 1e12, 2),
         "device_kind": getattr(dev, "device_kind", str(dev)),

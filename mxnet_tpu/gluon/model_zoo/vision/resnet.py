@@ -30,6 +30,23 @@ def _bn(layout="NCHW", **kw):
     return BatchNorm(axis=layout.index("C"), **kw)
 
 
+class _S2DStemConv(HybridBlock):
+    """MLPerf-style stem: the 7x7/s2/p3 conv evaluated as an equivalent
+    4x4/s1 conv over a 2x2 space-to-depth input (ops/nn.py
+    _s2d_stem_conv). Holds the standard OHWI (O,7,7,3) weight, so
+    checkpoints are interchangeable with the plain-conv stem. NHWC only."""
+
+    def __init__(self, channels, in_channels=3, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(channels, 7, 7, in_channels),
+                allow_deferred_init=True)
+
+    def hybrid_forward(self, F, x, weight=None):
+        return F._s2d_stem_conv(x, weight)
+
+
 class BasicBlockV1(HybridBlock):
     """Pre-2016 residual block (reference: resnet.py:40)."""
 
@@ -164,17 +181,22 @@ class ResNetV1(HybridBlock):
     """ResNet V1 (reference: resnet.py:246)."""
 
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, layout="NCHW", **kwargs):
+                 thumbnail=False, layout="NCHW", stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        assert not (stem_s2d and layout != "NHWC"), \
+            "stem_s2d requires layout='NHWC'"
         self._layout = layout
         with self.name_scope():
             self.features = HybridSequential(prefix="")
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
-                self.features.add(Conv2D(channels[0], 7, 2, 3,
-                                         use_bias=False, layout=layout))
+                if stem_s2d:
+                    self.features.add(_S2DStemConv(channels[0]))
+                else:
+                    self.features.add(Conv2D(channels[0], 7, 2, 3,
+                                             use_bias=False, layout=layout))
                 self.features.add(_bn(layout))
                 self.features.add(Activation("relu"))
                 self.features.add(MaxPool2D(3, 2, 1, layout=layout))
@@ -207,9 +229,11 @@ class ResNetV2(HybridBlock):
     """ResNet V2 (reference: resnet.py:303)."""
 
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, layout="NCHW", **kwargs):
+                 thumbnail=False, layout="NCHW", stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        assert not (stem_s2d and layout != "NHWC"), \
+            "stem_s2d requires layout='NHWC'"
         self._layout = layout
         with self.name_scope():
             self.features = HybridSequential(prefix="")
@@ -217,8 +241,11 @@ class ResNetV2(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
-                self.features.add(Conv2D(channels[0], 7, 2, 3,
-                                         use_bias=False, layout=layout))
+                if stem_s2d:
+                    self.features.add(_S2DStemConv(channels[0]))
+                else:
+                    self.features.add(Conv2D(channels[0], 7, 2, 3,
+                                             use_bias=False, layout=layout))
                 self.features.add(_bn(layout))
                 self.features.add(Activation("relu"))
                 self.features.add(MaxPool2D(3, 2, 1, layout=layout))

@@ -63,8 +63,12 @@ def foreach(body, data, init_states):
         def step(carry, xs):
             x_nd = [NDArray(x) for x in xs]
             s_nd = [NDArray(c) for c in carry]
+            if ns_ == 0:          # stateless loop: body sees states=None
+                s_arg = None
+            else:
+                s_arg = s_nd[0] if state_single else s_nd
             outs, new_states = body(x_nd[0] if data_single else x_nd,
-                                    s_nd[0] if state_single else s_nd)
+                                    s_arg)
             outs_l, meta["out_single"] = _aslist(outs)
             ns_l, _ = _aslist(new_states)
             meta["nout"] = len(outs_l)
@@ -77,8 +81,11 @@ def foreach(body, data, init_states):
     res = (res,) if not isinstance(res, tuple) else tuple(res)
     outs = list(res[:meta["nout"]])
     fin = list(res[meta["nout"]:])
-    return (outs[0] if meta["out_single"] else outs,
-            fin[0] if state_single else fin)
+    if ns_ == 0:
+        fin = None
+    elif state_single:
+        fin = fin[0]
+    return (outs[0] if meta["out_single"] else outs, fin)
 
 
 def while_loop(cond, func, loop_vars, max_iterations):

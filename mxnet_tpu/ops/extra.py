@@ -618,12 +618,17 @@ _reg("_contrib_allclose",
      jnp.allclose(a, b, rtol=rtol, atol=atol,
                   equal_nan=equal_nan)[None].astype(jnp.float32),
      differentiable=False)
-_reg("_contrib_arange_like",
-     lambda data, start=0.0, step=1.0, repeat=1, axis=None:
-     (jnp.arange(_np.prod(data.shape) if axis is None
-                 else data.shape[axis], dtype=data.dtype) * step + start)
-     .reshape(data.shape if axis is None else (-1,)),
-     differentiable=False)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    n = _np.prod(data.shape) if axis is None else data.shape[axis]
+    r = int(repeat)
+    # reference semantics (np_init_op.cc _npi_arange_like): each value is
+    # emitted `repeat` times, so n outputs cover ceil(n/repeat) steps
+    vals = jnp.repeat(jnp.arange(-(-n // r), dtype=data.dtype) * step
+                      + start, r)[:n]
+    return vals.reshape(data.shape if axis is None else (-1,))
+
+
+_reg("_contrib_arange_like", _arange_like, differentiable=False)
 _reg("_contrib_div_sqrt_dim",
      lambda data: data / jnp.sqrt(jnp.asarray(data.shape[-1],
                                               data.dtype)))

@@ -176,6 +176,37 @@ def _deconvolution(*args, kernel=None, stride=None, dilate=None, pad=None,
 _reg("Deconvolution", _deconvolution)
 
 
+def _s2d_stem_conv(x, w, num_filter=0, no_bias=True, layout="NHWC"):
+    """7x7/stride-2/pad-3 stem convolution computed as an equivalent
+    4x4/stride-1 convolution over a 2x2 space-to-depth input.
+
+    The MLPerf-ResNet TPU trick: a stride-2 conv with 3 input channels
+    tiles the MXU poorly (the minor dim pads 3 -> 128 lanes); regrouping
+    2x2 pixel phases into channels makes it a stride-1 conv with 4x the
+    input channels over a 2x smaller spatial grid — numerically identical
+    (tests/test_layout.py asserts exact agreement with Convolution).
+    Derivation: out(i,j) = sum_{a,b} x[2i+a-3, 2j+b-3] w[a,b]; writing
+    r = 2p+u splits taps by phase u=(a+1)%2 at offset p-i = (a-3-u)/2 in
+    {-2..1}, i.e. a 4-tap stride-1 conv per phase with padding (2,1).
+    Only used for NHWC; weight layout OHWI like Convolution.
+    """
+    n, h, ww_, c = x.shape
+    o = w.shape[0]
+    z = x.reshape(n, h // 2, 2, ww_ // 2, 2, c)
+    z = jnp.transpose(z, (0, 1, 3, 2, 4, 5)).reshape(n, h // 2, ww_ // 2,
+                                                     4 * c)
+    whwio = jnp.transpose(w, (1, 2, 3, 0))          # (7,7,C,O)
+    wp = jnp.pad(whwio, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    w2 = wp.reshape(4, 2, 4, 2, c, o)
+    w2 = jnp.transpose(w2, (0, 2, 1, 3, 4, 5)).reshape(4, 4, 4 * c, o)
+    return lax.conv_general_dilated(
+        z, w2, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+_reg("_s2d_stem_conv", _s2d_stem_conv)
+
+
 # ------------------------------------------------------------ pooling ------
 
 def _pool_pads(x, kernel, stride, pad, convention, sp_axes):
@@ -306,13 +337,26 @@ def _batch_norm(*args, eps=1e-3, momentum=0.9, fix_gamma=True,
     shape[axis] = x.shape[axis]
     rs = lambda a: a.reshape(shape)  # noqa: E731
     if _training and not use_global_stats:
+        # Single-pass statistics: E[x] and E[x^2] are sibling reductions
+        # over one read of x (XLA emits one multi-output reduce fusion),
+        # halving the HBM traffic of the two-pass mean/centered-var form.
+        # Accumulate in fp32 regardless of activation dtype.
         red = tuple(i for i in range(x.ndim) if i != axis)
-        mean = jnp.mean(x, axis=red)
-        var = jnp.mean(jnp.square(x - rs(mean)), axis=red)
+        xf = x.astype(jnp.float32)
+        mean32 = jnp.mean(xf, axis=red)
+        var32 = jnp.maximum(jnp.mean(xf * xf, axis=red)
+                            - mean32 * mean32, 0.0)
+        mean, var = mean32.astype(x.dtype), var32.astype(x.dtype)
     else:
         mean, var = mmean, mvar
-    inv = lax.rsqrt(var + eps)
-    out = (x - rs(mean)) * rs(inv * gamma) + rs(beta)
+        mean32 = mean.astype(jnp.float32)
+        var32 = var.astype(jnp.float32)
+    # Fold into out = x*scale + shift: one fused elementwise pass with no
+    # (x - mean) intermediate; scale/shift are per-channel fp32 vectors.
+    inv = lax.rsqrt(var32 + eps)
+    scale = inv * gamma.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - mean32 * scale
+    out = x * rs(scale.astype(x.dtype)) + rs(shift.astype(x.dtype))
     if output_mean_var:
         return out, mean, var
     return out

@@ -188,3 +188,14 @@ def test_foreach_inside_hybridized_block():
         jitted2 = net(x).asnumpy()   # second call: cache hit
     np.testing.assert_allclose(jitted, eager, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(jitted2, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_foreach_stateless():
+    """init_states=None runs a stateless loop (review finding r3)."""
+    import numpy as np
+    from mxnet_tpu import nd
+    data = nd.array(np.arange(6.0).reshape(3, 2))
+    outs, states = nd.contrib.foreach(lambda x, s: (x * 2, s), data, None)
+    np.testing.assert_allclose(outs.asnumpy(),
+                               np.arange(6.0).reshape(3, 2) * 2)
+    assert states is None

@@ -243,3 +243,12 @@ def test_monitor_and_runtime():
     assert feats.is_enabled("CPU")
     assert feats.is_enabled("DIST_KVSTORE")
     assert any(f.name == "PALLAS" for f in runtime.feature_list())
+
+
+def test_arange_like_repeat():
+    """repeat>1 emits each value `repeat` times (review finding r3)."""
+    import numpy as np
+    from mxnet_tpu import nd
+    x = nd.array(np.zeros(5))
+    out = nd._contrib_arange_like(x, repeat=2)
+    np.testing.assert_allclose(out.asnumpy(), [0, 0, 1, 1, 2])

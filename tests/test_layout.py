@@ -153,3 +153,50 @@ def test_nhwc_training_step_grads():
     trainer.step(4)
     g = net[0].weight.grad().asnumpy()
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_s2d_stem_conv_matches_convolution():
+    """The space-to-depth stem rewrite (ops/nn.py _s2d_stem_conv) must be
+    numerically identical to the plain 7x7/s2/p3 Convolution it replaces
+    (MLPerf-ResNet stem optimisation)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 224, 224, 3).astype(np.float32)
+    w = rng.randn(64, 7, 7, 3).astype(np.float32)  # OHWI
+    ref = _op("Convolution", x, w, kernel=(7, 7), stride=(2, 2),
+              pad=(3, 3), num_filter=64, no_bias=True, layout="NHWC")
+    out = _op("_s2d_stem_conv", x, w)
+    assert out.shape == ref.shape == (2, 112, 112, 64)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_s2d_stem_resnet_matches_plain_stem():
+    """resnet18_v1(stem_s2d=True) must produce the same logits as the
+    plain-stem model given identical parameters (the stem weight is the
+    same OHWI (O,7,7,3) tensor, so checkpoints interchange)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 64, 64, 3).astype(np.float32)
+    mx.random.seed(0)
+    net = vision.resnet18_v1(layout="NHWC")
+    net.initialize()
+    with ag.pause():
+        o1 = net(nd.NDArray(jnp.asarray(x)))
+    mx.random.seed(0)
+    net2 = vision.resnet18_v1(layout="NHWC", stem_s2d=True)
+    net2.initialize()
+    with ag.pause():
+        net2(nd.NDArray(jnp.asarray(x)))  # shape inference
+    # copy params across (the stem weight name differs only by block name)
+    strip = lambda k: k.split("_", 1)[1]  # drop the 'resnetv1N' prefix
+    src = {strip(k): v for k, v in net.collect_params().items()}
+    for name, p in net2.collect_params().items():
+        key = strip(name)
+        if "_s2dstemconv0_" in key:
+            key = key.replace("_s2dstemconv0_", "conv2d0_")
+        p.set_data(src[key].data())
+    with ag.pause():
+        o2 = net2(nd.NDArray(jnp.asarray(x)))
+    np.testing.assert_allclose(o2.asnumpy(), o1.asnumpy(),
+                               rtol=2e-3, atol=2e-3)
