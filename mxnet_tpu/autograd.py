@@ -205,9 +205,19 @@ def _run_backward(heads, head_grads, retain_graph, create_graph=False):
 
     grads: Dict[int, object] = {}
 
+    from .ndarray.sparse import RowSparseNDArray, add as _sparse_add
+
     def acc(old, new):
         if old is None:
             return new
+        so = isinstance(old, RowSparseNDArray)
+        sn = isinstance(new, RowSparseNDArray)
+        if so and sn:             # stays row-sparse: concat indices/values
+            return _sparse_add(old, new)
+        if so:
+            old = old._data       # mixed: fall back to dense accumulation
+        if sn:
+            new = new._data
         if create_graph and (isinstance(old, NDArray)
                              or isinstance(new, NDArray)):
             a = old if isinstance(old, NDArray) else NDArray(old)
@@ -314,7 +324,15 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             continue
         if slot in grads and req != "null":
             g = grads[slot]
-            if req == "add" and arr._grad is not None:
+            from .ndarray.sparse import RowSparseNDArray, add as _sp_add
+            if isinstance(g, RowSparseNDArray):
+                if req == "add" and isinstance(arr._grad, RowSparseNDArray):
+                    arr._grad = _sp_add(arr._grad, g)
+                elif req == "add" and arr._grad is not None:
+                    arr._grad = NDArray(arr._grad._data + g._data)
+                else:
+                    arr._grad = g
+            elif req == "add" and arr._grad is not None:
                 arr._grad = NDArray(arr._grad._data + g)
             else:
                 arr._grad = NDArray(g)

@@ -255,12 +255,24 @@ class Parameter:
                 self._data[c].attach_grad(self._grad_req)
 
     def row_sparse_data(self, row_id):
-        raise NotImplementedError(
-            "row_sparse parameters are emulated densely on TPU "
-            "(no native XLA sparse storage); use data()")
+        """Row-sparse view of the requested rows (reference:
+        parameter.py row_sparse_data). Storage stays dense on TPU (XLA
+        has no sparse buffers); the returned RowSparseNDArray holds only
+        the gathered rows, so the sparse *access pattern* is preserved."""
+        if self.stype != "row_sparse":
+            raise RuntimeError(
+                f"Parameter '{self.name}' stype is {self.stype!r}; "
+                "row_sparse_data requires stype='row_sparse'")
+        import jax.numpy as jnp
+        from ..ndarray.sparse import RowSparseNDArray
+        src = self.data()
+        rows = row_id._data if isinstance(row_id, NDArray) else \
+            jnp.asarray(row_id, jnp.int32)
+        rows = jnp.unique(rows.astype(jnp.int32).ravel())
+        return RowSparseNDArray(src._data[rows], rows, src.shape)
 
     def list_row_sparse_data(self, row_id):
-        raise NotImplementedError("see row_sparse_data")
+        return [self.row_sparse_data(row_id)]
 
     # --------------------------------------------------------------- ctx --
     def list_ctx(self):

@@ -83,18 +83,26 @@ class KVStoreLocal(KVStoreBase):
                     NDArray(v)
 
     def push(self, key, value, priority=0):
+        from ..ndarray.sparse import RowSparseNDArray, add as _sp_add
         keys, values = _key_value(key, value)
         for k, vlist in _group(keys, values):
             reduced = vlist[0]
             if len(vlist) > 1:
-                reduced = vlist[0].copy()
-                for v in vlist[1:]:
-                    reduced += v.as_in_context(reduced.context)
+                if all(isinstance(v, RowSparseNDArray) for v in vlist):
+                    for v in vlist[1:]:   # stays row-sparse end to end
+                        reduced = _sp_add(reduced, v)
+                else:
+                    reduced = vlist[0].copy()
+                    for v in vlist[1:]:
+                        reduced += v.as_in_context(reduced.context)
             if self._updater is not None:
                 self._updater(k if not isinstance(k, str) else
                               _str2int(k), reduced, self._store[k])
             else:
-                self._store[k] = reduced.copy()
+                self._store[k] = reduced.copy() \
+                    if not isinstance(reduced, RowSparseNDArray) else \
+                    RowSparseNDArray(reduced._values, reduced._indices,
+                                     reduced._sshape)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out)
@@ -113,8 +121,32 @@ class KVStoreLocal(KVStoreBase):
         self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # sparse is emulated densely on TPU (SURVEY.md §7 hard parts)
-        self.pull(key, out, priority)
+        """Pull only the requested rows (reference: kvstore.h
+        PullRowSparse). ``out`` gets a row-sparse view of the stored
+        value restricted to ``row_ids`` — the full array is never copied.
+        """
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        import jax.numpy as jnp
+        from ..ndarray.sparse import RowSparseNDArray
+        keys, outs = _key_value(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else \
+            [row_ids] * len(keys)
+        for (k, olist), rid in zip(_group(keys, outs), rids):
+            src = self._store[k]
+            rows = rid._data if isinstance(rid, NDArray) else \
+                jnp.asarray(rid, jnp.int32)
+            rows = jnp.unique(rows.astype(jnp.int32).ravel())
+            vals = src._data[rows]
+            for o in olist:
+                if isinstance(o, RowSparseNDArray):
+                    o._indices = rows
+                    o._values = vals
+                    o._sshape = tuple(src.shape)
+                    o._dense = None
+                else:
+                    o._data = jnp.zeros(src.shape, vals.dtype)\
+                        .at[rows].set(vals)
 
     def set_updater(self, updater):
         self._updater = updater

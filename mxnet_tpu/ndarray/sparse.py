@@ -1,42 +1,136 @@
 """Sparse NDArray storage types.
 
-Reference: include/mxnet/ndarray.h:63-65 (kDefaultStorage, kRowSparseStorage,
-kCSRStorage), python/mxnet/ndarray/sparse.py. XLA has no native sparse
-tensors, so the TPU design keeps the *API* (stype, indices/data accessors,
-cast_storage, sparse row_sparse_pull semantics in kvstore) over an explicit
-index+values representation; compute densifies at op boundaries. This is the
-"explicit gather/scatter" strategy called out in SURVEY.md §7 hard-parts.
-Gradient row-sparsity (Embedding sparse_grad) is handled structurally by the
-optimizer taking the row-index fast path when it sees a RowSparseNDArray.
+Reference: include/mxnet/ndarray.h:63-65 (kDefaultStorage,
+kRowSparseStorage, kCSRStorage), python/mxnet/ndarray/sparse.py,
+src/operator/tensor/cast_storage.cc, dot.cc.
+
+TPU-native design: XLA has no native sparse tensors, so sparsity here is
+*structural* — explicit (indices, values) pairs plus gather/scatter/
+segment-sum compute (the SURVEY §7 strategy). What is genuinely sparse:
+
+- storage: RowSparseNDArray/CSRNDArray hold only indices+values;
+  densification is lazy (first `_data` touch) and cached, so sparse
+  gradients and kvstore rows never materialize the full array unless a
+  dense op is applied to them.
+- Embedding sparse_grad=True backward produces a RowSparseNDArray of
+  (touched row ids, output cotangents) — no (vocab, dim) scatter
+  (reference: src/operator/tensor/indexing_op.cc EmbeddingOpBackward
+  with kRowSparseStorage).
+- optimizer lazy updates: sgd/adam touch only the rows present in a
+  row-sparse grad (reference: src/operator/optimizer_op.cc
+  SGDUpdateRspImpl "lazy update").
+- dot(csr, dense): one gather + segment-sum — a real CSR SpMM that
+  jits (reference: src/operator/tensor/dot-inl.h DotCsrDnsDns).
+
+Generic ops on sparse arrays fall back to the cached dense form —
+matching the reference's FallBackCompute / storage-fallback behaviour.
 """
 from __future__ import annotations
 
 import numpy as _np
+import jax
 import jax.numpy as jnp
 
 from .ndarray import NDArray
 
-__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "cast_storage"]
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "retain",
+           "dot", "add", "zeros"]
 
 
-class RowSparseNDArray(NDArray):
-    """Row-sparse array: (indices, values) over the leading axis."""
+class BaseSparseNDArray(NDArray):
+    """Common lazy-densification machinery.
 
-    __slots__ = ("_indices", "_values")
+    The base NDArray keeps its buffer in the ``_data`` slot; subclasses
+    shadow that slot with a property so the whole eager API works on
+    sparse arrays (densifying on demand), while sparse-aware paths
+    (optimizers, kvstore, sparse.dot) read ``indices``/``data`` and never
+    trigger it.
+    """
 
-    def __init__(self, values, indices, shape):
-        vals = values._data if isinstance(values, NDArray) else jnp.asarray(values)
-        idx = indices._data if isinstance(indices, NDArray) else \
-            jnp.asarray(indices, jnp.int32)
-        dense = jnp.zeros(tuple(shape), vals.dtype).at[idx].set(vals)
-        super().__init__(dense)
+    __slots__ = ("_dense",)
+
+    def _init_base(self):
+        # bypass NDArray.__init__ (no dense buffer yet)
+        self._dense = None
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_slot = None
+
+    def _densify(self):
+        raise NotImplementedError
+
+    @property
+    def _data(self):
+        if self._dense is None:
+            self._dense = self._densify()
+        return self._dense
+
+    @_data.setter
+    def _data(self, value):
+        # an in-place op rebinding the buffer makes the cached dense form
+        # authoritative (the array is no longer structurally sparse)
+        self._dense = value
+
+    @property
+    def densified(self):
+        """True once the dense form has been materialized."""
+        return self._dense is not None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        out = 1
+        for s in self.shape:
+            out *= s
+        return out
+
+    def wait_to_read(self):
+        (self._values if hasattr(self, "_values") else self._data)\
+            .block_until_ready()
+        return self
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array: ``values[i]`` is row ``indices[i]`` of a dense
+    array of shape ``shape``; all other rows are zero."""
+
+    __slots__ = ("_indices", "_values", "_sshape")
+
+    def __init__(self, values, indices, shape=None):
+        vals = values._data if isinstance(values, NDArray) \
+            else jnp.asarray(values)
+        idx = indices._data if isinstance(indices, NDArray) \
+            else jnp.asarray(indices, jnp.int32)
+        if idx.dtype not in (jnp.int32, jnp.int64):
+            idx = idx.astype(jnp.int32)
+        if shape is None:
+            first = int(idx.max()) + 1 if idx.size else 0
+            shape = (first,) + tuple(vals.shape[1:])
+        self._init_base()
         self._indices = idx
         self._values = vals
+        self._sshape = tuple(int(s) for s in shape)
 
+    def _densify(self):
+        return jnp.zeros(self._sshape, self._values.dtype)\
+            .at[self._indices].add(self._values)
+
+    # ------------------------------------------------------------ api --
     @property
     def stype(self):
         return "row_sparse"
+
+    @property
+    def shape(self):
+        return self._sshape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._values.dtype)
 
     @property
     def indices(self):
@@ -49,29 +143,66 @@ class RowSparseNDArray(NDArray):
     def tostype(self, stype):
         if stype == "default":
             return NDArray(self._data)
-        return self
+        if stype == "row_sparse":
+            return self
+        if stype == "csr" and len(self._sshape) == 2:
+            return cast_storage(NDArray(self._data), "csr")
+        raise ValueError(f"cannot cast row_sparse to {stype}")
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+    def copyto(self, other):
+        from ..context import Context
+        if isinstance(other, Context):
+            return RowSparseNDArray(self._values, self._indices,
+                                    self._sshape)
+        return NDArray.copyto(NDArray(self._data), other)
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {self._sshape} "
+                f"nnz-rows={int(self._indices.shape[0])}>")
 
 
-class CSRNDArray(NDArray):
+class CSRNDArray(BaseSparseNDArray):
     """Compressed sparse row matrix."""
 
-    __slots__ = ("_indptr", "_indices", "_values")
+    __slots__ = ("_indptr", "_indices", "_values", "_sshape")
 
     def __init__(self, data, indptr, indices, shape):
-        vals = _np.asarray(data)
-        ip = _np.asarray(indptr, _np.int32)
-        ind = _np.asarray(indices, _np.int32)
-        dense = _np.zeros(tuple(shape), vals.dtype)
-        for r in range(shape[0]):
-            dense[r, ind[ip[r]:ip[r + 1]]] = vals[ip[r]:ip[r + 1]]
-        super().__init__(jnp.asarray(dense))
-        self._indptr = jnp.asarray(ip)
-        self._indices = jnp.asarray(ind)
-        self._values = jnp.asarray(vals)
+        vals = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        ip = indptr._data if isinstance(indptr, NDArray) \
+            else jnp.asarray(indptr, jnp.int32)
+        ind = indices._data if isinstance(indices, NDArray) \
+            else jnp.asarray(indices, jnp.int32)
+        self._init_base()
+        self._indptr = ip.astype(jnp.int32)
+        self._indices = ind.astype(jnp.int32)
+        self._values = vals
+        self._sshape = tuple(int(s) for s in shape)
+
+    def _row_ids(self):
+        """Per-nonzero row id, from the indptr run lengths."""
+        counts = jnp.diff(self._indptr)
+        return jnp.repeat(jnp.arange(self._sshape[0], dtype=jnp.int32),
+                          counts, total_repeat_length=self._values.shape[0])
+
+    def _densify(self):
+        rows = self._row_ids()
+        return jnp.zeros(self._sshape, self._values.dtype)\
+            .at[rows, self._indices].add(self._values)
 
     @property
     def stype(self):
         return "csr"
+
+    @property
+    def shape(self):
+        return self._sshape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._values.dtype)
 
     @property
     def indptr(self):
@@ -88,42 +219,137 @@ class CSRNDArray(NDArray):
     def tostype(self, stype):
         if stype == "default":
             return NDArray(self._data)
-        return self
+        if stype == "csr":
+            return self
+        raise ValueError(f"cannot cast csr to {stype}")
 
+    def __repr__(self):
+        return (f"\n<CSRNDArray {self._sshape} "
+                f"nnz={int(self._values.shape[0])}>")
+
+
+# ---------------------------------------------------------- construct ----
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
-    if isinstance(arg1, tuple) and len(arg1) == 2:
+    """Create a RowSparseNDArray from (values, indices) or a dense source
+    (reference: python/mxnet/ndarray/sparse.py row_sparse_array)."""
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 2 \
+            and not _np.isscalar(arg1[0]):
         values, indices = arg1
         return RowSparseNDArray(values, indices, shape)
     dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    if dtype is not None:
+        dense = dense.astype(dtype)
     nz = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
     return RowSparseNDArray(dense[nz], nz, dense.shape)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr) or a dense source
+    (reference: python/mxnet/ndarray/sparse.py csr_matrix)."""
+    if isinstance(arg1, CSRNDArray):
+        return arg1
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         return CSRNDArray(data, indptr, indices, shape)
     dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
-    import numpy as np
-    indptr = [0]
-    indices = []
-    data = []
-    for row in dense:
-        nz = np.nonzero(row)[0]
-        indices.extend(nz.tolist())
-        data.extend(row[nz].tolist())
-        indptr.append(len(indices))
-    return CSRNDArray(np.asarray(data, dense.dtype), indptr, indices,
-                      dense.shape)
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    mask = dense != 0
+    indptr = _np.concatenate([[0], _np.cumsum(mask.sum(axis=1))])
+    cols = _np.nonzero(mask)[1]
+    data = dense[mask]
+    return CSRNDArray(data, indptr.astype(_np.int32),
+                      cols.astype(_np.int32), dense.shape)
 
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = dtype or _np.float32
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            jnp.zeros((0,) + tuple(shape[1:]), dtype),
+            jnp.zeros((0,), jnp.int32), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype),
+                          jnp.zeros((shape[0] + 1,), jnp.int32),
+                          jnp.zeros((0,), jnp.int32), shape)
+    return NDArray(jnp.zeros(tuple(shape), dtype))
+
+
+# ------------------------------------------------------------- compute ----
 
 def cast_storage(arr, stype):
     """Reference: src/operator/tensor/cast_storage.cc."""
     if stype == "default":
-        return NDArray(arr._data)
+        return NDArray(arr._data) if not isinstance(arr, NDArray) \
+            else NDArray(arr._data)
     if stype == "row_sparse":
         return row_sparse_array(arr)
     if stype == "csr":
         return csr_matrix(arr)
     raise ValueError(stype)
+
+
+def retain(rsp, row_ids):
+    """Keep only the requested rows of a row-sparse array (reference:
+    src/operator/tensor/sparse_retain.cc _retain). Rows absent from the
+    source come back as zero rows."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    ids = row_ids._data if isinstance(row_ids, NDArray) \
+        else jnp.asarray(row_ids, jnp.int32)
+    ids = ids.astype(jnp.int32)
+    # membership of each source row in row_ids, O(nnz * nids) compare —
+    # structural and jittable; vocab-scale dense scatter is avoided
+    keep = (rsp._indices[:, None] == ids[None, :]).any(axis=1)
+    vals = jnp.where(keep.reshape((-1,) + (1,) * (rsp._values.ndim - 1)),
+                     rsp._values, 0)
+    return RowSparseNDArray(vals, rsp._indices, rsp._sshape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse dot (reference: src/operator/tensor/dot.cc).
+
+    csr @ dense and csr.T @ dense run as gather + segment-sum (one FLOP
+    per stored nonzero — genuinely sparse compute); row_sparse operands
+    fall back to their dense form (XLA dense dot is the fast path on the
+    MXU once density is nontrivial).
+    """
+    if isinstance(lhs, CSRNDArray) and not transpose_b:
+        dense = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        rows = lhs._row_ids()
+        cols = lhs._indices
+        vals = lhs._values
+        if not transpose_a:
+            # out[r, :] += v * dense[c, :]
+            contrib = vals[:, None] * dense[cols]
+            out = jax.ops.segment_sum(contrib, rows,
+                                      num_segments=lhs._sshape[0])
+            return NDArray(out)
+        # out[c, :] += v * dense[r, :]
+        contrib = vals[:, None] * dense[rows]
+        out = jax.ops.segment_sum(contrib, cols,
+                                  num_segments=lhs._sshape[1])
+        return NDArray(out)
+    a = lhs._data if isinstance(lhs, NDArray) else jnp.asarray(lhs)
+    b = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+    if transpose_a:
+        a = a.T
+    if transpose_b:
+        b = b.T
+    return NDArray(jnp.dot(a, b))
+
+
+def add(lhs, rhs):
+    """Sparse-aware add: row_sparse + row_sparse stays row_sparse
+    (concatenate index/value lists — duplicate indices are legal and
+    densify additively, matching scatter-add semantics)."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        if lhs._sshape != rhs._sshape:
+            raise ValueError("shape mismatch")
+        return RowSparseNDArray(
+            jnp.concatenate([lhs._values, rhs._values]),
+            jnp.concatenate([lhs._indices, rhs._indices]), lhs._sshape)
+    return NDArray(lhs._data + rhs._data)

@@ -153,6 +153,42 @@ def apply_fn(fn, inputs: Sequence, nout: int = 1, differentiable: bool = True,
     return results[0] if single else tuple(results)
 
 
+def _embedding_sparse_grad(op, inputs, params):
+    """Eager Embedding with ``sparse_grad=True``: record a tape node whose
+    weight cotangent is a RowSparseNDArray of (looked-up row ids, output
+    cotangents) — no (vocab, dim) dense scatter (reference:
+    src/operator/tensor/indexing_op.cc EmbeddingOpBackward with
+    kRowSparseStorage). Returns None under tracing (jit of a hybridized
+    block): there the dense scatter-add vjp is the right XLA program.
+    """
+    NDArray = _ndarray_cls()
+    data, weight = inputs[0], inputs[1]
+    if any(isinstance(getattr(x, "_data", x), jax.core.Tracer)
+           for x in (data, weight)):
+        return None
+    from ..ndarray.sparse import RowSparseNDArray
+
+    in_slots, any_part = _participating_slots([data, weight])
+    if not any_part:
+        return None
+
+    idx = as_jax(data).astype(jnp.int32)
+    w = as_jax(weight)
+    out_val = jnp.take(w, idx, axis=0)
+    result = NDArray(out_val)
+
+    def vjp_fn(dy):
+        gw = RowSparseNDArray(dy.reshape(-1, w.shape[-1]), idx.ravel(),
+                              w.shape)
+        return (None, gw)
+
+    out_slot = autograd.new_slot()
+    result._ag_slot = out_slot
+    autograd.record_node(vjp_fn, in_slots, [out_slot],
+                         [(result.shape, out_val.dtype)])
+    return result
+
+
 def apply_op(op, inputs: Sequence, params: Optional[dict] = None, out=None):
     """Invoke a registered op on NDArray inputs."""
     if not isinstance(op, Operator):
@@ -224,6 +260,12 @@ def apply_op(op, inputs: Sequence, params: Optional[dict] = None, out=None):
 
                 return apply_fn(fn, moved, nout=op.nout,
                                 differentiable=op.differentiable, out=out)
+
+    if op.name == "Embedding" and params.get("sparse_grad") \
+            and autograd.is_recording():
+        res = _embedding_sparse_grad(op, inputs, params)
+        if res is not None:
+            return res
 
     if op.variadic:
         arrs = list(inputs)

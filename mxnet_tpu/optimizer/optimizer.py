@@ -201,6 +201,24 @@ def _common(self, index):
     return lr, wd, kwargs
 
 
+def _rsp_grad_rows(self, grad):
+    """(unique row ids, per-row summed+rescaled+clipped grads) of a
+    row-sparse gradient — the shared front half of every lazy update
+    (reference: src/operator/optimizer_op-inl.h SGDDnsRspKernel's
+    rescale/clip preamble). Eager-only (data-dependent sizes)."""
+    import jax
+    import jax.numpy as jnp
+    idx = grad._indices
+    vals = grad._values
+    uniq, inv = jnp.unique(idx, return_inverse=True)
+    vals = jax.ops.segment_sum(vals, inv.ravel(),
+                               num_segments=int(uniq.shape[0]))
+    vals = vals * self.rescale_grad
+    if self.clip_gradient is not None:
+        vals = jnp.clip(vals, -self.clip_gradient, self.clip_gradient)
+    return uniq, vals
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum (reference: optimizer.py SGD →
@@ -225,12 +243,33 @@ class SGD(Optimizer):
         return self.create_state(index, weight)
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            return self._update_rsp(index, weight, grad, state)
         lr, wd, kwargs = _common(self, index)
         if state is not None:
             apply_op("sgd_mom_update", [weight, grad, state],
                      dict(lr=lr, wd=wd, momentum=self.momentum, **kwargs))
         else:
             apply_op("sgd_update", [weight, grad], dict(lr=lr, wd=wd, **kwargs))
+
+    def _update_rsp(self, index, weight, grad, state):
+        """Lazy update: only the rows present in the row-sparse gradient
+        are touched — weight decay and momentum decay included
+        (reference: src/operator/optimizer_op.cc SGDUpdateRspImpl /
+        SGDMomLazyUpdateRspImpl)."""
+        lr, wd, _ = _common(self, index)
+        rows, g = _rsp_grad_rows(self, grad)
+        w = weight._data
+        wr = w[rows]
+        g = g.astype(wr.dtype) + wd * wr
+        if state is not None:
+            m = state._data
+            mr = self.momentum * m[rows] + g
+            state._data = m.at[rows].set(mr)
+            weight._data = w.at[rows].set(wr - lr * mr)
+        else:
+            weight._data = w.at[rows].set(wr - lr * g)
 
     def update_multi_precision(self, index, weight, grad, state):
         use_mp = self.multi_precision and weight.dtype in (
@@ -286,6 +325,9 @@ class Adam(Optimizer):
         return (zeros_like(weight), zeros_like(weight))  # mean, var
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            return self._update_rsp(index, weight, grad, state)
         lr, wd, kwargs = _common(self, index)
         t = self._index_update_count[index]
         coef1 = 1. - self.beta1 ** t
@@ -295,6 +337,26 @@ class Adam(Optimizer):
         apply_op("adam_update", [weight, grad, mean, var],
                  dict(lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
                       epsilon=self.epsilon, **kwargs))
+
+    def _update_rsp(self, index, weight, grad, state):
+        """Lazy Adam: only rows present in the gradient advance their
+        mean/var and weight (reference: src/operator/optimizer_op.cc
+        AdamUpdateRspImpl with lazy_update=True)."""
+        import jax.numpy as jnp
+        lr, wd, _ = _common(self, index)
+        t = self._index_update_count[index]
+        lr *= (1. - self.beta2 ** t) ** 0.5 / (1. - self.beta1 ** t)
+        rows, g = _rsp_grad_rows(self, grad)
+        mean, var = state
+        w, m, v = weight._data, mean._data, var._data
+        wr = w[rows]
+        g = g.astype(wr.dtype) + wd * wr
+        mr = self.beta1 * m[rows] + (1 - self.beta1) * g
+        vr = self.beta2 * v[rows] + (1 - self.beta2) * g * g
+        mean._data = m.at[rows].set(mr)
+        var._data = v.at[rows].set(vr)
+        weight._data = w.at[rows].set(
+            wr - lr * mr / (jnp.sqrt(vr) + self.epsilon))
 
 
 @register

@@ -1,0 +1,195 @@
+"""Sparse storage types and the sparse compute paths.
+
+Reference behaviours pinned here:
+- python/mxnet/ndarray/sparse.py (row_sparse_array/csr_matrix/tostype)
+- src/operator/tensor/dot.cc DotCsrDnsDns (csr @ dense, csr.T @ dense)
+- src/operator/tensor/indexing_op.cc EmbeddingOpBackward row-sparse grad
+- src/operator/optimizer_op.cc SGDUpdateRspImpl / AdamUpdateRspImpl
+  (lazy updates touch only rows present in the gradient)
+- kvstore.h PullRowSparse (row_sparse_pull gathers only requested rows)
+
+The TPU-native property under test everywhere: nothing densifies unless
+a dense op is explicitly applied (``.densified`` stays False).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.ndarray import sparse
+import mxnet_tpu.autograd as ag
+
+
+def test_row_sparse_lazy_storage():
+    r = sparse.row_sparse_array((np.ones((2, 3), np.float32), [1, 4]),
+                                shape=(6, 3))
+    assert r.stype == "row_sparse"
+    assert r.shape == (6, 3) and r.ndim == 2 and r.size == 18
+    assert not r.densified          # no dense buffer yet
+    np.testing.assert_allclose(r.indices.asnumpy(), [1, 4])
+    np.testing.assert_allclose(r.data.asnumpy(), np.ones((2, 3)))
+    dense = r.asnumpy()             # first dense touch materializes
+    assert r.densified
+    expect = np.zeros((6, 3), np.float32)
+    expect[[1, 4]] = 1
+    np.testing.assert_allclose(dense, expect)
+
+
+def test_row_sparse_from_dense_and_tostype():
+    d = np.zeros((5, 2), np.float32)
+    d[0] = [1, 2]
+    d[3] = [3, 4]
+    r = sparse.row_sparse_array(d)
+    np.testing.assert_allclose(r.indices.asnumpy(), [0, 3])
+    np.testing.assert_allclose(r.tostype("default").asnumpy(), d)
+    back = sparse.cast_storage(nd.array(d), "row_sparse")
+    np.testing.assert_allclose(back.asnumpy(), d)
+
+
+def test_csr_roundtrip_and_spmm():
+    rng = np.random.RandomState(0)
+    a = rng.randn(6, 8).astype(np.float32)
+    a[a < 0.5] = 0                   # sparsify
+    c = sparse.csr_matrix(a)
+    np.testing.assert_allclose(c.asnumpy(), a)
+    c2 = sparse.csr_matrix(a)        # fresh, undensified copy
+    b = rng.randn(8, 4).astype(np.float32)
+    out = sparse.dot(c2, nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5, atol=1e-5)
+    assert not c2.densified          # SpMM ran on the structure
+    bt = rng.randn(6, 4).astype(np.float32)
+    out_t = sparse.dot(c2, nd.array(bt), transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), a.T @ bt, rtol=1e-5,
+                               atol=1e-5)
+    assert not c2.densified
+
+
+def test_retain():
+    r = sparse.row_sparse_array(
+        (np.arange(6, dtype=np.float32).reshape(3, 2), [1, 4, 5]),
+        shape=(7, 2))
+    kept = sparse.retain(r, [4, 6])
+    dense = kept.asnumpy()
+    expect = np.zeros((7, 2), np.float32)
+    expect[4] = [2, 3]
+    np.testing.assert_allclose(dense, expect)
+
+
+def test_sparse_add_stays_sparse():
+    a = sparse.row_sparse_array((np.ones((1, 2), np.float32), [0]),
+                                shape=(4, 2))
+    b = sparse.row_sparse_array((np.ones((2, 2), np.float32), [0, 2]),
+                                shape=(4, 2))
+    s = sparse.add(a, b)
+    assert s.stype == "row_sparse" and not s.densified
+    expect = np.zeros((4, 2), np.float32)
+    expect[0] = 2
+    expect[2] = 1
+    np.testing.assert_allclose(s.asnumpy(), expect)
+
+
+def test_embedding_sparse_grad():
+    """sparse_grad=True produces a RowSparseNDArray gradient holding the
+    looked-up rows only — never a (vocab, dim) dense scatter."""
+    emb = gluon.nn.Embedding(1000, 4, sparse_grad=True)
+    emb.initialize()
+    assert emb.weight.grad_stype == "row_sparse"
+    x = nd.array(np.array([[1, 3], [3, 7]]))
+    with ag.record():
+        out = emb(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, sparse.RowSparseNDArray)
+    assert not g.densified
+    assert set(np.asarray(g.indices.asnumpy()).tolist()) == {1, 3, 3, 7} \
+        or sorted(np.asarray(g.indices.asnumpy()).tolist()) == [1, 3, 3, 7]
+    # value check against the dense-grad oracle
+    emb2 = gluon.nn.Embedding(1000, 4, sparse_grad=False)
+    emb2.initialize()
+    emb2.weight.set_data(emb.weight.data())
+    with ag.record():
+        loss2 = (emb2(x) ** 2).sum()
+    loss2.backward()
+    np.testing.assert_allclose(g.asnumpy(),
+                               emb2.weight.grad().asnumpy(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("opt,kwargs", [
+    ("sgd", {"learning_rate": 0.5}),
+    ("sgd", {"learning_rate": 0.5, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.1}),
+])
+def test_lazy_update_touches_only_grad_rows(opt, kwargs):
+    mx.random.seed(0)
+    emb = gluon.nn.Embedding(64, 3, sparse_grad=True)
+    emb.initialize()
+    w0 = emb.weight.data().asnumpy().copy()
+    tr = gluon.Trainer(emb.collect_params(), opt, kwargs)
+    x = nd.array(np.array([2, 5, 5, 9]))
+    with ag.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    tr.step(1)
+    w1 = emb.weight.data().asnumpy()
+    changed = set(np.where(np.abs(w1 - w0).sum(axis=1) > 0)[0].tolist())
+    assert changed <= {2, 5, 9}, changed
+    assert changed, "no rows updated"
+
+
+def test_lazy_sgd_matches_dense_on_touched_rows():
+    """On the touched rows, the lazy update must equal the dense sgd
+    update (reference: lazy_update only skips untouched rows)."""
+    mx.random.seed(1)
+    vals = np.array([[1.0, -2.0], [0.5, 0.25]], np.float32)
+    g = sparse.row_sparse_array((vals, [1, 3]), shape=(5, 2))
+    w = np.arange(10, dtype=np.float32).reshape(5, 2)
+    from mxnet_tpu import optimizer as optmod
+    opt = optmod.SGD(learning_rate=0.1, momentum=0.9, wd=0.01)
+    weight = nd.array(w.copy())
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, g, state)
+    out = weight.asnumpy()
+    # dense oracle
+    expect = w.copy()
+    mom = np.zeros_like(w)
+    gd = np.zeros_like(w)
+    gd[[1, 3]] = vals
+    touched = [1, 3]
+    mom_t = 0.9 * mom[touched] + gd[touched] + 0.01 * w[touched]
+    expect[touched] = w[touched] - 0.1 * mom_t
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_kvstore_row_sparse_pull_and_sparse_push():
+    kv = mx.kv.create("local")
+    val = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kv.init(3, nd.array(val))
+    out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull(3, out=out, row_ids=nd.array(np.array([1, 4, 4])))
+    assert not out.densified
+    np.testing.assert_allclose(out.indices.asnumpy(), [1, 4])
+    np.testing.assert_allclose(out.data.asnumpy(), val[[1, 4]])
+    # push of row-sparse values reduces sparsely (no updater set)
+    g1 = sparse.row_sparse_array((np.ones((1, 2), np.float32), [0]),
+                                 shape=(6, 2))
+    g2 = sparse.row_sparse_array((np.ones((1, 2), np.float32), [2]),
+                                 shape=(6, 2))
+    kv.init(4, sparse.zeros("row_sparse", (6, 2)))
+    kv.push(4, [g1, g2])
+    pulled = nd.zeros((6, 2))
+    kv.pull(4, out=pulled)
+    expect = np.zeros((6, 2), np.float32)
+    expect[0] = 1
+    expect[2] = 1
+    np.testing.assert_allclose(pulled.asnumpy(), expect)
+
+
+def test_parameter_row_sparse_data():
+    p = gluon.Parameter("w", shape=(8, 3), stype="row_sparse")
+    p.initialize()
+    rows = p.row_sparse_data(nd.array(np.array([0, 6])))
+    assert isinstance(rows, sparse.RowSparseNDArray)
+    np.testing.assert_allclose(rows.indices.asnumpy(), [0, 6])
+    np.testing.assert_allclose(rows.data.asnumpy(),
+                               p.data().asnumpy()[[0, 6]])
