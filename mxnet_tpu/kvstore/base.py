@@ -73,6 +73,8 @@ class KVStoreLocal(KVStoreBase):
         self._updater = None
         self._optimizer = None
         self._str_keys = False
+        self._compressor = None
+        self._residuals = {}
 
     # --- classic API (reference include/mxnet/kvstore.h) ---------------
     def init(self, key, value):
@@ -86,6 +88,13 @@ class KVStoreLocal(KVStoreBase):
         from ..ndarray.sparse import RowSparseNDArray, add as _sp_add
         keys, values = _key_value(key, value)
         for k, vlist in _group(keys, values):
+            if self._compressor is not None and \
+                    not any(isinstance(v, RowSparseNDArray) for v in vlist):
+                # quantize each worker's contribution with its own error-
+                # feedback residual before the reduce (reference: CommCPU
+                # ReduceCompressed, kvstore/comm.h)
+                vlist = [NDArray(self._compressed(k, i, v))
+                         for i, v in enumerate(vlist)]
             reduced = vlist[0]
             if len(vlist) > 1:
                 if all(isinstance(v, RowSparseNDArray) for v in vlist):
@@ -157,9 +166,28 @@ class KVStoreLocal(KVStoreBase):
         self.set_updater(get_updater(optimizer))
 
     def set_gradient_compression(self, compression_params):
-        # 2-bit compression (reference gradient_compression.h) is a
-        # wire-bandwidth optimization for PS/ethernet; a no-op on ICI.
-        pass
+        """Enable 2-bit gradient compression with error feedback on the
+        push path (reference: kvstore.py set_gradient_compression →
+        gradient_compression.h:52)."""
+        from . import compression as _gc
+        self._compressor = _gc.create(compression_params)
+        self._residuals = {}
+
+    @property
+    def gradient_compression(self):
+        return self._compressor
+
+    def _compressed(self, key, slot, value):
+        """Quantize one worker's push through its residual; returns the
+        dequantized jax array (what the receiving side would see)."""
+        import jax.numpy as jnp
+        g = value._data
+        res = self._residuals.get((key, slot))
+        if res is None or res.shape != g.shape:
+            res = jnp.zeros(g.shape, g.dtype)
+        deq, res = self._compressor.roundtrip(g, res)
+        self._residuals[(key, slot)] = res
+        return deq
 
     @staticmethod
     def is_capable(capability):

@@ -1,0 +1,105 @@
+"""Gradient compression (2-bit quantization with error feedback).
+
+Reference: src/kvstore/gradient_compression.h:52 (GradientCompression,
+CompressionType::kTwoBit), gradient_compression-inl.h (quantize_2bit /
+dequantize_2bit kernels), python/mxnet/kvstore/kvstore.py
+set_gradient_compression.
+
+The reference's scheme, kept exactly: each gradient element is mapped to
+one of {-threshold, 0, +threshold} (2 bits), the *quantization error* is
+kept in a per-key residual and added back into the next gradient
+("error feedback"), so the compression is unbiased over time. The wire
+format differs from the reference only in container: the reference packs
+16 2-bit codes into a float32 block; here they pack into an int32 (same
+16x size reduction) because XLA bitwise ops want integer types.
+
+Everything is jittable (static shapes, pure functions), so the same
+compress/decompress pair runs inside a sharded train step where the
+all-gather moves the *packed* int32 payload over ICI/DCN — a real 16x
+wire-bandwidth saving — as well as eagerly in the kvstore push path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["TwoBitCompression", "create"]
+
+_VALS_PER_WORD = 16   # 2 bits per value in an int32
+
+
+class TwoBitCompression:
+    """threshold-quantizer: sign(g) * threshold where |g| > threshold.
+
+    Codes: 0 -> 0, 1 -> +threshold, 2 -> -threshold (matches the
+    reference's posbits/negbits encoding idea).
+    """
+
+    def __init__(self, threshold=0.5):
+        if threshold <= 0:
+            raise ValueError("threshold must be positive")
+        self.threshold = float(threshold)
+
+    # ------------------------------------------------------------ core --
+    def quantize(self, grad, residual):
+        """(codes uint8 flat, new_residual). Error feedback: the part of
+        grad+residual not representable stays in the residual."""
+        g = grad + residual
+        t = jnp.asarray(self.threshold, g.dtype)
+        codes = jnp.where(g >= t, 1, jnp.where(g <= -t, 2, 0))
+        q = jnp.where(codes == 1, t, jnp.where(codes == 2, -t, 0))
+        return codes.astype(jnp.uint8).ravel(), g - q
+
+    def pack(self, codes):
+        """Pack flat 2-bit codes into int32 words (16 values/word)."""
+        n = codes.shape[0]
+        pad = (-n) % _VALS_PER_WORD
+        codes = jnp.pad(codes, (0, pad)).astype(jnp.int32)
+        words = codes.reshape(-1, _VALS_PER_WORD)
+        shifts = jnp.arange(_VALS_PER_WORD, dtype=jnp.int32) * 2
+        return (words << shifts).sum(axis=1, dtype=jnp.int32)
+
+    def unpack(self, packed, n):
+        shifts = jnp.arange(_VALS_PER_WORD, dtype=jnp.int32) * 2
+        codes = (packed[:, None] >> shifts) & 0x3
+        return codes.ravel()[:n]
+
+    def dequantize(self, codes, shape, dtype):
+        t = jnp.asarray(self.threshold, dtype)
+        vals = jnp.where(codes == 1, t, jnp.where(codes == 2, -t,
+                                                  jnp.zeros((), dtype)))
+        return vals.reshape(shape).astype(dtype)
+
+    # ----------------------------------------------------- conveniences --
+    def compress(self, grad, residual):
+        """grad -> (packed int32 payload, new residual). The payload is
+        what crosses the wire: ceil(n/16) int32s for n float32 grads."""
+        codes, residual = self.quantize(grad, residual)
+        return self.pack(codes), residual
+
+    def decompress(self, packed, shape, dtype):
+        n = 1
+        for s in shape:
+            n *= int(s)
+        return self.dequantize(self.unpack(packed, n), shape, dtype)
+
+    def roundtrip(self, grad, residual):
+        """compress+decompress in one call (the local/debug path)."""
+        packed, residual = self.compress(grad, residual)
+        return self.decompress(packed, grad.shape, grad.dtype), residual
+
+
+def create(compression_params):
+    """Build a compressor from the reference's set_gradient_compression
+    params dict ({'type': '2bit', 'threshold': 0.5})."""
+    if not compression_params:
+        return None
+    params = dict(compression_params)
+    ctype = params.pop("type", "2bit")
+    if ctype != "2bit":
+        raise ValueError(
+            f"unsupported compression type {ctype!r}; the reference "
+            "supports '2bit' (gradient_compression.h:59)")
+    threshold = float(params.pop("threshold", 0.5))
+    if params:
+        raise ValueError(f"unknown compression params: {sorted(params)}")
+    return TwoBitCompression(threshold)

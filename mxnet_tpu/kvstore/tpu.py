@@ -54,6 +54,7 @@ class KVStoreTPU(KVStoreLocal):
         self._devices = jax.devices()
         self._mesh = None
         self._reduce_jit = None
+        self._deq_jits = {}
 
     def _ensure_mesh(self):
         if self._mesh is None:
@@ -99,11 +100,54 @@ class KVStoreTPU(KVStoreLocal):
                 for v in vlist[1:]:
                     acc = acc + v._data
                 reduced = NDArray(acc)
-            reduced = self._reduce_across_processes(reduced)
+            if self._compressor is not None:
+                reduced = self._reduce_compressed(k, reduced)
+            else:
+                reduced = self._reduce_across_processes(reduced)
             if self._updater is not None:
                 self._updater(k, reduced, self._store[k])
             else:
                 self._store[k] = reduced.copy()
+
+    def _reduce_compressed(self, key, value):
+        """Compressed cross-host reduce (reference: kvstore_dist.h
+        PushCompressed): quantize the locally-reduced gradient through
+        this process's error-feedback residual, move only the PACKED
+        int32 payload across DCN (16x less traffic), dequantize+sum in a
+        compiled program on the receiving side."""
+        g = value._data
+        res = self._residuals.get(key)
+        if res is None or res.shape != g.shape:
+            res = jnp.zeros(g.shape, g.dtype)
+        packed, res = self._compressor.compress(g, res)
+        self._residuals[key] = res
+        if jax.process_count() == 1:
+            return NDArray(self._compressor.decompress(packed, g.shape,
+                                                       g.dtype))
+        from jax.experimental import multihost_utils
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._ensure_mesh()
+        sig = (tuple(g.shape), str(g.dtype))
+        fn = self._deq_jits.get(sig)
+        if fn is None:
+            comp = self._compressor
+
+            def deq_sum(p):
+                # p: (nproc, nwords) int32, sharded on axis 0 — XLA moves
+                # the packed rows, then each process dequantizes locally
+                rows = jax.vmap(lambda w: comp.decompress(
+                    w, tuple(g.shape), g.dtype))(p)
+                return jnp.sum(rows, axis=0)
+
+            fn = jax.jit(deq_sum, out_shardings=NamedSharding(
+                self._mesh, PartitionSpec()))
+            self._deq_jits[sig] = fn
+        gp = multihost_utils.host_local_array_to_global_array(
+            packed[None], self._mesh, PartitionSpec("p"))
+        out = fn(gp)
+        host = multihost_utils.global_array_to_host_local_array(
+            out, self._mesh, PartitionSpec())
+        return NDArray(host)
 
     @property
     def type(self):

@@ -1,0 +1,109 @@
+"""2-bit gradient compression with error feedback.
+
+Reference: src/kvstore/gradient_compression.h:52 (threshold quantizer to
+{-t, 0, +t}, 16 values per word, per-worker residual), kvstore.py
+set_gradient_compression. The multi-process packed-payload reduce is
+exercised in tests/test_distributed.py; here: wire format, quantizer
+semantics, error feedback, the kvstore push path, and convergence.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.kvstore import compression as gc
+
+
+def test_pack_unpack_roundtrip():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    comp = gc.TwoBitCompression(0.5)
+    codes = jnp.asarray(rng.randint(0, 3, 1003), jnp.uint8)
+    packed = comp.pack(codes)
+    assert packed.dtype == jnp.int32
+    assert packed.shape[0] == -(-1003 // 16)      # 16 values per word
+    out = comp.unpack(packed, 1003)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(codes))
+
+
+def test_quantizer_semantics_and_residual():
+    import jax.numpy as jnp
+    comp = gc.TwoBitCompression(1.0)
+    g = jnp.asarray(np.array([2.5, 0.3, -0.9, -1.0, 1.0, 0.0], np.float32))
+    res = jnp.zeros(6, jnp.float32)
+    deq, new_res = comp.roundtrip(g, res)
+    np.testing.assert_allclose(np.asarray(deq), [1, 0, 0, -1, 1, 0])
+    # residual keeps exactly what quantization dropped
+    np.testing.assert_allclose(np.asarray(new_res),
+                               np.asarray(g) - np.asarray(deq), rtol=1e-6)
+
+
+def test_error_feedback_is_unbiased_over_time():
+    """Pushing the same gradient repeatedly must transmit its full mass:
+    sum of dequantized outputs -> N * g as N grows (the whole point of
+    the residual, gradient_compression.h docstring)."""
+    import jax.numpy as jnp
+    comp = gc.TwoBitCompression(0.5)
+    g = jnp.asarray(np.array([0.2, -0.07, 0.45, -0.3], np.float32))
+    res = jnp.zeros(4, jnp.float32)
+    total = np.zeros(4, np.float32)
+    n = 200
+    for _ in range(n):
+        deq, res = comp.roundtrip(g, res)
+        total += np.asarray(deq)
+    np.testing.assert_allclose(total / n, np.asarray(g), atol=0.51 / n)
+
+
+def test_create_validates_params():
+    assert gc.create(None) is None
+    comp = gc.create({"type": "2bit", "threshold": 0.25})
+    assert comp.threshold == 0.25
+    with pytest.raises(ValueError):
+        gc.create({"type": "1bit"})
+    with pytest.raises(ValueError):
+        gc.create({"type": "2bit", "bogus": 1})
+
+
+def test_kvstore_push_applies_compression_per_worker():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    assert kv.gradient_compression is not None
+    kv.init(0, nd.zeros((4,)))
+    v1 = nd.array(np.array([2.0, 0.4, -1.5, 0.0], np.float32))
+    v2 = nd.array(np.array([0.9, 1.1, -0.2, -3.0], np.float32))
+    kv.push(0, [v1, v2])
+    out = nd.zeros((4,))
+    kv.pull(0, out=out)
+    # oracle: each worker quantized independently (zero residuals), then sum
+    expect = np.array([1, 0, -1, 0], np.float32) + \
+        np.array([0, 1, 0, -1], np.float32)
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    # second push consumes the residuals kept per worker slot
+    kv.push(0, [v1, v2])
+    out2 = nd.zeros((4,))
+    kv.pull(0, out=out2)
+    # worker1 residual [1, .4, -.5, 0] + v1 = [3,.8,-2,0] -> [1,0,-1,0](x?)
+    # compute oracle explicitly
+    comp = gc.TwoBitCompression(1.0)
+    import jax.numpy as jnp
+    r1 = jnp.asarray(v1.asnumpy()) - jnp.asarray([1, 0, -1, 0.])
+    r2 = jnp.asarray(v2.asnumpy()) - jnp.asarray([0, 1, 0, -1.])
+    d1, _ = comp.roundtrip(jnp.asarray(v1.asnumpy()) + r1, jnp.zeros(4))
+    d2, _ = comp.roundtrip(jnp.asarray(v2.asnumpy()) + r2, jnp.zeros(4))
+    np.testing.assert_allclose(out2.asnumpy(),
+                               np.asarray(d1) + np.asarray(d2))
+
+
+def test_compressed_training_converges():
+    """SGD through compressed grads + error feedback still drives a
+    quadratic to its optimum (the reference's acceptance property)."""
+    import jax.numpy as jnp
+    comp = gc.TwoBitCompression(0.5)
+    target = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    w = jnp.zeros(4)
+    res = jnp.zeros(4)
+    for _ in range(300):
+        g = w - jnp.asarray(target)          # grad of 0.5*|w - target|^2
+        deq, res = comp.roundtrip(g, res)
+        w = w - 0.2 * deq
+    np.testing.assert_allclose(np.asarray(w), target, atol=0.05)

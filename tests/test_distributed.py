@@ -47,6 +47,22 @@ _WORKER = textwrap.dedent("""
     np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
     kv.barrier()
 
+    # ---- compressed push: packed int32 payload over the process mesh --
+    kvc = mx.kv.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kvc.init("g", nd.array(np.zeros(5, np.float32)))
+    v = np.array([2.0, -0.5, 1.0, -3.0, 0.0], np.float32) * (rank + 1)
+    kvc.push("g", nd.array(v))
+    outc = nd.array(np.zeros(5, np.float32))
+    kvc.pull("g", out=outc)
+    # oracle: each rank quantizes its own v to {-1,0,1}, then sum
+    q = lambda a: np.clip(np.where(a >= 1, 1, np.where(a <= -1, -1, 0)),
+                          -1, 1).astype(np.float32)
+    expect_c = sum(q(np.array([2.0, -0.5, 1.0, -3.0, 0.0]) * (r + 1))
+                   for r in range(nproc))
+    np.testing.assert_allclose(outc.asnumpy(), expect_c)
+    kvc.barrier()
+
     # ---- ShardedTrainer dp step over the process-spanning mesh ------
     from mxnet_tpu import gluon, parallel
     from mxnet_tpu.gluon import nn
