@@ -39,7 +39,8 @@ _LAZY_MODULES = ("numpy", "numpy_extension", "symbol", "gluon", "module",
                  "optimizer", "metric", "initializer", "io", "kvstore",
                  "image", "parallel", "profiler", "lr_scheduler",
                  "callback", "test_utils", "util", "runtime", "amp",
-                 "recordio", "executor", "monitor", "model", "operator")
+                 "recordio", "executor", "monitor", "model", "operator",
+                 "contrib")
 
 _ALIAS = {"np": "numpy", "npx": "numpy_extension", "sym": "symbol",
           "mod": "module", "kv": "kvstore"}

@@ -1,0 +1,5 @@
+"""mx.contrib — contributed/experimental frontends.
+
+Reference: python/mxnet/contrib/ (quantization, onnx, amp re-exports).
+"""
+from . import quantization  # noqa: F401

@@ -1,0 +1,136 @@
+"""INT8 quantization operators.
+
+Reference: src/operator/quantization/ (quantize.cc, quantize_v2.cc,
+dequantize.cc, requantize.cc, quantized_conv.cc,
+quantized_fully_connected.cc). Semantics kept:
+
+- int8 is SYMMETRIC: scale = 127 / threshold with threshold =
+  max(|min|, |max|); value v -> round(v * scale) in [-127, 127].
+- uint8 is AFFINE over [min, max] with 255 steps.
+- quantized_conv / quantized_fully_connected accumulate int8 x int8 into
+  int32 on the MXU (lax preferred_element_type=int32) and return the
+  int32 accumulator plus its float range, exactly like the reference's
+  kernels; requantize folds int32 -> int8 given calibrated ranges.
+
+Every op returns (out, out_min, out_max) like the reference so the
+range bookkeeping composes.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import _REGISTRY, Operator, alias, register
+
+
+def _reg(name, fn, **kw):
+    _REGISTRY[name] = Operator(name, fn, **kw)
+
+
+def _thresh(mn, mx):
+    return jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+
+
+def _quantize(data, min_range, max_range, out_type="int8"):
+    """Reference: quantize.cc (_contrib_quantize)."""
+    mn = jnp.asarray(min_range).reshape(())
+    mx = jnp.asarray(max_range).reshape(())
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(mx - mn, 1e-30)
+        q = jnp.clip(jnp.round((data - mn) * scale), 0, 255)\
+            .astype(jnp.uint8)
+        return q, mn, mx
+    t = _thresh(mn, mx)
+    scale = 127.0 / jnp.maximum(t, 1e-30)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, -t, t
+
+
+_reg("_contrib_quantize", _quantize, nout=3, differentiable=False)
+
+
+def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                 out_type="int8"):
+    """Reference: quantize_v2.cc — computes the range from the data when
+    no calibrated range is given."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    return _quantize(data, mn, mx, out_type=out_type)
+
+
+_reg("_contrib_quantize_v2", _quantize_v2, nout=3, differentiable=False)
+
+
+def _dequantize(qdata, min_range, max_range, out_type="float32"):
+    """Reference: dequantize.cc."""
+    mn = jnp.asarray(min_range).reshape(())
+    mx = jnp.asarray(max_range).reshape(())
+    if qdata.dtype == jnp.uint8:
+        scale = jnp.maximum(mx - mn, 1e-30) / 255.0
+        return qdata.astype(jnp.float32) * scale + mn
+    t = _thresh(mn, mx)
+    return qdata.astype(jnp.float32) * (t / 127.0)
+
+
+_reg("_contrib_dequantize", _dequantize, differentiable=False)
+
+
+def _requantize(qdata, min_range, max_range, min_calib_range=None,
+                max_calib_range=None):
+    """int32 accumulator -> int8 (reference: requantize.cc). The int32
+    range [min_range, max_range] is the product-range bookkeeping from
+    the quantized op; the calibrated range decides the int8 scale."""
+    real = _dequantize(qdata.astype(jnp.float32), min_range, max_range) \
+        if qdata.dtype != jnp.int32 else \
+        qdata.astype(jnp.float32) * (_thresh(
+            jnp.asarray(min_range).reshape(()),
+            jnp.asarray(max_range).reshape(())) / (127.0 * 127.0))
+    if min_calib_range is None:
+        mn, mx = jnp.min(real), jnp.max(real)
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    return _quantize(real, mn, mx)
+
+
+_reg("_contrib_requantize", _requantize, nout=3, differentiable=False)
+
+
+def _quantized_fully_connected(qx, qw, x_scale=1.0, w_scale=1.0,
+                               num_hidden=0):
+    """int8 x int8 -> int32 dense (reference:
+    quantized_fully_connected.cc). Returns the fp32 result scaled back:
+    out = (qx @ qw.T) * (x_scale * w_scale); w_scale may be a per-row
+    (per-output-channel) vector — finer than the reference's per-tensor
+    scale."""
+    acc = lax.dot_general(qx, qw, (((qx.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    scale = jnp.asarray(x_scale) * jnp.asarray(w_scale)
+    return acc.astype(jnp.float32) * scale
+
+
+_reg("_contrib_quantized_fully_connected", _quantized_fully_connected,
+     differentiable=False)
+
+
+def _quantized_conv(qx, qw, kernel=None, stride=None, pad=None,
+                    num_filter=0, layout="NHWC", x_scale=1.0, w_scale=1.0):
+    """int8 conv with int32 accumulation (reference: quantized_conv.cc);
+    NHWC/HWIO only (the TPU-native layout)."""
+    nd = qx.ndim - 2
+    stride = stride or (1,) * nd
+    pad = pad or (0,) * nd
+    acc = lax.conv_general_dilated(
+        qx, qw, window_strides=tuple(stride),
+        padding=[(p, p) for p in pad],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.int32)
+    scale = jnp.asarray(x_scale) * jnp.asarray(w_scale)
+    return acc.astype(jnp.float32) * scale
+
+
+_reg("_contrib_quantized_conv", _quantized_conv, differentiable=False)
