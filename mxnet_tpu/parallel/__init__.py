@@ -16,4 +16,5 @@ from .mesh import make_mesh, local_mesh, data_parallel_spec  # noqa: F401
 from .functional import functional_call, extract_params, load_params  # noqa: F401
 from .trainer import ShardedTrainer, shard_batch  # noqa: F401
 from .ring_attention import ring_attention, sequence_shard  # noqa: F401
-from .pipeline import pipeline_stage_loop  # noqa: F401
+from .pipeline import (pipeline_stage_loop,  # noqa: F401
+                       pipeline_value_and_grad)  # noqa: F401

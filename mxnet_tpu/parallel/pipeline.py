@@ -1,11 +1,18 @@
-"""Pipeline parallelism: microbatched GPipe stage loop.
+"""Pipeline parallelism: microbatched GPipe stage loop, trainable.
 
-The reference has no pipeline parallelism (SURVEY.md §2.3); provided as a
-TPU-native capability. GPipe forward schedule expressed inside
-``shard_map`` over the 'pp' mesh axis: each rank holds one stage's params
-and an activation register; every tick it applies its stage and passes
-the activation to the next rank via ``ppermute`` — XLA overlaps the ICI
-hop with the next tick's compute.
+The reference has no pipeline parallelism (SURVEY.md §2.3); provided as
+a TPU-native capability. GPipe forward schedule expressed inside
+``shard_map`` over the 'pp' mesh axis: each rank holds one stage's
+params and an activation register; every tick it applies its stage and
+passes the activation to the next rank via ``ppermute`` — XLA overlaps
+the ICI hop with the next tick's compute.
+
+The tick loop is a ``lax.scan``, so the whole schedule is REVERSE-MODE
+DIFFERENTIABLE: ``jax.grad`` of a loss on the pipe's outputs yields the
+GPipe backward schedule automatically (the scan transpose runs the ticks
+in reverse and the ``ppermute`` transpose sends cotangents across the
+inverse permutation — backward activations flow last-stage -> first).
+``pipeline_value_and_grad`` packages that into a training step.
 
 Constraint of this schedule: all stages map activations of one shape to
 the same shape (pad stage widths or wrap uneven stages accordingly).
@@ -18,7 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-__all__ = ["pipeline_stage_loop"]
+__all__ = ["pipeline_stage_loop", "pipeline_value_and_grad"]
 
 
 def pipeline_stage_loop(stage_fn, n_microbatches: int, mesh: Mesh,
@@ -40,10 +47,10 @@ def pipeline_stage_loop(stage_fn, n_microbatches: int, mesh: Mesh,
         params = jax.tree_util.tree_map(lambda a: a[0], params)
         rank = lax.axis_index(axis_name)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-        reg = lax.pvary(jnp.zeros_like(mbs[0]), (axis_name,))
-        out = lax.pvary(jnp.zeros_like(mbs), (axis_name,))
+        reg0 = lax.pvary(jnp.zeros_like(mbs[0]), (axis_name,))
+        out0 = lax.pvary(jnp.zeros_like(mbs), (axis_name,))
 
-        def body(t, carry):
+        def tick(carry, t):
             reg, out = carry
             feed_idx = jnp.clip(t, 0, n_microbatches - 1)
             inp = jnp.where(rank == 0, mbs[feed_idx], reg)
@@ -54,9 +61,10 @@ def pipeline_stage_loop(stage_fn, n_microbatches: int, mesh: Mesh,
             slot = jnp.clip(done_idx, 0, n_microbatches - 1)
             out = out.at[slot].set(jnp.where(valid, y, out[slot]))
             reg = lax.ppermute(y, axis_name, perm)
-            return reg, out
+            return (reg, out), None
 
-        reg, out = lax.fori_loop(0, ticks, body, (reg, out))
+        (reg, out), _ = lax.scan(tick, (reg0, out0),
+                                 jnp.arange(ticks))
         # broadcast last rank's outputs to everyone
         out = jnp.where(rank == n_stages - 1, out, jnp.zeros_like(out))
         return lax.psum(out, axis_name)
@@ -64,3 +72,34 @@ def pipeline_stage_loop(stage_fn, n_microbatches: int, mesh: Mesh,
     return shard_map(local, mesh=mesh,
                      in_specs=(P(axis_name), P()),
                      out_specs=P())
+
+
+def pipeline_value_and_grad(stage_fn, loss_fn, n_microbatches: int,
+                            mesh: Mesh, axis_name: str = "pp"):
+    """Build a GPipe TRAINING step core:
+    ``f(stage_params, microbatches, labels) -> (loss, grads)``.
+
+    - ``loss_fn(outputs, labels) -> per-microbatch scalar`` is applied to
+      each finished microbatch (labels shaped (n_microbatches, mb, ...));
+      the reported loss is their mean.
+    - ``grads`` has the same pp-sharded (n_stages, ...) structure as
+      ``stage_params`` — each rank ends up holding exactly its own
+      stage's gradients, computed by the reverse pipeline schedule that
+      jax.grad derives from the forward scan.
+
+    Wrap the result in ``jax.jit`` together with an optimizer update for
+    a full pipeline-parallel train step (see tests/test_parallel.py and
+    __graft_entry__.dryrun_multichip).
+    """
+    pipe = pipeline_stage_loop(stage_fn, n_microbatches, mesh,
+                               axis_name=axis_name)
+
+    def loss_of(params, mbs, labels):
+        outs = pipe(params, mbs)
+        per_mb = jax.vmap(loss_fn)(outs, labels)
+        return per_mb.mean()
+
+    def step(params, mbs, labels):
+        return jax.value_and_grad(loss_of)(params, mbs, labels)
+
+    return step
