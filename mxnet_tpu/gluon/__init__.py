@@ -11,6 +11,18 @@ from . import utils  # noqa: F401
 
 
 def __getattr__(name):
+    # lazy: contrib imports estimator -> Trainer -> would cycle at module
+    # import time
+    if name == "contrib":
+        import importlib
+        mod = importlib.import_module(".contrib", __name__)
+        globals()["contrib"] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute "
+                         f"{name!r}")
+
+
+def __getattr__(name):
     # heavy/cyclic subpackages load lazily
     if name in ("rnn", "data", "model_zoo", "contrib"):
         import importlib

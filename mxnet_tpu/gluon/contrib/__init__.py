@@ -1,0 +1,5 @@
+"""gluon.contrib — contributed gluon components.
+
+Reference: python/mxnet/gluon/contrib/ (estimator, cnn/rnn extras).
+"""
+from . import estimator  # noqa: F401

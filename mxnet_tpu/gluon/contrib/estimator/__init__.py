@@ -1,0 +1,10 @@
+"""Gluon Estimator: high-level fit/evaluate with event handlers.
+
+Reference: python/mxnet/gluon/contrib/estimator/.
+"""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (  # noqa: F401
+    EventHandler, TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+    BatchEnd, StoppingHandler, MetricHandler, ValidationHandler,
+    LoggingHandler, CheckpointHandler, EarlyStoppingHandler,
+    GradientUpdateHandler)

@@ -1,0 +1,163 @@
+"""Gluon Estimator: train/validate a net with an event-handler loop.
+
+Reference: python/mxnet/gluon/contrib/estimator/estimator.py:42
+(Estimator, fit:326, evaluate:272, fit_batch, evaluate_batch,
+_prepare_default_handlers). TPU-native notes: one autograd.record()
+forward/backward per batch on whatever context the data sits on; the
+trainer step itself is the same jit-compiled path Trainer always uses,
+so the handler loop adds only Python-level orchestration.
+"""
+from __future__ import annotations
+
+from ....metric import Accuracy, Loss as LossMetric, EvalMetric
+from .... import autograd
+from ....ndarray import NDArray
+from ... import Trainer
+from ...loss import Loss as GluonLoss
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            TrainBegin, TrainEnd, MetricHandler,
+                            StoppingHandler, LoggingHandler,
+                            GradientUpdateHandler)
+
+__all__ = ["Estimator"]
+
+
+def _as_nd(x):
+    return x if isinstance(x, NDArray) else NDArray(x)
+
+
+class Estimator:
+    """Facilitates training & validation (reference: estimator.py:42).
+
+    Parameters
+    ----------
+    net : gluon Block (initialized)
+    loss : gluon Loss
+    train_metrics : EvalMetric or list (default: Accuracy)
+    val_metrics : EvalMetric or list (defaults to copies of train)
+    trainer : gluon Trainer (default: sgd lr=1e-3)
+    """
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None):
+        self.net = net
+        if not isinstance(loss, GluonLoss):
+            raise ValueError("loss must be a gluon Loss")
+        self.loss = loss
+        self.train_metrics = self._to_list(train_metrics) or [Accuracy()]
+        self.val_metrics = self._to_list(val_metrics) or \
+            [type(m)() for m in self.train_metrics]
+        self.train_loss_metric = LossMetric("train_loss")
+        self.val_loss_metric = LossMetric("val_loss")
+        self.trainer = trainer if trainer is not None else Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 1e-3})
+        self.stop_training = False
+
+    @staticmethod
+    def _to_list(m):
+        if m is None:
+            return None
+        if isinstance(m, EvalMetric):
+            return [m]
+        return list(m)
+
+    # ------------------------------------------------------------ batch --
+    def fit_batch(self, batch):
+        """One forward/backward; returns (data, label, pred, loss).
+        Override for custom batch semantics (reference: fit_batch)."""
+        data, label = _as_nd(batch[0]), _as_nd(batch[1])
+        with autograd.record():
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss
+
+    def evaluate_batch(self, batch):
+        data, label = _as_nd(batch[0]), _as_nd(batch[1])
+        pred = self.net(data)
+        loss = self.loss(pred, label)
+        return data, label, pred, loss
+
+    # ------------------------------------------------------------- eval --
+    def evaluate(self, val_data, batch_axis=0):
+        """Run validation, updating val metrics (reference:
+        evaluate:272)."""
+        for m in self.val_metrics:
+            m.reset()
+        self.val_loss_metric.reset()
+        with autograd.pause(train_mode=False):
+            for batch in val_data:
+                _, label, pred, loss = self.evaluate_batch(batch)
+                for m in self.val_metrics:
+                    m.update(label, pred)
+                self.val_loss_metric.update(0, loss)
+        return {m.get()[0]: m.get()[1]
+                for m in self.val_metrics + [self.val_loss_metric]}
+
+    # -------------------------------------------------------------- fit --
+    def fit(self, train_data, val_data=None, epochs=None,
+            event_handlers=None, batches=None):
+        """Train for ``epochs`` epochs or ``batches`` batches
+        (reference: fit:326)."""
+        if epochs is None and batches is None:
+            epochs = 1
+        handlers = self._prepare_handlers(val_data, epochs, batches,
+                                          event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize(handlers)
+
+        self.stop_training = False
+        for h in train_begin:
+            h.train_begin(self)
+        while not self.stop_training:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            for batch in train_data:
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                data, label, pred, loss = self.fit_batch(batch)
+                for h in batch_end:
+                    h.batch_end(self, batch=batch, pred=pred,
+                                label=label, loss=loss)
+                self._sync_stop(handlers)
+                if self.stop_training:
+                    break
+            for h in epoch_end:
+                h.epoch_end(self)
+            self._sync_stop(handlers)
+        for h in train_end:
+            h.train_end(self)
+
+    def _sync_stop(self, handlers):
+        if any(getattr(h, "stop_training", False) for h in handlers):
+            self.stop_training = True
+
+    def _prepare_handlers(self, val_data, epochs, batches,
+                          event_handlers):
+        handlers = list(event_handlers or [])
+        # defaults mirror _prepare_default_handlers: stopping, gradient
+        # update, metrics; logging/validation only when asked for
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if not any(isinstance(h, GradientUpdateHandler)
+                   for h in handlers):
+            handlers.append(GradientUpdateHandler())
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(
+                self.train_metrics + [self.train_loss_metric]))
+        from .event_handler import ValidationHandler
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler)
+                        for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        key = lambda h: getattr(h, "priority", 0)  # noqa: E731
+        return sorted(handlers, key=key)
+
+    def _categorize(self, handlers):
+        return ([h for h in handlers if isinstance(h, TrainBegin)],
+                [h for h in handlers if isinstance(h, EpochBegin)],
+                [h for h in handlers if isinstance(h, BatchBegin)],
+                [h for h in handlers if isinstance(h, BatchEnd)],
+                [h for h in handlers if isinstance(h, EpochEnd)],
+                [h for h in handlers if isinstance(h, TrainEnd)])

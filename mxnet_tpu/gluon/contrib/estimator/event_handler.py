@@ -1,0 +1,318 @@
+"""Estimator event handlers.
+
+Reference: python/mxnet/gluon/contrib/estimator/event_handler.py
+(EventHandler:37, StoppingHandler:82, MetricHandler:122,
+ValidationHandler:160, LoggingHandler:226, CheckpointHandler:336,
+EarlyStoppingHandler, GradientUpdateHandler). Same mixin protocol: a
+handler subclasses one or more of the six phase bases and the Estimator
+dispatches each phase to every handler that implements it, ordered by
+``priority`` (lower runs first) where defined.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import numpy as _np
+
+__all__ = ["EventHandler", "TrainBegin", "TrainEnd", "EpochBegin",
+           "EpochEnd", "BatchBegin", "BatchEnd", "StoppingHandler",
+           "MetricHandler", "ValidationHandler", "LoggingHandler",
+           "CheckpointHandler", "EarlyStoppingHandler",
+           "GradientUpdateHandler"]
+
+
+class EventHandler:
+    pass
+
+
+class TrainBegin(EventHandler):
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd(EventHandler):
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin(EventHandler):
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd(EventHandler):
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin(EventHandler):
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd(EventHandler):
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch epochs or max_batch batches (reference:
+    event_handler.py:82)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch is not None and \
+                self.current_batch >= self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch is not None and \
+                self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset train metrics each epoch, update them each batch
+    (reference: event_handler.py:122)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics or []
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        from ....metric import Loss as _LossMetric
+        for m in self.metrics:
+            if isinstance(m, _LossMetric):
+                if loss is not None:
+                    m.update(0, loss)
+            elif pred is not None and label is not None:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation every ``epoch_period`` epochs / ``batch_period``
+    batches (reference: event_handler.py:160)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1,
+                 batch_period=None, priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period is not None and \
+                self.current_batch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period is not None and \
+                self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                     BatchBegin, BatchEnd):
+    """Log training progress (reference: event_handler.py:226).
+    ``log_interval`` is 'epoch' or a batch count."""
+
+    def __init__(self, log_interval="epoch", metrics=None,
+                 priority=_np.inf):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        t = time.time() - self.train_start
+        self.logger.info("Training finished in %.3fs", t)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+        self.processed_samples = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        batch = kwargs.get("batch")
+        if batch is not None:
+            try:
+                self.processed_samples += len(batch[0])
+            except Exception:
+                pass
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            msg = ", ".join(f"{m.get()[0]}={m.get()[1]:.4f}"
+                            for m in self.metrics)
+            self.logger.info("[epoch %d batch %d] %s",
+                             self.current_epoch, self.batch_index, msg)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        t = time.time() - self.epoch_start
+        msg = ", ".join(f"{m.get()[0]}={m.get()[1]:.4f}"
+                        for m in self.metrics)
+        self.logger.info("[epoch %d] finished in %.3fs: %s",
+                         self.current_epoch, t, msg)
+        self.current_epoch += 1
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save model+trainer state periodically; optionally only on metric
+    improvement (reference: event_handler.py:336)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="auto", epoch_period=1, batch_period=None,
+                 max_checkpoints=5, resume_from_checkpoint=False,
+                 save_best=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.save_best = save_best
+        self.saved = []
+        self.current_epoch = 0
+        self.current_batch = 0
+        if mode == "auto" and monitor is not None:
+            name = monitor.get()[0]
+            mode = "min" if "loss" in name or "error" in name else "max"
+        self._cmp = (lambda a, b: a < b) if mode == "min" else \
+            (lambda a, b: a > b)
+        self.best = None
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+
+    def _save(self, estimator, tag):
+        path = os.path.join(self.model_dir,
+                            f"{self.model_prefix}-{tag}.params")
+        estimator.net.save_parameters(path)
+        if estimator.trainer is not None and \
+                hasattr(estimator.trainer, "save_states"):
+            try:
+                estimator.trainer.save_states(path + ".states")
+            except Exception:
+                pass
+        self.saved.append(path)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            for f in (old, old + ".states"):
+                if os.path.exists(f):
+                    os.remove(f)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period is not None and \
+                self.current_batch % self.batch_period == 0:
+            self._save(estimator, f"batch{self.current_batch}")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period is not None and \
+                self.current_epoch % self.epoch_period == 0:
+            if self.save_best and self.monitor is not None:
+                val = self.monitor.get()[1]
+                if self.best is None or self._cmp(val, self.best):
+                    self.best = val
+                    self._save(estimator, "best")
+            else:
+                self._save(estimator, f"epoch{self.current_epoch}")
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when the monitored metric stops improving (reference:
+    event_handler.py EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        name = monitor.get()[0]
+        if mode == "auto":
+            mode = "min" if "loss" in name or "error" in name else "max"
+        self._mode = mode
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.stopped_epoch = None
+        self.current_epoch = 0
+        self.best = self.baseline if self.baseline is not None else (
+            _np.inf if self._mode == "min" else -_np.inf)
+
+    def _improved(self, val):
+        if self._mode == "min":
+            return val < self.best - self.min_delta
+        return val > self.best + self.min_delta
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        val = self.monitor.get()[1]
+        if self._improved(val):
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stop_training = True
+                self.stopped_epoch = self.current_epoch
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch is not None:
+            logging.getLogger("mxnet_tpu.estimator").info(
+                "Early stop at epoch %d: best %s=%.4f",
+                self.stopped_epoch, self.monitor.get()[0], self.best)
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Perform the trainer step after each batch (reference:
+    event_handler.py GradientUpdateHandler). Kept as a handler so users
+    can reorder/replace the update (e.g. gradient accumulation)."""
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        batch = kwargs.get("batch")
+        n = len(batch[0]) if batch is not None else 1
+        estimator.trainer.step(n)
