@@ -92,6 +92,24 @@ class Context:
         """Reference API parity (MXStorageEmptyCache): PJRT owns the HBM
         pool, so this is a no-op provided for compatibility."""
 
+    def memory_info(self):
+        """(free, total) bytes on this context's device — the SURVEY §7
+        memory-stats surface (reference: context.py:279 gpu_memory_info
+        → MXGetGPUMemoryInformation64). Backed by PJRT's
+        device.memory_stats(); returns (None, None) where the platform
+        does not expose allocator stats (e.g. host CPU)."""
+        try:
+            stats = self.jax_device.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            return (None, None)
+        total = stats.get("bytes_limit") or stats.get(
+            "bytes_reservable_limit")
+        in_use = stats.get("bytes_in_use", 0)
+        free = total - in_use if total is not None else None
+        return (free, total)
+
     @classmethod
     def default_ctx(cls) -> "Context":
         stack = getattr(cls._tls, "stack", None)
@@ -165,3 +183,14 @@ class _DefaultCtx:
 
 
 _DEFAULT = _DefaultCtx()
+
+
+def gpu_memory_info(device_id=0):
+    """(free, total) for an accelerator device (reference:
+    context.py:279 gpu_memory_info). On this framework 'gpu' and 'tpu'
+    name the same accelerator pool."""
+    return gpu(device_id).memory_info()
+
+
+def tpu_memory_info(device_id=0):
+    return tpu(device_id).memory_info()

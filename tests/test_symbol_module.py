@@ -169,3 +169,108 @@ def test_bucketing_module():
         mod.backward()
         mod.update()
     assert mod.get_outputs()[0].shape == (2, 4)
+
+
+def test_sequential_module_train():
+    """SequentialModule chains two Modules with auto-wiring and trains
+    (reference: module/sequential_module.py:28, tests test_module.py
+    test_module_layout/test_sequential)."""
+    from mxnet_tpu.module import SequentialModule, Module
+    from mxnet_tpu.io.io import DataBatch
+
+    d = mx.sym.var("data")
+    net1 = mx.sym.FullyConnected(d, name="fc1", num_hidden=16)
+    net1 = mx.sym.Activation(net1, act_type="relu", name="a1")
+    d2 = mx.sym.var("data")
+    net2 = mx.sym.FullyConnected(d2, name="fc2", num_hidden=4)
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+
+    seq = SequentialModule()
+    seq.add(Module(net1, label_names=None)) \
+       .add(Module(net2), take_labels=True, auto_wiring=True)
+
+    seq.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mx.random.seed(0)
+    seq.init_params(initializer=mx.initializer.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),
+                                         ("momentum", 0.9)))
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 10).astype(np.float32))
+    y = mx.nd.array((np.arange(8) % 4).astype(np.float32))
+
+    def step():
+        batch = DataBatch(data=[x], label=[y])
+        seq.forward(batch, is_train=True)
+        out = seq.get_outputs()[0].asnumpy()
+        seq.backward()
+        seq.update()
+        probs = out[np.arange(8), (np.arange(8) % 4)]
+        return -np.log(np.maximum(probs, 1e-9)).mean()
+
+    losses = [step() for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    arg_params, _ = seq.get_params()
+    assert "fc1_weight" in arg_params and "fc2_weight" in arg_params
+    assert seq.output_shapes == [(8, 4)]
+
+
+def test_python_loss_module_chain():
+    """PythonLossModule provides the loss gradient for the module below
+    it (reference: module/python_module.py:243)."""
+    from mxnet_tpu.module import SequentialModule, Module, PythonLossModule
+    from mxnet_tpu.io.io import DataBatch
+
+    d = mx.sym.var("data")
+    net = mx.sym.FullyConnected(d, name="fc", num_hidden=4)
+
+    def ce_grad(scores, labels):
+        s = scores.asnumpy()
+        e = np.exp(s - s.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        lab = labels.asnumpy().astype(int)
+        p[np.arange(len(lab)), lab] -= 1.0
+        return mx.nd.array(p / len(lab))
+
+    seq = SequentialModule()
+    seq.add(Module(net, label_names=None)) \
+       .add(PythonLossModule(grad_func=ce_grad), take_labels=True,
+            auto_wiring=True)
+    seq.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mx.random.seed(1)
+    seq.init_params(initializer=mx.initializer.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 1.0),))
+
+    rng = np.random.RandomState(1)
+    x = mx.nd.array(rng.randn(8, 6).astype(np.float32))
+    y = mx.nd.array((np.arange(8) % 4).astype(np.float32))
+
+    def loss_now():
+        seq.forward(DataBatch(data=[x], label=[y]), is_train=True)
+        s = seq.get_outputs()[0].asnumpy()
+        e = np.exp(s - s.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        return -np.log(p[np.arange(8), (np.arange(8) % 4)]).mean()
+
+    l0 = loss_now()
+    for _ in range(20):
+        seq.forward(DataBatch(data=[x], label=[y]), is_train=True)
+        seq.backward()
+        seq.update()
+    l1 = loss_now()
+    assert l1 < l0 * 0.5, (l0, l1)
+
+
+def test_context_memory_info_surface():
+    """memory_info degrades gracefully where PJRT exposes no stats and
+    returns (free, total) ints where it does (SURVEY §7 memory-stats)."""
+    free, total = mx.context.current_context().memory_info()
+    assert free is None or isinstance(free, int)
+    assert total is None or isinstance(total, int)
+    f2, t2 = mx.context.gpu_memory_info(0)
+    assert f2 is None or isinstance(f2, int)
