@@ -1,0 +1,172 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference implements its data pipeline in C++ (dmlc-core recordio +
+src/io/ prefetching iterators); this package holds the TPU-native
+equivalents. Each component compiles on first use with the host
+toolchain (g++) into ``_build/`` and is cached by source mtime; every
+caller keeps a pure-Python fallback, so a missing toolchain degrades
+gracefully (set MXNET_TPU_NATIVE=0 to force the fallback).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["recordio_lib", "native_enabled"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "recordio_native.cpp")
+_BUILD = os.path.join(_DIR, "_build")
+_SO = os.path.join(_BUILD, "librecordio_native.so")
+
+_lock = threading.Lock()
+_lib = "unset"
+
+
+def native_enabled() -> bool:
+    return os.environ.get("MXNET_TPU_NATIVE", "1") != "0"
+
+
+def _build():
+    os.makedirs(_BUILD, exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _SO + ".tmp"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_SO + ".tmp", _SO)
+
+
+def recordio_lib():
+    """The compiled recordio library, or None (no toolchain / disabled).
+    Thread-safe; compiles at most once per process."""
+    global _lib
+    if not native_enabled():   # honored per call, not only at first load
+        return None
+    if _lib != "unset":
+        return _lib
+    with _lock:
+        if _lib != "unset":
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            _lib = None
+            return None
+        lib.rio_open_reader.restype = ctypes.c_void_p
+        lib.rio_open_reader.argtypes = [ctypes.c_char_p]
+        lib.rio_read.restype = ctypes.c_long
+        lib.rio_read.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.POINTER(
+                                     ctypes.c_ubyte))]
+        lib.rio_read_at.restype = ctypes.c_long
+        lib.rio_read_at.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                    ctypes.POINTER(ctypes.POINTER(
+                                        ctypes.c_ubyte))]
+        lib.rio_seek.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.rio_tell.restype = ctypes.c_long
+        lib.rio_tell.argtypes = [ctypes.c_void_p]
+        lib.rio_error.restype = ctypes.c_char_p
+        lib.rio_error.argtypes = [ctypes.c_void_p]
+        lib.rio_close.argtypes = [ctypes.c_void_p]
+        lib.rio_open_prefetch.restype = ctypes.c_void_p
+        lib.rio_open_prefetch.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.rio_pf_read.restype = ctypes.c_long
+        lib.rio_pf_read.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.POINTER(
+                                        ctypes.c_ubyte))]
+        lib.rio_pf_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeRecordReader:
+    """Sequential/indexed reader over the C++ core."""
+
+    def __init__(self, path):
+        lib = recordio_lib()
+        if lib is None:
+            raise RuntimeError("native recordio unavailable")
+        self._lib = lib
+        self._h = lib.rio_open_reader(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path!r}")
+
+    def read(self):
+        buf = ctypes.POINTER(ctypes.c_ubyte)()
+        n = self._lib.rio_read(self._h, ctypes.byref(buf))
+        if n == -1:
+            return None
+        if n < 0:
+            raise IOError(self._lib.rio_error(self._h).decode())
+        return ctypes.string_at(buf, n)
+
+    def read_at(self, pos):
+        buf = ctypes.POINTER(ctypes.c_ubyte)()
+        n = self._lib.rio_read_at(self._h, pos, ctypes.byref(buf))
+        if n == -1:
+            return None
+        if n < 0:
+            raise IOError(self._lib.rio_error(self._h).decode())
+        return ctypes.string_at(buf, n)
+
+    def seek(self, pos):
+        self._lib.rio_seek(self._h, pos)
+
+    def tell(self):
+        return self._lib.rio_tell(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativePrefetchReader:
+    """Background-thread prefetching reader (the C++ thread reads ahead
+    ``queue_size`` records while Python consumes)."""
+
+    def __init__(self, path, queue_size=64):
+        lib = recordio_lib()
+        if lib is None:
+            raise RuntimeError("native recordio unavailable")
+        self._lib = lib
+        self._h = lib.rio_open_prefetch(path.encode(), int(queue_size))
+        if not self._h:
+            raise IOError(f"cannot open {path!r}")
+
+    def read(self):
+        buf = ctypes.POINTER(ctypes.c_ubyte)()
+        n = self._lib.rio_pf_read(self._h, ctypes.byref(buf))
+        if n == -1:
+            return None
+        if n < 0:
+            raise IOError("prefetch reader failed")
+        return ctypes.string_at(buf, n)
+
+    def __iter__(self):
+        while True:
+            rec = self.read()
+            if rec is None:
+                return
+            yield rec
+
+    def close(self):
+        if self._h:
+            self._lib.rio_pf_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

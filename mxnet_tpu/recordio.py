@@ -36,12 +36,21 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        self._native = None
         if self.flag == "w":
             self.fhandle = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
             self.fhandle = open(self.uri, "rb")
             self.writable = False
+            # fast path: the C++ reader (mxnet_tpu/native) parses and
+            # assembles records off the GIL; transparently falls back to
+            # the pure-Python parser when no toolchain is available
+            try:
+                from .native import NativeRecordReader
+                self._native = NativeRecordReader(self.uri)
+            except Exception:
+                self._native = None
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.pid = os.getpid()
@@ -56,6 +65,7 @@ class MXRecordIO:
         d = dict(self.__dict__)
         d["is_open"] = is_open
         d.pop("fhandle", None)
+        d.pop("_native", None)
         return d
 
     def __setstate__(self, d):
@@ -78,6 +88,9 @@ class MXRecordIO:
     def close(self):
         if not self.is_open:
             return
+        if getattr(self, "_native", None) is not None:
+            self._native.close()
+            self._native = None
         self.fhandle.close()
         self.is_open = False
         self.pid = None
@@ -140,6 +153,8 @@ class MXRecordIO:
         the split points (dmlc-core ReadRecord semantics)."""
         assert not self.writable
         self._check_pid(allow_reset=True)
+        if self._native is not None:
+            return self._native.read()
         cflag, buf = self._read_chunk()
         if buf is None:
             return None
@@ -158,6 +173,8 @@ class MXRecordIO:
         return magic_bytes.join(parts)
 
     def tell(self):
+        if getattr(self, "_native", None) is not None and not self.writable:
+            return self._native.tell()
         return self.fhandle.tell()
 
 
@@ -195,7 +212,10 @@ class MXIndexedRecordIO(MXRecordIO):
     def seek(self, idx):
         assert not self.writable
         self._check_pid(allow_reset=True)
-        self.fhandle.seek(self.idx[idx])
+        if self._native is not None:
+            self._native.seek(self.idx[idx])
+        else:
+            self.fhandle.seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)
