@@ -40,7 +40,7 @@ _LAZY_MODULES = ("numpy", "numpy_extension", "symbol", "gluon", "module",
                  "image", "parallel", "profiler", "lr_scheduler",
                  "callback", "test_utils", "util", "runtime", "amp",
                  "recordio", "executor", "monitor", "model", "operator",
-                 "contrib")
+                 "contrib", "onnx", "native")
 
 _ALIAS = {"np": "numpy", "npx": "numpy_extension", "sym": "symbol",
           "mod": "module", "kv": "kvstore"}

@@ -1,0 +1,10 @@
+"""mx.onnx — ONNX export/import.
+
+Reference: python/mxnet/contrib/onnx/ (mx2onnx + onnx2mx, ~8k LoC over
+the onnx package). The TPU build ships its own minimal protobuf wire
+codec (_proto.py), so models serialize to standard ONNX (opset 13)
+without any onnx/protobuf dependency; the same codec powers the
+importer, and tests roundtrip models through both.
+"""
+from .export import export_model  # noqa: F401
+from .import_ import import_model  # noqa: F401

@@ -1,0 +1,115 @@
+"""Minimal protobuf wire-format encoder/decoder (no protobuf dependency).
+
+Implements exactly the subset of proto3 wire format ONNX model files
+use: varint (wire type 0), 64-bit (1), length-delimited (2), 32-bit
+(5). The ONNX schema constants live in onnx_spec.py; this module knows
+nothing about ONNX itself.
+
+Encoding: build messages as lists of (field_number, wire_type, value)
+where value is int (varint/fixed), bytes (length-delimited), or float
+(fixed32/64). Decoding: parse bytes into {field_number: [raw values]}
+— length-delimited values come back as bytes for the caller to decode
+recursively.
+"""
+from __future__ import annotations
+
+import struct
+
+VARINT, FIXED64, LEN, FIXED32 = 0, 1, 2, 5
+
+
+def encode_varint(v: int) -> bytes:
+    if v < 0:                      # proto int64 negative: 10-byte varint
+        v += 1 << 64
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return encode_varint((field << 3) | wire)
+
+
+def encode(fields) -> bytes:
+    """fields: iterable of (field_number, wire_type, value)."""
+    out = bytearray()
+    for field, wire, value in fields:
+        out += _tag(field, wire)
+        if wire == VARINT:
+            out += encode_varint(int(value))
+        elif wire == LEN:
+            if isinstance(value, str):
+                value = value.encode()
+            out += encode_varint(len(value))
+            out += value
+        elif wire == FIXED32:
+            out += struct.pack("<f", float(value))
+        elif wire == FIXED64:
+            out += struct.pack("<d", float(value))
+        else:
+            raise ValueError(f"wire type {wire}")
+    return bytes(out)
+
+
+def packed_varints(values) -> bytes:
+    out = bytearray()
+    for v in values:
+        out += encode_varint(int(v))
+    return bytes(out)
+
+
+def decode_varint(buf: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            if result >= 1 << 63:   # negative int64
+                result -= 1 << 64
+            return result, pos
+        shift += 7
+
+
+def decode(buf: bytes):
+    """-> {field_number: [value, ...]} (bytes for LEN, int for VARINT,
+    float for FIXED32/64). Packed repeated scalars arrive as one bytes
+    value — use decode_packed_varints on it."""
+    out = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = decode_varint(buf, pos)
+        field, wire = key >> 3, key & 0x7
+        if wire == VARINT:
+            value, pos = decode_varint(buf, pos)
+        elif wire == LEN:
+            length, pos = decode_varint(buf, pos)
+            value = buf[pos:pos + length]
+            pos += length
+        elif wire == FIXED32:
+            value = struct.unpack_from("<f", buf, pos)[0]
+            pos += 4
+        elif wire == FIXED64:
+            value = struct.unpack_from("<d", buf, pos)[0]
+            pos += 8
+        else:
+            raise ValueError(f"wire type {wire} at {pos}")
+        out.setdefault(field, []).append(value)
+    return out
+
+
+def decode_packed_varints(buf: bytes):
+    vals = []
+    pos = 0
+    while pos < len(buf):
+        v, pos = decode_varint(buf, pos)
+        vals.append(v)
+    return vals

@@ -1,0 +1,256 @@
+"""Symbol+params -> ONNX ModelProto bytes.
+
+Reference: python/mxnet/contrib/onnx/mx2onnx/export_model.py + the
+per-op converters in _op_translations.py. Same translation table for
+the core CNN/MLP set; serialization is the hand-rolled wire-format
+encoder in _proto.py (the environment ships no onnx/protobuf package),
+emitting standard ONNX (ir_version 8, opset 13) that any ONNX runtime
+loads.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import _proto as P
+
+# ONNX TensorProto.DataType
+TP_FLOAT, TP_INT32, TP_INT64 = 1, 6, 7
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_INTS = 1, 2, 3, 7
+
+_DT = {_np.dtype(_np.float32): TP_FLOAT, _np.dtype(_np.int32): TP_INT32,
+       _np.dtype(_np.int64): TP_INT64}
+
+
+def _attr(name, atype, value):
+    fields = [(1, P.LEN, name), (20, P.VARINT, atype)]
+    if atype == AT_FLOAT:
+        fields.append((2, P.FIXED32, value))
+    elif atype == AT_INT:
+        fields.append((3, P.VARINT, value))
+    elif atype == AT_STRING:
+        fields.append((4, P.LEN, value))
+    elif atype == AT_INTS:
+        fields += [(8, P.VARINT, v) for v in value]
+    return (5, P.LEN, P.encode(fields))
+
+
+def _node(op_type, inputs, outputs, name, attrs=()):
+    fields = [(1, P.LEN, i) for i in inputs]
+    fields += [(2, P.LEN, o) for o in outputs]
+    fields += [(3, P.LEN, name), (4, P.LEN, op_type)]
+    fields += list(attrs)
+    return (1, P.LEN, P.encode(fields))
+
+
+def _tensor(name, arr):
+    arr = _np.ascontiguousarray(arr)
+    dt = _DT.get(arr.dtype)
+    if dt is None:
+        arr = arr.astype(_np.float32)
+        dt = TP_FLOAT
+    fields = [(1, P.VARINT, d) for d in arr.shape]
+    fields += [(2, P.VARINT, dt), (8, P.LEN, name),
+               (9, P.LEN, arr.tobytes())]
+    return P.encode(fields)
+
+
+def _value_info(name, shape, dt=TP_FLOAT):
+    dims = P.encode([(1, P.VARINT, int(d)) for d in shape])
+    shape_p = P.encode([(1, P.LEN, d) for d in
+                        (P.encode([(1, P.VARINT, int(x))])
+                         for x in shape)])
+    tensor_t = P.encode([(1, P.VARINT, dt), (2, P.LEN, shape_p)])
+    type_p = P.encode([(1, P.LEN, tensor_t)])
+    return P.encode([(1, P.LEN, name), (2, P.LEN, type_p)])
+
+
+def _ints(params, key, default):
+    v = params.get(key, default)
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        v = (int(v),)
+    return [int(x) for x in v]
+
+
+class _Ctx:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.n = 0
+
+    def name(self, base):
+        self.n += 1
+        return f"{base}_{self.n}"
+
+
+def _convert(node, ins, out, ctx):
+    """One symbol node -> ONNX node(s). ins: input value names."""
+    op = node._op
+    p = node._params
+    nm = node._name
+
+    if op in ("FullyConnected",):
+        no_bias = bool(p.get("no_bias", False))
+        # Gemm(B transposed) matches FullyConnected exactly, but needs
+        # 2-D input: insert a Flatten like the reference converter
+        flat = ctx.name(nm + "_flatten")
+        ctx.nodes.append(_node("Flatten", [ins[0]], [flat],
+                               flat, [_attr("axis", AT_INT, 1)]))
+        attrs = [_attr("transB", AT_INT, 1)]
+        inputs = [flat, ins[1]] + ([] if no_bias else [ins[2]])
+        ctx.nodes.append(_node("Gemm", inputs, [out], nm, attrs))
+    elif op == "Convolution":
+        attrs = [_attr("kernel_shape", AT_INTS, _ints(p, "kernel", ()))]
+        stride = _ints(p, "stride", (1, 1))
+        pad = _ints(p, "pad", (0, 0))
+        dil = _ints(p, "dilate", (1, 1))
+        attrs += [_attr("strides", AT_INTS, stride),
+                  _attr("pads", AT_INTS, pad + pad),
+                  _attr("dilations", AT_INTS, dil),
+                  _attr("group", AT_INT, int(p.get("num_group", 1)))]
+        no_bias = bool(p.get("no_bias", False))
+        inputs = ins[:2] if no_bias else ins[:3]
+        ctx.nodes.append(_node("Conv", inputs, [out], nm, attrs))
+    elif op == "Pooling":
+        ptype = p.get("pool_type", "max")
+        if p.get("global_pool", False):
+            op_t = "GlobalAveragePool" if ptype == "avg" else \
+                "GlobalMaxPool"
+            ctx.nodes.append(_node(op_t, [ins[0]], [out], nm))
+        else:
+            op_t = "AveragePool" if ptype == "avg" else "MaxPool"
+            stride = _ints(p, "stride", (1, 1))
+            pad = _ints(p, "pad", (0, 0))
+            attrs = [_attr("kernel_shape", AT_INTS,
+                           _ints(p, "kernel", ())),
+                     _attr("strides", AT_INTS, stride),
+                     _attr("pads", AT_INTS, pad + pad)]
+            ctx.nodes.append(_node(op_t, [ins[0]], [out], nm, attrs))
+    elif op == "BatchNorm":
+        attrs = [_attr("epsilon", AT_FLOAT, float(p.get("eps", 1e-3))),
+                 _attr("momentum", AT_FLOAT,
+                       float(p.get("momentum", 0.9)))]
+        ctx.nodes.append(_node("BatchNormalization", ins[:5], [out], nm,
+                               attrs))
+    elif op == "Activation":
+        act = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+               "softrelu": "Softplus"}[p.get("act_type", "relu")]
+        ctx.nodes.append(_node(act, [ins[0]], [out], nm))
+    elif op == "LeakyReLU":
+        ctx.nodes.append(_node(
+            "LeakyRelu", [ins[0]], [out], nm,
+            [_attr("alpha", AT_FLOAT, float(p.get("slope", 0.25)))]))
+    elif op in ("SoftmaxOutput", "softmax", "Softmax"):
+        ctx.nodes.append(_node("Softmax", [ins[0]], [out], nm,
+                               [_attr("axis", AT_INT,
+                                      int(p.get("axis", -1)))]))
+    elif op in ("Flatten", "flatten"):
+        ctx.nodes.append(_node("Flatten", [ins[0]], [out], nm,
+                               [_attr("axis", AT_INT, 1)]))
+    elif op in ("elemwise_add", "broadcast_add", "_plus", "_add"):
+        ctx.nodes.append(_node("Add", ins[:2], [out], nm))
+    elif op in ("elemwise_mul", "broadcast_mul"):
+        ctx.nodes.append(_node("Mul", ins[:2], [out], nm))
+    elif op in ("elemwise_sub", "broadcast_sub"):
+        ctx.nodes.append(_node("Sub", ins[:2], [out], nm))
+    elif op in ("Concat", "concat"):
+        ctx.nodes.append(_node("Concat", ins, [out], nm,
+                               [_attr("axis", AT_INT,
+                                      int(p.get("dim", 1)))]))
+    elif op in ("Reshape", "reshape"):
+        shape = [int(s) for s in p.get("shape", ())]
+        shp_name = ctx.name(nm + "_shape")
+        ctx.initializers.append(_tensor(
+            shp_name, _np.asarray(shape, _np.int64)))
+        ctx.nodes.append(_node("Reshape", [ins[0], shp_name], [out], nm))
+    elif op == "Dropout":
+        # inference export: Identity (reference does the same for
+        # non-training exports)
+        ctx.nodes.append(_node("Identity", [ins[0]], [out], nm))
+    else:
+        raise NotImplementedError(
+            f"ONNX export: no converter for op {op!r} (reference "
+            "converter table: mx2onnx/_op_translations.py)")
+
+
+def export_model(sym, params, input_shapes, input_dtypes=None,
+                 onnx_file_path=None, model_name="mxnet_tpu"):
+    """Export a Symbol + params dict to ONNX bytes (reference:
+    contrib/onnx/mx2onnx/export_model.py:33). ``input_shapes``:
+    {input_name: shape}. Returns the serialized ModelProto; writes it
+    to ``onnx_file_path`` when given."""
+    from ..ndarray import NDArray
+
+    params = {k: (v.asnumpy() if isinstance(v, NDArray) else
+                  _np.asarray(v)) for k, v in (params or {}).items()}
+
+    ctx = _Ctx()
+    topo = sym._topo()
+    # graph outputs: the symbol's outputs
+    out_names = {}
+
+    # BatchNorm fix_gamma=True (the MXNet default) ignores gamma; ONNX
+    # BatchNormalization has no such switch, so fold it by exporting
+    # gamma as ones (reference converter does the same)
+    force_ones = set()
+    for node in topo:
+        if node._op == "BatchNorm" and node._params.get("fix_gamma",
+                                                        True):
+            if len(node._inputs) > 1:
+                force_ones.add(node._inputs[1]._name)
+
+    graph_inputs = []
+    for node in topo:
+        if node._is_var():
+            if node._name in params:
+                val = params[node._name]
+                if node._name in force_ones:
+                    val = _np.ones_like(val)
+                ctx.initializers.append(_tensor(node._name, val))
+            elif node._name in input_shapes:
+                graph_inputs.append(_value_info(
+                    node._name, input_shapes[node._name]))
+            elif node._name.endswith("_label"):
+                continue            # loss labels don't export
+            else:
+                raise ValueError(
+                    f"input {node._name!r} needs a shape in "
+                    "input_shapes or a value in params")
+            out_names[id(node)] = node._name
+        else:
+            ins = [out_names[id(i)] for i in node._inputs
+                   if id(i) in out_names]
+            out = node._name + "_out"
+            _convert(node, ins, out, ctx)
+            out_names[id(node)] = out
+
+    final = out_names[id(topo[-1])]
+    # infer output shape for the value_info via eval_shape
+    shapes = dict(input_shapes)
+    try:
+        _, out_shapes, _ = sym.infer_shape(**input_shapes)
+        out_shape = out_shapes[0]
+    except Exception:
+        out_shape = ()
+    graph_outputs = [_value_info(final, out_shape)]
+
+    graph = P.encode(
+        ctx.nodes
+        + [(2, P.LEN, model_name)]
+        + [(5, P.LEN, t) for t in ctx.initializers]
+        + [(11, P.LEN, vi) for vi in graph_inputs]
+        + [(12, P.LEN, vo) for vo in graph_outputs])
+
+    opset = P.encode([(1, P.LEN, ""), (2, P.VARINT, 13)])
+    model = P.encode([
+        (1, P.VARINT, 8),                       # ir_version
+        (2, P.LEN, "mxnet_tpu"),                # producer_name
+        (7, P.LEN, graph),
+        (8, P.LEN, opset),
+    ])
+    if onnx_file_path:
+        with open(onnx_file_path, "wb") as f:
+            f.write(model)
+    return model

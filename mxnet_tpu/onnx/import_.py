@@ -1,0 +1,186 @@
+"""ONNX ModelProto bytes -> Symbol + params.
+
+Reference: python/mxnet/contrib/onnx/onnx2mx/import_model.py + the
+per-op mappings in _op_translations.py. Covers the same core set the
+exporter emits, so export -> import roundtrips numerically.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import _proto as P
+from .export import (AT_FLOAT, AT_INT, AT_INTS, AT_STRING, TP_FLOAT,
+                     TP_INT32, TP_INT64)
+
+_DT_NP = {TP_FLOAT: _np.float32, TP_INT32: _np.int32, TP_INT64: _np.int64}
+
+
+def _parse_attrs(node_msg):
+    attrs = {}
+    for raw in node_msg.get(5, []):
+        a = P.decode(raw)
+        name = a[1][0].decode()
+        atype = a.get(20, [0])[0]
+        if atype == AT_FLOAT:
+            attrs[name] = a[2][0]
+        elif atype == AT_INT:
+            attrs[name] = a[3][0]
+        elif atype == AT_STRING:
+            attrs[name] = a[4][0].decode()
+        elif atype == AT_INTS:
+            ints = a.get(8, [])
+            if len(ints) == 1 and isinstance(ints[0], bytes):
+                ints = P.decode_packed_varints(ints[0])
+            attrs[name] = [int(v) for v in ints]
+    return attrs
+
+
+def _parse_tensor(raw):
+    t = P.decode(raw)
+    dims = [int(d) for d in t.get(1, [])]
+    if len(dims) == 1 and isinstance(dims[0], bytes):
+        dims = P.decode_packed_varints(dims[0])
+    dt = _DT_NP[t.get(2, [TP_FLOAT])[0]]
+    name = t.get(8, [b""])[0].decode()
+    if 9 in t:                      # raw_data
+        arr = _np.frombuffer(t[9][0], dt).reshape(dims)
+    elif 4 in t:                    # float_data
+        arr = _np.asarray(t[4], _np.float32).reshape(dims)
+    elif 7 in t:                    # int64_data
+        arr = _np.asarray(t[7], _np.int64).reshape(dims)
+    else:
+        arr = _np.zeros(dims, dt)
+    return name, arr
+
+
+def import_model(model_bytes):
+    """-> (sym, arg_params, aux_params) (reference:
+    onnx2mx/import_model.py:32). Accepts bytes or a file path."""
+    import mxnet_tpu as mx
+    from ..ndarray import NDArray
+
+    if isinstance(model_bytes, str):
+        with open(model_bytes, "rb") as f:
+            model_bytes = f.read()
+
+    model = P.decode(model_bytes)
+    graph = P.decode(model[7][0])
+
+    inits = {}
+    for raw in graph.get(5, []):
+        name, arr = _parse_tensor(raw)
+        inits[name] = arr
+
+    values = {}          # onnx value name -> Symbol
+    for raw in graph.get(11, []):   # graph inputs
+        vi = P.decode(raw)
+        name = vi[1][0].decode()
+        if name not in inits:
+            values[name] = mx.sym.var(name)
+
+    arg_params, aux_params = {}, {}
+
+    def sym_of(name):
+        if name in values:
+            return values[name]
+        if name in inits:
+            v = mx.sym.var(name)
+            values[name] = v
+            if name.endswith(("_moving_mean", "_moving_var",
+                              "_running_mean", "_running_var")):
+                aux_params[name] = NDArray(inits[name])
+            else:
+                arg_params[name] = NDArray(inits[name])
+            return v
+        raise KeyError(f"undefined ONNX value {name!r}")
+
+    last = None
+    for raw in graph.get(1, []):    # nodes, topologically ordered
+        msg = P.decode(raw)
+        ins = [v.decode() for v in msg.get(1, [])]
+        outs = [v.decode() for v in msg.get(2, [])]
+        name = msg.get(3, [b""])[0].decode()
+        op = msg[4][0].decode()
+        attrs = _parse_attrs(msg)
+        last = _make(op, ins, outs, name, attrs, sym_of, values, inits)
+    return last, arg_params, aux_params
+
+
+def _make(op, ins, outs, name, attrs, sym_of, values, inits):
+    import mxnet_tpu as mx
+
+    if op == "Gemm":
+        assert attrs.get("transB", 0) == 1, "only transB=1 Gemm"
+        data = sym_of(ins[0])
+        w = sym_of(ins[1])
+        num_hidden = inits[ins[1]].shape[0]
+        if len(ins) > 2:
+            out = mx.sym.FullyConnected(
+                data, w, sym_of(ins[2]), name=name,
+                num_hidden=num_hidden)
+        else:
+            out = mx.sym.FullyConnected(data, w, name=name,
+                                        num_hidden=num_hidden,
+                                        no_bias=True)
+    elif op == "Conv":
+        kwargs = dict(kernel=tuple(attrs["kernel_shape"]),
+                      stride=tuple(attrs.get("strides", (1, 1))),
+                      dilate=tuple(attrs.get("dilations", (1, 1))),
+                      pad=tuple(attrs.get("pads", (0, 0, 0, 0))[:2]),
+                      num_group=int(attrs.get("group", 1)),
+                      num_filter=inits[ins[1]].shape[0], name=name)
+        if len(ins) > 2:
+            out = mx.sym.Convolution(sym_of(ins[0]), sym_of(ins[1]),
+                                     sym_of(ins[2]), **kwargs)
+        else:
+            out = mx.sym.Convolution(sym_of(ins[0]), sym_of(ins[1]),
+                                     no_bias=True, **kwargs)
+    elif op in ("MaxPool", "AveragePool"):
+        out = mx.sym.Pooling(
+            sym_of(ins[0]), kernel=tuple(attrs["kernel_shape"]),
+            stride=tuple(attrs.get("strides", (1, 1))),
+            pad=tuple(attrs.get("pads", (0, 0, 0, 0))[:2]),
+            pool_type="max" if op == "MaxPool" else "avg", name=name)
+    elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+        out = mx.sym.Pooling(
+            sym_of(ins[0]), kernel=(1, 1), global_pool=True,
+            pool_type="max" if op == "GlobalMaxPool" else "avg",
+            name=name)
+    elif op == "BatchNormalization":
+        out = mx.sym.BatchNorm(
+            *[sym_of(i) for i in ins[:5]], name=name,
+            eps=float(attrs.get("epsilon", 1e-5)),
+            momentum=float(attrs.get("momentum", 0.9)),
+            fix_gamma=False)
+    elif op in ("Relu", "Sigmoid", "Tanh", "Softplus"):
+        act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+               "Softplus": "softrelu"}[op]
+        out = mx.sym.Activation(sym_of(ins[0]), act_type=act, name=name)
+    elif op == "LeakyRelu":
+        out = mx.sym.LeakyReLU(sym_of(ins[0]),
+                               slope=float(attrs.get("alpha", 0.01)),
+                               name=name)
+    elif op == "Softmax":
+        out = mx.sym.softmax(sym_of(ins[0]),
+                             axis=int(attrs.get("axis", -1)), name=name)
+    elif op == "Flatten":
+        out = mx.sym.Flatten(sym_of(ins[0]), name=name)
+    elif op == "Add":
+        out = sym_of(ins[0]) + sym_of(ins[1])
+    elif op == "Mul":
+        out = sym_of(ins[0]) * sym_of(ins[1])
+    elif op == "Sub":
+        out = sym_of(ins[0]) - sym_of(ins[1])
+    elif op == "Concat":
+        out = mx.sym.Concat(*[sym_of(i) for i in ins],
+                            dim=int(attrs.get("axis", 1)), name=name)
+    elif op == "Reshape":
+        shape = tuple(int(s) for s in inits[ins[1]])
+        out = mx.sym.Reshape(sym_of(ins[0]), shape=shape, name=name)
+    elif op == "Identity":
+        out = sym_of(ins[0])
+    else:
+        raise NotImplementedError(
+            f"ONNX import: no mapping for op {op!r}")
+    values[outs[0]] = out
+    return out
