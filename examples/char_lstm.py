@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Character-level LSTM language model with gluon.rnn.
+
+Reference example: example/gluon/char_lstm via example/rnn. Trains on
+an embedded corpus (no egress); the fused lax.scan LSTM (ops/rnn.py)
+is the compute path.
+
+  python examples/char_lstm.py --epochs 3
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, nd  # noqa: E402
+from mxnet_tpu.gluon import nn, rnn  # noqa: E402
+import mxnet_tpu.autograd as ag  # noqa: E402
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+) * 12
+
+
+class CharLM(gluon.Block):
+    def __init__(self, vocab, hidden=64, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.emb = nn.Embedding(vocab, 32)
+            self.lstm = rnn.LSTM(hidden, num_layers=1, layout="NTC")
+            self.out = nn.Dense(vocab, flatten=False)
+
+    def forward(self, x):
+        return self.out(self.lstm(self.emb(x)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--batch-size", type=int, default=8)
+    args = ap.parse_args()
+
+    chars = sorted(set(CORPUS))
+    c2i = {c: i for i, c in enumerate(chars)}
+    ids = np.array([c2i[c] for c in CORPUS], np.int32)
+
+    T, B = args.seq_len, args.batch_size
+    n = (len(ids) - 1) // T
+    xs = ids[:n * T].reshape(n, T)
+    ys = ids[1:n * T + 1].reshape(n, T)
+
+    mx.random.seed(0)
+    net = CharLM(len(chars))
+    net.initialize(init=mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total, count = 0.0, 0
+        for i in range(0, n - B + 1, B):
+            x = nd.array(xs[i:i + B])
+            y = nd.array(ys[i:i + B])
+            with ag.record():
+                logits = net(x)
+                loss = loss_fn(logits.reshape((-1, len(chars))),
+                               y.reshape((-1,))).mean()
+            loss.backward()
+            trainer.step(B)
+            total += float(loss.asnumpy())
+            count += 1
+        ppl = float(np.exp(total / max(count, 1)))
+        print(f"epoch {epoch}: perplexity {ppl:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Train an MLP classifier with the Module API.
+
+Reference example: example/image-classification/train_mnist.py. This
+environment has no network egress, so data is a synthetic MNIST-shaped
+problem (random images, learnable structure via a fixed teacher); swap
+`synthetic_mnist` for mx.io.NDArrayIter over real MNIST arrays to train
+the real thing — the Module flow is identical.
+
+  python examples/train_mnist_mlp.py [--epochs 3] [--batch-size 64]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+
+
+def synthetic_mnist(n=2048, seed=0):
+    """Random 28x28 images whose label is decided by a FIXED random
+    teacher projection — the same teacher for every split, so train and
+    validation measure the same learnable rule."""
+    teacher = np.random.RandomState(42).randn(784, 10).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 784).astype(np.float32)
+    y = (x @ teacher).argmax(axis=1).astype(np.float32)
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=64)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc3", num_hidden=10)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    x, y = synthetic_mnist()
+    xv, yv = synthetic_mnist(512, seed=1)
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(xv, yv, args.batch_size)
+
+    mod = mx.mod.Module(net)
+    mod.fit(train, eval_data=val, num_epoch=args.epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, 20))
+    score = mod.score(val, mx.metric.Accuracy())
+    print("validation:", dict(score) if not isinstance(score, dict)
+          else score)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,20 @@ Layer map (TPU-native redesign of the reference's, see SURVEY.md §1):
 """
 __version__ = "2.0.0.tpu0"
 
+# Honor JAX_PLATFORMS before any backend touch: a site hook can register
+# accelerator plugins that ignore the env var, so explicit platform
+# selection (CPU-only runs, tests, tools) must be synced into the jax
+# config here — otherwise the first jax.devices() call may block trying
+# to reach an accelerator the user explicitly opted out of.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax as _jax
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
 from . import base
 from .base import MXNetError
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,

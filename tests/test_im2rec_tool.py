@@ -1,0 +1,46 @@
+"""tools/im2rec.py end-to-end (reference: tools/im2rec.py list+pack)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_im2rec_list_and_pack(tmp_path):
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            img = (rng.rand(40, 40, 3) * 255).astype(np.uint8)
+            cv2.imwrite(str(d / f"{i}.jpg"), img)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    prefix = str(tmp_path / "data")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "im2rec.py"),
+         "--list", "--recursive", prefix, str(tmp_path / "imgs")],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    labels = {line.split("\t")[1] for line in lines}
+    assert labels == {"0", "1"}          # two classes -> two labels
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "im2rec.py"),
+         prefix, str(tmp_path / "imgs"), "--resize", "32"],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+
+    from mxnet_tpu import recordio
+    rd = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(rd.keys) == 6
+    hdr, img = recordio.unpack_img(rd.read_idx(rd.keys[0]))
+    assert min(img.shape[:2]) == 32      # shorter edge resized
+    rd.close()
