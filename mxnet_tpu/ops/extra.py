@@ -773,3 +773,686 @@ def _sign_ste_core(x):
 _sign_ste_core.defvjp(lambda x: (jnp.sign(x), None),
                       lambda _, g: (g,))
 _reg("_contrib_sign_ste", lambda data: _sign_ste_core(data))
+
+
+# ===================================================================
+# round-3 tail: transformer interleaved matmuls, image frontend ops,
+# npx/npi internals, packed-triangular linalg, scatter family, sync BN,
+# correlation, count-sketch, bipartite matching.
+# ===================================================================
+
+# ---------------------------------------------------- transformer ----
+# reference: src/operator/contrib/transformer.cc:650-780. Layouts:
+# qkv (T, B, 3*H*D) interleaved; attention maps (B*H, Tq, Tk).
+
+def _selfatt_split(qkv, heads, idx):
+    t, b, _ = qkv.shape
+    tmp = qkv.reshape(t, b, heads, 3, -1)
+    proj = jnp.transpose(tmp[:, :, :, idx, :], (1, 2, 0, 3))
+    return proj.reshape(b * heads, t, -1)
+
+
+def _interleaved_matmul_selfatt_qk(qkv, heads=1):
+    q = _selfatt_split(qkv, heads, 0)
+    k = _selfatt_split(qkv, heads, 1)
+    q = q / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    return jnp.einsum("bqd,bkd->bqk", q, k)
+
+
+_reg("_contrib_interleaved_matmul_selfatt_qk",
+     _interleaved_matmul_selfatt_qk)
+
+
+def _interleaved_matmul_selfatt_valatt(qkv, att, heads=1):
+    t, b, _ = qkv.shape
+    v = _selfatt_split(qkv, heads, 2)           # (B*H, T, D)
+    out = jnp.einsum("bqk,bkd->bqd", att, v)
+    out = out.reshape(b, heads, t, -1)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(t, b, -1)
+
+
+_reg("_contrib_interleaved_matmul_selfatt_valatt",
+     _interleaved_matmul_selfatt_valatt)
+
+
+def _encdec_split(kv, heads, idx):
+    t, b, _ = kv.shape
+    tmp = kv.reshape(t, b, heads, 2, -1)
+    proj = jnp.transpose(tmp[:, :, :, idx, :], (1, 2, 0, 3))
+    return proj.reshape(b * heads, t, -1)
+
+
+def _interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    tq, b, _ = queries.shape
+    q = jnp.transpose(queries.reshape(tq, b, heads, -1), (1, 2, 0, 3))
+    q = q.reshape(b * heads, tq, -1)
+    q = q / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    k = _encdec_split(keys_values, heads, 0)
+    return jnp.einsum("bqd,bkd->bqk", q, k)
+
+
+_reg("_contrib_interleaved_matmul_encdec_qk",
+     _interleaved_matmul_encdec_qk)
+
+
+def _interleaved_matmul_encdec_valatt(keys_values, att, heads=1):
+    tk, b, _ = keys_values.shape
+    v = _encdec_split(keys_values, heads, 1)
+    out = jnp.einsum("bqk,bkd->bqd", att, v)
+    tq = out.shape[1]
+    out = out.reshape(b, heads, tq, -1)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(tq, b, -1)
+
+
+_reg("_contrib_interleaved_matmul_encdec_valatt",
+     _interleaved_matmul_encdec_valatt)
+
+
+# -------------------------------------------------- image frontend ----
+# reference: src/operator/image/ (crop.cc, resize.cc, image_random.cc).
+# HWC (or NHWC) uint8/float images, matching mx.image semantics.
+
+def _image_crop(data, x=0, y=0, width=1, height=1):
+    if data.ndim == 3:
+        return lax.dynamic_slice(
+            data, (y, x, 0), (height, width, data.shape[2]))
+    return lax.dynamic_slice(
+        data, (0, y, x, 0),
+        (data.shape[0], height, width, data.shape[3]))
+
+
+_reg("_image_crop", _image_crop)
+
+
+def _image_resize(data, size=None, keep_ratio=False, interp=1):
+    import jax.image as jimage
+    if isinstance(size, int):
+        size = (size, size)
+    h, w = int(size[1]), int(size[0])     # reference size is (w, h)
+    method = "nearest" if interp == 0 else "linear"
+    if data.ndim == 3:
+        out = jimage.resize(data.astype(jnp.float32),
+                            (h, w, data.shape[2]), method=method)
+    else:
+        out = jimage.resize(data.astype(jnp.float32),
+                            (data.shape[0], h, w, data.shape[3]),
+                            method=method)
+    return out.astype(data.dtype) if jnp.issubdtype(
+        data.dtype, jnp.integer) else out
+
+
+_reg("_image_resize", _image_resize)
+
+
+def _image_to_tensor(data):
+    x = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+_reg("_image_to_tensor", _image_to_tensor)
+
+
+def _image_normalize(data, mean=0.0, std=1.0):
+    # CHW (or NCHW) float input, per-channel mean/std
+    mean = jnp.asarray(mean, data.dtype)
+    std = jnp.asarray(std, data.dtype)
+    shape = ((-1, 1, 1) if data.ndim == 3 else (1, -1, 1, 1))
+    if mean.ndim:
+        mean = mean.reshape(shape)
+    if std.ndim:
+        std = std.reshape(shape)
+    return (data - mean) / std
+
+
+_reg("_image_normalize", _image_normalize)
+
+
+# ------------------------------------------------------ npx tail ----
+# reference: src/operator/numpy/npx_*.cc internals backing mx.npx.
+
+_reg("_npx_relu", lambda data: jnp.maximum(data, 0))
+_reg("_npx_sigmoid", lambda data: jax.nn.sigmoid(data))
+
+
+def _npx_reshape(data, newshape=None, reverse=False, order="C"):
+    """npx.reshape special codes (reference: np_matrix_op.cc): -1 infer,
+    -2 copy all remaining dims, 0 copy this dim."""
+    shape = list(newshape)
+    if reverse:
+        shape = shape[::-1]
+        src = list(data.shape)[::-1]
+    else:
+        src = list(data.shape)
+    out = []
+    si = 0
+    for s in shape:
+        if s == 0:
+            out.append(src[si])
+            si += 1
+        elif s == -2:
+            out.extend(src[si:])
+            si = len(src)
+        else:
+            out.append(s)
+            if s != -1:
+                si += 1
+    if reverse:
+        out = out[::-1]
+    return data.reshape(tuple(out))
+
+
+_reg("_npx_reshape", _npx_reshape)
+
+
+def _npx_nonzero(data):
+    # dynamic output shape: eager/host only (reference marks it
+    # dynamic-shape too)
+    idx = _np.nonzero(_np.asarray(data))
+    return jnp.asarray(_np.stack(idx, axis=-1), jnp.int64)
+
+
+_reg("_npx_nonzero", _npx_nonzero, host_op=True, differentiable=False)
+
+
+def _npx_constraint_check(data, msg="constraint violated"):
+    ok = jnp.all(data)
+    if not isinstance(ok, jax.core.Tracer) and not bool(ok):
+        raise ValueError(str(msg))
+    return ok
+
+
+_reg("_npx_constraint_check", _npx_constraint_check,
+     differentiable=False)
+
+
+# ------------------------------------------------------ npi tail ----
+
+_reg("_npi_where_lscalar",
+     lambda cond, x, scalar=0.0: jnp.where(cond, x, scalar))
+_reg("_npi_where_rscalar",
+     lambda cond, y, scalar=0.0: jnp.where(cond, scalar, y))
+_reg("_npi_where_scalar2",
+     lambda cond, x=0.0, y=0.0: jnp.where(
+         cond, jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32)))
+_reg("_npi_powerd", lambda a, exp=1.0: jnp.power(a, exp))
+_reg("_npi_tensordot_int_axes",
+     lambda a, b, axes=2: jnp.tensordot(a, b, axes=int(axes)))
+_reg("_npi_matrix_rank_none_tol",
+     lambda M, hermitian=False: jnp.linalg.matrix_rank(M),
+     differentiable=False)
+_reg("_npi_pinv_scalar_rcond",
+     lambda a, rcond=1e-15: jnp.linalg.pinv(a, rcond=float(rcond)))
+
+
+def _npi_boolean_mask_assign_scalar(data, mask, value=0.0):
+    return jnp.where(mask.astype(bool), jnp.asarray(value, data.dtype),
+                     data)
+
+
+_reg("_npi_boolean_mask_assign_scalar", _npi_boolean_mask_assign_scalar)
+
+
+def _npi_boolean_mask_assign_tensor(data, mask, value):
+    m = mask.astype(bool)
+    # value holds one entry per True position (numpy fancy-assign
+    # semantics): scatter them in mask order — host path for the
+    # dynamic count, mirroring the reference's dynamic-shape op
+    mnp = _np.asarray(m)
+    out = _np.asarray(data).copy()
+    out[mnp] = _np.asarray(value).reshape(-1)[:int(mnp.sum())] \
+        if _np.asarray(value).size != out[mnp].size else \
+        _np.asarray(value).reshape(out[mnp].shape)
+    return jnp.asarray(out)
+
+
+_reg("_npi_boolean_mask_assign_tensor", _npi_boolean_mask_assign_tensor,
+     host_op=True, differentiable=False)
+
+
+def _npi_insert_slice(data, obj=0, values=0.0, axis=None, **kw):
+    return jnp.asarray(_np.insert(_np.asarray(data), int(obj),
+                                  _np.asarray(values), axis=axis))
+
+
+_reg("_npi_insert_slice", _npi_insert_slice, host_op=True,
+     differentiable=False)
+
+
+def _npi_insert_tensor(data, obj, values=0.0, axis=None, **kw):
+    return jnp.asarray(_np.insert(_np.asarray(data),
+                                  _np.asarray(obj).astype(_np.int64),
+                                  _np.asarray(values), axis=axis))
+
+
+_reg("_npi_insert_tensor", _npi_insert_tensor, host_op=True,
+     differentiable=False)
+
+
+def _npi_share_memory(a, b):
+    try:
+        same = a.unsafe_buffer_pointer() == b.unsafe_buffer_pointer()
+    except Exception:
+        same = a is b
+    return jnp.asarray(same)
+
+
+_reg("_npi_share_memory", _npi_share_memory, host_op=True,
+     differentiable=False)
+
+
+def _npi_uniform_n(low=0.0, high=1.0, rng=None, size=None,
+                   dtype="float32"):
+    from ..base import dtype_np
+    shape = tuple(size) if size is not None else ()
+    return jax.random.uniform(rng, shape, dtype_np(dtype),
+                              minval=low, maxval=high)
+
+
+_REGISTRY["_npi_uniform_n"] = Operator(
+    "_npi_uniform_n", _npi_uniform_n, needs_rng=True,
+    differentiable=False)
+
+
+def _npi_normal_n(loc=0.0, scale=1.0, rng=None, size=None,
+                  dtype="float32"):
+    from ..base import dtype_np
+    shape = tuple(size) if size is not None else ()
+    return loc + scale * jax.random.normal(rng, shape, dtype_np(dtype))
+
+
+_REGISTRY["_npi_normal_n"] = Operator(
+    "_npi_normal_n", _npi_normal_n, needs_rng=True, differentiable=False)
+
+
+# ------------------------------------------- packed triangular linalg --
+# reference: src/operator/linalg/ extracttrian/maketrian (packed storage
+# of triangular matrices).
+
+def _tri_indices(n, offset, lower):
+    if lower:
+        return _np.tril_indices(n, k=offset)
+    return _np.triu_indices(n, k=offset)
+
+
+def _linalg_extracttrian(A, offset=0, lower=True):
+    n = A.shape[-1]
+    rows, cols = _tri_indices(n, offset if not lower else offset, lower)
+    return A[..., rows, cols]
+
+
+_reg("_linalg_extracttrian", _linalg_extracttrian)
+
+
+def _linalg_maketrian(a, offset=0, lower=True):
+    # invert extracttrian: packed vector of length n*(n+1)/2-ish -> matrix
+    m = a.shape[-1]
+    # solve n from m given the diagonal offset
+    k = abs(offset)
+    n = int(round((_np.sqrt(8 * m + (2 * k - 1) ** 2) - 1) / 2)) + \
+        (k if offset else 0)
+    # find n by search (robust for any offset)
+    for cand in range(1, m + k + 2):
+        if len(_tri_indices(cand, offset, lower)[0]) == m:
+            n = cand
+            break
+    rows, cols = _tri_indices(n, offset, lower)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+_reg("_linalg_maketrian", _linalg_maketrian)
+
+
+# ------------------------------------------------- scatter family ----
+# reference: src/operator/tensor/indexing_op.cc _scatter_set_nd,
+# elemwise_binary_op_basic.cc _scatter_elemwise_div: the "apply only on
+# stored (nonzero) positions" kernels backing sparse arithmetic.
+
+def _scatter_set_nd(lhs, rhs, indices, shape=None):
+    idx = tuple(indices[i].astype(jnp.int32)
+                for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+_reg("_scatter_set_nd", _scatter_set_nd)
+
+_reg("_scatter_elemwise_div",
+     lambda lhs, rhs: jnp.where(lhs != 0, lhs / rhs,
+                                jnp.zeros((), lhs.dtype)))
+_reg("_scatter_minus_scalar",
+     lambda data, scalar=0.0: jnp.where(
+         data != 0, data - jnp.asarray(scalar, data.dtype),
+         jnp.zeros((), data.dtype)))
+_reg("_scatter_plus_scalar",
+     lambda data, scalar=0.0: jnp.where(
+         data != 0, data + jnp.asarray(scalar, data.dtype),
+         jnp.zeros((), data.dtype)))
+
+
+# ------------------------------------------------------- misc tail ----
+
+_reg("_zeros_without_dtype",
+     lambda shape=(), ctx=None, dtype=None: jnp.zeros(
+         tuple(shape), jnp.float32),
+     differentiable=False)
+
+
+def _rnn_param_concat(arrays, dim=0):
+    return jnp.concatenate(arrays, axis=int(dim))
+
+
+_REGISTRY["_rnn_param_concat"] = Operator(
+    "_rnn_param_concat", _rnn_param_concat, variadic=True)
+
+
+def _contrib_boolean_mask(data, index, axis=0):
+    # dynamic output shape -> host/eager, like the reference's
+    # dynamic-shape ops
+    sel = _np.asarray(index).astype(bool)
+    return jnp.asarray(_np.compress(sel, _np.asarray(data), axis=axis))
+
+
+_reg("_contrib_boolean_mask", _contrib_boolean_mask, host_op=True,
+     differentiable=False)
+
+
+def _contrib_getnnz(data, axis=None):
+    return jnp.count_nonzero(data, axis=axis).astype(jnp.int64)
+
+
+_reg("_contrib_getnnz", _contrib_getnnz, differentiable=False)
+
+
+def _identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                   penalty=0.001, momentum=0.9):
+    """Forward identity (reference: src/operator/regression_output...
+    identity_attach_KL_sparse_reg.cc). The KL sparsity penalty is a
+    training-loss addend in the reference; in this framework add the
+    penalty to the loss explicitly — the op passes data through so
+    reference model definitions load."""
+    return data
+
+
+_reg("IdentityAttachKLSparseReg", _identity_attach_kl_sparse_reg)
+
+
+def _contrib_count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count-sketch projection (reference:
+    src/operator/contrib/count_sketch.cc): out[:, h[j]] += s[j]*data[:, j].
+    """
+    h = h.reshape(-1).astype(jnp.int32)
+    s = s.reshape(-1).astype(data.dtype)
+    contrib = data * s[None, :]
+    out = jnp.zeros((data.shape[0], int(out_dim)), data.dtype)
+    return out.at[:, h].add(contrib)
+
+
+_reg("_contrib_count_sketch", _contrib_count_sketch)
+
+
+def _contrib_bipartite_matching(data, threshold=1e-12, is_ascend=False,
+                                topk=-1):
+    """Greedy bipartite matching (reference:
+    src/operator/contrib/bipartite_matching.cc): returns (row->col
+    matches, col->row matches), -1 for unmatched. Host op (inherently
+    sequential argmax-and-mask loop)."""
+    scores = _np.asarray(data)
+    squeeze = scores.ndim == 2
+    if squeeze:
+        scores = scores[None]
+    b, n, m = scores.shape
+    row_match = _np.full((b, n), -1, _np.float32)
+    col_match = _np.full((b, m), -1, _np.float32)
+    for i in range(b):
+        sc = scores[i].copy()
+        order = _np.argsort(sc.ravel())
+        if not is_ascend:
+            order = order[::-1]
+        k = 0
+        limit = topk if topk > 0 else min(n, m)
+        for flat in order:
+            r, c = divmod(int(flat), m)
+            val = sc[r, c]
+            if (not is_ascend and val < threshold) or \
+                    (is_ascend and val > threshold):
+                break
+            if row_match[i, r] >= 0 or col_match[i, c] >= 0:
+                continue
+            row_match[i, r] = c
+            col_match[i, c] = r
+            k += 1
+            if k >= limit:
+                break
+    if squeeze:
+        row_match, col_match = row_match[0], col_match[0]
+    return jnp.asarray(row_match), jnp.asarray(col_match)
+
+
+_reg("_contrib_bipartite_matching", _contrib_bipartite_matching, nout=2,
+     host_op=True, differentiable=False)
+
+
+# ------------------------------------- preloaded / multi optimizer tail --
+# reference: optimizer_op.cc preloaded_multi_sgd_* (lrs/wds arrive as
+# tensors, the last two inputs), contrib/adamw.cc _multi_adamw_update,
+# contrib/optimizer_op.cc _multi_lamb_update, group_adagrad,
+# optimizer_op.cc _sparse_adagrad_update.
+
+def _preloaded_like(arrays, n_per, upd):
+    lrs, wds = arrays[-2], arrays[-1]
+    body = arrays[:-2]
+    num = len(body) // n_per
+    outs = []
+    for i in range(num):
+        group = body[i * n_per:(i + 1) * n_per]
+        outs.extend(upd(group, lrs[i], wds[i]))
+    return tuple(outs)
+
+
+def _preloaded_multi_sgd_update(arrays, rescale_grad=1.0,
+                                clip_gradient=-1.0, **kw):
+    def upd(group, lr, wd):
+        w, g = group
+        gg = _clip(rescale_grad * g, clip_gradient)
+        return [w - lr * (gg + wd * w)]
+    return _preloaded_like(arrays, 2, upd)
+
+
+_reg("preloaded_multi_sgd_update", _preloaded_multi_sgd_update,
+     variadic=True, nout=2, differentiable=False)
+
+
+def _preloaded_multi_sgd_mom_update(arrays, momentum=0.0,
+                                    rescale_grad=1.0, clip_gradient=-1.0,
+                                    **kw):
+    def upd(group, lr, wd):
+        w, g, m = group
+        gg = _clip(rescale_grad * g, clip_gradient)
+        m_new = momentum * m - lr * (gg + wd * w)
+        return [w + m_new, m_new]
+    return _preloaded_like(arrays, 3, upd)
+
+
+_reg("preloaded_multi_sgd_mom_update", _preloaded_multi_sgd_mom_update,
+     variadic=True, nout=2, differentiable=False)
+
+
+def _preloaded_multi_mp_sgd_update(arrays, rescale_grad=1.0,
+                                   clip_gradient=-1.0, **kw):
+    def upd(group, lr, wd):
+        w, g, w32 = group
+        gg = _clip(rescale_grad * g, clip_gradient).astype(jnp.float32)
+        new32 = w32 - lr * (gg + wd * w32)
+        return [new32.astype(w.dtype), new32]
+    return _preloaded_like(arrays, 3, upd)
+
+
+_reg("preloaded_multi_mp_sgd_update", _preloaded_multi_mp_sgd_update,
+     variadic=True, nout=2, differentiable=False)
+
+
+def _preloaded_multi_mp_sgd_mom_update(arrays, momentum=0.0,
+                                       rescale_grad=1.0,
+                                       clip_gradient=-1.0, **kw):
+    def upd(group, lr, wd):
+        w, g, m, w32 = group
+        gg = _clip(rescale_grad * g, clip_gradient).astype(jnp.float32)
+        m_new = momentum * m - lr * (gg + wd * w32)
+        new32 = w32 + m_new
+        return [new32.astype(w.dtype), m_new, new32]
+    return _preloaded_like(arrays, 4, upd)
+
+
+_reg("preloaded_multi_mp_sgd_mom_update",
+     _preloaded_multi_mp_sgd_mom_update, variadic=True, nout=2,
+     differentiable=False)
+
+
+def _adamw_step(w32, g, m, v, lr, eta, wd, beta1, beta2, epsilon,
+                rescale, clip_gradient):
+    gg = _clip(g.astype(jnp.float32) * rescale, clip_gradient)
+    m_new = beta1 * m + (1 - beta1) * gg
+    v_new = beta2 * v + (1 - beta2) * gg * gg
+    new32 = w32 - eta * (lr * m_new / (jnp.sqrt(v_new) + epsilon)
+                         + wd * w32)
+    return new32, m_new, v_new
+
+
+def _multi_adamw_update(arrays, lrs=(), wds=(), etas=(), beta1=0.9,
+                        beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                        **kw):
+    """reference: contrib/adamw.cc _multi_adamw_update — last input is
+    the dynamic rescale_grad scalar tensor."""
+    rescale = arrays[-1].reshape(())
+    body = arrays[:-1]
+    outs = []
+    for i in range(len(body) // 4):
+        w, g, m, v = body[i * 4:(i + 1) * 4]
+        new32, m_new, v_new = _adamw_step(
+            w.astype(jnp.float32), g, m, v, float(lrs[i]),
+            float(etas[i]), float(wds[i]), beta1, beta2, epsilon,
+            rescale, clip_gradient)
+        outs.extend([new32.astype(w.dtype), m_new, v_new])
+    return tuple(outs)
+
+
+_reg("_multi_adamw_update", _multi_adamw_update, variadic=True, nout=2,
+     differentiable=False)
+
+
+def _multi_mp_adamw_update(arrays, lrs=(), wds=(), etas=(), beta1=0.9,
+                           beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                           **kw):
+    rescale = arrays[-1].reshape(())
+    body = arrays[:-1]
+    outs = []
+    for i in range(len(body) // 5):
+        w, g, m, v, w32 = body[i * 5:(i + 1) * 5]
+        new32, m_new, v_new = _adamw_step(
+            w32, g, m, v, float(lrs[i]), float(etas[i]), float(wds[i]),
+            beta1, beta2, epsilon, rescale, clip_gradient)
+        outs.extend([new32.astype(w.dtype), m_new, v_new, new32])
+    return tuple(outs)
+
+
+_reg("_multi_mp_adamw_update", _multi_mp_adamw_update, variadic=True,
+     nout=2, differentiable=False)
+
+
+def _lamb_step(w32, g, m, v, lr, wd, beta1, beta2, epsilon, t,
+               rescale_grad, clip_gradient, lower_bound, upper_bound):
+    gg = _clip(g.astype(jnp.float32) * rescale_grad, clip_gradient)
+    m_new = beta1 * m + (1 - beta1) * gg
+    v_new = beta2 * v + (1 - beta2) * gg * gg
+    mhat = m_new / (1 - beta1 ** t)
+    vhat = v_new / (1 - beta2 ** t)
+    gdash = mhat / (jnp.sqrt(vhat) + epsilon) + wd * w32
+    wnorm = jnp.linalg.norm(w32)
+    gnorm = jnp.linalg.norm(gdash)
+    ratio = jnp.where(gnorm > 0, wnorm / gnorm, 1.0)
+    if lower_bound is not None and lower_bound > 0:
+        ratio = jnp.maximum(ratio, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        ratio = jnp.minimum(ratio, upper_bound)
+    ratio = jnp.where(wnorm > 0, ratio, 1.0)
+    return w32 - lr * ratio * gdash, m_new, v_new
+
+
+def _multi_lamb_update(arrays, learning_rates=(), wds=(), beta1=0.9,
+                       beta2=0.999, epsilon=1e-6, step_count=(),
+                       rescale_grad=1.0, clip_gradient=-1.0,
+                       lower_bound=-1.0, upper_bound=-1.0, **kw):
+    """reference: contrib/optimizer_op.cc multi_lamb_update."""
+    outs = []
+    for i in range(len(arrays) // 4):
+        w, g, m, v = arrays[i * 4:(i + 1) * 4]
+        new32, m_new, v_new = _lamb_step(
+            w.astype(jnp.float32), g, m, v, float(learning_rates[i]),
+            float(wds[i]), beta1, beta2, epsilon, int(step_count[i]),
+            rescale_grad, clip_gradient,
+            lower_bound if lower_bound > 0 else None,
+            upper_bound if upper_bound > 0 else None)
+        outs.extend([new32.astype(w.dtype), m_new, v_new])
+    return tuple(outs)
+
+
+_reg("_multi_lamb_update", _multi_lamb_update, variadic=True, nout=2,
+     differentiable=False)
+
+
+def _multi_mp_lamb_update(arrays, learning_rates=(), wds=(), beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, step_count=(),
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          lower_bound=-1.0, upper_bound=-1.0, **kw):
+    outs = []
+    for i in range(len(arrays) // 5):
+        w, g, m, v, w32 = arrays[i * 5:(i + 1) * 5]
+        new32, m_new, v_new = _lamb_step(
+            w32, g, m, v, float(learning_rates[i]), float(wds[i]),
+            beta1, beta2, epsilon, int(step_count[i]), rescale_grad,
+            clip_gradient, lower_bound if lower_bound > 0 else None,
+            upper_bound if upper_bound > 0 else None)
+        outs.extend([new32.astype(w.dtype), m_new, v_new, new32])
+    return tuple(outs)
+
+
+_reg("_multi_mp_lamb_update", _multi_mp_lamb_update, variadic=True,
+     nout=2, differentiable=False)
+
+
+def _sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """reference: optimizer_op.cc _sparse_adagrad_update (lazy adagrad
+    for row-sparse grads). At this dense registry boundary the lazy
+    semantics hold structurally: rows with all-zero gradient are
+    untouched (their history addend is 0 and the masked update is 0);
+    RowSparseNDArray grads take the optimizer-level fast path
+    (optimizer.py _update_rsp) before reaching here."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    row_nonzero = jnp.any(g != 0, axis=tuple(range(1, g.ndim)),
+                          keepdims=True)
+    h_new = history + g * g
+    upd = lr * g / (jnp.sqrt(h_new) + epsilon) + lr * wd * weight
+    return jnp.where(row_nonzero, weight - upd, weight), h_new
+
+
+_reg("_sparse_adagrad_update", _sparse_adagrad_update, nout=2,
+     mutates=(0, 2), differentiable=False)
+
+
+def _group_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-5,
+                          rescale_grad=1.0, clip_gradient=-1.0):
+    """reference: contrib/optimizer_op.cc group_adagrad_update — one
+    accumulator per row (group), mean of squared grads."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    red = tuple(range(1, g.ndim))
+    h_new = history + jnp.mean(g * g, axis=red).reshape(history.shape)
+    scale = (jnp.sqrt(h_new) + epsilon).reshape(
+        (-1,) + (1,) * (g.ndim - 1))
+    return weight - lr * g / scale, h_new
+
+
+_reg("_contrib_group_adagrad_update", _group_adagrad_update, nout=2,
+     mutates=(0, 2), differentiable=False)

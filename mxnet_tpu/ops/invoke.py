@@ -261,7 +261,8 @@ def apply_op(op, inputs: Sequence, params: Optional[dict] = None, out=None):
                 return apply_fn(fn, moved, nout=op.nout,
                                 differentiable=op.differentiable, out=out)
 
-    if op.name == "Embedding" and params.get("sparse_grad") \
+    if ((op.name == "Embedding" and params.get("sparse_grad"))
+            or op.name == "_contrib_SparseEmbedding") \
             and autograd.is_recording():
         res = _embedding_sparse_grad(op, inputs, params)
         if res is not None:

@@ -594,6 +594,13 @@ def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
 
 
 _reg("Embedding", _embedding)
+# reference: src/operator/contrib/sparse_embedding... (deprecated alias
+# of Embedding with a row-sparse weight gradient); the invoke chokepoint
+# gives it the sparse-grad tape path unconditionally
+_reg("_contrib_SparseEmbedding",
+     lambda data, weight, **kw: _embedding(data, weight,
+                                           **{k: v for k, v in kw.items()
+                                              if k != "sparse_grad"}))
 alias("embedding", "Embedding")
 
 
@@ -676,3 +683,98 @@ def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
 
 _reg("CTCLoss", _ctc_loss)
 alias("ctc_loss", "CTCLoss")
+
+
+def _batch_norm_with_relu(*args, **kw):
+    """reference: src/operator/contrib/batch_norm_relu.cc — BatchNorm
+    with a fused ReLU epilogue (XLA fuses the max into the same
+    elementwise pass)."""
+    out = _batch_norm(*args, **kw)
+    if isinstance(out, tuple):
+        return (jnp.maximum(out[0], 0),) + out[1:]
+    return jnp.maximum(out, 0)
+
+
+_REGISTRY["_contrib_BatchNormWithReLU"] = Operator(
+    "_contrib_BatchNormWithReLU", _batch_norm_with_relu,
+    needs_train=True, nout=3)
+
+
+def _sync_batch_norm(*args, eps=1e-3, momentum=0.9, fix_gamma=True,
+                     use_global_stats=False, output_mean_var=False,
+                     ndev=1, key=None, axis=1, axis_name=None,
+                     _training=False, **kw):
+    """reference: src/operator/contrib/sync_batch_norm.cc — BatchNorm
+    whose batch statistics are averaged across data-parallel workers.
+    TPU-native: inside shard_map/pmap pass ``axis_name`` and the
+    moments are lax.pmean'd over that mesh axis (one fused ICI
+    collective); the reference synchronised via its KVStore-side
+    barrier+broadcast instead."""
+    x, gamma, beta, mmean, mvar = args[:5]
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    rs = lambda a: a.reshape(shape)  # noqa: E731
+    if _training and not use_global_stats:
+        red = tuple(i for i in range(x.ndim) if i != axis)
+        xf = x.astype(jnp.float32)
+        mean32 = jnp.mean(xf, axis=red)
+        meansq = jnp.mean(xf * xf, axis=red)
+        if axis_name is not None:
+            mean32 = lax.pmean(mean32, axis_name)
+            meansq = lax.pmean(meansq, axis_name)
+        var32 = jnp.maximum(meansq - mean32 * mean32, 0.0)
+        mean, var = mean32.astype(x.dtype), var32.astype(x.dtype)
+    else:
+        mean, var = mmean, mvar
+        mean32 = mean.astype(jnp.float32)
+        var32 = var.astype(jnp.float32)
+    inv = lax.rsqrt(var32 + eps)
+    scale = inv * gamma.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - mean32 * scale
+    out = x * rs(scale.astype(x.dtype)) + rs(shift.astype(x.dtype))
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+_REGISTRY["_contrib_SyncBatchNorm"] = Operator(
+    "_contrib_SyncBatchNorm", _sync_batch_norm, needs_train=True)
+
+
+def _correlation(data1, data2, kernel_size=1, max_displacement=1,
+                 stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """reference: src/operator/correlation.cc (FlowNet correlation
+    layer). NCHW inputs; output channel d indexes the displacement grid
+    (2*max_displacement/stride2+1)^2; each value is the patch
+    correlation (mean over channels x kernel window) between data1 at
+    (i,j) and data2 at (i+di, j+dj)."""
+    n, c, h, w = data1.shape
+    d = int(max_displacement)
+    s2 = int(stride2)
+    disps = list(range(-d, d + 1, s2))
+    p = pad_size
+    x1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    x2 = jnp.pad(data2, ((0, 0), (0, 0), (p + d, p + d), (p + d, p + d)))
+    hh, ww = x1.shape[2], x1.shape[3]
+    outs = []
+    for di in disps:
+        for dj in disps:
+            shifted = lax.dynamic_slice(
+                x2, (0, 0, d + di, d + dj), (n, c, hh, ww))
+            prod = x1 * shifted if is_multiply else -jnp.abs(x1 - shifted)
+            corr = jnp.mean(prod, axis=1)          # mean over channels
+            if kernel_size > 1:
+                k = int(kernel_size)
+                corr = lax.reduce_window(
+                    corr, 0.0, lax.add, (1, k, k), (1, 1, 1),
+                    [(0, 0), (k // 2, k // 2), (k // 2, k // 2)]) / (k * k)
+            outs.append(corr)
+    out = jnp.stack(outs, axis=1)
+    if stride1 > 1:
+        out = out[:, :, ::int(stride1), ::int(stride1)]
+    return out
+
+
+_reg("Correlation", _correlation)

@@ -134,3 +134,150 @@ def _quantized_conv(qx, qw, kernel=None, stride=None, pad=None,
 
 
 _reg("_contrib_quantized_conv", _quantized_conv, differentiable=False)
+
+
+# ------------------------------------------------ quantized op family --
+# reference: src/operator/quantization/quantized_activation.cc,
+# quantized_pooling.cc, quantized_flatten.cc, quantized_concat.cc,
+# quantized_elemwise_add.cc / _mul.cc, quantized_batch_norm.cc,
+# quantized_indexing_op.cc, calibrate.cc. Every op keeps the
+# (values, min, max) triple contract.
+
+def _quantized_act(data, min_data, max_data, act_type="relu"):
+    assert act_type == "relu", "int8 activation supports relu"
+    zero = jnp.zeros((), data.dtype)
+    return jnp.maximum(data, zero), jnp.maximum(
+        jnp.asarray(min_data).reshape(()), 0.0), \
+        jnp.asarray(max_data).reshape(())
+
+
+_reg("_contrib_quantized_act", _quantized_act, nout=3,
+     differentiable=False)
+
+
+def _quantized_pooling(data, min_data, max_data, kernel=None, stride=None,
+                       pad=None, pool_type="max", global_pool=False,
+                       layout="NCHW"):
+    from .nn import _pooling
+    out = _pooling(data.astype(jnp.float32), kernel=kernel, stride=stride,
+                   pad=pad, pool_type=pool_type, global_pool=global_pool,
+                   layout=layout)
+    if pool_type == "max":
+        out = out.astype(data.dtype)      # exact for max
+    else:
+        out = jnp.round(out).astype(data.dtype)
+    return out, jnp.asarray(min_data).reshape(()), \
+        jnp.asarray(max_data).reshape(())
+
+
+_reg("_contrib_quantized_pooling", _quantized_pooling, nout=3,
+     differentiable=False)
+
+
+def _quantized_flatten(data, min_data, max_data):
+    return data.reshape(data.shape[0], -1), \
+        jnp.asarray(min_data).reshape(()), \
+        jnp.asarray(max_data).reshape(())
+
+
+_reg("_contrib_quantized_flatten", _quantized_flatten, nout=3,
+     differentiable=False)
+
+
+def _quantized_concat(arrays, num_args=1, dim=1):
+    """Inputs: data0..dataN, min0..maxN interleaved per the reference
+    (data..., min..., max...). Requantizes every part to the widest
+    range, then concatenates."""
+    n = len(arrays) // 3
+    datas, mins, maxs = arrays[:n], arrays[n:2 * n], arrays[2 * n:]
+    ts = [jnp.maximum(jnp.abs(mn.reshape(())), jnp.abs(mx.reshape(())))
+          for mn, mx in zip(mins, maxs)]
+    t_out = ts[0]
+    for t in ts[1:]:
+        t_out = jnp.maximum(t_out, t)
+    parts = []
+    for d, t in zip(datas, ts):
+        real = d.astype(jnp.float32) * (t / 127.0)
+        parts.append(jnp.clip(jnp.round(real / (t_out / 127.0)),
+                              -127, 127).astype(jnp.int8))
+    return jnp.concatenate(parts, axis=int(dim)), -t_out, t_out
+
+
+_REGISTRY["_contrib_quantized_concat"] = Operator(
+    "_contrib_quantized_concat", _quantized_concat, nout=3,
+    variadic=True, differentiable=False)
+
+
+def _quantized_elemwise(op):
+    def impl(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+        tl = jnp.maximum(jnp.abs(lhs_min.reshape(())),
+                         jnp.abs(lhs_max.reshape(())))
+        tr = jnp.maximum(jnp.abs(rhs_min.reshape(())),
+                         jnp.abs(rhs_max.reshape(())))
+        a = lhs.astype(jnp.float32) * (tl / 127.0)
+        b = rhs.astype(jnp.float32) * (tr / 127.0)
+        real = op(a, b)
+        t = jnp.maximum(jnp.max(jnp.abs(real)), 1e-30)
+        q = jnp.clip(jnp.round(real / (t / 127.0)), -127, 127)\
+            .astype(jnp.int8)
+        return q, -t, t
+    return impl
+
+
+_reg("_contrib_quantized_elemwise_add",
+     _quantized_elemwise(lambda a, b: a + b), nout=3,
+     differentiable=False)
+_reg("_contrib_quantized_elemwise_mul",
+     _quantized_elemwise(lambda a, b: a * b), nout=3,
+     differentiable=False)
+
+
+def _quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                          min_data=None, max_data=None, eps=1e-3,
+                          min_calib_range=None, max_calib_range=None,
+                          **kw):
+    t_in = jnp.maximum(jnp.abs(min_data.reshape(())),
+                       jnp.abs(max_data.reshape(())))
+    x = data.astype(jnp.float32) * (t_in / 127.0)
+    inv = 1.0 / jnp.sqrt(moving_var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (x - moving_mean.reshape(shape)) * \
+        (inv * gamma).reshape(shape) + beta.reshape(shape)
+    if min_calib_range is not None:
+        t = jnp.maximum(abs(float(min_calib_range)),
+                        abs(float(max_calib_range)))
+    else:
+        t = jnp.maximum(jnp.max(jnp.abs(out)), 1e-30)
+    q = jnp.clip(jnp.round(out / (t / 127.0)), -127, 127)\
+        .astype(jnp.int8)
+    return q, -t, t
+
+
+_reg("_contrib_quantized_batch_norm", _quantized_batch_norm, nout=3,
+     differentiable=False)
+
+
+def _quantized_embedding(data, weight, min_weight, max_weight,
+                         input_dim=0, output_dim=0, dtype="float32",
+                         **kw):
+    out = jnp.take(weight, data.astype(jnp.int32), axis=0)
+    return out, jnp.asarray(min_weight).reshape(()), \
+        jnp.asarray(max_weight).reshape(())
+
+
+_reg("_contrib_quantized_embedding", _quantized_embedding, nout=3,
+     differentiable=False)
+
+
+def _calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """KL-optimal threshold from a histogram (reference: calibrate.cc
+    _contrib_calibrate_entropy); returns (min, max) calib range."""
+    import numpy as _onp
+    from ..contrib.quantization import optimal_threshold
+    t = optimal_threshold(_onp.asarray(hist), _onp.asarray(hist_edges),
+                          num_quantized_bins=int(num_quantized_bins))
+    return jnp.asarray(-t, jnp.float32), jnp.asarray(t, jnp.float32)
+
+
+_reg("_contrib_calibrate_entropy", _calibrate_entropy, nout=2,
+     host_op=True, differentiable=False)
