@@ -1,0 +1,216 @@
+"""Detection op tail: proposals, PS/deformable/rotated ROI ops,
+Mask R-CNN targets, Hawkes LL (reference files cited in
+mxnet_tpu/ops/contrib_det2.py docstrings).
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops.registry import _REGISTRY
+
+
+def _op(name, *args, **kw):
+    import jax.numpy as jnp
+    arrays = [jnp.asarray(a) for a in args]
+    return _REGISTRY[name].impl(*arrays, **kw)
+
+
+def test_proposal_basic():
+    """A strong-scoring anchor at a known location must surface as the
+    top proposal with (near) zero deltas."""
+    rng = np.random.RandomState(0)
+    H = W = 8
+    A = 3                                  # 1 scale x 3 ratios
+    cls = rng.rand(1, 2 * A, H, W).astype(np.float32) * 0.1
+    cls[0, A + 1, 3, 5] = 0.99             # fg score of anchor 1 @ (3,5)
+    bbox = np.zeros((1, 4 * A, H, W), np.float32)
+    im_info = np.array([[128.0, 128.0, 1.0]], np.float32)
+    rois = _op("_contrib_Proposal", cls, bbox, im_info,
+               scales=(8,), ratios=(0.5, 1, 2), feature_stride=16,
+               rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+               threshold=0.7, rpn_min_size=4)
+    rois = np.asarray(rois)
+    assert rois.shape == (10, 5)
+    assert (rois[:, 0] == 0).all()
+    # top roi must be inside the image and near the hot position
+    x1, y1, x2, y2 = rois[0, 1:]
+    assert 0 <= x1 < x2 <= 127 and 0 <= y1 < y2 <= 127
+    cx, cy = (x1 + x2) / 2, (y1 + y2) / 2
+    assert abs(cx - 5 * 16) < 24 and abs(cy - 3 * 16) < 24, (cx, cy)
+
+
+def test_multi_proposal_batched():
+    rng = np.random.RandomState(1)
+    A, H, W = 3, 4, 4
+    cls = rng.rand(2, 2 * A, H, W).astype(np.float32)
+    bbox = rng.randn(2, 4 * A, H, W).astype(np.float32) * 0.1
+    im_info = np.array([[64.0, 64.0, 1.0]] * 2, np.float32)
+    rois, scores = _op("_contrib_MultiProposal", cls, bbox, im_info,
+                       scales=(8,), ratios=(0.5, 1, 2),
+                       feature_stride=16, rpn_pre_nms_top_n=20,
+                       rpn_post_nms_top_n=5, output_score=True)
+    rois = np.asarray(rois)
+    assert rois.shape == (10, 5)
+    assert (rois[:5, 0] == 0).all() and (rois[5:, 0] == 1).all()
+    assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_psroi_pooling_uniform_plane():
+    """On a channel-constant input, each output channel's bins must
+    equal the constant of the mapped input channel."""
+    p, g, od = 2, 2, 3
+    C = od * g * g
+    data = np.zeros((1, C, 8, 8), np.float32)
+    for c in range(C):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = np.asarray(_op("_contrib_PSROIPooling", data, rois,
+                         spatial_scale=1.0, output_dim=od,
+                         pooled_size=p, group_size=g))
+    assert out.shape == (1, od, p, p)
+    for o in range(od):
+        for i in range(p):
+            for j in range(p):
+                want = o * g * g + (i * g // p) * g + (j * g // p)
+                assert out[0, o, i, j] == want, (o, i, j)
+
+
+def test_deformable_conv_zero_offsets_match_conv():
+    """With zero offsets the deformable conv must equal a plain conv
+    (the defining property, reference deformable_convolution.cc)."""
+    import jax.numpy as jnp
+    from jax import lax
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 4, 7, 7).astype(np.float32)
+    w = rng.randn(6, 4, 3, 3).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+    out = np.asarray(_op("_contrib_DeformableConvolution", x, off, w,
+                         kernel=(3, 3), pad=(1, 1), num_filter=6,
+                         no_bias=True))
+    want = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+def test_modulated_deformable_conv_mask_scales():
+    """Unit mask == DCNv1; half mask halves the output (linearity in
+    the mask, reference modulated_deformable_convolution.cc)."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 5, 5), np.float32)
+    ones = np.ones((1, 9, 5, 5), np.float32)
+    out1 = np.asarray(_op("_contrib_ModulatedDeformableConvolution",
+                          x, off, ones, w, kernel=(3, 3), pad=(1, 1),
+                          num_filter=3, no_bias=True))
+    out_h = np.asarray(_op("_contrib_ModulatedDeformableConvolution",
+                           x, off, ones * 0.5, w, kernel=(3, 3),
+                           pad=(1, 1), num_filter=3, no_bias=True))
+    np.testing.assert_allclose(out_h, out1 * 0.5, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_no_trans_matches_psroi_constant():
+    p, g, od = 2, 2, 2
+    C = od * g * g
+    data = np.zeros((1, C, 8, 8), np.float32)
+    for c in range(C):
+        data[0, c] = c
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    out = np.asarray(_op("_contrib_DeformablePSROIPooling", data, rois,
+                         spatial_scale=1.0, output_dim=od,
+                         group_size=g, pooled_size=p, no_trans=True,
+                         sample_per_part=2))
+    assert out.shape == (1, od, p, p)
+    for o in range(od):
+        for i in range(p):
+            for j in range(p):
+                want = o * g * g + i * g + j
+                np.testing.assert_allclose(out[0, o, i, j], want,
+                                           atol=1e-4)
+
+
+def test_rroi_align_zero_angle_matches_axis_aligned():
+    """theta=0 must reduce to ordinary bilinear ROI pooling of the
+    axis-aligned box."""
+    rng = np.random.RandomState(4)
+    data = np.tile(np.arange(8, dtype=np.float32)[None, None, None, :],
+                   (1, 1, 8, 1))          # value == x coordinate
+    rois = np.array([[0, 3.5, 3.5, 4.0, 4.0, 0.0]], np.float32)
+    out = np.asarray(_op("_contrib_RROIAlign", data, rois,
+                         pooled_size=(2, 2), spatial_scale=1.0))
+    assert out.shape == (1, 1, 2, 2)
+    # columns sample around x = 2.5 and x = 4.5
+    np.testing.assert_allclose(out[0, 0, :, 0], [2.5, 2.5], atol=0.01)
+    np.testing.assert_allclose(out[0, 0, :, 1], [4.5, 4.5], atol=0.01)
+    # rotating 90 degrees swaps the sampling axes of this symmetric roi:
+    # the sampled x becomes cx - ly, so rows are constant across cols
+    rois90 = np.array([[0, 3.5, 3.5, 4.0, 4.0, 90.0]], np.float32)
+    out90 = np.asarray(_op("_contrib_RROIAlign", data, rois90,
+                           pooled_size=(2, 2), spatial_scale=1.0))
+    np.testing.assert_allclose(out90[0, 0, 0, :], [4.5, 4.5], atol=0.01)
+    np.testing.assert_allclose(out90[0, 0, 1, :], [2.5, 2.5], atol=0.01)
+
+
+def test_mrcnn_mask_target_shapes_and_onehot():
+    rng = np.random.RandomState(5)
+    B, R, M, H, W = 1, 3, 2, 16, 16
+    NC, MS = 4, 8
+    rois = np.array([[[0, 0, 15, 15], [4, 4, 11, 11],
+                      [0, 0, 7, 7]]], np.float32)
+    masks = (rng.rand(B, M, H, W) > 0.5).astype(np.float32)
+    matches = np.array([[0, 1, 0]], np.int32)
+    cls_t = np.array([[1, 3, 0]], np.int32)
+    t, c = _op("_contrib_mrcnn_mask_target", rois, masks, matches,
+               cls_t, num_rois=R, num_classes=NC, mask_size=(MS, MS))
+    t, c = np.asarray(t), np.asarray(c)
+    assert t.shape == (B, R, NC, MS, MS)
+    assert c.shape == (B, R, NC, MS, MS)
+    assert c[0, 0, 1].all() and not c[0, 0, 2].any()
+    assert c[0, 1, 3].all()
+    assert not c[0, 2].any()               # background roi: no class
+    assert ((t >= 0) & (t <= 1)).all()
+
+
+def test_hawkesll_oracle():
+    """Numpy transcription of the reference kernel
+    (hawkes_ll-inl.h:113) as the oracle."""
+    rng = np.random.RandomState(6)
+    N, T, K = 2, 5, 3
+    mu = rng.rand(N, K).astype(np.float32) * 0.5 + 0.1
+    alpha = rng.rand(K).astype(np.float32) * 0.5
+    beta = rng.rand(K).astype(np.float32) + 0.5
+    state = rng.rand(N, K).astype(np.float32)
+    lags = rng.rand(N, T).astype(np.float32)
+    marks = rng.randint(0, K, (N, T)).astype(np.int32)
+    vl = np.array([5, 3], np.float32)
+    mt = np.array([10.0, 8.0], np.float32)
+
+    ll, out_state = _op("_contrib_hawkesll", mu, alpha, beta, state,
+                        lags, marks, vl, mt)
+
+    def oracle(i):
+        st = state[i].copy()
+        last = np.zeros(K)
+        t = 0.0
+        llv = 0.0
+        for j in range(int(vl[i])):
+            ci = marks[i, j]
+            t += lags[i, j]
+            d = t - last[ci]
+            ed = np.exp(-beta[ci] * d)
+            lam = mu[i, ci] + alpha[ci] * beta[ci] * st[ci] * ed
+            comp = mu[i, ci] * d + alpha[ci] * st[ci] * (1 - ed)
+            llv += np.log(lam) - comp
+            st[ci] = 1 + st[ci] * ed
+            last[ci] = t
+        d = mt[i] - last
+        ed = np.exp(-beta * d)
+        llv -= (mu[i] * d + alpha * st * (1 - ed)).sum()
+        return llv, st * ed
+
+    for i in range(N):
+        want_ll, want_st = oracle(i)
+        np.testing.assert_allclose(float(np.asarray(ll)[i]), want_ll,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(out_state)[i], want_st,
+                                   rtol=1e-4)
