@@ -3,3 +3,4 @@
 Reference: python/mxnet/gluon/contrib/ (estimator, cnn/rnn extras).
 """
 from . import estimator  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,7 @@
+"""gluon.contrib.nn — contributed layers.
+
+Reference: python/mxnet/gluon/contrib/nn/basic_layers.py.
+"""
+from .basic_layers import (  # noqa: F401
+    Concurrent, HybridConcurrent, Identity, SparseEmbedding,
+    SyncBatchNorm, PixelShuffle1D, PixelShuffle2D, PixelShuffle3D)

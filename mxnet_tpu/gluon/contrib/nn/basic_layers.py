@@ -1,0 +1,200 @@
+"""Contributed gluon layers.
+
+Reference: python/mxnet/gluon/contrib/nn/basic_layers.py
+(Concurrent:31, HybridConcurrent:64, Identity:97, SparseEmbedding:118,
+SyncBatchNorm:165, PixelShuffle1D/2D/3D:245+).
+"""
+from __future__ import annotations
+
+from ...nn.basic_layers import (Sequential, HybridSequential, BatchNorm,
+                                HybridBlock, Block)
+from ... import nn as _nn
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity",
+           "SparseEmbedding", "SyncBatchNorm", "PixelShuffle1D",
+           "PixelShuffle2D", "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs (reference:
+    basic_layers.py:31)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import nd
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference: basic_layers.py:64).
+
+    Overrides both ``forward`` (HybridSequential's eager path chains
+    children sequentially) and ``hybrid_forward`` (the traced path)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x, *args):
+        if self._active:
+            return HybridBlock.forward(self, x, *args)
+        return self.hybrid_forward(None, x)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        from .... import nd
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block for Concurrent branches (reference:
+    basic_layers.py:97)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding whose weight gradient is row-sparse (reference:
+    basic_layers.py:118). Same storage-dense/gradient-sparse design as
+    nn.Embedding(sparse_grad=True) — this class keeps the reference's
+    contrib name."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._inner = _nn.Embedding(input_dim, output_dim, dtype=dtype,
+                                    weight_initializer=weight_initializer,
+                                    sparse_grad=True, params=self.params)
+        self.register_child(self._inner)
+        self.weight = self._inner.weight
+
+    def forward(self, x):
+        return self._inner(x)
+
+    def __repr__(self):
+        return "Sparse" + repr(self._inner)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference: basic_layers.py:165 over
+    src/operator/contrib/sync_batch_norm.cc). Inside a pmap/shard_map
+    context pass ``axis_name``: batch moments are lax.pmean'd over that
+    mesh axis (ops/nn.py _contrib_SyncBatchNorm). Outside a collective
+    context it behaves exactly like BatchNorm — which on this framework
+    is already correct for the single-process ShardedTrainer, since its
+    batch axis is one global sharded array and XLA computes global
+    moments."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", axis_name=None,
+                 **kwargs):
+        super().__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels, **kwargs)
+        self._axis_name = axis_name
+
+    def hybrid_forward(self, F, x, gamma=None, beta=None,
+                       running_mean=None, running_var=None):
+        from .... import autograd
+        training = autograd.is_training()
+        kwargs = dict(self._kwargs)
+        kwargs["axis_name"] = self._axis_name
+        if training and not self._use_global_stats:
+            out, mean, var = F._contrib_SyncBatchNorm(
+                x, gamma, beta, running_mean, running_var,
+                output_mean_var=True, **kwargs)
+            with autograd.pause():
+                m = self._momentum
+                self.running_mean.set_data(running_mean * m
+                                           + mean * (1 - m))
+                self.running_var.set_data(running_var * m
+                                          + var * (1 - m))
+            return out
+        return F._contrib_SyncBatchNorm(x, gamma, beta, running_mean,
+                                        running_var, **kwargs)
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = ((factor,) * ndim
+                         if isinstance(factor, int) else tuple(factor))
+        assert len(self._factors) == ndim
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factors})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) -> (N, C, W*f) (reference: basic_layers.py:245)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        from ....ops.invoke import apply_fn
+        f, = self._factors
+
+        def fwd(x):
+            n, cf, w = x.shape
+            c = cf // f
+            return x.reshape(n, c, f, w).transpose(0, 1, 3, 2)\
+                .reshape(n, c, w * f)
+
+        return apply_fn(fwd, [x])
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*fh*fw, H, W) -> (N, C, H*fh, W*fw) (reference:
+    basic_layers.py:293)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        from ....ops.invoke import apply_fn
+        fh, fw = self._factors
+
+        def fwd(x):
+            n, c2, h, w = x.shape
+            c = c2 // (fh * fw)
+            return x.reshape(n, c, fh, fw, h, w)\
+                .transpose(0, 1, 4, 2, 5, 3)\
+                .reshape(n, c, h * fh, w * fw)
+
+        return apply_fn(fwd, [x])
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3) (reference:
+    basic_layers.py:355)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        from ....ops.invoke import apply_fn
+        f1, f2, f3 = self._factors
+
+        def fwd(x):
+            n, cf, d, h, w = x.shape
+            c = cf // (f1 * f2 * f3)
+            return x.reshape(n, c, f1, f2, f3, d, h, w)\
+                .transpose(0, 1, 5, 2, 6, 3, 7, 4)\
+                .reshape(n, c, d * f1, h * f2, w * f3)
+
+        return apply_fn(fwd, [x])

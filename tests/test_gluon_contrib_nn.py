@@ -1,0 +1,64 @@
+"""gluon.contrib.nn layers (reference:
+python/mxnet/gluon/contrib/nn/basic_layers.py).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import nn as cnn
+import mxnet_tpu.autograd as ag
+
+
+def test_concurrent_concats_branches():
+    mx.random.seed(0)
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(nn.Dense(4), cnn.Identity())
+    net.initialize()
+    x = nd.array(np.ones((2, 3), np.float32))
+    out = net(x)
+    assert out.shape == (2, 7)
+    np.testing.assert_allclose(out.asnumpy()[:, 4:], 1.0)
+    net.hybridize()
+    out2 = net(x)
+    np.testing.assert_allclose(out2.asnumpy(), out.asnumpy(), rtol=1e-6)
+
+
+def test_pixelshuffle_oracles():
+    x1 = np.arange(6, dtype=np.float32).reshape(1, 2, 3)
+    y1 = cnn.PixelShuffle1D(2)(nd.array(x1)).asnumpy()
+    np.testing.assert_allclose(y1, [[[0, 3, 1, 4, 2, 5]]])
+    x2 = np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2)
+    y2 = cnn.PixelShuffle2D((2, 2))(nd.array(x2)).asnumpy()
+    assert y2.shape == (1, 1, 4, 4)
+    # torch pixel_shuffle oracle for the same layout convention
+    np.testing.assert_allclose(y2[0, 0, 0], [0, 4, 1, 5])
+    np.testing.assert_allclose(y2[0, 0, 1], [8, 12, 9, 13])
+    x3 = np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1, 1)
+    y3 = cnn.PixelShuffle3D((2, 2, 2))(nd.array(x3)).asnumpy()
+    assert y3.shape == (1, 1, 2, 2, 2)
+
+
+def test_sparse_embedding_layer():
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    emb = cnn.SparseEmbedding(50, 4)
+    emb.initialize()
+    with ag.record():
+        loss = (emb(nd.array(np.array([1, 9]))) ** 2).sum()
+    loss.backward()
+    assert isinstance(emb.weight.grad(), RowSparseNDArray)
+
+
+def test_sync_batch_norm_layer_trains_and_syncs():
+    import jax
+    import jax.numpy as jnp
+    sbn = cnn.SyncBatchNorm(in_channels=3)
+    sbn.initialize()
+    x = nd.array(np.random.RandomState(0).randn(8, 3, 6)
+                 .astype(np.float32))
+    with ag.record():
+        out = sbn(x)
+    out.backward()
+    assert np.isfinite(out.asnumpy()).all()
+    # running stats moved off their init
+    assert np.abs(sbn.running_mean.data().asnumpy()).sum() > 0
