@@ -1,0 +1,170 @@
+"""TensorBoard event-file writer with no TensorFlow dependency.
+
+Reference analogue: the mxboard package the reference ecosystem uses
+for `python/mxnet` training visibility (SURVEY §5.5 metrics/logging).
+Writes standard `events.out.tfevents.*` files that TensorBoard loads:
+TFRecord framing (length + masked crc32c) around Event protos, encoded
+with the same minimal protobuf wire codec the ONNX module uses
+(mxnet_tpu/onnx/_proto.py).
+
+Supported summaries: scalars (`add_scalar`) and histograms
+(`add_histogram`) — the two the reference's Speedometer/estimator
+logging surface maps onto.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+import numpy as _np
+
+from ..onnx import _proto as P
+
+__all__ = ["SummaryWriter"]
+
+# ---------------------------------------------------------------- crc32c --
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if _CRC_TABLE:
+        return _CRC_TABLE
+    poly = 0x82F63B78                 # Castagnoli, reflected
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    _CRC_TABLE = table
+    return table
+
+
+def _crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------- protos ----
+# tensorboard Event: wall_time(1,double) step(2,int64) file_version(3,str)
+#   summary(5,Summary)
+# Summary.Value: tag(1,str) simple_value(2,float) histo(5,HistogramProto)
+# HistogramProto: min(1,d) max(2,d) num(3,d) sum(4,d) sum_squares(5,d)
+#   bucket_limit(6,repeated d) bucket(7,repeated d)
+
+def _event(wall_time, step=None, file_version=None, summary=None):
+    fields = [(1, P.FIXED64, wall_time)]
+    if step is not None:
+        fields.append((2, P.VARINT, int(step)))
+    if file_version is not None:
+        fields.append((3, P.LEN, file_version))
+    if summary is not None:
+        fields.append((5, P.LEN, summary))
+    return P.encode(fields)
+
+
+def _scalar_summary(tag, value):
+    val = P.encode([(1, P.LEN, tag), (2, P.FIXED32, float(value))])
+    return P.encode([(1, P.LEN, val)])
+
+
+def _histo_summary(tag, values, bins=30):
+    a = _np.asarray(values, _np.float64).ravel()
+    counts, edges = _np.histogram(a, bins=bins)
+    histo = [(1, P.FIXED64, float(a.min())),
+             (2, P.FIXED64, float(a.max())),
+             (3, P.FIXED64, float(a.size)),
+             (4, P.FIXED64, float(a.sum())),
+             (5, P.FIXED64, float((a * a).sum()))]
+    histo += [(6, P.FIXED64, float(e)) for e in edges[1:]]
+    histo += [(7, P.FIXED64, float(c)) for c in counts]
+    val = P.encode([(1, P.LEN, tag), (5, P.LEN, P.encode(histo))])
+    return P.encode([(1, P.LEN, val)])
+
+
+class SummaryWriter:
+    """Append-only event-file writer (TensorBoard/mxboard-compatible)."""
+
+    def __init__(self, logdir, filename_suffix=""):
+        os.makedirs(logdir, exist_ok=True)
+        name = f"events.out.tfevents.{int(time.time())}.mxnet_tpu" \
+               f"{filename_suffix}"
+        self.path = os.path.join(logdir, name)
+        self._f = open(self.path, "ab")
+        self._write_event(_event(time.time(), file_version="brain.Event:2"))
+
+    # ----------------------------------------------------------- record --
+    def _write_event(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._write_event(_event(time.time(), step=global_step,
+                                 summary=_scalar_summary(tag, value)))
+
+    def add_histogram(self, tag, values, global_step=0, bins=30):
+        self._write_event(_event(time.time(), step=global_step,
+                                 summary=_histo_summary(tag, values,
+                                                        bins=bins)))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_events(path):
+    """Parse an event file back (used by tests; also handy without a
+    TensorBoard install). Returns a list of dicts with wall_time, step,
+    and {tag: value} for scalar summaries."""
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if hcrc != _masked_crc(header):
+                raise IOError("corrupt event header crc")
+            payload = f.read(length)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            if pcrc != _masked_crc(payload):
+                raise IOError("corrupt event payload crc")
+            msg = P.decode(payload)
+            ev = {"wall_time": msg.get(1, [0.0])[0],
+                  "step": msg.get(2, [0])[0], "scalars": {}}
+            for s in msg.get(5, []):
+                summ = P.decode(s)
+                for v in summ.get(1, []):
+                    val = P.decode(v)
+                    tag = val.get(1, [b""])[0].decode()
+                    if 2 in val:
+                        ev["scalars"][tag] = val[2][0]
+                    elif 5 in val:
+                        ev["scalars"][tag] = "<histogram>"
+            out.append(ev)
+    return out
