@@ -1,0 +1,10 @@
+"""gluon.contrib.rnn — contributed recurrent cells.
+
+Reference: python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py,
+rnn_cell.py (VariationalDropoutCell, LSTMPCell).
+"""
+from .conv_rnn_cell import (  # noqa: F401
+    Conv1DRNNCell, Conv2DRNNCell, Conv3DRNNCell,
+    Conv1DLSTMCell, Conv2DLSTMCell, Conv3DLSTMCell,
+    Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell)
+from .rnn_cell import VariationalDropoutCell, LSTMPCell  # noqa: F401

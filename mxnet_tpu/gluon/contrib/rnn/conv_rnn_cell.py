@@ -1,0 +1,166 @@
+"""Convolutional recurrent cells.
+
+Reference: python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py
+(_BaseConvRNNCell:33, ConvRNNCell/ConvLSTMCell/ConvGRUCell families).
+Same contract: ``input_shape`` is the per-step input (C, *spatial);
+states are (batch, hidden_channels, *spatial); i2h/h2h are
+convolutions (SAME padding derived from the kernel like the
+reference's _get_conv_out_size for stride 1).
+"""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import HybridRecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _BaseConvCell(HybridRecurrentCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, gates, ndim, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)
+        self._channels = hidden_channels
+        self._ndim = ndim
+        self._gates = gates
+        self._activation = activation
+        self._i2h_kernel = _tup(i2h_kernel, ndim)
+        self._h2h_kernel = _tup(h2h_kernel, ndim)
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, \
+                "h2h kernel must be odd for SAME-size states " \
+                "(reference conv_rnn_cell.py check)"
+        self._i2h_pad = tuple(k // 2 for k in self._i2h_kernel)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        in_c = input_shape[0]
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight",
+                shape=(gates * hidden_channels, in_c) + self._i2h_kernel,
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(gates * hidden_channels,
+                       hidden_channels) + self._h2h_kernel,
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(gates * hidden_channels,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(gates * hidden_channels,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._channels) + self._input_shape[1:]
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._ndim:]}
+                ] * self._n_states
+
+    def _conv_pre(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                  h2h_bias):
+        i2h = F.Convolution(x, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, stride=(1,) *
+                            self._ndim, pad=self._i2h_pad,
+                            num_filter=self._gates * self._channels)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, stride=(1,) *
+                            self._ndim, pad=self._h2h_pad,
+                            num_filter=self._gates * self._channels)
+        return i2h, h2h
+
+
+class _ConvRNNCell(_BaseConvCell):
+    _n_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, ndim, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, gates=1, ndim=ndim, **kwargs)
+
+    def hybrid_forward(self, F, x, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        i2h, h2h = self._conv_pre(F, x, states, i2h_weight, h2h_weight,
+                                  i2h_bias, h2h_bias)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvCell):
+    _n_states = 2
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, ndim, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, gates=4, ndim=ndim, **kwargs)
+
+    def hybrid_forward(self, F, x, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        i2h, h2h = self._conv_pre(F, x, states, i2h_weight, h2h_weight,
+                                  i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        i, f, g, o = F.split(gates, num_outputs=4, axis=1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.Activation(g, act_type=self._activation)
+        c = f * states[1] + i * g
+        out = o * F.Activation(c, act_type=self._activation)
+        return out, [out, c]
+
+
+class _ConvGRUCell(_BaseConvCell):
+    _n_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, ndim, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, gates=3, ndim=ndim, **kwargs)
+
+    def hybrid_forward(self, F, x, states, i2h_weight=None,
+                       h2h_weight=None, i2h_bias=None, h2h_bias=None):
+        i2h, h2h = self._conv_pre(F, x, states, i2h_weight, h2h_weight,
+                                  i2h_bias, h2h_bias)
+        xr, xz, xn = F.split(i2h, num_outputs=3, axis=1)
+        hr, hz, hn = F.split(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        n = F.Activation(xn + r * hn, act_type=self._activation)
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+def _make(base, ndim, name, doc_line):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, **kwargs):
+        base.__init__(self, input_shape, hidden_channels, i2h_kernel,
+                      h2h_kernel, ndim=ndim, **kwargs)
+    cls = type(name, (base,), {"__init__": __init__,
+                               "__doc__": doc_line})
+    return cls
+
+
+Conv1DRNNCell = _make(_ConvRNNCell, 1, "Conv1DRNNCell",
+                      "1D conv Elman cell (reference: "
+                      "conv_rnn_cell.py Conv1DRNNCell).")
+Conv2DRNNCell = _make(_ConvRNNCell, 2, "Conv2DRNNCell",
+                      "2D conv Elman cell.")
+Conv3DRNNCell = _make(_ConvRNNCell, 3, "Conv3DRNNCell",
+                      "3D conv Elman cell.")
+Conv1DLSTMCell = _make(_ConvLSTMCell, 1, "Conv1DLSTMCell",
+                       "1D ConvLSTM (Shi et al. 2015; reference: "
+                       "conv_rnn_cell.py Conv1DLSTMCell).")
+Conv2DLSTMCell = _make(_ConvLSTMCell, 2, "Conv2DLSTMCell",
+                       "2D ConvLSTM (Shi et al. 2015).")
+Conv3DLSTMCell = _make(_ConvLSTMCell, 3, "Conv3DLSTMCell",
+                       "3D ConvLSTM.")
+Conv1DGRUCell = _make(_ConvGRUCell, 1, "Conv1DGRUCell",
+                      "1D conv GRU cell.")
+Conv2DGRUCell = _make(_ConvGRUCell, 2, "Conv2DGRUCell",
+                      "2D conv GRU cell.")
+Conv3DGRUCell = _make(_ConvGRUCell, 3, "Conv3DGRUCell",
+                      "3D conv GRU cell.")

@@ -1,0 +1,125 @@
+"""Contributed recurrent cells.
+
+Reference: python/mxnet/gluon/contrib/rnn/rnn_cell.py
+(VariationalDropoutCell:33, LSTMPCell:184).
+"""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import ModifierCell, HybridRecurrentCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (per-sequence) dropout around a base cell
+    (reference: rnn_cell.py:33, Gal & Ghahramani 2016). One dropout
+    mask per unroll is sampled for inputs/states/outputs and reused at
+    every time step; ``reset()`` clears the masks."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    @staticmethod
+    def _mask(like, rate):
+        from .... import nd
+        keep = 1.0 - rate
+        return nd.random.uniform(0, 1, shape=like.shape,
+                                 dtype="float32") < keep
+
+    def _apply(self, x, rate, cache_attr):
+        from .... import nd, autograd
+        if rate == 0.0 or not autograd.is_training():
+            return x
+        mask = getattr(self, cache_attr)
+        if mask is None or mask.shape != x.shape:
+            mask = self._mask(x, rate).astype(x.dtype) / (1.0 - rate)
+            setattr(self, cache_attr, mask)
+        return x * mask
+
+    def hybrid_forward(self, F, x, states):
+        x = self._apply(x, self.drop_inputs, "_input_mask")
+        if self.drop_states:
+            states = [self._apply(s, self.drop_states, "_state_mask")
+                      for s in states[:1]] + list(states[1:])
+        out, nstates = self.base_cell(x, states)
+        out = self._apply(out, self.drop_outputs, "_output_mask")
+        return out, nstates
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell(in={self.drop_inputs}, "
+                f"state={self.drop_states}, out={self.drop_outputs}, "
+                f"base={self.base_cell!r})")
+
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with a projected hidden state (reference: rnn_cell.py:184,
+    Sak et al. 2014): ``r' = P (o * tanh(c))`` with P
+    (projection_size, hidden_size); the recurrent path uses the
+    projected state."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=h2r_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def _infer_param_shapes(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def hybrid_forward(self, F, x, states, i2h_weight=None,
+                       h2h_weight=None, h2r_weight=None, i2h_bias=None,
+                       h2h_bias=None):
+        h = self._hidden_size
+        gates = (F.FullyConnected(x, i2h_weight, i2h_bias,
+                                  num_hidden=4 * h)
+                 + F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                                    num_hidden=4 * h))
+        i, f, g, o = F.split(gates, num_outputs=4, axis=-1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.tanh(g)
+        c = f * states[1] + i * g
+        hidden = o * F.tanh(c)
+        r = F.FullyConnected(hidden, h2r_weight, no_bias=True,
+                             num_hidden=self._projection_size)
+        return r, [r, c]

@@ -1,0 +1,82 @@
+"""gluon.contrib.rnn cells (reference:
+python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py, rnn_cell.py).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon.contrib import rnn as crnn
+from mxnet_tpu.gluon import rnn as grnn
+import mxnet_tpu.autograd as ag
+
+
+def test_conv_lstm_cell_step_and_unroll():
+    mx.random.seed(0)
+    cell = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=5,
+                               i2h_kernel=3, h2h_kernel=3)
+    cell.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 3, 8, 8)
+                 .astype(np.float32))
+    states = cell.begin_state(batch_size=2)
+    out, nstates = cell(x, states)
+    assert out.shape == (2, 5, 8, 8)
+    assert len(nstates) == 2 and nstates[1].shape == (2, 5, 8, 8)
+    # unroll over time keeps shapes and is finite
+    seq = nd.array(np.random.RandomState(1).randn(2, 4, 3, 8, 8)
+                   .astype(np.float32))
+    outs, final = cell.unroll(4, seq, layout="TNC"
+                              if False else "NTC", merge_outputs=False)
+    assert len(outs) == 4
+    assert np.isfinite(outs[-1].asnumpy()).all()
+
+
+def test_conv_gru_and_rnn_cells():
+    for cls, states in [(crnn.Conv1DGRUCell, 1),
+                        (crnn.Conv1DRNNCell, 1)]:
+        mx.random.seed(1)
+        cell = cls(input_shape=(2, 6), hidden_channels=4)
+        cell.initialize()
+        x = nd.array(np.random.RandomState(2).randn(3, 2, 6)
+                     .astype(np.float32))
+        out, ns = cell(x, cell.begin_state(batch_size=3))
+        assert out.shape == (3, 4, 6)
+        assert len(ns) == states
+
+
+def test_lstmp_cell_projects():
+    mx.random.seed(2)
+    cell = crnn.LSTMPCell(hidden_size=16, projection_size=6)
+    cell.initialize()
+    x = nd.array(np.random.RandomState(3).randn(4, 10).astype(np.float32))
+    out, states = cell(x, cell.begin_state(batch_size=4))
+    assert out.shape == (4, 6)                 # projected
+    assert states[0].shape == (4, 6)
+    assert states[1].shape == (4, 16)          # memory cell unprojected
+    # trains
+    tr = gluon.Trainer(cell.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    with ag.record():
+        o, _ = cell(x, cell.begin_state(batch_size=4))
+        loss = (o ** 2).sum()
+    loss.backward()
+    tr.step(4)
+
+
+def test_variational_dropout_mask_is_fixed_per_unroll():
+    mx.random.seed(3)
+    base = grnn.LSTMCell(8)
+    cell = crnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    cell.initialize()
+    x = nd.array(np.ones((2, 4), np.float32))
+    states = cell.begin_state(batch_size=2)
+    with ag.record():     # masks only apply in training mode
+        out1, states = cell(x, states)
+        out2, _ = cell(x, states)
+    m1 = np.asarray(out1.asnumpy() == 0)
+    m2 = np.asarray(out2.asnumpy() == 0)
+    # the same output units are dropped at both steps
+    np.testing.assert_array_equal(m1, m2)
+    assert m1.any()
+    # eval mode: no dropout
+    out3, _ = cell(x, cell.begin_state(batch_size=2))
+    assert not (out3.asnumpy() == 0).all()
