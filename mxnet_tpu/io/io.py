@@ -347,7 +347,38 @@ class CSVIter(NDArrayIter):
         super().__init__(data, label, batch_size=batch_size, **kwargs)
 
 
-def MXDataIter(*args, **kwargs):
+def MXDataIter(iter_name, *args, **kwargs):
+    """Dispatch the reference's C++ iterator names to their TPU-build
+    equivalents (reference: python/mxnet/io/io.py:935 creates C++
+    iterators via MXDataIterCreateIter; here each name maps to the
+    Python/native-reader implementation of the same pipeline):
+
+    - ImageRecordIter / ImageRecordIter_v1 -> image.ImageIter over the
+      native C++ RecordIO reader (mxnet_tpu/native)
+    - CSVIter -> CSVIter
+    - NDArrayIter/MNISTIter-style in-memory data -> NDArrayIter
+    """
+    name = iter_name if isinstance(iter_name, str) else \
+        getattr(iter_name, "__name__", str(iter_name))
+    if name in ("ImageRecordIter", "ImageRecordIter_v1",
+                "ImageRecordUInt8Iter"):
+        from ..image import ImageIter
+        kwargs.pop("preprocess_threads", None)
+        kwargs.pop("verbose", None)
+        resize = kwargs.pop("resize", 0)
+        if resize and "aug_list" not in kwargs:
+            from ..image import CreateAugmenter
+            kwargs["aug_list"] = CreateAugmenter(
+                data_shape=tuple(kwargs.get("data_shape")),
+                resize=resize,
+                rand_crop=kwargs.pop("rand_crop", False),
+                rand_mirror=kwargs.pop("rand_mirror", False))
+        return ImageIter(*args, **kwargs)
+    if name == "CSVIter":
+        return CSVIter(*args, **kwargs)
+    if name in ("NDArrayIter", "MNISTIter"):
+        return NDArrayIter(*args, **kwargs)
     raise MXNetError(
-        "MXDataIter wrapped the reference's C++ iterators; on the TPU "
-        "build use NDArrayIter, CSVIter, or gluon.data.DataLoader")
+        f"MXDataIter: no TPU-build equivalent for {name!r}; use "
+        "NDArrayIter, CSVIter, image.ImageIter, or "
+        "gluon.data.DataLoader")

@@ -125,3 +125,38 @@ def test_ndarray_iter_discard():
     assert len(batches) == 2
     for b in batches:
         assert b.data[0].shape == (4, 2)
+
+
+def test_mxdataiter_dispatch(tmp_path):
+    """MXDataIter maps the reference's C++ iterator names onto the
+    TPU-build pipelines (reference: io.py MXDataIter)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    cv2 = pytest.importorskip("cv2")
+    from mxnet_tpu import recordio
+
+    # build a tiny .rec
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(8):
+        img = (rng.rand(24, 24, 3) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        header = recordio.IRHeader(0, float(i % 2), i, 0)
+        w.write_idx(i, recordio.pack(header, buf.tobytes()))
+    w.close()
+
+    it = mx.io.MXDataIter("ImageRecordIter", batch_size=4,
+                          data_shape=(3, 24, 24), path_imgrec=rec)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    assert batch.label[0].shape[0] == 4
+
+    # CSV dispatch
+    csv = tmp_path / "x.csv"
+    np.savetxt(csv, rng.rand(6, 5), delimiter=",")
+    it2 = mx.io.MXDataIter("CSVIter", data_csv=str(csv),
+                           data_shape=(5,), batch_size=3)
+    b2 = next(iter(it2))
+    assert b2.data[0].shape == (3, 5)
