@@ -144,7 +144,42 @@ NN_OPS = [
                                          "_numeric_grad_inputs": ()}),
 ]
 
-ALL_CASES = UNARY_SMOOTH + BINARY + REDUCE_SHAPE + NN_OPS
+R3_OPS = [
+    # round-3 additions: differentiable tail ops get the same oracle
+    ("_s2d_stem_conv", [_r(1, 8, 8, 3), _r(4, 7, 7, 3, seed=1,
+                                           scale=0.3)], {}),
+    ("_contrib_interleaved_matmul_selfatt_qk",
+     [_r(4, 2, 3 * 2 * 3, scale=0.5)], {"heads": 2}),
+    ("_contrib_interleaved_matmul_selfatt_valatt",
+     [_r(4, 2, 3 * 2 * 3, scale=0.5), _r(4, 4, 4, seed=1, scale=0.3)],
+     {"heads": 2}),
+    ("Correlation", [_r(1, 2, 4, 4), _r(1, 2, 4, 4, seed=1)],
+     {"kernel_size": 1, "max_displacement": 1, "pad_size": 1}),
+    # numeric diff is O(size) forwards and the deformable forward is a
+    # python tap loop: keep it tiny and check only the 12-element weight
+    ("_contrib_DeformableConvolution",
+     [_r(1, 1, 3, 3), _r(1, 8, 2, 2, seed=1, scale=0.2),
+      _r(3, 1, 2, 2, seed=2, scale=0.5)],
+     {"kernel": (2, 2), "pad": (0, 0), "num_filter": 3, "no_bias": True,
+      "_numeric_grad_inputs": (2,)}),
+    ("_contrib_RROIAlign",
+     [_r(1, 2, 8, 8), np.array([[0, 4.0, 4.0, 4.0, 4.0, 20.0]],
+                               np.float32)],
+     {"pooled_size": (2, 2), "_numeric_grad_inputs": (0,)}),
+    ("GroupNorm", [_r(2, 4, 3), np.ones(4, np.float32),
+                   np.zeros(4, np.float32)], {"num_groups": 2}),
+    ("InstanceNorm", [_r(2, 3, 5), np.ones(3, np.float32),
+                      np.zeros(3, np.float32)], {}),
+    ("im2col", [_r(1, 2, 5, 5)], {"kernel": (3, 3), "stride": (1, 1),
+                                  "pad": (1, 1)}),
+    ("_image_normalize", [_r(3, 4, 4)], {"mean": 0.2, "std": 0.7}),
+    ("_contrib_count_sketch",
+     [_r(2, 4), np.array([0.0, 1, 0, 2]),
+      np.array([1.0, -1, 1, -1], np.float32)],
+     {"out_dim": 3, "_numeric_grad_inputs": (0,)}),
+]
+
+ALL_CASES = UNARY_SMOOTH + BINARY + REDUCE_SHAPE + NN_OPS + R3_OPS
 
 
 @pytest.mark.parametrize(
