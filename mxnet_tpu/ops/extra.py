@@ -117,12 +117,6 @@ _reg("_slice_assign_scalar",
      _slice_assign(lhs, scalar, begin, end, step))
 
 
-def _scatter_set_nd(lhs, indices, shape=None):
-    raise NotImplementedError(
-        "_scatter_set_nd is an in-place alias used by the reference's "
-        "advanced indexing; use NDArray.__setitem__ / scatter_nd")
-
-
 def _im2col(data, kernel=None, stride=None, dilate=None, pad=None):
     """reference: src/operator/nn/im2col.h via lax patch extraction.
     data (N, C, H, W) -> (N, C*kh*kw, L)."""
