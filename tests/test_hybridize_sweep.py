@@ -1,0 +1,84 @@
+"""Eager vs hybridized equality across the gluon layer zoo.
+
+The CachedOp jit path (gluon/block.py) must be numerically transparent
+for every layer — the property the reference pins per-layer in
+tests/python/unittest/test_gluon.py; here swept uniformly.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+import mxnet_tpu.autograd as ag
+
+
+def _mk(layer_fn, shape, seed=0):
+    mx.random.seed(seed)
+    net = layer_fn()
+    net.initialize()
+    x = nd.array(np.random.RandomState(seed).randn(*shape)
+                 .astype(np.float32))
+    return net, x
+
+
+CASES = [
+    ("dense", lambda: nn.Dense(8, activation="relu"), (4, 6)),
+    ("dense_nobias", lambda: nn.Dense(5, use_bias=False), (3, 7)),
+    ("conv2d", lambda: nn.Conv2D(6, 3, padding=1), (2, 3, 8, 8)),
+    ("conv2d_nhwc", lambda: nn.Conv2D(6, 3, padding=1, layout="NHWC"),
+     (2, 8, 8, 3)),
+    ("conv1d", lambda: nn.Conv1D(4, 3, padding=1), (2, 3, 9)),
+    ("conv2dT", lambda: nn.Conv2DTranspose(4, 2, strides=2),
+     (2, 3, 5, 5)),
+    ("maxpool", lambda: nn.MaxPool2D(2), (2, 3, 8, 8)),
+    ("avgpool", lambda: nn.AvgPool2D(2), (2, 3, 8, 8)),
+    ("gap", lambda: nn.GlobalAvgPool2D(), (2, 3, 6, 6)),
+    ("batchnorm", lambda: nn.BatchNorm(), (4, 3, 5)),
+    ("layernorm", lambda: nn.LayerNorm(), (4, 6)),
+    ("instancenorm", lambda: nn.InstanceNorm(), (3, 4, 6)),
+    ("dropout_eval", lambda: nn.Dropout(0.5), (4, 6)),
+    ("embedding", lambda: nn.Embedding(20, 5), (3, 4)),
+    ("leakyrelu", lambda: nn.LeakyReLU(0.1), (3, 5)),
+    ("prelu", lambda: nn.PReLU(), (3, 5)),
+    ("elu", lambda: nn.ELU(), (3, 5)),
+    ("swish", lambda: nn.Swish(), (3, 5)),
+    ("flatten", lambda: nn.Flatten(), (2, 3, 4)),
+]
+
+
+@pytest.mark.parametrize("name,layer_fn,shape",
+                         CASES, ids=[c[0] for c in CASES])
+def test_hybridize_matches_eager(name, layer_fn, shape):
+    net, x = _mk(layer_fn, shape)
+    with ag.pause():
+        eager = net(x).asnumpy()
+    net.hybridize()
+    with ag.pause():
+        hybrid1 = net(x).asnumpy()
+        hybrid2 = net(x).asnumpy()      # second call: cached program
+    np.testing.assert_allclose(hybrid1, eager, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(hybrid2, eager, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name,layer_fn,shape",
+                         [c for c in CASES if c[0] not in
+                          ("dropout_eval", "embedding")],
+                         ids=[c[0] for c in CASES
+                              if c[0] not in ("dropout_eval",
+                                              "embedding")])
+def test_hybridize_gradients_match_eager(name, layer_fn, shape):
+    net, x = _mk(layer_fn, shape, seed=1)
+    x.attach_grad()
+    with ag.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g_eager = x.grad.asnumpy()
+    net.hybridize()
+    x2 = nd.array(x.asnumpy())
+    x2.attach_grad()
+    with ag.record():
+        loss2 = (net(x2) ** 2).sum()
+    loss2.backward()
+    np.testing.assert_allclose(x2.grad.asnumpy(), g_eager, rtol=1e-4,
+                               atol=1e-5)
