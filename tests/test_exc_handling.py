@@ -1,0 +1,94 @@
+"""Exception propagation semantics.
+
+Reference analogue: tests/python/unittest/test_exc_handling.py. The
+reference's async engine defers kernel errors until WaitToRead, so it
+tests that exceptions surface on wait. This framework's contract is
+STRONGER and pinned here: shape/validity errors raise synchronously at
+the call site (imperative) or at trace/compile time (hybridized), never
+silently poisoning later reads — immutability + tracing remove the
+deferred-failure window the reference had to test around.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon import nn
+import mxnet_tpu.autograd as ag
+
+
+def test_imperative_shape_error_raises_at_callsite():
+    a = nd.array(np.ones((2, 3)))
+    b = nd.array(np.ones((4, 5)))
+    with pytest.raises(Exception):
+        (a + b).asnumpy()
+
+
+def test_dot_shape_error_is_synchronous():
+    a = nd.array(np.ones((2, 3)))
+    b = nd.array(np.ones((4, 5)))
+    raised = False
+    try:
+        nd.dot(a, b)
+    except Exception:
+        raised = True
+    assert raised, "mismatched dot must raise at the call site"
+
+
+def test_hybridized_error_raises_at_first_call():
+    net = nn.Dense(4, in_units=7, flatten=False)
+    net.initialize()
+    net.hybridize()
+    with pytest.raises(Exception):
+        net(nd.array(np.ones((2, 5))))     # wrong in_units
+
+
+def test_custom_op_exception_propagates():
+    """Errors inside a Python CustomOp callback must reach the caller
+    (reference: test_exc_handling.py test_custom_op_exc)."""
+    class Bad(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            raise RuntimeError("boom in custom op")
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            pass
+
+    @mx.operator.register("bad_op_exc_test")
+    class BadProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Bad()
+
+    x = nd.array(np.ones((2, 2)))
+    with pytest.raises(Exception, match="boom"):
+        out = nd.Custom(x, op_type="bad_op_exc_test")
+        out.asnumpy()                      # force execution
+
+
+def test_backward_without_record_raises():
+    x = nd.array(np.ones(3))
+    x.attach_grad()
+    y = (x * 2).sum()                      # computed OUTSIDE record()
+    with pytest.raises(Exception):
+        y.backward()
+
+
+def test_error_does_not_poison_subsequent_ops():
+    """After a failed op, the imperative frontend keeps working — the
+    reference had to re-create executors after engine errors."""
+    a = nd.array(np.ones((2, 3)))
+    try:
+        nd.dot(a, nd.array(np.ones((4, 5))))
+    except Exception:
+        pass
+    out = (a * 3).asnumpy()
+    np.testing.assert_allclose(out, 3.0)
