@@ -22,7 +22,8 @@ from . import autograd
 
 __all__ = ["default_context", "rand_ndarray", "assert_almost_equal",
            "numeric_grad", "check_numeric_gradient",
-           "check_eager_jit_consistency", "same", "almost_equal"]
+           "check_eager_jit_consistency", "check_consistency", "same",
+           "almost_equal"]
 
 
 def default_context():
@@ -156,3 +157,56 @@ def check_eager_jit_consistency(op_name, inputs, kwargs=None, rtol=1e-5,
                  else zip(eager, jitted)):
         assert_almost_equal(np.asarray(j), np.asarray(e), rtol=rtol,
                             atol=atol, names=("jit", "eager"))
+
+
+def check_consistency(op_name, inputs, kwargs=None, dtypes=None,
+                      rtol=None, atol=None):
+    """Run one op on every available context and dtype and compare the
+    results pairwise (reference: test_utils.py:1460 check_consistency,
+    which compared CPU vs GPU executors). Contexts: host CPU plus the
+    accelerator when one is present; dtypes default to
+    (float64, float32, bfloat16-ish tolerance ladder). The highest-
+    precision result is the reference; every other (ctx, dtype) result
+    must match within its dtype tolerance.
+    """
+    import jax
+    from .context import cpu, num_tpus, tpu
+    from .ops.registry import get as get_op
+
+    kwargs = kwargs or {}
+    dtypes = dtypes or [np.float64, np.float32]
+    tol = {np.dtype(np.float64): (1e-10, 1e-12),
+           np.dtype(np.float32): (1e-4, 1e-5),
+           np.dtype("bfloat16"): (2e-2, 1e-2),
+           np.dtype(np.float16): (1e-2, 1e-2)}
+    if rtol is not None:
+        tol = {k: (rtol, atol if atol is not None else 0.0) for k in tol}
+
+    ctxs = [cpu()]
+    if num_tpus() > 0:
+        ctxs.append(tpu())
+    op = get_op(op_name)
+
+    results = {}
+    for ctx in ctxs:
+        for dt in dtypes:
+            cast = [np.asarray(x).astype(dt)
+                    if np.issubdtype(np.asarray(x).dtype, np.floating)
+                    else np.asarray(x) for x in inputs]
+            import jax.numpy as jnp
+            with jax.default_device(ctx.jax_device):
+                arrays = [jnp.asarray(c) for c in cast]
+                out = op.impl(*arrays, **kwargs)
+            out0 = out[0] if isinstance(out, (tuple, list)) else out
+            results[(str(ctx), np.dtype(dt))] = np.asarray(
+                out0, dtype=np.float64)
+
+    ref_key = min(results, key=lambda k: np.dtype(k[1]).itemsize * -1)
+    ref = results[ref_key]
+    for key, val in results.items():
+        if key == ref_key:
+            continue
+        r, a = tol[np.dtype(key[1])]
+        assert_almost_equal(val, ref, rtol=r, atol=a,
+                            names=(str(key), str(ref_key)))
+    return results

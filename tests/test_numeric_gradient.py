@@ -210,3 +210,28 @@ def test_assert_almost_equal_reports_location():
     b[1, 0] = 1.0
     with pytest.raises(AssertionError, match=r"\(1, 0\)"):
         assert_almost_equal(a, b)
+
+
+@pytest.mark.parametrize("op,inputs,kwargs", [
+    ("FullyConnected", [np.random.RandomState(0).randn(3, 4)
+                        .astype(np.float32),
+                        np.random.RandomState(1).randn(5, 4)
+                        .astype(np.float32),
+                        np.random.RandomState(2).randn(5)
+                        .astype(np.float32)], {"num_hidden": 5}),
+    ("softmax", [np.random.RandomState(0).randn(3, 5)
+                 .astype(np.float32)], {}),
+    ("Convolution", [np.random.RandomState(0).randn(1, 2, 5, 5)
+                     .astype(np.float32),
+                     np.random.RandomState(1).randn(3, 2, 3, 3)
+                     .astype(np.float32) * 0.5,
+                     np.zeros(3, np.float32)],
+     {"kernel": (3, 3), "num_filter": 3, "pad": (1, 1)}),
+])
+def test_check_consistency_across_contexts_dtypes(op, inputs, kwargs):
+    """test_utils.check_consistency (reference: test_utils.py:1460):
+    results agree across every available context and the fp64/fp32
+    dtype ladder."""
+    from mxnet_tpu.test_utils import check_consistency
+    results = check_consistency(op, inputs, kwargs)
+    assert len(results) >= 2
