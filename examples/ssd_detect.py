@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""SSD-300 detection: train a few steps on synthetic boxes, then run
+full inference (forward + decode + NMS).
+
+Reference example: example/ssd/ (train.py + demo.py). Data is synthetic
+(colored rectangles on noise with their boxes as labels), so the script
+runs with zero egress; swap in a .rec dataset packed by
+tools/im2rec.py + image.ImageDetIter for real training.
+
+  python examples/ssd_detect.py --steps 10
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, nd  # noqa: E402
+import mxnet_tpu.autograd as ag  # noqa: E402
+from mxnet_tpu.gluon.model_zoo.ssd import (  # noqa: E402
+    ssd_300_vgg16_reduced, MultiBoxLoss)
+
+
+def synthetic_batch(rng, n, size=300):
+    """Images with one bright rectangle each; label rows
+    [cls, x1, y1, x2, y2, difficult] normalized to [0, 1]."""
+    x = rng.rand(n, 3, size, size).astype(np.float32) * 0.1
+    labels = np.zeros((n, 1, 6), np.float32)
+    for i in range(n):
+        w, h = rng.randint(60, 150, 2)
+        x1, y1 = rng.randint(0, size - w), rng.randint(0, size - h)
+        cls = rng.randint(0, 2)
+        x[i, cls, y1:y1 + h, x1:x1 + w] += 0.8
+        labels[i, 0] = [cls, x1 / size, y1 / size, (x1 + w) / size,
+                        (y1 + h) / size, 0]
+    return x, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=2)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    net = ssd_300_vgg16_reduced(classes=2)
+    net.initialize(init=mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    loss_fn = MultiBoxLoss()
+
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        xb, yb = synthetic_batch(rng, args.batch_size)
+        with ag.record():
+            cls_preds, loc_preds, anchors = net(nd.array(xb))
+            loss = loss_fn(cls_preds, loc_preds, nd.array(yb),
+                           anchors).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step % 2 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss {float(loss.asnumpy()):.4f}")
+
+    # inference: top detections on a fresh image
+    xb, yb = synthetic_batch(rng, 1)
+    with ag.pause(train_mode=False):
+        dets = net.detect(nd.array(xb), threshold=0.05).asnumpy()[0]
+    kept = dets[dets[:, 0] >= 0][:5]
+    print("top detections [cls, score, x1, y1, x2, y2]:")
+    for row in kept:
+        print("  ", np.round(row, 3))
+    print("ground truth:", np.round(yb[0, 0], 3))
+
+
+if __name__ == "__main__":
+    main()
