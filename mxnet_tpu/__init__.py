@@ -54,9 +54,12 @@ _LAZY_MODULES = ("numpy", "numpy_extension", "symbol", "gluon", "module",
                  "image", "parallel", "profiler", "lr_scheduler",
                  "callback", "test_utils", "util", "runtime", "amp",
                  "recordio", "executor", "monitor", "model", "operator",
-                 "contrib", "onnx", "native")
+                 "contrib", "onnx", "native", "library", "visualization",
+                 "error", "engine", "attribute", "name")
 
-_ALIAS = {"np": "numpy", "npx": "numpy_extension", "sym": "symbol",
+
+
+_ALIAS = {"np": "numpy", "npx": "numpy_extension", "sym": "symbol", "viz": "visualization",
           "mod": "module", "kv": "kvstore"}
 
 

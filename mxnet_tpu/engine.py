@@ -1,0 +1,32 @@
+"""mx.engine — execution-engine controls (compatibility surface).
+
+Reference: python/mxnet/engine.py (bulk/set_bulk_size batching of
+engine ops to amortize dependency-tracking overhead). There is no
+dependency engine here: JAX async dispatch queues work and XLA fuses
+whole programs, so bulking is inherent. The API is kept so reference
+training loops (`with mx.engine.bulk(64):`) run unchanged as no-ops.
+"""
+from contextlib import contextmanager
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_BULK_SIZE = 15
+
+
+def set_bulk_size(size):
+    """Set the bulk size (reference: engine.py:49). Returns the
+    previous value; advisory only on this backend."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+@contextmanager
+def bulk(size):
+    """Bulk scope (reference: engine.py:91) — a no-op context: XLA
+    already executes each jitted step as one fused program."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

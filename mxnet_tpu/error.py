@@ -1,0 +1,45 @@
+"""mx.error — typed error hierarchy.
+
+Reference: python/mxnet/error.py (MXNetError + per-kind registry used
+by the C FFI to rethrow typed errors). No C boundary here, so the
+classes exist for API/except-clause compatibility.
+"""
+from .base import MXNetError
+
+__all__ = ["MXNetError", "InternalError", "ValueError", "TypeError",
+           "IndexError", "NotImplementedForSymbol", "register_error"]
+
+
+class InternalError(MXNetError):
+    pass
+
+
+class ValueError(MXNetError, ValueError):
+    pass
+
+
+class TypeError(MXNetError, TypeError):
+    pass
+
+
+class IndexError(MXNetError, IndexError):
+    pass
+
+
+class NotImplementedForSymbol(MXNetError):
+    pass
+
+
+_ERROR_REGISTRY = {"MXNetError": MXNetError}
+
+
+def register_error(func_name=None, cls=None):
+    """Register a custom error class (reference: error.py register)."""
+    def _do(c, name):
+        _ERROR_REGISTRY[name] = c
+        return c
+    if callable(func_name) and cls is None:
+        return _do(func_name, func_name.__name__)
+    if cls is not None:
+        return _do(cls, func_name or cls.__name__)
+    return lambda c: _do(c, func_name or c.__name__)

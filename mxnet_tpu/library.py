@@ -1,0 +1,143 @@
+"""mx.library — load external operator libraries (plugins).
+
+Reference: python/mxnet/library.py (MXLoadLib) + include/mxnet/lib_api.h:
+user-compiled shared libraries register custom operators into the
+running framework. The TPU build keeps the capability with a simpler C
+ABI (lib_api.h's 4k-line header exists to marshal NDArrays through the
+engine; here host ops marshal plain buffers through ctypes and run as
+jax host callbacks, so a plugin is a handful of exported symbols):
+
+  // number of ops in the library
+  int mxtpu_num_ops(void);
+  // name of op i (NUL-terminated, static storage)
+  const char* mxtpu_op_name(int i);
+  // compute: inputs/outputs as float32 buffers.
+  //   in/out descriptors: n_arrays, per-array (data*, ndim, shape*)
+  //   returns 0 on success
+  int mxtpu_op_compute(int i,
+                       int n_in, const float** in, const int* in_ndim,
+                       const long* const* in_shape,
+                       float* out, const long* out_shape, int out_ndim);
+  // output shape inference: writes out_shape/out_ndim from input shapes
+  int mxtpu_op_infer_shape(int i,
+                           int n_in, const int* in_ndim,
+                           const long* const* in_shape,
+                           long* out_shape, int* out_ndim);
+
+Loaded ops register under their exported names as host ops (CPU
+callback), callable from nd/sym/gluon like any other operator. See
+tests/test_library.py for a complete C++ plugin built with g++.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as _np
+
+__all__ = ["load", "loaded_libraries"]
+
+_LOADED = {}
+
+
+def loaded_libraries():
+    return dict(_LOADED)
+
+
+def _bind(lib):
+    lib.mxtpu_num_ops.restype = ctypes.c_int
+    lib.mxtpu_op_name.restype = ctypes.c_char_p
+    lib.mxtpu_op_name.argtypes = [ctypes.c_int]
+    lib.mxtpu_op_compute.restype = ctypes.c_int
+    lib.mxtpu_op_infer_shape.restype = ctypes.c_int
+
+
+def load(path, verbose=True):
+    """Load an operator library and register its ops (reference:
+    library.py:29 load). Returns the list of registered op names."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise OSError(f"library not found: {path}")
+    lib = ctypes.CDLL(path)
+    for sym in ("mxtpu_num_ops", "mxtpu_op_name", "mxtpu_op_compute",
+                "mxtpu_op_infer_shape"):
+        if not hasattr(lib, sym):
+            raise OSError(
+                f"{path} does not export {sym!r}; not an mxnet_tpu op "
+                "library (see mxnet_tpu/library.py for the ABI)")
+    _bind(lib)
+
+    from .ops.registry import _REGISTRY, Operator
+
+    names = []
+    for i in range(lib.mxtpu_num_ops()):
+        name = lib.mxtpu_op_name(i).decode()
+        _REGISTRY[name] = Operator(name, _make_impl(lib, i, name),
+                                   host_op=True, differentiable=False)
+        names.append(name)
+    # expose the new ops on the nd namespace
+    from . import ndarray as _nd
+    from .ndarray.register import make_op_func
+    for name in names:
+        setattr(_nd, name, make_op_func(_REGISTRY[name]))
+    _LOADED[path] = names
+    if verbose:
+        print(f"loaded library {path!r}: ops {names}")
+    return names
+
+
+def _make_impl(lib, index, name):
+    import jax
+
+    def infer(shapes):
+        n = len(shapes)
+        ndims = (ctypes.c_int * n)(*[len(s) for s in shapes])
+        shape_arrs = [(ctypes.c_long * len(s))(*s) for s in shapes]
+        shape_ptrs = (ctypes.POINTER(ctypes.c_long) * n)(
+            *[ctypes.cast(a, ctypes.POINTER(ctypes.c_long))
+              for a in shape_arrs])
+        out_shape = (ctypes.c_long * 8)()
+        out_ndim = ctypes.c_int()
+        rc = lib.mxtpu_op_infer_shape(index, n, ndims, shape_ptrs,
+                                      out_shape, ctypes.byref(out_ndim))
+        if rc != 0:
+            raise RuntimeError(f"{name}: infer_shape failed ({rc})")
+        return tuple(out_shape[j] for j in range(out_ndim.value))
+
+    def host_compute(*arrays):
+        arrays = [_np.ascontiguousarray(_np.asarray(a, _np.float32))
+                  for a in arrays]
+        out_shape = infer([a.shape for a in arrays])
+        out = _np.zeros(out_shape, _np.float32)
+        n = len(arrays)
+        ptrs = (ctypes.POINTER(ctypes.c_float) * n)(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        ndims = (ctypes.c_int * n)(*[a.ndim for a in arrays])
+        shape_arrs = [(ctypes.c_long * a.ndim)(*a.shape)
+                      for a in arrays]
+        shape_ptrs = (ctypes.POINTER(ctypes.c_long) * n)(
+            *[ctypes.cast(s, ctypes.POINTER(ctypes.c_long))
+              for s in shape_arrs])
+        oshape = (ctypes.c_long * out.ndim)(*out.shape)
+        rc = lib.mxtpu_op_compute(
+            index, n, ptrs, ndims, shape_ptrs,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), oshape,
+            out.ndim)
+        if rc != 0:
+            raise RuntimeError(f"{name}: compute failed ({rc})")
+        return out
+
+    def impl(*arrays, **kw):
+        concrete = not any(isinstance(a, jax.core.Tracer)
+                           for a in arrays)
+        if concrete:
+            import jax.numpy as jnp
+            return jnp.asarray(host_compute(*[_np.asarray(a)
+                                              for a in arrays]))
+        out_shape = infer([tuple(a.shape) for a in arrays])
+        return jax.pure_callback(
+            host_compute, jax.ShapeDtypeStruct(out_shape, _np.float32),
+            *arrays)
+
+    return impl

@@ -1,0 +1,74 @@
+"""Top-level compatibility modules: viz, engine, attribute, name,
+error (reference: python/mxnet/{visualization,engine,attribute,name,
+error}.py).
+"""
+import contextlib
+import io
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    d = mx.sym.var("data")
+    n = mx.sym.FullyConnected(d, name="fc1", num_hidden=16)
+    n = mx.sym.Activation(n, act_type="relu", name="act1")
+    return mx.sym.FullyConnected(n, name="fc2", num_hidden=4)
+
+
+def test_print_summary_counts_params():
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        total = mx.viz.print_summary(_mlp(), shape={"data": (1, 10)})
+    assert total == 10 * 16 + 16 + 16 * 4 + 4
+    text = buf.getvalue()
+    assert "fc1" in text and "FullyConnected" in text
+    assert f"Total params: {total}" in text
+
+
+def test_plot_network_requires_graphviz():
+    try:
+        import graphviz  # noqa: F401
+        has = True
+    except ImportError:
+        has = False
+    if has:
+        dot = mx.viz.plot_network(_mlp())
+        assert "fc1" in dot.source
+    else:
+        with pytest.raises(ImportError):
+            mx.viz.plot_network(_mlp())
+
+
+def test_engine_bulk_scope():
+    prev = mx.engine.set_bulk_size(10)
+    with mx.engine.bulk(64):
+        pass
+    mx.engine.set_bulk_size(prev)
+
+
+def test_attr_scope_and_name_manager():
+    with mx.attribute.AttrScope(__lr_mult__="2.0"):
+        with mx.attribute.AttrScope(ctx_group="dev1"):
+            attrs = mx.attribute.get_current_attrs()
+    assert attrs == {"__lr_mult__": "2.0", "ctx_group": "dev1"}
+    with pytest.raises(ValueError):
+        mx.attribute.AttrScope(bad=3)
+    with mx.name.Prefix("s1_"):
+        nm = mx.name.current()
+        assert nm.get(None, "conv") == "s1_conv0"
+        assert nm.get(None, "conv") == "s1_conv1"
+        assert nm.get("explicit", "conv") == "s1_explicit"
+
+
+def test_error_hierarchy():
+    assert issubclass(mx.error.ValueError, mx.error.MXNetError)
+    assert issubclass(mx.error.ValueError, ValueError)
+    with pytest.raises(ValueError):
+        raise mx.error.ValueError("boom")
+
+    @mx.error.register_error("CustomErr")
+    class CustomErr(mx.error.MXNetError):
+        pass
