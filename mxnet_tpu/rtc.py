@@ -1,0 +1,118 @@
+"""mx.rtc — runtime kernel compilation (the Pallas escape hatch).
+
+Reference: python/mxnet/rtc.py (CudaModule/CudaKernel:28 — compile CUDA
+C source at runtime and launch it on arrays). The TPU-native analogue
+compiles Pallas kernels: a user writes a Python kernel body against
+``pl.BlockSpec`` refs, registers it, and calls it like any other
+operator (nd.*, inside hybridized blocks, under jit). On non-TPU
+backends the kernel runs in Pallas interpret mode, so the same code
+tests on CPU and compiles to Mosaic on TPU — the role runtime CUDA
+compilation played in the reference.
+
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def scale_add(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+
+    mx.rtc.register_pallas_op("my_scale_add", scale_add)
+    out = mx.nd.my_scale_add(a, b)
+
+``CudaModule`` is kept as a named stub that points here, so reference
+code fails with a actionable message rather than an AttributeError.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["register_pallas_op", "CudaModule"]
+
+
+def _default_out(shapes, dtypes):
+    return shapes[0], dtypes[0]
+
+
+def register_pallas_op(name, kernel, out_shape=None, grid=None,
+                       in_specs=None, out_specs=None, reference_fn=None,
+                       interpret=None):
+    """Register a Pallas kernel as a framework operator.
+
+    - ``kernel(*in_refs, out_ref)``: Pallas kernel body.
+    - ``out_shape``: callable (shapes, dtypes) -> (shape, dtype); default
+      mirrors input 0 (elementwise kernels).
+    - ``grid``/``in_specs``/``out_specs``: forwarded to pallas_call for
+      blocked kernels; omitted = whole-array refs.
+    - ``reference_fn``: optional plain-jnp implementation of the same
+      math. When given, the op is differentiable: the Pallas kernel runs
+      the forward and the backward is jax.vjp of ``reference_fn`` — the
+      same custom_vjp pattern ops/flash_attention.py uses (Pallas has no
+      generic reverse-mode rule). Without it, the op is forward-only.
+    - ``interpret``: force interpret mode; default auto (interpret
+      everywhere except real TPU backends).
+
+    Returns the op name; the op is immediately available as ``nd.<name>``
+    and in Symbol/Gluon.
+    """
+    import jax
+    from jax.experimental import pallas as pl
+
+    shape_fn = out_shape or _default_out
+
+    def run_kernel(*arrays):
+        shapes = [tuple(a.shape) for a in arrays]
+        dtypes = [a.dtype for a in arrays]
+        oshape, odtype = shape_fn(shapes, dtypes)
+        if interpret is None:
+            interp = jax.default_backend() not in ("tpu",)
+        else:
+            interp = interpret
+        call_kwargs = {}
+        if grid is not None:
+            call_kwargs["grid"] = grid
+        if in_specs is not None:
+            call_kwargs["in_specs"] = in_specs
+        if out_specs is not None:
+            call_kwargs["out_specs"] = out_specs
+        fn = pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct(oshape, odtype),
+            interpret=interp, **call_kwargs)
+        return fn(*arrays)
+
+    if reference_fn is not None:
+        @jax.custom_vjp
+        def core(*arrays):
+            return run_kernel(*arrays)
+
+        def core_fwd(*arrays):
+            return run_kernel(*arrays), arrays
+
+        def core_bwd(res, g):
+            _, vjp = jax.vjp(reference_fn, *res)
+            return vjp(g)
+
+        core.defvjp(core_fwd, core_bwd)
+        impl = lambda *arrays, **kw: core(*arrays)   # noqa: E731
+        differentiable = True
+    else:
+        impl = lambda *arrays, **kw: run_kernel(*arrays)  # noqa: E731
+        differentiable = False
+
+    from .ops.registry import _REGISTRY, Operator
+    _REGISTRY[name] = Operator(name, impl,
+                               differentiable=differentiable)
+    from . import ndarray as _nd
+    from .ndarray.register import make_op_func
+    setattr(_nd, name, make_op_func(_REGISTRY[name]))
+    return name
+
+
+class CudaModule:
+    """Reference rtc.CudaModule compiled CUDA C at runtime; there is no
+    CUDA on this backend. Use register_pallas_op (same capability,
+    TPU-native)."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "CUDA RTC does not exist on the TPU build; write the kernel "
+            "in Pallas and mx.rtc.register_pallas_op it (module "
+            "docstring has a template)")

@@ -1,0 +1,55 @@
+"""mx.rtc — runtime Pallas kernel registration (reference: rtc.py
+CudaModule/CudaKernel; here Pallas is the runtime-compiled kernel
+path).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.autograd as ag
+
+
+def test_register_and_run_pallas_op():
+    from jax.experimental import pallas as pl  # noqa: F401
+
+    def scale_add(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+
+    mx.rtc.register_pallas_op("rtc_scale_add", scale_add)
+    a = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    b = nd.array(np.ones((2, 4), np.float32))
+    out = nd.rtc_scale_add(a, b)
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy() * 2 + 1)
+
+
+def test_registered_kernel_is_differentiable():
+    def sq(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * x_ref[...]
+
+    mx.rtc.register_pallas_op("rtc_square", sq,
+                              reference_fn=lambda x: x * x)
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = nd.rtc_square(x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_custom_out_shape():
+    import jax.numpy as jnp
+
+    def rowsum(x_ref, o_ref):
+        o_ref[...] = jnp.sum(x_ref[...], axis=1)
+
+    mx.rtc.register_pallas_op(
+        "rtc_rowsum", rowsum,
+        out_shape=lambda shapes, dtypes: ((shapes[0][0],), dtypes[0]))
+    x = nd.array(np.ones((3, 5), np.float32))
+    np.testing.assert_allclose(nd.rtc_rowsum(x).asnumpy(), [5, 5, 5])
+
+
+def test_cuda_module_points_to_pallas():
+    with pytest.raises(NotImplementedError, match="[Pp]allas"):
+        mx.rtc.CudaModule("__global__ void k() {}")
