@@ -87,7 +87,7 @@ def _make_sym_func(op: Operator):
         skip = 1 if op.variadic else min(len(syms), n_pos)
         for v, pname in zip(extra, pnames[skip:]):
             params.setdefault(pname, v)
-        params.pop("attr", None)
+        explicit_attr = params.pop("attr", None)
 
         base = name or _auto_name(op.name.lower().lstrip("_"))
         suffixes = _wanted_suffixes(op.name, params)
@@ -111,6 +111,12 @@ def _make_sym_func(op: Operator):
         else:
             syms.extend(kw_inputs.values())
         node = _make_node(op.name, syms, params, name=base)
+        # AttrScope attributes (reference: attribute.py AttrScope.get is
+        # consulted on every symbol creation)
+        from ..attribute import get_current_attrs
+        attrs = get_current_attrs(explicit_attr)
+        if attrs:
+            node._attr = dict(node._attr or {}, **attrs)
         return node
 
     fn.__name__ = op.name

@@ -72,3 +72,21 @@ def test_error_hierarchy():
     @mx.error.register_error("CustomErr")
     class CustomErr(mx.error.MXNetError):
         pass
+
+
+def test_attr_scope_applies_to_symbols():
+    """AttrScope attributes land on symbols created inside the scope
+    (reference: attribute.py AttrScope consulted at symbol creation)."""
+    d = mx.sym.var("data")
+    with mx.attribute.AttrScope(__lr_mult__="2.0", ctx_group="dev1"):
+        fc = mx.sym.FullyConnected(d, name="fca", num_hidden=4)
+    assert fc.attr("__lr_mult__") == "2.0"
+    assert fc.attr("ctx_group") == "dev1"
+    # explicit attr= merges over the scope
+    with mx.attribute.AttrScope(ctx_group="dev1"):
+        fc2 = mx.sym.FullyConnected(d, name="fcb", num_hidden=4,
+                                    attr={"ctx_group": "dev2"})
+    assert fc2.attr("ctx_group") == "dev2"
+    # outside any scope: untouched
+    fc3 = mx.sym.FullyConnected(d, name="fcc", num_hidden=4)
+    assert fc3.attr("ctx_group") is None
