@@ -18,7 +18,8 @@ jax host callbacks, so a plugin is a handful of exported symbols):
                        int n_in, const float** in, const int* in_ndim,
                        const long* const* in_shape,
                        float* out, const long* out_shape, int out_ndim);
-  // output shape inference: writes out_shape/out_ndim from input shapes
+  // output shape inference: writes out_shape/out_ndim from input
+  // shapes; out_shape has room for 8 dims (MXTPU_MAX_NDIM)
   int mxtpu_op_infer_shape(int i,
                            int n_in, const int* in_ndim,
                            const long* const* in_shape,
@@ -96,12 +97,16 @@ def _make_impl(lib, index, name):
         shape_ptrs = (ctypes.POINTER(ctypes.c_long) * n)(
             *[ctypes.cast(a, ctypes.POINTER(ctypes.c_long))
               for a in shape_arrs])
-        out_shape = (ctypes.c_long * 8)()
+        out_shape = (ctypes.c_long * 8)()          # MXTPU_MAX_NDIM
         out_ndim = ctypes.c_int()
         rc = lib.mxtpu_op_infer_shape(index, n, ndims, shape_ptrs,
                                       out_shape, ctypes.byref(out_ndim))
         if rc != 0:
             raise RuntimeError(f"{name}: infer_shape failed ({rc})")
+        if not 0 <= out_ndim.value <= 8:
+            raise RuntimeError(
+                f"{name}: infer_shape wrote out_ndim={out_ndim.value}; "
+                "the ABI caps outputs at 8 dims")
         return tuple(out_shape[j] for j in range(out_ndim.value))
 
     def host_compute(*arrays):
