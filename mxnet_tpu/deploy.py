@@ -1,0 +1,98 @@
+"""mx.deploy — self-contained inference artifacts.
+
+Reference analogue: the C predict API (include/mxnet/c_predict_api.h,
+src/c_api/c_predict_api.cc) + amalgamation: deploy a trained model
+where the framework is not installed. The TPU-native equivalent
+serializes the jitted forward as a StableHLO artifact via ``jax.export``
+with the parameters baked in as constants — the loader needs ONLY jax
+(any backend: CPU, TPU), not mxnet_tpu, matching the role of the
+reference's dependency-free predictor:
+
+    mx.deploy.export_predictor(net, example, "model.mxtpu")
+    # ... on the serving side (only jax installed):
+    from jax import export
+    blob = open("model.mxtpu", "rb").read()[HEADER:]
+    out = export.deserialize(blob).call(x)
+
+The file format is a small JSON header (input/output specs) + the
+serialized artifact; ``load_predictor`` reads it back and ``Predictor``
+calls it on numpy arrays.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as _np
+
+__all__ = ["export_predictor", "load_predictor", "Predictor"]
+
+_MAGIC = b"MXTPUPRED1"
+
+
+def export_predictor(net, example_input, path=None, training=False):
+    """Serialize a gluon block's forward (params baked in) to a
+    self-contained artifact. ``example_input``: NDArray/ndarray fixing
+    the input shape/dtype. Returns the bytes; writes ``path`` if given.
+    """
+    import jax
+    from jax import export as jexport
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+    from .parallel import functional_call, extract_params
+    from . import autograd
+
+    x = example_input._data if isinstance(example_input, NDArray) \
+        else jnp.asarray(example_input)
+    with autograd.pause(train_mode=False):
+        net(NDArray(x[:1]))                 # resolve deferred shapes
+    params = {k: v for k, v in extract_params(net).items()}
+
+    def fwd(inp):
+        out, _ = functional_call(net, params, inp, training=training)
+        return out
+
+    exp = jexport.export(jax.jit(fwd))(
+        jax.ShapeDtypeStruct(x.shape, x.dtype))
+    blob = exp.serialize()
+    header = json.dumps({
+        "input_shape": list(x.shape), "input_dtype": str(x.dtype),
+        "format": "jax.export/stablehlo",
+    }).encode()
+    artifact = _MAGIC + struct.pack("<I", len(header)) + header + blob
+    if path:
+        with open(path, "wb") as f:
+            f.write(artifact)
+    return artifact
+
+
+class Predictor:
+    """Loaded artifact (reference: MXPredCreate/MXPredForward)."""
+
+    def __init__(self, artifact):
+        from jax import export as jexport
+        if isinstance(artifact, str):
+            with open(artifact, "rb") as f:
+                artifact = f.read()
+        if not artifact.startswith(_MAGIC):
+            raise ValueError("not an mxnet_tpu predictor artifact")
+        off = len(_MAGIC)
+        (hlen,) = struct.unpack_from("<I", artifact, off)
+        off += 4
+        self.meta = json.loads(artifact[off:off + hlen].decode())
+        self._exported = jexport.deserialize(artifact[off + hlen:])
+
+    @property
+    def input_shape(self):
+        return tuple(self.meta["input_shape"])
+
+    def predict(self, x):
+        import jax.numpy as jnp
+        out = self._exported.call(jnp.asarray(x))
+        return _np.asarray(out)
+
+    __call__ = predict
+
+
+def load_predictor(path_or_bytes):
+    return Predictor(path_or_bytes)

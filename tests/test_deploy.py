@@ -1,0 +1,75 @@
+"""Self-contained inference artifacts (mx.deploy — the C predict API
+analogue, reference include/mxnet/c_predict_api.h).
+"""
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+import mxnet_tpu.autograd as ag
+
+
+def _net():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    return net
+
+
+def test_export_load_roundtrip(tmp_path):
+    net = _net()
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    with ag.pause():
+        want = net(nd.array(x)).asnumpy()
+    path = str(tmp_path / "model.mxtpu")
+    mx.deploy.export_predictor(net, x, path)
+    pred = mx.deploy.load_predictor(path)
+    assert pred.input_shape == (3, 8)
+    np.testing.assert_allclose(pred(x), want, rtol=1e-5, atol=1e-6)
+
+
+def test_artifact_loads_with_only_jax(tmp_path):
+    """The serving side needs ONLY jax — the defining property of the
+    reference's dependency-free predictor."""
+    net = _net()
+    x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+    with ag.pause():
+        want = net(nd.array(x)).asnumpy()
+    path = str(tmp_path / "m.mxtpu")
+    mx.deploy.export_predictor(net, x, path)
+    xpath = str(tmp_path / "x.npy")
+    np.save(xpath, x)
+    script = f"""
+import struct, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from jax import export
+blob = open({path!r}, "rb").read()
+assert blob.startswith(b"MXTPUPRED1")
+off = len(b"MXTPUPRED1")
+(hlen,) = struct.unpack_from("<I", blob, off)
+exp = export.deserialize(blob[off + 4 + hlen:])
+out = exp.call(np.load({xpath!r}))
+np.save({str(tmp_path / 'out.npy')!r}, np.asarray(out))
+"""
+    env = {k: v for k, v in os.environ.items()}
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    got = np.load(tmp_path / "out.npy")
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_rejects_garbage():
+    import pytest
+    with pytest.raises(ValueError):
+        mx.deploy.Predictor(b"not an artifact")
