@@ -227,14 +227,58 @@ def bench_resnet(dtype, layout, batch, train_iters, infer_iters,
     }
 
 
-def main():
-    import jax
-    # A site hook can register accelerator plugins that ignore the
-    # JAX_PLATFORMS env var; sync it into the config so explicit
-    # platform selection (e.g. CPU-only test runs) actually sticks.
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+def _skip_record(batch, dtype, layout, reason, detail):
+    """One machine-readable JSON line for a run that could not produce a
+    number because the backend is unavailable — distinguishable by the
+    driver from a broken benchmark (which still dies with a traceback)."""
+    return {
+        "metric": f"resnet50_v1_train_bs{batch}_{dtype}_{layout}_mfu",
+        "value": None,
+        "unit": "% of bf16 peak",
+        "vs_baseline": None,
+        "skipped": reason,
+        "detail": detail,
+    }
 
+
+def _probe_backend(timeout_s):
+    """Probe JAX backend init in a subprocess with a hard timeout.
+
+    When the TPU tunnel is down, `jax.devices()` HANGS rather than
+    raising (observed round 3), so the probe must run out-of-process
+    where it can be killed. Returns (info_dict, None) on success or
+    (None, reason_string) on failure/timeout.
+    """
+    import subprocess
+    import sys
+    code = (
+        "import os, json\n"
+        "import jax\n"
+        "if os.environ.get('JAX_PLATFORMS'):\n"
+        "    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])\n"
+        "ds = jax.devices()\n"
+        "print(json.dumps({'platform': ds[0].platform,"
+        " 'kind': getattr(ds[0], 'device_kind', '')}))\n"
+    )
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=os.environ.copy())
+    except subprocess.TimeoutExpired:
+        return None, f"backend init hung >{timeout_s}s (tunnel down?)"
+    except Exception as e:  # noqa: BLE001 - probe must never raise
+        return None, f"backend probe failed to launch: {e!r}"
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()
+        return None, ("backend init failed: "
+                      + (tail[-1] if tail else f"rc={p.returncode}"))
+    try:
+        return json.loads(p.stdout.strip().splitlines()[-1]), None
+    except Exception:
+        return None, "unparseable backend probe output"
+
+
+def main():
     batch = int(os.environ.get("BENCH_BATCH", 128))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
@@ -244,8 +288,42 @@ def main():
     # 7x7/s2 stem — tests/test_layout.py); BENCH_S2D=0 opts out.
     stem_s2d = os.environ.get("BENCH_S2D", "1") != "0" and layout == "NHWC"
 
-    r = bench_resnet(dtype, layout, batch, train_iters, infer_iters,
-                     stem_s2d=stem_s2d)
+    # ---- backend availability gate (before touching jax in-process) -----
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
+    info, err = _probe_backend(probe_timeout)
+    if info is None:
+        print(json.dumps(_skip_record(batch, dtype, layout,
+                                      "tpu_unavailable", err)))
+        return
+    if info["platform"] != "tpu" and not os.environ.get("BENCH_ALLOW_CPU"):
+        print(json.dumps(_skip_record(
+            batch, dtype, layout, "tpu_unavailable",
+            f"backend is {info['platform']} ({info['kind'] or 'n/a'}); "
+            "set BENCH_ALLOW_CPU=1 to bench anyway")))
+        return
+
+    import jax
+    # A site hook can register accelerator plugins that ignore the
+    # JAX_PLATFORMS env var; sync it into the config so explicit
+    # platform selection (e.g. CPU-only test runs) actually sticks.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    try:
+        r = bench_resnet(dtype, layout, batch, train_iters, infer_iters,
+                         stem_s2d=stem_s2d)
+    except jax.errors.JaxRuntimeError as e:
+        # Tunnel died mid-run (UNAVAILABLE/DEADLINE_EXCEEDED). Anything
+        # else is a real benchmark bug and should still traceback.
+        msg = str(e)
+        if any(s in msg for s in ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                                  "failed to connect")):
+            first = msg.strip().splitlines()[0] if msg.strip() else repr(e)
+            print(json.dumps(_skip_record(batch, dtype, layout,
+                                          "tpu_unavailable",
+                                          f"backend lost mid-run: {first}")))
+            return
+        raise
     dev = r["dev"]
     peak = _peak_flops(dev)
 
