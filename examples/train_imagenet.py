@@ -1,0 +1,285 @@
+"""Train an ImageNet classifier through the user-facing legacy path:
+symbolic network -> Module.fit + MXDataIter("ImageRecordIter") over the
+native RecordIO reader + kvstore.
+
+This is the parity driver for the reference's north-star protocol
+(reference: example/image-classification/train_imagenet.py:1 and
+common/fit.py:150 — Module.fit fed by ImageRecordIter with kvstore), the
+same script whose throughput is the BASELINE.md headline number.
+
+Usage (real data):
+    python examples/train_imagenet.py --data-train train.rec \
+        --data-val val.rec --network resnet --num-layers 50 \
+        --batch-size 128 --num-epochs 90 --lr 0.1 --lr-step-epochs 30,60
+
+Synthetic-data mode (no rec files; reference fit.py:236 does the same
+for its --benchmark flag):
+    python examples/train_imagenet.py --benchmark 1 --num-examples 1024
+
+The smoke test in tests/test_train_imagenet.py drives main() end-to-end
+on generated .rec files at a reduced image shape.
+"""
+import argparse
+import logging
+import math
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+# --------------------------------------------------------------- network --
+def _conv_bn_relu(data, num_filter, kernel, stride, pad, name, relu=True):
+    body = mx.sym.Convolution(data, num_filter=num_filter, kernel=kernel,
+                              stride=stride, pad=pad, no_bias=True,
+                              name=name + "_conv")
+    body = mx.sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=0.9,
+                            name=name + "_bn")
+    if relu:
+        body = mx.sym.Activation(body, act_type="relu",
+                                 name=name + "_relu")
+    return body
+
+
+def _residual_unit(data, num_filter, stride, dim_match, name, bottle_neck):
+    """One ResNet v1.5 unit (stride lives in the 3x3 conv)."""
+    if bottle_neck:
+        body = _conv_bn_relu(data, num_filter // 4, (1, 1), (1, 1), (0, 0),
+                             name + "_a")
+        body = _conv_bn_relu(body, num_filter // 4, (3, 3), stride, (1, 1),
+                             name + "_b")
+        body = _conv_bn_relu(body, num_filter, (1, 1), (1, 1), (0, 0),
+                             name + "_c", relu=False)
+    else:
+        body = _conv_bn_relu(data, num_filter, (3, 3), stride, (1, 1),
+                             name + "_a")
+        body = _conv_bn_relu(body, num_filter, (3, 3), (1, 1), (1, 1),
+                             name + "_b", relu=False)
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = _conv_bn_relu(data, num_filter, (1, 1), stride, (0, 0),
+                                 name + "_ds", relu=False)
+    out = mx.sym.elemwise_add(body, shortcut, name=name + "_add")
+    return mx.sym.Activation(out, act_type="relu", name=name + "_out")
+
+
+_RESNET_UNITS = {18: ([2, 2, 2, 2], False), 34: ([3, 4, 6, 3], False),
+                 50: ([3, 4, 6, 3], True), 101: ([3, 4, 23, 3], True),
+                 152: ([3, 8, 36, 3], True)}
+
+
+def get_resnet_symbol(num_classes, num_layers, image_shape):
+    """Symbolic ResNet (reference network builder:
+    example/image-classification/symbols/resnet.py:1)."""
+    if num_layers not in _RESNET_UNITS:
+        raise ValueError(f"resnet num_layers must be one of "
+                         f"{sorted(_RESNET_UNITS)}, got {num_layers}")
+    units, bottle_neck = _RESNET_UNITS[num_layers]
+    filters = [256, 512, 1024, 2048] if bottle_neck else [64, 128, 256, 512]
+    height = image_shape[1]
+
+    data = mx.sym.Variable("data")
+    if height <= 32:  # CIFAR-style stem
+        body = _conv_bn_relu(data, 64, (3, 3), (1, 1), (1, 1), "stem")
+    else:
+        body = _conv_bn_relu(data, 64, (7, 7), (2, 2), (3, 3), "stem")
+        body = mx.sym.Pooling(body, kernel=(3, 3), stride=(2, 2),
+                              pad=(1, 1), pool_type="max", name="stem_pool")
+    for stage, (n_units, nf) in enumerate(zip(units, filters)):
+        for unit in range(n_units):
+            stride = (1, 1) if stage == 0 or unit > 0 else (2, 2)
+            body = _residual_unit(body, nf, stride, dim_match=unit > 0,
+                                  name=f"stage{stage + 1}_unit{unit + 1}",
+                                  bottle_neck=bottle_neck)
+    body = mx.sym.Pooling(body, global_pool=True, pool_type="avg",
+                          kernel=(1, 1), name="gap")
+    body = mx.sym.Flatten(body, name="flat")
+    body = mx.sym.FullyConnected(body, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(body, name="softmax")
+
+
+def get_network(args):
+    if args.network == "resnet":
+        return get_resnet_symbol(args.num_classes, args.num_layers,
+                                 args.image_shape_t)
+    raise ValueError(f"unknown --network {args.network!r} "
+                     "(this driver ships resnet; other families live in "
+                     "mxnet_tpu.gluon.model_zoo)")
+
+
+# ------------------------------------------------------------------ data --
+def get_rec_iter(args, kv):
+    """ImageRecordIter pair through the MXDataIter dispatch (reference:
+    common/data.py get_rec_iter — ImageRecordIter with sharding by
+    kv.rank/kv.num_workers)."""
+    image_shape = args.image_shape_t
+    train = mx.io.MXDataIter(
+        "ImageRecordIter",
+        path_imgrec=args.data_train,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        shuffle=True,
+        rand_crop=True,
+        rand_mirror=True,
+        resize=args.resize,
+        num_parts=kv.num_workers,
+        part_index=kv.rank,
+    )
+    if not args.data_val:
+        return train, None
+    val = mx.io.MXDataIter(
+        "ImageRecordIter",
+        path_imgrec=args.data_val,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        shuffle=False,
+        resize=args.resize,
+        num_parts=kv.num_workers,
+        part_index=kv.rank,
+    )
+    return train, val
+
+
+def get_synthetic_iter(args):
+    """Random-data iterator for --benchmark runs (reference:
+    common/fit.py:236 SyntheticDataIter usage)."""
+    image_shape = args.image_shape_t
+    n = max(args.batch_size * 4, 64)
+    rng = np.random.RandomState(0)
+    data = rng.uniform(-1, 1, (n,) + image_shape).astype(np.float32)
+    label = rng.randint(0, args.num_classes, (n,)).astype(np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=args.batch_size,
+                           shuffle=False)
+    epoch_size = math.ceil(args.num_examples / args.batch_size)
+    return mx.io.ResizeIter(it, epoch_size), None
+
+
+# ------------------------------------------------------------------- fit --
+def _lr_scheduler(args, epoch_size, begin_epoch):
+    """(lr, scheduler) with resume handling: decays already passed by
+    begin_epoch are applied to the base lr, remaining steps are offset
+    (reference: common/fit.py _get_lr_scheduler:29)."""
+    lr = args.lr
+    tokens = [t.strip() for t in (args.lr_step_epochs or "").split(",")]
+    step_epochs = [int(t) for t in tokens if t]
+    for e in step_epochs:
+        if begin_epoch >= e:
+            lr *= args.lr_factor
+    if lr != args.lr:
+        logging.info("Adjusted learning rate to %e for epoch %d",
+                     lr, begin_epoch)
+    steps = [epoch_size * (e - begin_epoch) for e in step_epochs
+             if e > begin_epoch]
+    if not steps:
+        return lr, None
+    return lr, mx.lr_scheduler.MultiFactorScheduler(
+        step=steps, factor=args.lr_factor, base_lr=lr)
+
+
+def fit(args, network, train, val=None, kv=None):
+    """Module.fit wiring (reference: common/fit.py:150)."""
+    if kv is None:
+        kv = mx.kv.create(args.kv_store)
+    begin_epoch = (args.load_epoch
+                   if args.model_prefix and args.load_epoch is not None
+                   else 0)
+    epoch_size = math.ceil(args.num_examples / kv.num_workers
+                           / args.batch_size)
+    lr, sched = _lr_scheduler(args, epoch_size, begin_epoch)
+
+    mod = mx.mod.Module(symbol=network, context=mx.context.current_context())
+    optimizer_params = {
+        "learning_rate": lr,
+        "wd": args.wd,
+        "rescale_grad": 1.0 / args.batch_size,
+    }
+    if sched is not None:
+        optimizer_params["lr_scheduler"] = sched
+    if args.optimizer in ("sgd", "nag"):
+        optimizer_params["momentum"] = args.mom
+
+    eval_metrics = ["accuracy"]
+    if args.top_k > 0:
+        eval_metrics.append(
+            mx.metric.create("top_k_accuracy", top_k=args.top_k))
+
+    batch_cb = mx.callback.Speedometer(args.batch_size, args.disp_batches)
+    epoch_cb = (mx.callback.do_checkpoint(args.model_prefix,
+                                          period=args.save_period)
+                if args.model_prefix else None)
+
+    initializer = mx.initializer.Xavier(rnd_type="gaussian",
+                                        factor_type="in", magnitude=2)
+    arg_params = aux_params = None
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+
+    mod.fit(train,
+            eval_data=val,
+            eval_metric=eval_metrics,
+            kvstore=kv,
+            optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            initializer=initializer,
+            arg_params=arg_params,
+            aux_params=aux_params,
+            batch_end_callback=batch_cb,
+            epoch_end_callback=epoch_cb,
+            begin_epoch=begin_epoch,
+            num_epoch=args.num_epochs,
+            allow_missing=True)
+    return mod
+
+
+def add_args(parser):
+    parser.add_argument("--network", type=str, default="resnet")
+    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-examples", type=int, default=1281167)
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--resize", type=int, default=0,
+                        help="resize shorter edge before augmentation")
+    parser.add_argument("--data-train", type=str,
+                        help="training .rec file")
+    parser.add_argument("--data-val", type=str, help="validation .rec")
+    parser.add_argument("--kv-store", type=str, default="device")
+    parser.add_argument("--num-epochs", type=int, default=90)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--lr-factor", type=float, default=0.1)
+    parser.add_argument("--lr-step-epochs", type=str, default="30,60,80")
+    parser.add_argument("--optimizer", type=str, default="sgd")
+    parser.add_argument("--mom", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=1e-4)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--disp-batches", type=int, default=20)
+    parser.add_argument("--model-prefix", type=str)
+    parser.add_argument("--save-period", type=int, default=1)
+    parser.add_argument("--load-epoch", type=int)
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--benchmark", type=int, default=0,
+                        help="1 = train on synthetic random data")
+    return parser
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    parser = add_args(argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter))
+    args = parser.parse_args(argv)
+    args.image_shape_t = tuple(int(x) for x in args.image_shape.split(","))
+    network = get_network(args)
+    kv = mx.kv.create(args.kv_store)
+    if args.benchmark:
+        train, val = get_synthetic_iter(args)
+    else:
+        if not args.data_train:
+            parser.error("--data-train is required (or pass --benchmark 1)")
+        train, val = get_rec_iter(args, kv)
+    return fit(args, network, train, val, kv=kv)
+
+
+if __name__ == "__main__":
+    main()

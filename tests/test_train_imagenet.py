@@ -1,0 +1,111 @@
+"""End-to-end smoke of the north-star protocol driver:
+examples/train_imagenet.py — symbolic ResNet-50 + Module.fit +
+MXDataIter("ImageRecordIter") over .rec files + kvstore.
+
+Reference protocol: example/image-classification/train_imagenet.py:1
+(+ common/fit.py:150). The reference's CI equivalent trains a small
+net on synthetic rec files; here we pack a tiny JPEG dataset and run
+the actual driver main().
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "examples"))
+
+
+def _make_rec(path_prefix, n, num_classes, rng):
+    rec = recordio.MXIndexedRecordIO(path_prefix + ".idx",
+                                     path_prefix + ".rec", "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (24, 24, 3), dtype=np.uint8)
+        header = recordio.IRHeader(0, float(i % num_classes), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, quality=90,
+                                           img_fmt=".jpg"))
+    rec.close()
+
+
+@pytest.fixture(scope="module")
+def rec_dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("imagenet_rec")
+    rng = np.random.RandomState(0)
+    _make_rec(str(d / "train"), 32, 4, rng)
+    _make_rec(str(d / "val"), 16, 4, rng)
+    return d
+
+
+def test_train_imagenet_resnet50_rec(rec_dataset, tmp_path):
+    import train_imagenet
+    prefix = str(tmp_path / "r50")
+    mod = train_imagenet.main([
+        "--data-train", str(rec_dataset / "train.rec"),
+        "--data-val", str(rec_dataset / "val.rec"),
+        "--network", "resnet", "--num-layers", "50",
+        "--num-classes", "4", "--image-shape", "3,24,24",
+        "--batch-size", "8", "--num-examples", "32",
+        "--num-epochs", "1", "--lr", "0.01", "--lr-step-epochs", "",
+        "--kv-store", "local", "--disp-batches", "2",
+        "--model-prefix", prefix, "--top-k", "2",
+    ])
+    # checkpoint written through the user-facing callback path
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0001.params")
+    # reload and score: the saved model must be usable standalone
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 1)
+    scored = mx.mod.Module(symbol=sym, context=mx.context.current_context())
+    val = mx.io.MXDataIter("ImageRecordIter",
+                           path_imgrec=str(rec_dataset / "val.rec"),
+                           data_shape=(3, 24, 24), batch_size=8)
+    scored.bind(data_shapes=val.provide_data,
+                label_shapes=val.provide_label, for_training=False)
+    scored.set_params(args, auxs)
+    res = scored.score(val, mx.metric.create("accuracy"))
+    acc = dict(res)["accuracy"]
+    assert 0.0 <= acc <= 1.0 and np.isfinite(acc)
+    del mod
+
+
+def test_train_imagenet_synthetic_benchmark():
+    import train_imagenet
+    mod = train_imagenet.main([
+        "--benchmark", "1", "--num-layers", "18", "--num-classes", "4",
+        "--image-shape", "3,16,16", "--batch-size", "4",
+        "--num-examples", "8", "--num-epochs", "1",
+        "--lr", "0.01", "--lr-step-epochs", "", "--kv-store", "local",
+    ])
+    assert mod.binded and mod.params_initialized
+
+
+def test_lr_scheduler_resume_offsets():
+    """Resuming at epoch 60 of lr-step-epochs 30,60 must start at
+    lr*factor^2 with no stale steps (reference: common/fit.py:29)."""
+    import argparse
+    import train_imagenet
+    args = argparse.Namespace(lr=0.1, lr_factor=0.1,
+                              lr_step_epochs="30,60,")  # trailing comma ok
+    lr, sched = train_imagenet._lr_scheduler(args, epoch_size=100,
+                                             begin_epoch=60)
+    assert abs(lr - 0.001) < 1e-12
+    assert sched is None  # 30 and 60 both passed, no steps remain
+    lr, sched = train_imagenet._lr_scheduler(args, epoch_size=100,
+                                             begin_epoch=30)
+    assert abs(lr - 0.01) < 1e-12
+    assert sched.step == [100 * 30]  # epoch 60 is 30 epochs away
+    lr, sched = train_imagenet._lr_scheduler(args, epoch_size=100,
+                                             begin_epoch=0)
+    assert lr == 0.1 and sched.step == [3000, 6000]
+
+
+def test_resnet_symbol_shapes():
+    import train_imagenet
+    for layers, img in ((18, (3, 32, 32)), (50, (3, 224, 224))):
+        sym = train_imagenet.get_resnet_symbol(1000, layers, img)
+        _, out_shapes, _ = sym.infer_shape(data=(2,) + img,
+                                           softmax_label=(2,))
+        assert out_shapes == [(2, 1000)]
