@@ -347,14 +347,60 @@ class CSVIter(NDArrayIter):
         super().__init__(data, label, batch_size=batch_size, **kwargs)
 
 
+def _pop_mean_std(kwargs):
+    """mean_r/g/b + std_r/g/b channel kwargs -> (mean, std) tuples."""
+    mean = std = None
+    if any(k in kwargs for k in ("mean_r", "mean_g", "mean_b")):
+        mean = (kwargs.pop("mean_r", 0.0), kwargs.pop("mean_g", 0.0),
+                kwargs.pop("mean_b", 0.0))
+    if any(k in kwargs for k in ("std_r", "std_g", "std_b")):
+        std = (kwargs.pop("std_r", 1.0), kwargs.pop("std_g", 1.0),
+               kwargs.pop("std_b", 1.0))
+    return mean, std
+
+
+# option names ImageRecordIterNative implements directly; anything else
+# (brightness, pca_noise, rand_resize, ...) falls back to ImageIter
+_NATIVE_REC_KEYS = {
+    "path_imgrec", "path_imgidx", "data_shape", "batch_size", "shuffle",
+    "rand_crop", "rand_mirror", "resize", "num_parts", "part_index",
+    "preprocess_threads", "label_width", "seed", "layout", "data_name",
+    "label_name", "last_batch_handle", "mean", "std",
+    "mean_r", "mean_g", "mean_b", "std_r", "std_g", "std_b",
+}
+
+
+def _native_rec_kwargs(args, kwargs):
+    """kwargs for ImageRecordIterNative, or None if out of its scope."""
+    if args or not kwargs.get("path_imgrec"):
+        return None
+    if any(k not in _NATIVE_REC_KEYS for k in kwargs):
+        return None
+    if kwargs.get("last_batch_handle", "pad") == "roll_over":
+        return None
+    kw = dict(kwargs)
+    mean, std = _pop_mean_std(kw)
+    if mean is not None and "mean" not in kw:
+        kw["mean"] = mean
+    if std is not None and "std" not in kw:
+        kw["std"] = std
+    shape = tuple(kw.get("data_shape", ()))
+    channels = shape[-1] if kw.get("layout") == "NHWC" else shape[:1]
+    gray = channels in (1, (1,))
+    if (kw.get("mean") is not None or kw.get("std") is not None) and gray:
+        return None  # channel stats here assume 3-channel decode
+    return kw
+
+
 def MXDataIter(iter_name, *args, **kwargs):
     """Dispatch the reference's C++ iterator names to their TPU-build
     equivalents (reference: python/mxnet/io/io.py:935 creates C++
     iterators via MXDataIterCreateIter; here each name maps to the
     Python/native-reader implementation of the same pipeline):
 
-    - ImageRecordIter / ImageRecordIter_v1 -> image.ImageIter over the
-      native C++ RecordIO reader (mxnet_tpu/native)
+    - ImageRecordIter / ImageRecordIter_v1 -> image.ImageRecordIterNative
+      (C++ decode/augment worker pool, mxnet_tpu/native) when the options
+      are in its scope, else image.ImageIter (pure-Python augmenters)
     - CSVIter -> CSVIter
     - NDArrayIter/MNISTIter-style in-memory data -> NDArrayIter
     """
@@ -362,9 +408,31 @@ def MXDataIter(iter_name, *args, **kwargs):
         getattr(iter_name, "__name__", str(iter_name))
     if name in ("ImageRecordIter", "ImageRecordIter_v1",
                 "ImageRecordUInt8Iter"):
+        kwargs.pop("verbose", None)
+        # Prefer the C++ decode/augment pool (the actual analogue of the
+        # reference's ImageRecordIter) when the requested options fall
+        # inside its support; otherwise the pure-Python ImageIter covers
+        # the long tail of augmenters.
+        native_kw = _native_rec_kwargs(args, kwargs)
+        if native_kw is not None:
+            from ..image import (ImageRecordIterNative,
+                                 native_pipeline_available)
+            if native_pipeline_available():
+                return ImageRecordIterNative(**native_kw)
         from ..image import ImageIter
         kwargs.pop("preprocess_threads", None)
-        kwargs.pop("verbose", None)
+        kwargs.pop("seed", None)
+        mean, std = _pop_mean_std(kwargs)
+        if (mean is not None or std is not None) and \
+                "mean" not in kwargs and "std" not in kwargs:
+            # CreateAugmenter normalizes only when BOTH are present;
+            # default the missing one so mean-only/std-only requests
+            # behave the same as on the native path
+            kwargs["mean"] = _np.asarray(
+                mean if mean is not None else (0.0, 0.0, 0.0),
+                _np.float32)
+            kwargs["std"] = _np.asarray(
+                std if std is not None else (1.0, 1.0, 1.0), _np.float32)
         resize = kwargs.pop("resize", 0)
         if resize and "aug_list" not in kwargs:
             from ..image import CreateAugmenter

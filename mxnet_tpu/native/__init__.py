@@ -14,27 +14,34 @@ import os
 import subprocess
 import threading
 
-__all__ = ["recordio_lib", "native_enabled"]
+__all__ = ["recordio_lib", "imagepipe_lib", "native_enabled"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "recordio_native.cpp")
 _BUILD = os.path.join(_DIR, "_build")
 _SO = os.path.join(_BUILD, "librecordio_native.so")
+_IP_SRC = os.path.join(_DIR, "src", "imagepipe_native.cpp")
+_IP_SO = os.path.join(_BUILD, "libimagepipe_native.so")
 
 _lock = threading.Lock()
 _lib = "unset"
+_ip_lib = "unset"
 
 
 def native_enabled() -> bool:
     return os.environ.get("MXNET_TPU_NATIVE", "1") != "0"
 
 
-def _build():
+def _compile(src, so, extra=()):
     os.makedirs(_BUILD, exist_ok=True)
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _SO + ".tmp"]
+           src, "-o", so + ".tmp", *extra]
     subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(_SO + ".tmp", _SO)
+    os.replace(so + ".tmp", so)
+
+
+def _build():
+    _compile(_SRC, _SO)
 
 
 def recordio_lib():
@@ -80,6 +87,49 @@ def recordio_lib():
                                         ctypes.c_ubyte))]
         lib.rio_pf_close.argtypes = [ctypes.c_void_p]
         _lib = lib
+        return lib
+
+
+def imagepipe_lib():
+    """The compiled decode/augment pipeline (needs the system OpenCV
+    C++ libs — the same dependency the reference's C++ ImageRecordIter
+    has), or None. Thread-safe; compiles at most once per process."""
+    global _ip_lib
+    if not native_enabled():
+        return None
+    if _ip_lib != "unset":
+        return _ip_lib
+    with _lock:
+        if _ip_lib != "unset":
+            return _ip_lib
+        try:
+            if (not os.path.exists(_IP_SO)
+                    or os.path.getmtime(_IP_SO)
+                    < os.path.getmtime(_IP_SRC)):
+                _compile(_IP_SRC, _IP_SO,
+                         extra=("-I/usr/include/opencv4", "-lopencv_core",
+                                "-lopencv_imgcodecs", "-lopencv_imgproc"))
+            lib = ctypes.CDLL(_IP_SO)
+        except Exception:
+            _ip_lib = None
+            return None
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.ip_create.restype = ctypes.c_void_p
+        lib.ip_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, f32p, f32p, ctypes.c_int]
+        lib.ip_start_epoch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_uint32]
+        lib.ip_next_batch.restype = ctypes.c_long
+        lib.ip_next_batch.argtypes = [ctypes.c_void_p, f32p, f32p]
+        lib.ip_error_count.restype = ctypes.c_long
+        lib.ip_error_count.argtypes = [ctypes.c_void_p]
+        lib.ip_last_error.restype = ctypes.c_char_p
+        lib.ip_last_error.argtypes = [ctypes.c_void_p]
+        lib.ip_destroy.argtypes = [ctypes.c_void_p]
+        _ip_lib = lib
         return lib
 
 

@@ -1,0 +1,208 @@
+"""Native C++ decode/augment pipeline (image.ImageRecordIterNative).
+
+Reference behavior being matched: src/io/iter_image_recordio_2.cc:887
+(ImageRecordIter worker threads: JPEG decode, resize/crop/mirror,
+normalize, batch). Plus one property the reference lacks and we pin:
+bit-determinism independent of thread count.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image import ImageRecordIterNative, native_pipeline_available
+
+pytestmark = pytest.mark.skipif(
+    not native_pipeline_available(),
+    reason="native image pipeline unavailable (no toolchain/OpenCV)")
+
+
+def _make_rec(prefix, n, hw=(32, 24), num_classes=5, seed=0):
+    rng = np.random.RandomState(seed)
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    imgs = []
+    for i in range(n):
+        img = rng.randint(0, 255, hw + (3,), dtype=np.uint8)
+        imgs.append(img)
+        header = recordio.IRHeader(0, float(i % num_classes), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, quality=95,
+                                           img_fmt=".jpg"))
+    rec.close()
+    return imgs
+
+
+@pytest.fixture(scope="module")
+def rec20(tmp_path_factory):
+    d = tmp_path_factory.mktemp("native_pipe")
+    prefix = str(d / "data")
+    imgs = _make_rec(prefix, 20)
+    return prefix, imgs
+
+
+def test_labels_and_order(rec20):
+    prefix, _ = rec20
+    it = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 24, 24), batch_size=5)
+    labels = np.concatenate([b.label[0].asnumpy() for b in it])
+    assert labels.tolist() == [float(i % 5) for i in range(20)]
+    it.close()
+
+
+def test_decode_matches_python_decoder(rec20):
+    """Center-crop-free case: native decode == cv2 decode of the same
+    JPEG bytes (both are libjpeg; allow tiny IDCT wiggle)."""
+    prefix, _ = rec20
+    it = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 32, 24), batch_size=4)
+    batch = next(it)
+    arr = batch.data[0].asnumpy()  # NCHW float32
+    reader = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                        "r")
+    for i in range(4):
+        header, img_bytes = recordio.unpack(reader.read_idx(i))
+        ref = mx.image.imdecode(img_bytes).asnumpy()  # HWC RGB uint8
+        got = arr[i].transpose(1, 2, 0)
+        assert np.abs(got - ref.astype(np.float32)).max() <= 2.0
+    it.close()
+
+
+def test_nhwc_layout_and_normalize(rec20):
+    prefix, _ = rec20
+    mean, std = (100.0, 110.0, 120.0), (50.0, 55.0, 60.0)
+    raw = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                                data_shape=(3, 32, 24), batch_size=4)
+    norm = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                                 data_shape=(32, 24, 3), batch_size=4,
+                                 layout="NHWC", mean=mean, std=std)
+    a = next(raw).data[0].asnumpy().transpose(0, 2, 3, 1)
+    b = next(norm).data[0].asnumpy()
+    expect = (a - np.asarray(mean)) / np.asarray(std)
+    assert np.allclose(b, expect, atol=1e-5)
+    raw.close()
+    norm.close()
+
+
+def test_deterministic_across_thread_counts(rec20):
+    prefix, _ = rec20
+    outs = []
+    for nthreads in (1, 8):
+        it = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                                   data_shape=(3, 16, 16), batch_size=4,
+                                   shuffle=True, rand_crop=True,
+                                   rand_mirror=True, seed=7,
+                                   preprocess_threads=nthreads)
+        outs.append(np.stack([b.data[0].asnumpy() for b in it]))
+        it.close()
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_epochs_reshuffle_and_pad(rec20):
+    prefix, _ = rec20
+    it = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 16, 16), batch_size=8,
+                               shuffle=True, seed=3)
+    ep0 = [next(it) for _ in range(3)]
+    assert [b.pad for b in ep0] == [0, 0, 4]  # 20 = 2*8 + 4
+    with pytest.raises(StopIteration):
+        next(it)
+    labels0 = np.concatenate([b.label[0].asnumpy() for b in ep0])
+    it.reset()
+    labels1 = np.concatenate([b.label[0].asnumpy()
+                              for b in [next(it) for _ in range(3)]])
+    assert labels0.shape == labels1.shape == (24,)
+    assert not np.array_equal(labels0, labels1)  # epoch reshuffled
+    it.close()
+
+
+def test_sharding_disjoint(rec20):
+    prefix, _ = rec20
+    seen = []
+    for part in (0, 1):
+        it = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                                   data_shape=(3, 16, 16), batch_size=10,
+                                   num_parts=2, part_index=part)
+        seen.append(set(next(it).label[0].asnumpy().tolist()))
+        it.close()
+    # each shard holds 10 of the 20 samples; labels cycle mod 5 so both
+    # shards see every class but from disjoint records
+    assert len(seen[0]) == len(seen[1]) == 5
+
+
+def test_mirror_flips_pixels(rec20):
+    prefix, _ = rec20
+    base = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                                 data_shape=(3, 32, 24), batch_size=1)
+    a = next(base).data[0].asnumpy()[0]
+    found_flip = False
+    for seed in range(6):
+        mir = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                                    data_shape=(3, 32, 24), batch_size=1,
+                                    rand_mirror=True, seed=seed)
+        m = next(mir).data[0].asnumpy()[0]
+        mir.close()
+        if np.array_equal(m, a[:, :, ::-1]):
+            found_flip = True
+            break
+    base.close()
+    assert found_flip, "rand_mirror never produced a horizontal flip"
+
+
+def test_corrupt_record_zero_filled(tmp_path):
+    prefix = str(tmp_path / "bad")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    header = recordio.IRHeader(0, 1.0, 0, 0)
+    rec.write_idx(0, recordio.pack(header, b"not a jpeg at all"))
+    img = np.full((16, 16, 3), 200, dtype=np.uint8)
+    rec.write_idx(1, recordio.pack_img(recordio.IRHeader(0, 2.0, 1, 0),
+                                       img, quality=95, img_fmt=".jpg"))
+    rec.close()
+    it = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 16, 16), batch_size=2)
+    batch = next(it)
+    data = batch.data[0].asnumpy()
+    assert np.all(data[0] == 0.0)          # corrupt -> zero-filled
+    assert data[1].mean() > 100.0          # good record decoded
+    assert it.error_count == 1
+    it.close()
+
+
+def test_std_only_normalizes(rec20):
+    """std without mean must still divide (regression: silently raw)."""
+    prefix, _ = rec20
+    raw = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                                data_shape=(3, 32, 24), batch_size=4)
+    scaled = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                                   data_shape=(3, 32, 24), batch_size=4,
+                                   std=(2.0, 4.0, 8.0))
+    a = next(raw).data[0].asnumpy()
+    b = next(scaled).data[0].asnumpy()
+    assert np.allclose(b, a / np.asarray([2.0, 4.0, 8.0])[:, None, None],
+                       atol=1e-5)
+    raw.close()
+    scaled.close()
+
+
+def test_discard_last_batch(rec20):
+    prefix, _ = rec20
+    it = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 16, 16), batch_size=8,
+                               last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 2 and all(b.pad == 0 for b in batches)
+    it.close()
+
+
+def test_mxdataiter_prefers_native(rec20):
+    prefix, _ = rec20
+    it = mx.io.MXDataIter("ImageRecordIter", path_imgrec=prefix + ".rec",
+                          data_shape=(3, 16, 16), batch_size=4,
+                          preprocess_threads=2, mean_r=1.0, mean_g=2.0,
+                          mean_b=3.0)
+    assert isinstance(it, ImageRecordIterNative)
+    it.close()
+    # out-of-scope option falls back to the Python augmenter pipeline
+    it2 = mx.io.MXDataIter("ImageRecordIter", path_imgrec=prefix + ".rec",
+                           data_shape=(3, 16, 16), batch_size=4,
+                           brightness=0.5)
+    from mxnet_tpu.image import ImageIter
+    assert isinstance(it2, ImageIter)
