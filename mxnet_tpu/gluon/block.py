@@ -761,7 +761,7 @@ class SymbolBlock(HybridBlock):
                 # symbol's shape solver, then materialize
                 known = {n: v.shape for n, v in
                          zip(input_names, (x,) + args)}
-                shape_of, _ = self._outputs._solve_shapes(known,
+                shape_of, _, _ = self._outputs._solve_shapes(known,
                                                           partial=True)
                 for pname, pp in self.collect_params().items():
                     if pname in shape_of and pp._data is None:

@@ -79,10 +79,34 @@ class _Ctx:
         self.nodes = []
         self.initializers = []
         self.n = 0
+        self.node_shapes = {}    # id(sym node) -> inferred out shape
 
     def name(self, base):
         self.n += 1
         return f"{base}_{self.n}"
+
+    def out_shape(self, node):
+        s = self.node_shapes.get(id(node))
+        if isinstance(s, list):
+            s = s[0]
+        return s
+
+
+# simple elementwise unaries with a 1:1 ONNX node
+_EW_UNARY = {
+    "sqrt": "Sqrt", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+    "sigmoid": "Sigmoid", "erf": "Erf", "relu": "Relu", "abs": "Abs",
+    "negative": "Neg", "floor": "Floor", "ceil": "Ceil", "sign": "Sign",
+}
+
+# mx scalar op -> (onnx op, operands swapped?)
+_SCALAR_BIN = {
+    "_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
+    "_rminus_scalar": ("Sub", True), "_mul_scalar": ("Mul", False),
+    "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True),
+    "_power_scalar": ("Pow", False), "_rpower_scalar": ("Pow", True),
+    "_maximum_scalar": ("Max", False), "_minimum_scalar": ("Min", False),
+}
 
 
 def _convert(node, ins, out, ctx):
@@ -93,14 +117,28 @@ def _convert(node, ins, out, ctx):
 
     if op in ("FullyConnected",):
         no_bias = bool(p.get("no_bias", False))
-        # Gemm(B transposed) matches FullyConnected exactly, but needs
-        # 2-D input: insert a Flatten like the reference converter
-        flat = ctx.name(nm + "_flatten")
-        ctx.nodes.append(_node("Flatten", [ins[0]], [flat],
-                               flat, [_attr("axis", AT_INT, 1)]))
-        attrs = [_attr("transB", AT_INT, 1)]
-        inputs = [flat, ins[1]] + ([] if no_bias else [ins[2]])
-        ctx.nodes.append(_node("Gemm", inputs, [out], nm, attrs))
+        if p.get("flatten", True):
+            # Gemm(B transposed) matches FullyConnected exactly, but
+            # needs 2-D input: insert a Flatten like the reference
+            flat = ctx.name(nm + "_flatten")
+            ctx.nodes.append(_node("Flatten", [ins[0]], [flat],
+                                   flat, [_attr("axis", AT_INT, 1)]))
+            attrs = [_attr("transB", AT_INT, 1)]
+            inputs = [flat, ins[1]] + ([] if no_bias else [ins[2]])
+            ctx.nodes.append(_node("Gemm", inputs, [out], nm, attrs))
+        else:
+            # per-token projection: x @ W^T (+ b) on the last axis
+            wt = ctx.name(nm + "_wt")
+            ctx.nodes.append(_node("Transpose", [ins[1]], [wt], wt, [
+                _attr("perm", AT_INTS, [1, 0])]))
+            if no_bias:
+                ctx.nodes.append(_node("MatMul", [ins[0], wt], [out],
+                                       nm))
+            else:
+                mm = ctx.name(nm + "_mm")
+                ctx.nodes.append(_node("MatMul", [ins[0], wt], [mm],
+                                       mm))
+                ctx.nodes.append(_node("Add", [mm, ins[2]], [out], nm))
     elif op == "Convolution":
         attrs = [_attr("kernel_shape", AT_INTS, _ints(p, "kernel", ()))]
         stride = _ints(p, "stride", (1, 1))
@@ -160,15 +198,208 @@ def _convert(node, ins, out, ctx):
                                [_attr("axis", AT_INT,
                                       int(p.get("dim", 1)))]))
     elif op in ("Reshape", "reshape"):
-        shape = [int(s) for s in p.get("shape", ())]
+        # mxnet reshape specials (0-cursor, -1..-4) are not
+        # ONNX-expressible: 0 copies by a moving cursor here but by
+        # output index in ONNX, and -3/-4 merge/split dims. Export the
+        # concretely inferred output shape instead (shapes are known —
+        # export_model fixes the input shapes).
+        shape = ctx.out_shape(node)
+        if shape is None:
+            raw = [int(s) for s in p.get("shape", ())]
+            # a single -1 among positive dims means the same thing in
+            # ONNX; 0 (cursor copy here, positional copy there) and
+            # -2/-3/-4 do not — refusing beats exporting a silently
+            # wrong graph
+            if any(s == 0 or s < -1 for s in raw) or raw.count(-1) > 1:
+                raise NotImplementedError(
+                    "ONNX export: Reshape with special dims "
+                    f"{tuple(raw)} needs inferable shapes (pass "
+                    "concrete input_shapes)")
+            shape = raw
         shp_name = ctx.name(nm + "_shape")
         ctx.initializers.append(_tensor(
-            shp_name, _np.asarray(shape, _np.int64)))
+            shp_name, _np.asarray(list(shape), _np.int64)))
         ctx.nodes.append(_node("Reshape", [ins[0], shp_name], [out], nm))
     elif op == "Dropout":
         # inference export: Identity (reference does the same for
         # non-training exports)
         ctx.nodes.append(_node("Identity", [ins[0]], [out], nm))
+    elif op == "Deconvolution":
+        stride = _ints(p, "stride", (1, 1))
+        pad = _ints(p, "pad", (0, 0))
+        attrs = [_attr("kernel_shape", AT_INTS, _ints(p, "kernel", ())),
+                 _attr("strides", AT_INTS, stride),
+                 _attr("pads", AT_INTS, pad + pad),
+                 _attr("group", AT_INT, int(p.get("num_group", 1)))]
+        adj = _ints(p, "adj", None)
+        if adj:
+            attrs.append(_attr("output_padding", AT_INTS, adj))
+        no_bias = bool(p.get("no_bias", False))
+        ctx.nodes.append(_node("ConvTranspose",
+                               ins[:2] if no_bias else ins[:3], [out],
+                               nm, attrs))
+    elif op in ("transpose", "Transpose"):
+        axes = _ints(p, "axes", None)
+        attrs = [_attr("perm", AT_INTS, axes)] if axes else []
+        ctx.nodes.append(_node("Transpose", [ins[0]], [out], nm, attrs))
+    elif op in ("dot", "batch_dot", "_linalg_gemm2"):
+        a, b = ins[0], ins[1]
+
+        def _swap_last2(value, inp_node, tag):
+            shape = ctx.out_shape(inp_node)
+            rank = len(shape) if shape else (3 if op != "dot" else 2)
+            perm = list(range(rank - 2)) + [rank - 1, rank - 2]
+            t = ctx.name(nm + tag)
+            ctx.nodes.append(_node("Transpose", [value], [t], t, [
+                _attr("perm", AT_INTS, perm)]))
+            return t
+
+        if p.get("transpose_a", False):
+            a = _swap_last2(a, node._inputs[0], "_ta")
+        if p.get("transpose_b", False):
+            b = _swap_last2(b, node._inputs[1], "_tb")
+        alpha = float(p.get("alpha", 1.0))
+        if alpha != 1.0:
+            mm = ctx.name(nm + "_mm")
+            ctx.nodes.append(_node("MatMul", [a, b], [mm], mm))
+            ac = ctx.name(nm + "_alpha")
+            ctx.initializers.append(_tensor(
+                ac, _np.asarray(alpha, _np.float32)))
+            ctx.nodes.append(_node("Mul", [mm, ac], [out], nm))
+        else:
+            ctx.nodes.append(_node("MatMul", [a, b], [out], nm))
+    elif op == "LayerNorm":
+        axis = int(p.get("axis", -1))
+        shape = ctx.out_shape(node)
+        # ONNX LayerNormalization normalizes over [axis, rank); mxnet
+        # over the single `axis` — they only coincide for the last axis
+        if axis != -1 and not (shape and axis == len(shape) - 1):
+            raise NotImplementedError(
+                "ONNX export: LayerNorm only with axis=-1 (ONNX "
+                "normalizes over a trailing RANGE of axes)")
+        attrs = [_attr("epsilon", AT_FLOAT, float(p.get("eps", 1e-5))),
+                 _attr("axis", AT_INT, -1)]
+        ctx.nodes.append(_node("LayerNormalization", ins[:3], [out], nm,
+                               attrs))
+    elif op == "InstanceNorm":
+        attrs = [_attr("epsilon", AT_FLOAT, float(p.get("eps", 1e-3)))]
+        ctx.nodes.append(_node("InstanceNormalization", ins[:3], [out],
+                               nm, attrs))
+    elif op in _EW_UNARY:
+        ctx.nodes.append(_node(_EW_UNARY[op], [ins[0]], [out], nm))
+    elif op == "square":
+        ctx.nodes.append(_node("Mul", [ins[0], ins[0]], [out], nm))
+    elif op in ("elemwise_div", "broadcast_div"):
+        ctx.nodes.append(_node("Div", ins[:2], [out], nm))
+    elif op in ("broadcast_power",):
+        ctx.nodes.append(_node("Pow", ins[:2], [out], nm))
+    elif op in _SCALAR_BIN:
+        onnx_op, swap = _SCALAR_BIN[op]
+        sc = ctx.name(nm + "_const")
+        ctx.initializers.append(_tensor(
+            sc, _np.asarray(float(p.get("scalar", 0.0)), _np.float32)))
+        pair = [sc, ins[0]] if swap else [ins[0], sc]
+        ctx.nodes.append(_node(onnx_op, pair, [out], nm))
+    elif op in ("expand_dims",):
+        ax = ctx.name(nm + "_axes")
+        ctx.initializers.append(_tensor(
+            ax, _np.asarray([int(p.get("axis", 0))], _np.int64)))
+        ctx.nodes.append(_node("Unsqueeze", [ins[0], ax], [out], nm))
+    elif op in ("squeeze",):
+        axis = _ints(p, "axis", None)
+        inputs = [ins[0]]
+        if axis is not None:
+            ax = ctx.name(nm + "_axes")
+            ctx.initializers.append(_tensor(
+                ax, _np.asarray(axis, _np.int64)))
+            inputs.append(ax)
+        ctx.nodes.append(_node("Squeeze", inputs, [out], nm))
+    elif op in ("sum", "mean", "max", "min"):
+        onnx_op = {"sum": "ReduceSum", "mean": "ReduceMean",
+                   "max": "ReduceMax", "min": "ReduceMin"}[op]
+        axis = _ints(p, "axis", None)
+        keep = _attr("keepdims", AT_INT,
+                     1 if p.get("keepdims", False) else 0)
+        if op == "sum" and axis is not None:
+            ax = ctx.name(nm + "_axes")
+            ctx.initializers.append(_tensor(
+                ax, _np.asarray(axis, _np.int64)))
+            ctx.nodes.append(_node(onnx_op, [ins[0], ax], [out], nm,
+                                   [keep]))
+        else:
+            attrs = [keep]
+            if axis is not None:
+                attrs.append(_attr("axes", AT_INTS, axis))
+            ctx.nodes.append(_node(onnx_op, [ins[0]], [out], nm, attrs))
+    elif op in ("slice", "slice_axis"):
+        if op == "slice_axis":
+            axes = [int(p["axis"])]
+            begin = [int(p["begin"])]
+            end = [int(p["end"]) if p.get("end") is not None else 2**31]
+            step = [1]
+        else:
+            begin = [0 if b is None else int(b)
+                     for b in p.get("begin", ())]
+            end = [2**31 if e is None else int(e)
+                   for e in p.get("end", ())]
+            step = [1 if s is None else int(s)
+                    for s in (p.get("step") or [1] * len(begin))]
+            axes = list(range(len(begin)))
+        names = []
+        for tag, vals in (("_starts", begin), ("_ends", end),
+                          ("_axes", axes), ("_steps", step)):
+            cn = ctx.name(nm + tag)
+            ctx.initializers.append(_tensor(
+                cn, _np.asarray(vals, _np.int64)))
+            names.append(cn)
+        ctx.nodes.append(_node("Slice", [ins[0]] + names, [out], nm))
+    elif op in ("clip",):
+        lo = ctx.name(nm + "_min")
+        hi = ctx.name(nm + "_max")
+        ctx.initializers.append(_tensor(
+            lo, _np.asarray(float(p.get("a_min", 0.0)), _np.float32)))
+        ctx.initializers.append(_tensor(
+            hi, _np.asarray(float(p.get("a_max", 0.0)), _np.float32)))
+        ctx.nodes.append(_node("Clip", [ins[0], lo, hi], [out], nm))
+    elif op == "Embedding":
+        idx = ctx.name(nm + "_idx")
+        ctx.nodes.append(_node("Cast", [ins[0]], [idx], idx,
+                               [_attr("to", AT_INT, TP_INT64)]))
+        ctx.nodes.append(_node("Gather", [ins[1], idx], [out], nm))
+    elif op in ("UpSampling", "_contrib_BilinearResize2D"):
+        mode = "nearest" if p.get("sample_type", "nearest") == "nearest" \
+            and op == "UpSampling" else "linear"
+        roi = ctx.name(nm + "_roi")
+        ctx.initializers.append(_tensor(
+            roi, _np.asarray([], _np.float32)))
+        sc = ctx.name(nm + "_scales")
+        if op == "UpSampling":
+            sh = sw = float(p.get("scale", 2))
+        else:
+            # BilinearResize2D takes height/width or scale_height/_width;
+            # derive the true scales from the inferred in/out shapes
+            in_shape = ctx.out_shape(node._inputs[0])
+            out_shape = ctx.out_shape(node)
+            if in_shape and out_shape:
+                sh = out_shape[2] / in_shape[2]
+                sw = out_shape[3] / in_shape[3]
+            elif p.get("scale_height") is not None:
+                sh = float(p["scale_height"])
+                sw = float(p.get("scale_width", sh))
+            else:
+                raise NotImplementedError(
+                    "ONNX export: BilinearResize2D needs inferable "
+                    "shapes or scale_height/scale_width")
+        ctx.initializers.append(_tensor(
+            sc, _np.asarray([1.0, 1.0, sh, sw], _np.float32)))
+        ctx.nodes.append(_node(
+            "Resize", [ins[0], roi, sc], [out], nm,
+            [_attr("mode", AT_STRING, mode)]))
+    elif op == "where":
+        b = ctx.name(nm + "_cond")
+        ctx.nodes.append(_node("Cast", [ins[0]], [b], b,
+                               [_attr("to", AT_INT, 9)]))  # BOOL
+        ctx.nodes.append(_node("Where", [b, ins[1], ins[2]], [out], nm))
     else:
         raise NotImplementedError(
             f"ONNX export: no converter for op {op!r} (reference "
@@ -188,6 +419,14 @@ def export_model(sym, params, input_shapes, input_dtypes=None,
 
     ctx = _Ctx()
     topo = sym._topo()
+    # per-node output shapes: lets converters resolve shape-dependent
+    # attributes (mxnet Reshape specials) to concrete dims
+    known = dict(input_shapes)
+    known.update({k: tuple(v.shape) for k, v in params.items()})
+    try:
+        _, _, ctx.node_shapes = sym._solve_shapes(known, partial=True)
+    except Exception:
+        pass
     # graph outputs: the symbol's outputs
     out_names = {}
 
@@ -243,7 +482,9 @@ def export_model(sym, params, input_shapes, input_dtypes=None,
         + [(11, P.LEN, vi) for vi in graph_inputs]
         + [(12, P.LEN, vo) for vo in graph_outputs])
 
-    opset = P.encode([(1, P.LEN, ""), (2, P.VARINT, 13)])
+    # opset 17: lowest with LayerNormalization; everything else emitted
+    # here is stable since 13
+    opset = P.encode([(1, P.LEN, ""), (2, P.VARINT, 17)])
     model = P.encode([
         (1, P.VARINT, 8),                       # ir_version
         (2, P.LEN, "mxnet_tpu"),                # producer_name

@@ -179,8 +179,138 @@ def _make(op, ins, outs, name, attrs, sym_of, values, inits):
         out = mx.sym.Reshape(sym_of(ins[0]), shape=shape, name=name)
     elif op == "Identity":
         out = sym_of(ins[0])
+    elif op == "ConvTranspose":
+        kwargs = dict(kernel=tuple(attrs["kernel_shape"]),
+                      stride=tuple(attrs.get("strides", (1, 1))),
+                      pad=tuple(attrs.get("pads", (0, 0, 0, 0))[:2]),
+                      num_group=int(attrs.get("group", 1)),
+                      name=name)
+        if "output_padding" in attrs:
+            kwargs["adj"] = tuple(attrs["output_padding"])
+        w = inits[ins[1]]
+        kwargs["num_filter"] = w.shape[1] * kwargs["num_group"]
+        args = [sym_of(ins[0]), sym_of(ins[1])]
+        if len(ins) > 2:
+            args.append(sym_of(ins[2]))
+        else:
+            kwargs["no_bias"] = True
+        out = mx.sym.Deconvolution(*args, **kwargs)
+    elif op == "Transpose":
+        out = mx.sym.transpose(sym_of(ins[0]),
+                               axes=tuple(attrs.get("perm", ())),
+                               name=name)
+    elif op == "MatMul":
+        out = mx.sym._npi_matmul(sym_of(ins[0]), sym_of(ins[1]),
+                                 name=name)
+    elif op == "LayerNormalization":
+        out = mx.sym.LayerNorm(
+            sym_of(ins[0]), sym_of(ins[1]), sym_of(ins[2]), name=name,
+            axis=int(attrs.get("axis", -1)),
+            eps=float(attrs.get("epsilon", 1e-5)))
+    elif op == "InstanceNormalization":
+        out = mx.sym.InstanceNorm(
+            sym_of(ins[0]), sym_of(ins[1]), sym_of(ins[2]), name=name,
+            eps=float(attrs.get("epsilon", 1e-3)))
+    elif op in _UNARY_IMPORT:
+        out = getattr(mx.sym, _UNARY_IMPORT[op])(sym_of(ins[0]),
+                                                 name=name)
+    elif op == "Div":
+        out = sym_of(ins[0]) / sym_of(ins[1])
+    elif op == "Pow":
+        out = mx.sym.broadcast_power(sym_of(ins[0]), sym_of(ins[1]),
+                                     name=name)
+    elif op in ("Max", "Min"):
+        fn = mx.sym.broadcast_maximum if op == "Max" else \
+            mx.sym.broadcast_minimum
+        out = fn(sym_of(ins[0]), sym_of(ins[1]), name=name)
+    elif op == "Unsqueeze":
+        axes = [int(a) for a in inits[ins[1]]] if len(ins) > 1 else \
+            list(attrs.get("axes", ()))
+        out = sym_of(ins[0])
+        for a in sorted(axes):
+            out = mx.sym.expand_dims(out, axis=int(a))
+    elif op == "Squeeze":
+        axes = ([int(a) for a in inits[ins[1]]] if len(ins) > 1
+                else list(attrs.get("axes", ())) or None)
+        out = mx.sym.squeeze(sym_of(ins[0]),
+                             axis=tuple(axes) if axes else None,
+                             name=name)
+    elif op in ("ReduceSum", "ReduceMean", "ReduceMax", "ReduceMin"):
+        fn = {"ReduceSum": "sum", "ReduceMean": "mean",
+              "ReduceMax": "max", "ReduceMin": "min"}[op]
+        axes = (tuple(int(a) for a in inits[ins[1]]) if len(ins) > 1
+                else tuple(attrs.get("axes", ())) or None)
+        out = getattr(mx.sym, fn)(
+            sym_of(ins[0]), axis=axes, name=name,
+            keepdims=bool(attrs.get("keepdims", 1)))
+    elif op == "Slice":
+        starts = [int(v) for v in inits[ins[1]]]
+        ends = [int(v) for v in inits[ins[2]]]
+        axes = ([int(v) for v in inits[ins[3]]] if len(ins) > 3
+                else list(range(len(starts))))
+        steps = ([int(v) for v in inits[ins[4]]] if len(ins) > 4
+                 else [1] * len(starts))
+        if any(s != 1 for s in steps):
+            # strided/reversed slices: build the full slice spec over
+            # max axis + 1 dims (leading axes untouched)
+            nax = max(axes) + 1
+            begin = [None] * nax
+            end = [None] * nax
+            step = [1] * nax
+            for a, b, e, st in zip(axes, starts, ends, steps):
+                begin[a] = b
+                end[a] = None if abs(e) >= 2**31 - 1 else e
+                step[a] = st
+            out = mx.sym.slice(sym_of(ins[0]), begin=tuple(begin),
+                               end=tuple(end), step=tuple(step),
+                               name=name)
+        else:
+            out = sym_of(ins[0])
+            for a, b, e in zip(axes, starts, ends):
+                out = mx.sym.slice_axis(
+                    out, axis=a, begin=b,
+                    end=None if e >= 2**31 - 1 else e)
+    elif op == "Clip":
+        lo = float(_np.asarray(inits[ins[1]]).reshape(())) \
+            if len(ins) > 1 else float(attrs.get("min", -3.4e38))
+        hi = float(_np.asarray(inits[ins[2]]).reshape(())) \
+            if len(ins) > 2 else float(attrs.get("max", 3.4e38))
+        out = mx.sym.clip(sym_of(ins[0]), a_min=lo, a_max=hi, name=name)
+    elif op == "Cast":
+        to = int(attrs.get("to", 1))
+        # BOOL(9) round-trips as float32 0/1 — mx.where treats nonzero
+        # as true, so the semantics are preserved
+        dt = {1: "float32", 6: "int32", 7: "int64"}.get(to, "float32")
+        out = mx.sym.Cast(sym_of(ins[0]), dtype=dt, name=name)
+    elif op == "Gather":
+        out = mx.sym.take(sym_of(ins[0]),
+                          mx.sym.Cast(sym_of(ins[1]), dtype="float32"),
+                          axis=int(attrs.get("axis", 0)), name=name)
+    elif op == "Resize":
+        scales = inits.get(ins[2]) if len(ins) > 2 else None
+        mode = attrs.get("mode", b"nearest")
+        mode = mode.decode() if isinstance(mode, bytes) else mode
+        s = float(scales[2]) if scales is not None and len(scales) >= 4 \
+            else 2.0
+        if mode == "nearest":
+            out = mx.sym.UpSampling(sym_of(ins[0]), scale=int(s),
+                                    sample_type="nearest", name=name)
+        else:
+            out = mx.sym._contrib_BilinearResize2D(
+                sym_of(ins[0]), scale_height=s, scale_width=s, name=name)
+    elif op == "Where":
+        out = mx.sym.where(sym_of(ins[0]), sym_of(ins[1]),
+                           sym_of(ins[2]), name=name)
+    elif op == "Erf":
+        out = mx.sym.erf(sym_of(ins[0]), name=name)
     else:
         raise NotImplementedError(
             f"ONNX import: no mapping for op {op!r}")
     values[outs[0]] = out
     return out
+
+
+_UNARY_IMPORT = {
+    "Sqrt": "sqrt", "Exp": "exp", "Log": "log", "Abs": "abs",
+    "Neg": "negative", "Floor": "floor", "Ceil": "ceil", "Sign": "sign",
+}

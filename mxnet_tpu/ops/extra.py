@@ -971,6 +971,9 @@ _reg("_npi_where_scalar2",
      lambda cond, x=0.0, y=0.0: jnp.where(
          cond, jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32)))
 _reg("_npi_powerd", lambda a, exp=1.0: jnp.power(a, exp))
+# numpy-semantics matmul (reference: _npi_matmul, src/operator/numpy/
+# np_matmul_op.cc) — broadcasting batch matmul, the ONNX MatMul contract
+_reg("_npi_matmul", lambda a, b: jnp.matmul(a, b))
 _reg("_npi_tensordot_int_axes",
      lambda a, b, axes=2: jnp.tensordot(a, b, axes=int(axes)))
 _reg("_npi_matrix_rank_none_tol",

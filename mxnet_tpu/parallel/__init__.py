@@ -17,4 +17,7 @@ from .functional import functional_call, extract_params, load_params  # noqa: F4
 from .trainer import ShardedTrainer, shard_batch  # noqa: F401
 from .ring_attention import ring_attention, sequence_shard  # noqa: F401
 from .pipeline import (pipeline_stage_loop,  # noqa: F401
-                       pipeline_value_and_grad)  # noqa: F401
+                       pipeline_value_and_grad,  # noqa: F401
+                       hetero_pipeline, HeteroPipeline)  # noqa: F401
+from .stages import gluon_pipeline_stages  # noqa: F401
+from .auto_spec import auto_spec  # noqa: F401

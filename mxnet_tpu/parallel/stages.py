@@ -1,0 +1,72 @@
+"""Split a gluon network into pipeline stages for HeteroPipeline.
+
+Real models (ResNet, BERT) change activation shape between stages, so
+each stage becomes its own sub-network with its own param pytree; the
+packed-register schedule in ``pipeline.HeteroPipeline`` runs them under
+one jitted scan. (The reference has no pipeline parallelism to cite —
+SURVEY.md §2.3; this is TPU-native capability.)
+
+BatchNorm note: stage fns run with ``training=True`` (batch statistics)
+but drop running-stat updates — the pipeline schedule is stateless. The
+sequential oracle used in tests does the same, so gradients are exactly
+comparable; fold running stats offline if inference-time stats matter.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .functional import extract_params, functional_call
+
+__all__ = ["gluon_pipeline_stages"]
+
+
+def gluon_pipeline_stages(net, boundaries, sample_shape,
+                          dtype=jnp.float32):
+    """Partition ``net`` (a features+output gluon model) into pipeline
+    stages split at ``boundaries`` (indices into ``net.features``).
+
+    Returns ``(stage_fns, stage_params, act_shapes)`` ready to hand to
+    :class:`mxnet_tpu.parallel.HeteroPipeline`:
+      - ``stage_fns[i](params, x)`` applies stage i's sub-network
+        functionally (training-mode BN, see module docstring);
+      - ``stage_params[i]`` is the stage's param dict (disjoint across
+        stages, names preserved from the net);
+      - ``act_shapes`` are the per-boundary activation shapes (without
+        the microbatch dim), inferred with ``jax.eval_shape`` from
+        ``sample_shape`` (a full input shape INCLUDING the microbatch
+        dim, e.g. ``(mb, 3, 32, 32)``).
+
+    The net must already be initialized (shapes known).
+    """
+    from ..gluon import nn
+
+    children = list(net.features)
+    idx = [0] + sorted(boundaries) + [len(children)]
+    if any(a >= b for a, b in zip(idx[:-1], idx[1:])):
+        raise ValueError(f"boundaries {boundaries} must be strictly "
+                         f"increasing within (0, {len(children)})")
+    groups = []
+    for a, b in zip(idx[:-1], idx[1:]):
+        seq = nn.HybridSequential(prefix=f"pipe_stage{len(groups)}_")
+        seq.add(*children[a:b])  # shares the blocks; names unchanged
+        groups.append(seq)
+    if getattr(net, "output", None) is not None:
+        groups[-1].add(net.output)
+
+    stage_params = [extract_params(g) for g in groups]
+
+    def make_fn(group):
+        def fn(params, x):
+            return functional_call(group, params, x, training=True)[0]
+        return fn
+
+    stage_fns = [make_fn(g) for g in groups]
+
+    act_shapes = []
+    spec = jax.ShapeDtypeStruct(tuple(sample_shape), dtype)
+    act_shapes.append(tuple(spec.shape[1:]))
+    for fn, p in zip(stage_fns, stage_params):
+        spec = jax.eval_shape(fn, p, spec)
+        act_shapes.append(tuple(spec.shape[1:]))
+    return stage_fns, stage_params, act_shapes

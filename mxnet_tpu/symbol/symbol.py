@@ -314,7 +314,7 @@ class Symbol:
                 hint = self._find_var(n)._shape_hint
                 if hint:
                     known[n] = tuple(hint)
-        shape_of, out_shapes = self._solve_shapes(known, partial)
+        shape_of, out_shapes, _ = self._solve_shapes(known, partial)
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
         if not partial:
@@ -396,7 +396,7 @@ class Symbol:
                 out_shapes = list(root)
         else:
             out_shapes = [root]
-        return shape_of, out_shapes
+        return shape_of, out_shapes, node_out
 
     def infer_type(self, *args, **kwargs):
         names = self.list_inputs()

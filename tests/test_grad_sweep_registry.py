@@ -1,0 +1,381 @@
+"""Registry-wide numeric-gradient sweep.
+
+Closes the gap between the 114-case hand list (test_numeric_gradient.py)
+and the full differentiable registry: every registered op with
+``differentiable=True`` must be either
+  (a) covered by the hand list,
+  (b) covered by a template here (checked against finite differences), or
+  (c) listed in EXCLUDED with a stated reason.
+``test_registry_grad_coverage_is_total`` enforces the trichotomy, so a
+newly registered differentiable op fails the suite until it is swept or
+justified. (Reference practice: tests/python/unittest/test_operator.py
+calls check_numeric_gradient per op, with the same kinds of exclusions —
+loss layers whose backward is the loss gradient, STE estimators, RNG
+ops.)
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.ops import registry
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+_spec = importlib.util.spec_from_file_location(
+    "_tng", os.path.join(os.path.dirname(__file__),
+                         "test_numeric_gradient.py"))
+_tng = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_tng)
+HAND_COVERED = {c[0] for c in _tng.ALL_CASES}
+
+
+def _r(*shape, seed=0, scale=1.0, shift=0.0):
+    return np.random.RandomState(seed).randn(*shape) * scale + shift
+
+
+def _pos(*shape, seed=0, shift=1.0):
+    return np.abs(_r(*shape, seed=seed)) + shift
+
+
+def _spd(n, seed=0):
+    a = _r(n, n, seed=seed)
+    return a @ a.T + n * np.eye(n)
+
+
+def _first(name):
+    """Wrap a multi-output op: project only output[0]."""
+    def f(*xs, **kw):
+        return getattr(nd, name)(*xs, **kw)[0]
+    return f
+
+
+def _sum_outs(name):
+    def f(*xs, **kw):
+        outs = getattr(nd, name)(*xs, **kw)
+        return sum(o.sum() for o in outs)
+    return f
+
+
+# --------------------------------------------------------------------------
+# Templates: op -> (callable-or-name, inputs, kwargs, grad_inputs or None)
+# Inputs stay tiny: numeric diff costs O(size) forward evals per case.
+# --------------------------------------------------------------------------
+T = {}
+
+
+def case(name, inputs, kwargs=None, grad_inputs=None, op=None,
+         rtol=1e-2, atol=1e-3, eps=1e-3):
+    T[name] = (op or name, inputs, kwargs or {}, grad_inputs, rtol, atol,
+               eps)
+
+
+# scalar-arithmetic family (kwarg name: scalar)
+for opname in ["_plus_scalar", "_minus_scalar", "_rminus_scalar",
+               "_mul_scalar", "_div_scalar", "_maximum_scalar",
+               "_minimum_scalar", "_hypot_scalar",
+               "_scatter_plus_scalar", "_scatter_minus_scalar"]:
+    case(opname, [_r(3, 4, shift=0.3)], {"scalar": 2.5})
+case("_rdiv_scalar", [_pos(3, 4)], {"scalar": 2.5})
+case("_power_scalar", [_pos(3, 4)], {"scalar": 2.5})
+case("_rpower_scalar", [_r(3, 4, scale=0.5)], {"scalar": 2.5})
+case("_mod_scalar", [_pos(3, 4, shift=0.6)], {"scalar": 2.5})
+case("_rmod_scalar", [_pos(3, 4, shift=3.0)], {"scalar": 2.0})
+case("_power", [_pos(3, 4), _r(3, 4, seed=1, scale=0.5)])
+case("_mod", [_pos(3, 4, shift=5.0), _pos(3, 4, seed=1, shift=2.0)])
+case("broadcast_mod", [_pos(3, 4, shift=5.0), _pos(1, 4, seed=1,
+                                                   shift=2.0)])
+case("_grad_add", [_r(3, 4), _r(3, 4, seed=1)])
+case("_scatter_elemwise_div", [_r(3, 4), _pos(3, 4, seed=1)])
+case("_npi_powerd", [_pos(3, 4), _pos(3, 4, seed=1, shift=0.5)])
+
+# zero-slope-almost-everywhere rounders: both sides are 0 away from the
+# jumps, so the check is meaningful (inputs kept off half-integers)
+for opname in ["ceil", "floor", "fix", "rint", "round", "sign"]:
+    case(opname, [_r(3, 4, shift=0.29)])
+
+case("degrees", [_r(3, 4)])
+case("radians", [_r(3, 4)])
+case("digamma", [_pos(3, 4, shift=1.5)])
+case("hard_sigmoid", [_r(3, 4, shift=0.3)])
+case("_npx_relu", [_r(3, 4, shift=0.4)])
+case("_npx_sigmoid", [_r(3, 4)])
+case("Cast", [_r(3, 4)], {"dtype": "float32"})
+case("moments", [_r(3, 4)], {"axes": (1,)}, op=_sum_outs("moments"))
+case("nanprod", [_pos(2, 3, shift=0.5)], {"axis": 1})
+case("_square_sum", [_r(3, 4)], {"axis": 1})
+case("softmax_cross_entropy",
+     [_r(3, 5), np.array([0.0, 2.0, 4.0])], grad_inputs=[0])
+
+# shape/layout movers (gradient = inverse rearrangement)
+case("broadcast_to", [_r(3, 1)], {"shape": (3, 4)})
+case("broadcast_axes", [_r(3, 1)], {"axis": 1, "size": 4})
+case("broadcast_like", [_r(3, 1), _r(3, 4, seed=1)], grad_inputs=[0])
+case("reshape_like", [_r(3, 4), _r(2, 6, seed=1)], grad_inputs=[0])
+case("_npx_reshape", [_r(3, 4)], {"newshape": (4, 3)})
+case("space_to_depth", [_r(1, 2, 4, 4)], {"block_size": 2})
+case("depth_to_space", [_r(1, 8, 2, 2)], {"block_size": 2})
+case("slice_like", [_r(4, 5), _r(2, 3, seed=1)], grad_inputs=[0])
+case("stack", [_r(2, 3), _r(2, 3, seed=1)], {"axis": 1})
+case("Concat", [_r(2, 3), _r(2, 4, seed=1)], {"dim": 1})
+case("_rnn_param_concat", [_r(4), _r(6, seed=1)], {"dim": 0})
+case("ElementWiseSum", [_r(3, 4), _r(3, 4, seed=1), _r(3, 4, seed=2)])
+case("SliceChannel", [_r(2, 6)], {"num_outputs": 3, "axis": 1},
+     op=_sum_outs("SliceChannel"))
+case("_split_v2", [_r(2, 6)], {"indices": (2, 4), "axis": 1},
+     op=_sum_outs("_split_v2"))
+case("Crop", [_r(1, 3, 6, 6)], {"h_w": (4, 4), "center_crop": True})
+case("batch_take", [_r(3, 4), np.array([0.0, 2.0, 1.0])],
+     grad_inputs=[0])
+case("gather_nd", [_r(3, 4), np.array([[0.0, 2.0], [1.0, 3.0]])],
+     grad_inputs=[0])
+case("scatter_nd", [_r(2), np.array([[0.0, 1.0], [1.0, 2.0]])],
+     {"shape": (3, 4)}, grad_inputs=[0])
+case("_scatter_set_nd",
+     [_r(3, 4), _r(2, seed=1), np.array([[0.0, 1.0], [1.0, 2.0]])],
+     {"shape": (3, 4)}, grad_inputs=[0, 1])
+case("_slice_assign", [_r(3, 4), _r(2, 2, seed=1)],
+     {"begin": (0, 1), "end": (2, 3)}, grad_inputs=[0, 1])
+case("_slice_assign_scalar", [_r(3, 4)],
+     {"scalar": 1.5, "begin": (0, 1), "end": (2, 3)})
+case("_contrib_index_copy",
+     [_r(4, 3), np.array([1.0, 3.0]), _r(2, 3, seed=1)],
+     grad_inputs=[0, 2])
+case("_npi_boolean_mask_assign_scalar",
+     [_r(3, 4), (np.arange(12).reshape(3, 4) % 3 == 0).astype(np.float32)],
+     {"value": 1.5}, grad_inputs=[0])
+case("_npi_where_lscalar",
+     [(np.arange(12).reshape(3, 4) % 2).astype(np.float32), _r(3, 4)],
+     {"scalar": 1.5}, grad_inputs=[1])
+case("_npi_where_rscalar",
+     [(np.arange(12).reshape(3, 4) % 2).astype(np.float32), _r(3, 4)],
+     {"scalar": 1.5}, grad_inputs=[1])
+case("_npi_tensordot_int_axes", [_r(2, 3), _r(3, 4, seed=1)],
+     {"axes": 1})
+case("_npi_matmul", [_r(2, 3, 4, scale=0.5), _r(2, 4, 2, seed=1,
+                                                scale=0.5)])
+
+# sorting/selection (permutation gradients; ties measure zero)
+case("sort", [_r(3, 4)], {"axis": 1})
+
+# sequence family (length input is integral -> data grad only)
+case("SequenceLast", [_r(4, 2, 3), np.array([2.0, 4.0])],
+     {"use_sequence_length": True}, grad_inputs=[0])
+case("SequenceMask", [_r(4, 2, 3), np.array([2.0, 4.0])],
+     {"use_sequence_length": True, "value": 0.0}, grad_inputs=[0])
+case("SequenceReverse", [_r(4, 2, 3), np.array([2.0, 4.0])],
+     {"use_sequence_length": True}, grad_inputs=[0])
+
+# normalization / nn tail
+# use_global_stats pins BN to the moving-stats path in BOTH the eager
+# probe (inference mode) and the recorded pass — without it the numeric
+# side evaluates inference BN while autograd differentiates batch-stats
+# BN and the comparison is between two different functions
+case("BatchNorm",
+     [_r(2, 3, 4, 4), _pos(3), _r(3, seed=1), _r(3, seed=2, scale=0.3),
+      _pos(3, seed=3)],
+     {"fix_gamma": False, "use_global_stats": True},
+     grad_inputs=[0, 1, 2], rtol=3e-2, atol=3e-3)
+case("_contrib_SyncBatchNorm",
+     [_r(2, 3, 4, 4), _pos(3), _r(3, seed=1), _r(3, seed=2, scale=0.3),
+      _pos(3, seed=3)],
+     {"fix_gamma": False, "use_global_stats": True},
+     grad_inputs=[0, 1, 2], rtol=3e-2, atol=3e-3)
+case("LRN", [_r(1, 4, 3, 3)], {"nsize": 3})
+case("SoftmaxActivation", [_r(2, 5)])
+case("L2Normalization", [_r(2, 6)])
+case("UpSampling", [_r(1, 2, 3, 3)], {"scale": 2,
+                                      "sample_type": "nearest"})
+case("_contrib_AdaptiveAvgPooling2D", [_r(1, 2, 6, 6)],
+     {"output_size": (3, 3)})
+case("_contrib_BilinearResize2D", [_r(1, 2, 4, 4)],
+     {"height": 6, "width": 6})
+case("_contrib_div_sqrt_dim", [_r(2, 8)])
+case("_contrib_quadratic", [_r(3, 4)], {"a": 0.5, "b": -1.0, "c": 2.0})
+case("_contrib_gradientmultiplier", [_r(3, 4)], {"scalar": 1.0})
+case("scaled_dot_product_attention",
+     [_r(1, 2, 4, 3, scale=0.5), _r(1, 2, 4, 3, seed=1, scale=0.5),
+      _r(1, 2, 4, 3, seed=2, scale=0.5)])
+case("_contrib_interleaved_matmul_encdec_qk",
+     [_r(3, 1, 8, scale=0.5), _r(3, 1, 16, seed=1, scale=0.5)],
+     {"heads": 2})
+case("_contrib_interleaved_matmul_encdec_valatt",
+     [_r(3, 1, 16, scale=0.5), _r(2, 3, 3, seed=1, scale=0.5)],
+     {"heads": 2})
+case("col2im",
+     [_r(1, 8, 4)], {"output_size": (3, 3), "kernel": (2, 2),
+                     "stride": (1, 1)})
+case("khatri_rao", [_r(2, 3), _r(4, 3, seed=1)])
+case("_contrib_hawkesll",
+     [_pos(2, 3, shift=0.5),                      # lda (N,K)
+      _pos(3, seed=1, shift=0.2),                 # alpha (K,)
+      _pos(3, seed=2, shift=0.5),                 # beta (K,)
+      np.abs(_r(2, 3, seed=3)),                   # state (N,K)
+      _pos(2, 4, seed=4, shift=0.1),              # lags (N,T)
+      np.array([[0.0, 1.0, 2.0, 0.0],
+                [1.0, 0.0, 2.0, 1.0]]),           # marks (N,T) int
+      np.array([3.0, 4.0]),                       # valid_length (N,)
+      np.array([5.0, 5.0])],                      # max_time (N,)
+     grad_inputs=[0, 1, 2, 3], op=_first("_contrib_hawkesll"),
+     # f32 log-lik sums need a larger step: at eps=1e-3 the secant is
+     # round-off (verified convergent at 1e-2/3e-2)
+     eps=1e-2, rtol=2e-2, atol=2e-3)
+
+# spatial / detection tail (integral or box inputs -> data grads only)
+case("ROIPooling",
+     [_r(1, 2, 8, 8), np.array([[0.0, 0.0, 0.0, 6.0, 6.0]])],
+     {"pooled_size": (2, 2), "spatial_scale": 1.0}, grad_inputs=[0])
+case("_contrib_ROIAlign",
+     [_r(1, 2, 8, 8), np.array([[0.0, 0.5, 0.5, 6.0, 6.0]])],
+     {"pooled_size": (2, 2), "spatial_scale": 1.0}, grad_inputs=[0])
+case("_contrib_PSROIPooling",
+     [_r(1, 8, 8, 8), np.array([[0.0, 0.5, 0.5, 6.0, 6.0]])],
+     {"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2},
+     grad_inputs=[0])
+case("_contrib_box_decode",
+     [_r(1, 3, 4, scale=0.1), np.array([[[2.0, 2.0, 6.0, 6.0],
+                                         [1.0, 1.0, 4.0, 5.0],
+                                         [0.0, 2.0, 3.0, 7.0]]])],
+     grad_inputs=[0])
+case("_contrib_box_iou",
+     [np.array([[1.0, 1.0, 4.0, 4.0]]),
+      np.array([[2.0, 2.0, 5.0, 5.0]])])
+case("GridGenerator", [_r(1, 6, scale=0.2)],
+     {"transform_type": "affine", "target_shape": (4, 4)})
+case("BilinearSampler",
+     [_r(1, 2, 5, 5), np.clip(_r(1, 2, 4, 4, seed=1, scale=0.3), -0.8,
+                              0.8)])
+case("SpatialTransformer",
+     [_r(1, 2, 5, 5), _r(1, 6, seed=1, scale=0.1)],
+     {"transform_type": "affine", "sampler_type": "bilinear",
+      "target_shape": (4, 4)})
+case("_image_crop", [_r(6, 6, 3)], {"x": 1, "y": 1, "width": 4,
+                                    "height": 4})
+case("_image_resize", [_r(5, 5, 3)], {"size": (4, 4)})
+case("_image_to_tensor", [_pos(4, 4, 3, shift=0.0)])
+case("_contrib_SparseEmbedding",
+     [np.array([0.0, 2.0, 1.0]), _r(4, 3, seed=1)],
+     {"input_dim": 4, "output_dim": 3}, grad_inputs=[1])
+case("_contrib_ModulatedDeformableConvolution",
+     [_r(1, 2, 5, 5), _r(1, 8, 4, 4, seed=1, scale=0.1),
+      np.full((1, 4, 4, 4), 0.5, np.float32),
+      _r(3, 2, 2, 2, seed=2, scale=0.3)],
+     {"kernel": (2, 2), "num_filter": 3, "no_bias": True},
+     grad_inputs=[0, 3], rtol=3e-2, atol=3e-3)
+case("_contrib_DeformablePSROIPooling",
+     [_r(1, 8, 8, 8), np.array([[0.0, 0.5, 0.5, 6.0, 6.0]]),
+      np.zeros((1, 2, 2, 2), np.float32)],
+     {"spatial_scale": 1.0, "output_dim": 2, "group_size": 2,
+      "pooled_size": 2, "part_size": 2, "sample_per_part": 2,
+      "trans_std": 0.1}, grad_inputs=[0], rtol=3e-2, atol=3e-3)
+
+# linalg tail (well-conditioned inputs)
+case("_linalg_gemm", [_r(2, 3), _r(3, 4, seed=1), _r(2, 4, seed=2)],
+     {"alpha": 1.0, "beta": 1.0})
+case("_linalg_gemm2", [_r(2, 3), _r(3, 4, seed=1)])
+case("_linalg_det", [_spd(3)])
+case("_linalg_slogdet", [_spd(3)], op=_first("_linalg_slogdet"))
+case("_linalg_inverse", [_spd(3)])
+case("_linalg_potrf", [_spd(3)])
+case("_linalg_potri", [np.linalg.cholesky(_spd(3))])
+case("_linalg_sumlogdiag", [_spd(3)])
+case("_linalg_extractdiag", [_r(3, 3)])
+case("_linalg_makediag", [_r(3)])
+case("_linalg_extracttrian", [_r(3, 3)])
+case("_linalg_maketrian", [_r(6)])
+case("_linalg_syrk", [_r(2, 3)], {"alpha": 1.0})
+case("_linalg_trmm", [np.tril(_pos(3, 3, shift=0.5)), _r(3, 2, seed=1)])
+case("_linalg_trsm", [np.tril(_pos(3, 3, shift=1.5)), _r(3, 2, seed=1)])
+case("_npi_pinv_scalar_rcond", [_r(3, 2)])
+
+# fft pair (linear maps)
+case("_contrib_fft", [_r(2, 4)])
+case("_contrib_ifft", [_r(2, 8)])
+
+case("CTCLoss",
+     [_r(5, 2, 4, scale=0.5), np.array([[1.0, 2.0], [2.0, 1.0]])],
+     grad_inputs=[0], rtol=3e-2, atol=3e-3)
+
+# --------------------------------------------------------------------------
+# Exclusions, each with its reason
+# --------------------------------------------------------------------------
+EXCLUDED = {
+    # backward is a LOSS gradient by contract, not the forward Jacobian
+    # (reference output-layer semantics: src/operator/softmax_output.cc)
+    "SoftmaxOutput": "backward emits d(CE loss), not forward Jacobian",
+    "LinearRegressionOutput": "backward emits d(L2 loss) by contract",
+    "LogisticRegressionOutput": "backward emits d(logistic loss)",
+    "MAERegressionOutput": "backward emits d(L1 loss) by contract",
+    "SVMOutput": "backward emits d(hinge loss) by contract",
+    "MakeLoss": "backward is grad_scale*1 (loss contract), not Jacobian",
+    "BlockGrad": "gradient is defined to be zero (stop_gradient)",
+    "IdentityAttachKLSparseReg":
+        "backward adds KL penalty; forward is identity",
+    "_contrib_round_ste": "straight-through estimator: grad != Jacobian",
+    "_contrib_sign_ste": "straight-through estimator: grad != Jacobian",
+    "_contrib_gradientmultiplier_doc_note":
+        "covered with scalar=1.0 template above",
+    # stochastic / stateful
+    "Dropout": "stochastic mask (needs_rng); identity in eval mode",
+    "Custom": "user-defined callback op; tests/test_custom_op.py",
+    "RNN": "fused multi-gate kernel; dedicated oracle tests "
+           "(tests/test_rnn.py pin fwd+bwd vs hand LSTM/GRU)",
+    # optimizer update kernels (mutating; reference defines no gradient)
+    "ftml_update": "optimizer update kernel (tests/test_optimizer.py)",
+    "mp_lamb_update_phase1": "optimizer update kernel",
+    "mp_lamb_update_phase2": "optimizer update kernel",
+    "mp_nag_mom_update": "optimizer update kernel",
+    "_mp_adamw_update": "optimizer update kernel",
+    # piecewise-constant selection outputs (reference: no gradient)
+    "_contrib_box_nms": "selection/suppression output is piecewise "
+                        "constant in scores",
+    "_npi_where_scalar2":
+        "only input is the selector; output is piecewise constant and "
+        "finite differences at the 0/nonzero boundary straddle branches",
+    "_contrib_Proposal": "top-k anchor selection, piecewise constant",
+    "_contrib_MultiProposal": "top-k anchor selection, piecewise constant",
+    # factorization outputs with sign/basis ambiguity: finite
+    # differences of a non-unique factor are ill-defined
+    "_linalg_gelqf": "LQ factor sign ambiguity",
+    "_linalg_syevd": "eigenvector sign/ordering ambiguity",
+    "_contrib_BatchNormWithReLU":
+        "ReLU kink sits exactly at the BN mean — a measure-zero kink "
+        "for analytic grads but a dense failure set for finite "
+        "differences; BN half is covered by the BatchNorm template",
+}
+
+
+def _unique_impl_groups():
+    ops = {n: registry.get(n) for n in registry.list_ops()}
+    groups = {}
+    for n, o in ops.items():
+        if o.differentiable:
+            groups.setdefault(id(o.impl), []).append(n)
+    return list(groups.values())
+
+
+def test_registry_grad_coverage_is_total():
+    """Every differentiable op impl is hand-covered, templated here, or
+    excluded with a reason."""
+    missing = []
+    for names in _unique_impl_groups():
+        ns = set(names)
+        if ns & HAND_COVERED or ns & set(T) or ns & set(EXCLUDED):
+            continue
+        missing.append(sorted(names))
+    assert not missing, (
+        f"{len(missing)} differentiable op groups have no gradient "
+        f"coverage and no stated exclusion: {missing}")
+
+
+_IDS = sorted(T)
+
+
+@pytest.mark.parametrize("name", _IDS)
+def test_numeric_gradient_tail(name):
+    op, inputs, kwargs, grad_inputs, rtol, atol, eps = T[name]
+    check_numeric_gradient(op, inputs, kwargs=kwargs,
+                           grad_inputs=grad_inputs, rtol=rtol, atol=atol,
+                           eps=eps)
