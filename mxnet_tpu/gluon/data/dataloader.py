@@ -116,22 +116,40 @@ class DataLoader:
                 from multiprocessing.dummy import Pool as ThreadPool
                 self._pool = ThreadPool(self._num_workers)
             else:
-                # spawn (not fork): forking after JAX/PJRT initialization
-                # can deadlock the multithreaded parent. Spawn requires a
-                # picklable dataset; fall back to a thread pool otherwise
-                # (decode/augment work on numpy releases the GIL anyway).
-                try:
-                    ctx = multiprocessing.get_context("spawn")
-                    self._pool = ctx.Pool(
-                        self._num_workers,
-                        initializer=_WorkerInitializer.init,
-                        initargs=(self._dataset,))
-                    self._fetch = _worker_fetch
-                except Exception:
+                # Process-pool start method, in preference order:
+                # - forkserver: the server process is started clean and
+                #   children fork from IT, so (a) no fork of the
+                #   multithreaded JAX/PJRT parent (deadlock risk) and
+                #   (b) unlike spawn, an unguarded user __main__ script
+                #   is NOT re-executed in every worker — the classic
+                #   spawn footgun.
+                # - spawn: same safety w.r.t. the parent, but scripts
+                #   without `if __name__ == "__main__":` re-run in each
+                #   worker.
+                # - thread pool: when the dataset cannot cross a process
+                #   boundary at all (decode/augment in numpy/cv2
+                #   releases the GIL anyway).
+                methods = [m for m in ("forkserver", "spawn")
+                           if m in multiprocessing.get_all_start_methods()]
+                err = None
+                for method in methods:
+                    try:
+                        ctx = multiprocessing.get_context(method)
+                        self._pool = ctx.Pool(
+                            self._num_workers,
+                            initializer=_WorkerInitializer.init,
+                            initargs=(self._dataset,))
+                        self._fetch = _worker_fetch
+                        break
+                    except Exception as e:
+                        err = e
+                        self._pool = None
+                if self._pool is None:
                     import warnings
                     warnings.warn(
-                        "dataset is not picklable; DataLoader falls back "
-                        "to a thread pool for workers", stacklevel=2)
+                        "dataset cannot be sent to worker processes "
+                        f"({err!r}); DataLoader falls back to a thread "
+                        "pool", stacklevel=2)
                     from multiprocessing.dummy import Pool as ThreadPool
                     self._pool = ThreadPool(self._num_workers)
 
@@ -162,5 +180,11 @@ class DataLoader:
         return len(self._batch_sampler)
 
     def __del__(self):
-        if self._pool is not None:
-            self._pool.terminate()
+        # tolerate partially-constructed instances and interpreter
+        # shutdown (modules may already be torn down)
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.terminate()
+            except Exception:
+                pass

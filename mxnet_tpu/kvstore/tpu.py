@@ -31,11 +31,29 @@ _INITIALIZED = False
 def init_process_group(coordinator_address=None, num_processes=None,
                        process_id=None):
     """Bootstrap multi-host collectives (≙ KVStore::InitPSEnv,
-    include/mxnet/kvstore.h:324). Reads jax.distributed env when args
-    are None; safe to call once per process."""
+    include/mxnet/kvstore.h:324). When args are None, reads the
+    MXNET_TPU_* env vars that ``python -m mxnet_tpu.launch`` sets
+    (falling back to the reference's DMLC_* names); safe to call once
+    per process."""
+    import os
     global _INITIALIZED
     if _INITIALIZED:
         return
+    # env only fills arguments the caller did NOT pass explicitly
+    if num_processes is None:
+        num_processes = int(
+            os.environ.get("MXNET_TPU_NUM_WORKERS")
+            or os.environ.get("DMLC_NUM_WORKER") or 1)
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MXNET_TPU_COORDINATOR")
+        if coordinator_address is None and \
+                os.environ.get("DMLC_PS_ROOT_URI"):
+            coordinator_address = (
+                os.environ["DMLC_PS_ROOT_URI"] + ":"
+                + os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    if process_id is None:
+        process_id = int(os.environ.get("MXNET_TPU_RANK")
+                         or os.environ.get("DMLC_WORKER_ID") or 0)
     if num_processes is not None and num_processes > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -2,15 +2,15 @@
 
 The reference exercises its wire protocol with local multi-process
 launches (tests/nightly/dist_sync_kvstore.py via tools/launch.py
---launcher local); this is the TPU-build analogue: N OS processes, each
-a jax process with one virtual CPU device, joined by
-jax.distributed.initialize. Covers the KVStoreTPU('dist_sync') compiled
-psum reduce and a ShardedTrainer dp step over the process-spanning mesh,
-asserting byte-identical results on every rank.
+--launcher local); this is the TPU-build analogue, and it goes through
+the SAME user-facing door: ``mxnet_tpu.launch`` spawns N OS processes
+(each a jax process with one virtual CPU device) that join via the
+MXNET_TPU_* env plumbing + jax.distributed.initialize. Covers the
+KVStoreTPU('dist_sync') compiled psum reduce and a ShardedTrainer dp
+step over the process-spanning mesh, asserting byte-identical results
+on every rank.
 """
 import os
-import socket
-import subprocess
 import sys
 import textwrap
 
@@ -25,12 +25,14 @@ _WORKER = textwrap.dedent("""
     import jax
     jax.config.update("jax_platforms", "cpu")
 
-    coord, nproc, rank = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
     sys.path.insert(0, "__REPO__")
     import mxnet_tpu as mx
     from mxnet_tpu import nd
+    # rank/world/coordinator arrive via the launcher's env plumbing
+    nproc = int(os.environ["MXNET_TPU_NUM_WORKERS"])
+    rank = int(os.environ["MXNET_TPU_RANK"])
     from mxnet_tpu.kvstore.tpu import init_process_group
-    init_process_group(coord, nproc, rank)
+    init_process_group()
     assert jax.process_count() == nproc, jax.process_count()
 
     # ---- kvstore dist_sync: compiled psum reduce --------------------
@@ -87,47 +89,30 @@ _WORKER = textwrap.dedent("""
     for n in sorted(tr.params):
         local = np.asarray(tr.params[n].addressable_data(0))
         h.update(np.ascontiguousarray(local).tobytes())
-    print(f"RESULT rank={rank} losses={losses[-1]:.6f} "
-          f"hash={h.hexdigest()}", flush=True)
+    with open(os.path.join("__OUT__", f"result_{rank}.txt"), "w") as f:
+        f.write(f"RESULT rank={rank} losses={losses[-1]:.6f} "
+                f"hash={h.hexdigest()}\\n")
 """)
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 @pytest.mark.parametrize("nproc", [2])
-def test_multiprocess_dist_sync(tmp_path, nproc):
+def test_multiprocess_dist_sync(tmp_path, nproc, monkeypatch):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER.replace("__REPO__", repo))
-    coord = f"127.0.0.1:{_free_port()}"
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), coord, str(nproc), str(r)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env)
-        for r in range(nproc)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("distributed worker timed out")
-        outs.append(out)
-    for r, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r} failed:\n{out[-4000:]}"
-    results = [line for out in outs for line in out.splitlines()
-               if line.startswith("RESULT")]
-    assert len(results) == nproc, outs
+    script.write_text(_WORKER.replace("__REPO__", repo)
+                      .replace("__OUT__", str(tmp_path)))
+    # launch(cpu=True) overrides the runner's device-count/platform env
+    # per worker; monkeypatch keeps this module's own env untouched for
+    # later tests
+    monkeypatch.syspath_prepend(repo)
+    from mxnet_tpu.launch import launch
+    rc = launch(nproc, [sys.executable, str(script)], cpu=True,
+                timeout=420)
+    assert rc == 0, f"launcher reported failure rc={rc}"
+    results = []
+    for r in range(nproc):
+        f = tmp_path / f"result_{r}.txt"
+        assert f.exists(), f"rank {r} wrote no result"
+        results.append(f.read_text().strip())
     hashes = {line.split("hash=")[1] for line in results}
     assert len(hashes) == 1, f"ranks diverged: {results}"
