@@ -220,6 +220,13 @@ def bench_resnet(dtype, layout, batch, train_iters, infer_iters,
     final_loss = float(loss)
     assert np.isfinite(final_loss), "training diverged"
 
+    # BENCH_PROFILE=<dir>: capture a device trace of one timed scan so
+    # the HBM/MXU split of the step is inspectable (feeds docs/PERF.md)
+    prof_dir = os.environ.get("BENCH_PROFILE")
+    if prof_dir:
+        with jax.profiler.trace(prof_dir):
+            run_train(train_iters)
+
     return {
         "train_img_s": train_img_s, "infer_img_s": infer_img_s,
         "train_flops": train_flops, "infer_flops": infer_flops,

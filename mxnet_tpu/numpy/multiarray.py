@@ -107,11 +107,32 @@ def dispatch(jfn, args, kwargs, differentiable=True, out=None):
     return to_np(apply_fn(fn, arrs, differentiable=differentiable, out=out))
 
 
+# value-dependent output shapes: eager-only unless a size bound makes
+# them static (npx.dynamic_shape_bound, SURVEY §7 bounded-shape strategy)
+DYNAMIC_SIZE = frozenset({"unique", "nonzero", "flatnonzero", "argwhere"})
+
+_shape_bound_fn = None
+
+
+def _shape_bound():
+    # resolved lazily once (import cycle), then cached off the hot path
+    global _shape_bound_fn
+    if _shape_bound_fn is None:
+        from ..numpy_extension.dynamic import current_shape_bound
+        _shape_bound_fn = current_shape_bound
+    return _shape_bound_fn()
+
+
 def make_np_func(name, jfn):
     """Build one mx.np namespace function from its jax.numpy counterpart."""
     differentiable = name not in NONDIFF
+    dynamic = name in DYNAMIC_SIZE
 
     def fn(*args, out=None, **kwargs):
+        if dynamic and "size" not in kwargs:
+            bound = _shape_bound()
+            if bound is not None:
+                kwargs["size"] = bound
         return dispatch(jfn, args, kwargs, differentiable=differentiable,
                         out=out)
 
@@ -379,6 +400,9 @@ class ndarray(NDArray):
                        differentiable=False)
 
     def nonzero(self):
+        bound = _shape_bound()   # method honors the bound like mnp.nonzero
+        if bound is not None:
+            return self._m(jnp.nonzero, size=bound, differentiable=False)
         return self._m(jnp.nonzero, differentiable=False)
 
     def tostype(self, stype):

@@ -58,6 +58,10 @@ def _npx_func(opfn):
     return fn
 
 
+from .dynamic import (dynamic_shape_bound,  # noqa: F401,E402
+                      current_shape_bound, shape_bucket)
+__all__ += ["dynamic_shape_bound", "current_shape_bound", "shape_bucket"]
+
 # Generate the op surface from the registry (the same source that feeds
 # mx.nd), wrapped to return mx.np ndarrays. Internal/underscore ops are
 # omitted, matching the reference's public npx namespace.

@@ -1,0 +1,79 @@
+"""Bounded-shape execution of dynamic-output ops under jit.
+
+The reference re-infers shapes in-executor at runtime for ops whose
+output shape depends on VALUES (np.unique, boolean_mask, nonzero —
+reference: src/executor/graph_executor.cc:1497-1530 runtime shape
+re-inference). XLA compiles static shapes, so the TPU-native strategy
+(SURVEY §7) is *bounded shapes + bucketed recompilation*:
+
+- inside ``dynamic_shape_bound(n)``, dynamic-output ops produce
+  fixed-size results padded to ``n`` (jnp's ``size=``/``fill_value=``
+  contract), making them jit-compatible;
+- callers that see many different run-time cardinalities round the
+  bound up with :func:`shape_bucket` so the number of distinct compiled
+  programs stays logarithmic, not linear, in the observed sizes.
+
+Example::
+
+    from mxnet_tpu import np as mnp, npx
+
+    @jax.jit
+    def f(x):
+        with npx.dynamic_shape_bound(8):
+            u = mnp.unique(x)              # shape (8,), padded
+            nz = mnp.nonzero(x)[0]         # shape (8,), padded
+        return u, nz
+
+Without an active bound (and no explicit ``size=``), these ops remain
+eager-only exactly like before — tracing them raises jax's concretization
+error, which is the honest failure mode.
+
+CACHING CAVEAT: the bound is consumed at TRACE time and is NOT part of
+jit's cache key. Enter the context INSIDE the jitted function (as above)
+so the traced program and the bound always agree; wrapping a call to an
+already-jitted function in a *different* bound is a cache hit on the old
+program and would silently keep the old size. If the bound must vary,
+make it an explicit ``size=``/static argument (see
+tests/test_dynamic_shapes.py::test_shape_bucket_bounds_recompiles).
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+__all__ = ["dynamic_shape_bound", "current_shape_bound", "shape_bucket"]
+
+_STATE = threading.local()
+
+
+def current_shape_bound():
+    """The innermost active bound, or None."""
+    stack = getattr(_STATE, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextmanager
+def dynamic_shape_bound(n: int):
+    """Within this context, dynamic-output ops (np.unique, np.nonzero,
+    np.flatnonzero, np.argwhere, npx/contrib boolean_mask) emit
+    fixed-size outputs padded to ``n`` and are therefore traceable."""
+    if n <= 0:
+        raise ValueError(f"bound must be positive, got {n}")
+    stack = getattr(_STATE, "stack", None)
+    if stack is None:
+        stack = _STATE.stack = []
+    stack.append(int(n))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def shape_bucket(n: int, base: int = 2, minimum: int = 8) -> int:
+    """Round a run-time cardinality up to a bucket boundary (powers of
+    ``base``), bounding how many distinct XLA programs a varying-size
+    workload compiles — the recompilation half of the strategy."""
+    b = minimum
+    while b < n:
+        b *= base
+    return b

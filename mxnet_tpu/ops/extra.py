@@ -1144,9 +1144,24 @@ _REGISTRY["_rnn_param_concat"] = Operator(
     "_rnn_param_concat", _rnn_param_concat, variadic=True)
 
 
-def _contrib_boolean_mask(data, index, axis=0):
-    # dynamic output shape -> host/eager, like the reference's
-    # dynamic-shape ops
+def _contrib_boolean_mask(data, index, axis=0, size=None):
+    """Dynamic output shape. Eager: exact (host compress, like the
+    reference's runtime shape re-inference). Under an
+    ``npx.dynamic_shape_bound`` (or explicit ``size=``): fixed-size
+    output padded with zero rows — jit-compatible."""
+    if size is None:
+        from ..numpy_extension.dynamic import current_shape_bound
+        size = current_shape_bound()
+    if size is not None:
+        sel = jnp.asarray(index).astype(bool)
+        (idx,) = jnp.where(sel, size=int(size), fill_value=-1)
+        taken = jnp.take(data, jnp.maximum(idx, 0), axis=axis)
+        shape = [1] * taken.ndim
+        shape[axis] = int(size)
+        # select (not multiply): 0*inf/0*nan would leak NaN into the
+        # zero-padded rows
+        return jnp.where((idx >= 0).reshape(shape), taken,
+                         jnp.zeros((), taken.dtype))
     sel = _np.asarray(index).astype(bool)
     return jnp.asarray(_np.compress(sel, _np.asarray(data), axis=axis))
 

@@ -1,0 +1,112 @@
+"""Bounded-shape execution of dynamic-output ops under jit
+(SURVEY §7: the TPU answer to the reference's in-executor runtime shape
+re-inference, src/executor/graph_executor.cc:1497-1530)."""
+import jax
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp, npx
+
+
+def test_unique_nonzero_traceable_under_bound():
+    x = mnp.array([3.0, 1.0, 3.0, 0.0, 2.0, 1.0])
+
+    # drive through the mx.np surface inside jit
+    def g(a):
+        with npx.dynamic_shape_bound(8):
+            u = mnp.unique(mnp.ndarray(a))
+            (nz,) = mnp.nonzero(mnp.ndarray(a))
+        return u._data, nz._data
+
+    u, nz = jax.jit(g)(np.asarray(x.asnumpy()))
+    assert u.shape == (8,) and nz.shape == (8,)
+    # padded with the repeated max/fill; leading entries are the truth
+    np.testing.assert_array_equal(np.asarray(u)[:4], [0.0, 1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(sorted(np.asarray(nz)[:4]),
+                                  [0, 1, 2, 4])
+
+
+def test_unique_without_bound_stays_eager_only():
+    x = mnp.array([1.0, 2.0, 2.0])
+    u = mnp.unique(x)            # eager: exact dynamic shape
+    assert u.shape == (2,)
+
+    def f(a):
+        return mnp.unique(mnp.ndarray(a))._data
+
+    with pytest.raises(Exception):   # concretization error: honest fail
+        jax.jit(f)(np.asarray([1.0, 2.0, 2.0]))
+
+
+def test_boolean_mask_bounded_matches_eager():
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    index = mx.nd.array(np.array([1.0, 0.0, 1.0, 0.0]))
+    exact = mx.nd.contrib.boolean_mask(data, index).asnumpy()
+    assert exact.shape == (2, 3)
+
+    with npx.dynamic_shape_bound(4):
+        padded = mx.nd.contrib.boolean_mask(data, index).asnumpy()
+    assert padded.shape == (4, 3)
+    np.testing.assert_array_equal(padded[:2], exact)
+    np.testing.assert_array_equal(padded[2:], 0.0)
+
+    # and it traces
+    def f(d, i):
+        with npx.dynamic_shape_bound(4):
+            from mxnet_tpu.ops.registry import get
+            return get("_contrib_boolean_mask").impl(d, i)
+
+    out = jax.jit(f)(data._data, index._data)
+    np.testing.assert_array_equal(np.asarray(out), padded)
+
+
+def test_shape_bucket_bounds_recompiles():
+    assert npx.shape_bucket(1) == 8
+    assert npx.shape_bucket(8) == 8
+    assert npx.shape_bucket(9) == 16
+    assert npx.shape_bucket(1000) == 1024
+    # a varying workload compiles one program per bucket, not per size
+    traces = {"n": 0}
+
+    def f(a, size):
+        traces["n"] += 1
+        return mnp.unique(mnp.ndarray(a), size=size)._data
+
+    jf = jax.jit(f, static_argnums=1)
+    for n in (3, 5, 7, 9, 12, 15):
+        a = np.arange(n, dtype=np.float32)
+        out = jf(np.pad(a, (0, 16 - n)), npx.shape_bucket(n))
+        assert out.shape[0] in (8, 16)
+    assert traces["n"] == 2   # two buckets -> two traces
+
+
+def test_nested_bounds_innermost_wins():
+    with npx.dynamic_shape_bound(16):
+        with npx.dynamic_shape_bound(4):
+            assert npx.current_shape_bound() == 4
+            u = mnp.unique(mnp.array([5.0, 5.0, 1.0]))
+            assert u.shape == (4,)
+        assert npx.current_shape_bound() == 16
+    assert npx.current_shape_bound() is None
+
+
+def test_ndarray_nonzero_method_honors_bound():
+    def g(a):
+        with npx.dynamic_shape_bound(6):
+            return mnp.ndarray(a).nonzero()[0]._data
+
+    out = jax.jit(g)(np.array([0.0, 3.0, 0.0, 5.0]))
+    assert out.shape == (6,)
+    assert sorted(np.asarray(out)[:2].tolist()) == [1, 3]
+
+
+def test_boolean_mask_bounded_no_nan_from_inf():
+    """Padding must SELECT zeros, not multiply by zero (0*inf = nan)."""
+    from mxnet_tpu.ops.registry import get
+    impl = get("_contrib_boolean_mask").impl
+    data = np.array([[np.inf, 1.0]], np.float32)
+    out = np.asarray(impl(data, np.array([1.0]), size=3))
+    assert out.shape == (3, 2)
+    assert np.isinf(out[0, 0]) and np.all(out[1:] == 0.0)
+    assert not np.isnan(out).any()
