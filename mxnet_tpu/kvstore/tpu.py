@@ -111,6 +111,7 @@ class KVStoreTPU(KVStoreLocal):
     def push(self, key, value, priority=0):
         keys, values = _kv(key, value)
         from .base import _group
+        local = []                      # [(key, locally-reduced NDArray)]
         for k, vlist in _group(keys, values):
             reduced = vlist[0]
             if len(vlist) > 1:
@@ -118,14 +119,44 @@ class KVStoreTPU(KVStoreLocal):
                 for v in vlist[1:]:
                     acc = acc + v._data
                 reduced = NDArray(acc)
-            if self._compressor is not None:
-                reduced = self._reduce_compressed(k, reduced)
-            else:
-                reduced = self._reduce_across_processes(reduced)
+            local.append((k, reduced))
+
+        if self._compressor is not None:
+            done = [(k, self._reduce_compressed(k, r)) for k, r in local]
+        elif len(local) > 1 and jax.process_count() > 1:
+            # batch the cross-process reduce: ONE flattened payload and
+            # ONE compiled launch for the whole key group, not one per
+            # key (the reference batches key launches in its NCCL path
+            # the same way; per-key dispatch shows up with hundreds of
+            # params)
+            done = self._batched_reduce(local)
+        else:
+            done = [(k, self._reduce_across_processes(r))
+                    for k, r in local]
+        for k, reduced in done:
             if self._updater is not None:
                 self._updater(k, reduced, self._store[k])
             else:
                 self._store[k] = reduced.copy()
+
+    def _batched_reduce(self, local):
+        """One cross-process reduce for many keys: ravel + concat per
+        dtype, reduce, split back."""
+        by_dtype = {}
+        for k, r in local:
+            by_dtype.setdefault(jnp.asarray(r._data).dtype, []).append(
+                (k, r))
+        out = []
+        for _, group in by_dtype.items():
+            flat = jnp.concatenate([g._data.ravel() for _, g in group])
+            red = self._reduce_across_processes(NDArray(flat))._data
+            off = 0
+            for k, g in group:
+                n = g._data.size
+                out.append((k, NDArray(red[off:off + n]
+                                       .reshape(g._data.shape))))
+                off += n
+        return out
 
     def _reduce_compressed(self, key, value):
         """Compressed cross-host reduce (reference: kvstore_dist.h

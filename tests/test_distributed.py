@@ -49,6 +49,22 @@ _WORKER = textwrap.dedent("""
     np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
     kv.barrier()
 
+    # ---- batched multi-key push: one flattened cross-process launch --
+    kv.init("m1", nd.array(np.zeros((2, 2), np.float32)))
+    kv.init("m2", nd.array(np.zeros(3, np.float32)))
+    kv.push(["m1", "m2"],
+            [nd.array(np.full((2, 2), float(rank + 1), np.float32)),
+             nd.array(np.arange(3, dtype=np.float32) * (rank + 1))])
+    o1 = nd.array(np.zeros((2, 2), np.float32))
+    o2 = nd.array(np.zeros(3, np.float32))
+    kv.pull("m1", out=o1)
+    kv.pull("m2", out=o2)
+    tot = sum(r + 1 for r in range(nproc))
+    np.testing.assert_allclose(o1.asnumpy(), np.full((2, 2), float(tot)))
+    np.testing.assert_allclose(o2.asnumpy(),
+                               np.arange(3, dtype=np.float32) * tot)
+    kv.barrier()
+
     # ---- compressed push: packed int32 payload over the process mesh --
     kvc = mx.kv.create("dist_sync")
     kvc.set_gradient_compression({"type": "2bit", "threshold": 1.0})
