@@ -379,3 +379,37 @@ def test_numeric_gradient_tail(name):
     check_numeric_gradient(op, inputs, kwargs=kwargs,
                            grad_inputs=grad_inputs, rtol=rtol, atol=atol,
                            eps=eps)
+
+
+# eager-vs-jit consistency over the same templates (the reference's
+# check_consistency compared cpu-vs-gpu executors; here the two
+# execution modes of one op). Wrapper-based and host-callback cases are
+# skipped: the former aren't registry names, the latter don't jit.
+_JIT_IDS = [n for n in _IDS
+            if isinstance(T[n][0], str)
+            and not registry.get(n).host_op
+            and not registry.get(n).needs_rng]
+
+
+@pytest.mark.parametrize("name", _JIT_IDS)
+def test_eager_jit_consistency_tail(name):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.test_utils import assert_almost_equal
+    op, inputs, kwargs, grad_inputs, rtol, atol, eps = T[name]
+    o = registry.get(name)
+    okwargs = dict(kwargs)
+    if o.needs_train:
+        okwargs["_training"] = True
+    xs = [jnp.asarray(x) for x in inputs]
+    if o.variadic:
+        fn = lambda *a: o.impl(list(a), **okwargs)  # noqa: E731
+    else:
+        fn = lambda *a: o.impl(*a, **okwargs)       # noqa: E731
+    eager = fn(*xs)
+    jitted = jax.jit(fn)(*xs)
+    pairs = [(eager, jitted)] if not isinstance(eager, (tuple, list)) \
+        else list(zip(eager, jitted))
+    for e, j in pairs:
+        assert_almost_equal(np.asarray(j), np.asarray(e), rtol=1e-5,
+                            atol=1e-6, names=("jit", "eager"))
