@@ -76,6 +76,33 @@ _FROM_JNP = [
     "tensordot", "tile", "trace", "transpose", "tril", "tril_indices",
     "triu", "true_divide", "trunc", "unique", "unravel_index", "var",
     "vdot", "vsplit", "vstack", "where",
+    # round-4 widening toward the full reference np surface (names jnp
+    # implements with array outputs; meta/IO/datetime functions that
+    # return dtypes/shape-tuples stay off the dispatch path on purpose)
+    "allclose", "amax", "amin", "angle", "apply_along_axis",
+    "apply_over_axes", "argpartition", "array_equal", "array_equiv",
+    "astype", "bartlett", "bitwise_count", "bitwise_invert",
+    "bitwise_left_shift", "bitwise_right_shift", "block", "choose",
+    "compress", "concat", "conj", "conjugate", "convolve", "corrcoef",
+    "correlate", "cov", "diag_indices", "digitize", "divmod", "exp2",
+    "extract", "float_power", "frexp", "fromfunction", "geomspace",
+    "gradient", "heaviside", "histogram2d", "histogram_bin_edges",
+    "histogramdd", "i0", "imag", "intersect1d", "isclose", "iscomplex",
+    "iscomplexobj", "isin", "isreal", "isrealobj", "ix_", "kaiser",
+    "left_shift", "lexsort", "logaddexp2", "mask_indices",
+    "matrix_transpose", "modf", "nanargmax", "nanargmin", "nancumprod",
+    "nancumsum", "nanmedian", "nanpercentile", "nanprod", "nanquantile",
+    "nextafter", "packbits", "partition", "permute_dims", "piecewise",
+    "place", "poly", "polyadd", "polyder", "polydiv", "polyfit",
+    "polyint", "polymul", "polysub", "pow", "put", "put_along_axis",
+    "putmask", "ravel_multi_index", "real", "real_if_close",
+    "right_shift", "roots", "select", "setdiff1d", "setxor1d",
+    "signbit", "sinc", "sort_complex", "spacing", "trapezoid", "tri",
+    "tril_indices_from", "trim_zeros", "triu_indices",
+    "triu_indices_from", "union1d", "unique_all", "unique_counts",
+    "unique_inverse", "unique_values", "unpackbits", "unwrap", "vander",
+    "vecdot", "acos", "acosh", "asin", "asinh", "atan", "atan2",
+    "atanh", "in1d", "union1d",
 ]
 
 _generated = []

@@ -47,6 +47,15 @@ NONDIFF = frozenset({
     "unravel_index", "diag_indices_from", "floor", "ceil", "trunc", "fix",
     "rint", "around", "round", "round_", "all", "any", "lcm", "gcd",
     "digitize", "count_nonzero",
+    # round-4 widening: predicates, integer outputs, index generators
+    "allclose", "array_equal", "array_equiv", "argpartition",
+    "bitwise_count", "bitwise_invert", "bitwise_left_shift",
+    "bitwise_right_shift", "diag_indices", "isclose", "iscomplex",
+    "iscomplexobj", "isin", "in1d", "isreal", "isrealobj", "ix_",
+    "left_shift", "lexsort", "mask_indices", "packbits",
+    "ravel_multi_index", "right_shift", "signbit", "tri",
+    "tril_indices_from", "triu_indices", "triu_indices_from",
+    "unpackbits", "unique_counts", "unique_inverse",
 })
 
 

@@ -243,3 +243,42 @@ class TestJitTransparency:
         onp.testing.assert_allclose(
             float(out), float(onp.mean(onp.tanh(onp.ones(4)) ** 2)),
             rtol=1e-6)
+
+
+def test_round4_widened_surface():
+    """Round-4 np-namespace widening: spot-pin representative new
+    functions (array-output jnp bridges) and their NONDIFF taping."""
+    import mxnet_tpu.autograd as ag
+    np, mnp = onp, mx.np
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    ma = mnp.array(a)
+    np.testing.assert_allclose(mnp.cov(ma).asnumpy(), np.cov(a))
+    np.testing.assert_allclose(mnp.gradient(mnp.array([1.0, 2.0, 4.0]))
+                               .asnumpy(), np.gradient([1.0, 2.0, 4.0]))
+    np.testing.assert_allclose(
+        mnp.heaviside(mnp.array([-1.0, 0.0, 2.0]),
+                      mnp.array(0.5)).asnumpy(), [0.0, 0.5, 1.0])
+    np.testing.assert_allclose(mnp.vander(mnp.array([2.0, 3.0]), 3)
+                               .asnumpy(), np.vander([2.0, 3.0], 3))
+    np.testing.assert_allclose(
+        mnp.unwrap(mnp.array([0.0, 3.0, 6.0, 9.0])).asnumpy(),
+        np.unwrap([0.0, 3.0, 6.0, 9.0]))
+    assert bool(mnp.allclose(ma, ma))
+    assert mnp.isin(ma, mnp.array([2.0])).asnumpy().tolist() == \
+        [[False, True], [False, False]]
+    # sized set ops stay jit-compatible
+    np.testing.assert_array_equal(
+        mnp.setdiff1d(mnp.array([1.0, 2.0, 3.0]), mnp.array([2.0]),
+                      size=2).asnumpy(), [1.0, 3.0])
+    # new smooth fns differentiate; predicates don't tape
+    x = mnp.array([0.3, 0.7])
+    x.attach_grad()
+    with ag.record():
+        y = (mnp.sinc(x) + mnp.exp2(x)).sum()
+    y.backward()
+    assert np.isfinite(x.grad.asnumpy()).all()
+    x2 = mnp.array([1.0, 2.0])
+    x2.attach_grad()
+    with ag.record():
+        p = mnp.signbit(x2)
+    assert p.asnumpy().tolist() == [False, False]
