@@ -395,6 +395,25 @@ def _convert(node, ins, out, ctx):
         ctx.nodes.append(_node(
             "Resize", [ins[0], roi, sc], [out], nm,
             [_attr("mode", AT_STRING, mode)]))
+    elif op in ("Pad", "pad"):
+        widths = [int(w) for w in p.get("pad_width", ())]
+        ndim = len(widths) // 2
+        # mxnet interleaves (before,after) per axis; ONNX wants all
+        # befores then all afters
+        pads = ([widths[2 * i] for i in range(ndim)]
+                + [widths[2 * i + 1] for i in range(ndim)])
+        pn = ctx.name(nm + "_pads")
+        ctx.initializers.append(_tensor(
+            pn, _np.asarray(pads, _np.int64)))
+        cn = ctx.name(nm + "_cval")
+        ctx.initializers.append(_tensor(
+            cn, _np.asarray(float(p.get("constant_value", 0)),
+                            _np.float32)))
+        mode = p.get("mode", "constant")
+        mode = {"constant": "constant", "edge": "edge",
+                "reflect": "reflect"}.get(mode, "constant")
+        ctx.nodes.append(_node("Pad", [ins[0], pn, cn], [out], nm,
+                               [_attr("mode", AT_STRING, mode)]))
     elif op == "where":
         b = ctx.name(nm + "_cond")
         ctx.nodes.append(_node("Cast", [ins[0]], [b], b,

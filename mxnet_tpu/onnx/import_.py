@@ -32,6 +32,8 @@ def _parse_attrs(node_msg):
             if len(ints) == 1 and isinstance(ints[0], bytes):
                 ints = P.decode_packed_varints(ints[0])
             attrs[name] = [int(v) for v in ints]
+        elif atype == 4:            # AttributeProto.TENSOR (field t=5)
+            attrs[name] = a[5][0]   # raw TensorProto bytes
     return attrs
 
 
@@ -110,18 +112,39 @@ def _make(op, ins, outs, name, attrs, sym_of, values, inits):
     import mxnet_tpu as mx
 
     if op == "Gemm":
-        assert attrs.get("transB", 0) == 1, "only transB=1 Gemm"
+        alpha = float(attrs.get("alpha", 1.0))
+        beta = float(attrs.get("beta", 1.0))
+        trans_a = bool(attrs.get("transA", 0))
+        trans_b = bool(attrs.get("transB", 0))
         data = sym_of(ins[0])
         w = sym_of(ins[1])
-        num_hidden = inits[ins[1]].shape[0]
-        if len(ins) > 2:
-            out = mx.sym.FullyConnected(
-                data, w, sym_of(ins[2]), name=name,
-                num_hidden=num_hidden)
+        if (trans_b and not trans_a and alpha == 1.0
+                and beta in (0.0, 1.0)):
+            # the FullyConnected shape: y = x @ W^T (+ b)
+            num_hidden = inits[ins[1]].shape[0]
+            if len(ins) > 2 and beta == 1.0:
+                out = mx.sym.FullyConnected(
+                    data, w, sym_of(ins[2]), name=name,
+                    num_hidden=num_hidden)
+            else:
+                out = mx.sym.FullyConnected(data, w, name=name,
+                                            num_hidden=num_hidden,
+                                            no_bias=True)
         else:
-            out = mx.sym.FullyConnected(data, w, name=name,
-                                        num_hidden=num_hidden,
-                                        no_bias=True)
+            # general Gemm from external exporters:
+            # alpha*op(A)@op(B) + beta*C
+            if trans_a:
+                data = mx.sym.transpose(data, axes=(1, 0))
+            if trans_b:
+                w = mx.sym.transpose(w, axes=(1, 0))
+            out = mx.sym.dot(data, w, name=name + "_mm")
+            if alpha != 1.0:
+                out = mx.sym._mul_scalar(out, scalar=alpha)
+            if len(ins) > 2 and beta != 0.0:
+                c = sym_of(ins[2])
+                if beta != 1.0:
+                    c = mx.sym._mul_scalar(c, scalar=beta)
+                out = mx.sym.broadcast_add(out, c, name=name)
     elif op == "Conv":
         kwargs = dict(kernel=tuple(attrs["kernel_shape"]),
                       stride=tuple(attrs.get("strides", (1, 1))),
@@ -303,6 +326,30 @@ def _make(op, ins, outs, name, attrs, sym_of, values, inits):
                            sym_of(ins[2]), name=name)
     elif op == "Erf":
         out = mx.sym.erf(sym_of(ins[0]), name=name)
+    elif op == "Pad":
+        pads = [int(v) for v in inits[ins[1]]] if len(ins) > 1 else \
+            list(attrs.get("pads", ()))
+        ndim = len(pads) // 2
+        widths = []
+        for i in range(ndim):
+            widths += [pads[i], pads[ndim + i]]
+        cval = 0.0
+        if len(ins) > 2 and ins[2]:
+            cval = float(_np.asarray(inits[ins[2]]).reshape(()))
+        mode = attrs.get("mode", b"constant")
+        mode = mode.decode() if isinstance(mode, bytes) else mode
+        out = mx.sym.Pad(sym_of(ins[0]), mode=mode,
+                         pad_width=tuple(widths), constant_value=cval,
+                         name=name)
+    elif op == "Constant":
+        # value tensor arrives as an attribute; materialize it like an
+        # initializer so downstream nodes can reference it
+        raw = attrs.get("value")
+        if raw is None:
+            raise NotImplementedError("Constant without 'value'")
+        cname, arr = _parse_tensor(raw)
+        inits[outs[0]] = arr
+        return sym_of(outs[0])
     else:
         raise NotImplementedError(
             f"ONNX import: no mapping for op {op!r}")

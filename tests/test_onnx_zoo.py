@@ -166,3 +166,52 @@ def test_roundtrip_deconv_resize_slice():
     c = mx.sym.clip(s, a_min=-1.0, a_max=1.0, name="cl")
     m = mx.sym.mean(c, axis=(2, 3), keepdims=False, name="mn")
     _roundtrip(m, (2, 3, 4, 4))
+
+
+def test_roundtrip_pad():
+    x = mx.sym.var("data")
+    p = mx.sym.Pad(x, mode="constant",
+                   pad_width=(0, 0, 0, 0, 1, 2, 2, 1),
+                   constant_value=0.5, name="pd")
+    out = mx.sym.relu(p, name="r")
+    _roundtrip(out, (2, 3, 4, 4))
+
+
+def test_import_general_gemm_and_constant():
+    """External-exporter patterns: Gemm with transA/alpha/beta and a
+    Constant node (built by hand through the export encoder)."""
+    import numpy as np
+    from mxnet_tpu.onnx import _proto as P
+    from mxnet_tpu.onnx.export import (_attr, _node, _tensor,
+                                       _value_info, AT_FLOAT, AT_INT)
+    rng = np.random.RandomState(0)
+    A = rng.randn(3, 2).astype(np.float32)   # transA -> (2,3)@(3,4)
+    B = rng.randn(3, 4).astype(np.float32)
+    C = rng.randn(1, 4).astype(np.float32)
+    nodes = [
+        _node("Constant", [], ["cst"], "cst",
+              [(5, P.LEN, P.encode([(1, P.LEN, "value"),
+                                    (20, P.VARINT, 4),
+                                    (5, P.LEN, _tensor("", C))]))]),
+        _node("Gemm", ["a", "b", "cst"], ["y"], "gemm",
+              [_attr("alpha", AT_FLOAT, 0.5),
+               _attr("beta", AT_FLOAT, 2.0),
+               _attr("transA", AT_INT, 1)]),
+    ]
+    graph = P.encode(
+        nodes
+        + [(2, P.LEN, "g")]
+        + [(5, P.LEN, _tensor("b", B))]
+        + [(11, P.LEN, _value_info("a", (3, 2)))]
+        + [(12, P.LEN, _value_info("y", (2, 4)))])
+    model = P.encode([(1, P.VARINT, 8), (2, P.LEN, "t"),
+                      (7, P.LEN, graph),
+                      (8, P.LEN, P.encode([(1, P.LEN, ""),
+                                           (2, P.VARINT, 17)]))])
+    sym, args, aux = mx.onnx.import_model(model)
+    feed = {"a": mx.nd.array(A)}
+    feed.update(args)
+    got = sym.eval_dict(feed)
+    got = (got[0] if isinstance(got, list) else got).asnumpy()
+    want = 0.5 * (A.T @ B) + 2.0 * C
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
