@@ -260,6 +260,10 @@ def add_args(parser):
     parser.add_argument("--top-k", type=int, default=0)
     parser.add_argument("--benchmark", type=int, default=0,
                         help="1 = train on synthetic random data")
+    parser.add_argument("--dtype", type=str, default="float32",
+                        choices=["float32", "bfloat16", "float16"],
+                        help="mixed precision via mx.amp (float16 maps "
+                             "to bfloat16 — the TPU-native half type)")
     return parser
 
 
@@ -270,6 +274,10 @@ def main(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter))
     args = parser.parse_args(argv)
     args.image_shape_t = tuple(int(x) for x in args.image_shape.split(","))
+    if args.dtype != "float32":
+        # reference --dtype float16 == AMP; bf16 is the TPU half type
+        from mxnet_tpu import amp
+        amp.init(target_dtype="bfloat16")
     network = get_network(args)
     kv = mx.kv.create(args.kv_store)
     if args.benchmark:
