@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Drop-in path for the reference's tools/launch.py (reference:
+tools/launch.py:29 — dmlc_tracker local/ssh/mpi launchers). Delegates
+to ``python -m mxnet_tpu.launch``; reference-style ``-n`` / trailing
+command invocations work unchanged:
+
+    python tools/launch.py -n 4 python train.py --epochs 1
+
+Parameter-server-specific flags (-s, --launcher ssh/mpi) have no
+TPU-build equivalent — there are no servers to start; multi-host jobs
+run this launcher once per host (see mxnet_tpu/launch.py docstring).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.launch import main  # noqa: E402
+
+if __name__ == "__main__":
+    argv = []
+    skip = False
+    for i, a in enumerate(sys.argv[1:]):
+        if skip:
+            skip = False
+            continue
+        if a in ("-s", "--num-servers", "--launcher"):
+            skip = True          # accepted-and-ignored ps-lite flags
+            continue
+        argv.append(a)
+    sys.exit(main(argv))
