@@ -1,0 +1,89 @@
+"""Matrix-factorization recommender with sparse embedding gradients.
+
+The reference ships MF/recommender examples (example/recommenders/)
+built on sparse row_sparse embeddings + lazy optimizer updates; this is
+the TPU-build counterpart: two Embedding tables trained on synthetic
+ratings with a planted low-rank structure. Per step only the touched
+rows carry gradient — the sparse Embedding grad + lazy SGD path
+(mxnet_tpu/ndarray/sparse.py) keeps updates O(batch) instead of
+O(vocab).
+
+  JAX_PLATFORMS=cpu python examples/recommender_mf.py --steps 60
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+import mxnet_tpu.autograd as ag  # noqa: E402
+from mxnet_tpu import gluon, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+class MFNet(gluon.HybridBlock):
+    def __init__(self, n_users, n_items, rank):
+        super().__init__()
+        with self.name_scope():
+            self.user = nn.Embedding(n_users, rank, prefix="user_")
+            self.item = nn.Embedding(n_items, rank, prefix="item_")
+
+    def forward(self, users, items):
+        u = self.user(users)
+        v = self.item(items)
+        return (u * v).sum(axis=-1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--users", type=int, default=200)
+    ap.add_argument("--items", type=int, default=150)
+    ap.add_argument("--rank", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.2)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    # planted low-rank ratings
+    U = rng.randn(args.users, args.rank) * 0.5
+    V = rng.randn(args.items, args.rank) * 0.5
+
+    mx.random.seed(0)
+    net = MFNet(args.users, args.items, args.rank)
+    net.initialize(init=mx.initializer.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.L2Loss()
+
+    for step in range(args.steps):
+        users = rng.randint(0, args.users, args.batch_size)
+        items = rng.randint(0, args.items, args.batch_size)
+        ratings = (U[users] * V[items]).sum(-1)
+        x_u = nd.array(users.astype(np.float32))
+        x_i = nd.array(items.astype(np.float32))
+        y = nd.array(ratings.astype(np.float32))
+        with ag.record():
+            pred = net(x_u, x_i)
+            loss = loss_fn(pred, y).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step}: mse {2 * float(loss.asnumpy()):.4f}")
+
+    # held-out check
+    users = rng.randint(0, args.users, 512)
+    items = rng.randint(0, args.items, 512)
+    truth = (U[users] * V[items]).sum(-1)
+    with ag.pause():
+        pred = net(nd.array(users.astype(np.float32)),
+                   nd.array(items.astype(np.float32))).asnumpy()
+    corr = np.corrcoef(pred, truth)[0, 1]
+    print(f"held-out correlation with planted ratings: {corr:.3f}")
+
+
+if __name__ == "__main__":
+    main()

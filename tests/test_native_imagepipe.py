@@ -206,3 +206,25 @@ def test_mxdataiter_prefers_native(rec20):
                            brightness=0.5)
     from mxnet_tpu.image import ImageIter
     assert isinstance(it2, ImageIter)
+
+
+def test_multi_float_labels(tmp_path):
+    """label_width > 1: the reference packs extra label floats after the
+    IRHeader (flag = count); the native pipe must surface all of them."""
+    prefix = str(tmp_path / "ml")
+    rng = np.random.RandomState(0)
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "w")
+    labels = rng.randn(6, 3).astype(np.float32)
+    for i in range(6):
+        img = rng.randint(0, 255, (16, 16, 3), dtype=np.uint8)
+        header = recordio.IRHeader(0, labels[i], i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, quality=95,
+                                           img_fmt=".jpg"))
+    rec.close()
+    it = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                              data_shape=(3, 16, 16), batch_size=3,
+                              label_width=3)
+    got = np.concatenate([b.label[0].asnumpy() for b in it])
+    np.testing.assert_allclose(got, labels, rtol=1e-6)
+    it.close()
