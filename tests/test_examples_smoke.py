@@ -1,0 +1,60 @@
+"""Every example script must run end to end at a tiny configuration.
+
+The reference CI runs its example/ scripts the same way
+(tests/nightly/test_tutorial etc.); a broken example is a broken
+user-facing surface. Each case is a real subprocess — fresh
+interpreter, argparse, import path — not an in-process import.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+CASES = [
+    ("recommender_mf.py", ["--steps", "4", "--batch-size", "32",
+                           "--users", "20", "--items", "15"]),
+    ("dcgan.py", ["--steps", "2", "--batch-size", "4"]),
+    ("bert_pretrain_mlm.py", ["--steps", "2", "--batch-size", "4",
+                              "--seq-len", "8", "--vocab", "16"]),
+    ("train_cifar_gluon.py", ["--steps", "2", "--batch-size", "4",
+                              "--model", "resnet18_v1"]),
+    ("train_mnist_mlp.py", ["--epochs", "1", "--batch-size", "32"]),
+    ("char_lstm.py", ["--epochs", "1", "--seq-len", "8",
+                      "--batch-size", "4"]),
+    ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
+                           "--num-classes", "4", "--image-shape",
+                           "3,16,16", "--batch-size", "4",
+                           "--num-examples", "8", "--num-epochs", "1",
+                           "--lr", "0.01", "--lr-step-epochs", "",
+                           "--kv-store", "local"]),
+]
+
+
+@pytest.mark.parametrize("script,args", CASES,
+                         ids=[c[0] for c in CASES])
+def test_example_runs(script, args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script)] + list(args),
+        capture_output=True, text=True, timeout=420, env=env)
+    assert p.returncode == 0, \
+        f"{script} failed:\n{p.stdout[-2000:]}\n{p.stderr[-2000:]}"
+
+
+def test_pipeline_parallel_example_runs():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(EXAMPLES, "pipeline_parallel_resnet.py"),
+         "--steps", "1"],
+        capture_output=True, text=True, timeout=500, env=env)
+    assert p.returncode == 0, \
+        f"pipeline example failed:\n{p.stdout[-2000:]}\n" \
+        f"{p.stderr[-2000:]}"
