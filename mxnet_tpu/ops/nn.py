@@ -21,6 +21,7 @@ Weight layout follows the reference rule: data layout with N->O, C->I
 """
 from __future__ import annotations
 
+import os as _os
 from functools import partial
 
 import numpy as _np
@@ -323,6 +324,79 @@ _reg("_contrib_BilinearResize2D", _bilinear_resize2d)
 
 # ------------------------------------------------------- normalization -----
 
+def _bn_reduce_axes(x, axis):
+    return tuple(i for i in range(x.ndim) if i != axis)
+
+
+def _bn_train_stats(x, axis):
+    """fp32 E[x] and clamped E[x^2]-E[x]^2 as sibling reductions over one
+    read of x (XLA emits one multi-output reduce fusion)."""
+    red = _bn_reduce_axes(x, axis)
+    xf = x.astype(jnp.float32)
+    mean32 = jnp.mean(xf, axis=red)
+    var32 = jnp.maximum(jnp.mean(xf * xf, axis=red) - mean32 * mean32, 0.0)
+    return mean32, var32
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _bn_train_fused(axis, eps, x, gamma, beta):
+    """Training-mode BN core with a hand-written backward.
+
+    Autodiff of the stats+normalise chain produces a correct but
+    reduction-heavy backward; the canonical BN gradient needs only two
+    per-channel reductions — sum(dy) and sum(dy * xhat) — which are
+    siblings over one joint read of (dy, x), followed by one fused
+    elementwise pass for dx (reference computes the same grouping on GPU
+    in src/operator/nn/batch_norm.cu DoBNBackward). Opt-in via
+    MXNET_TPU_BN_FUSED_BWD=1; numerics pinned against the autodiff path
+    in tests/test_bn_fused_bwd.py. Returns (out, batch_mean32, batch_var32)."""
+    primal, _res = _bn_train_fused_fwd(axis, eps, x, gamma, beta)
+    return primal
+
+
+def _bn_train_fused_fwd(axis, eps, x, gamma, beta):
+    mean32, var32 = _bn_train_stats(x, axis)
+    inv32 = lax.rsqrt(var32 + eps)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    scale = inv32 * gamma.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - mean32 * scale
+    out = (x * scale.astype(x.dtype).reshape(shape)
+           + shift.astype(x.dtype).reshape(shape))
+    return (out, mean32, var32), (x, gamma, beta, mean32, inv32)
+
+
+def _bn_train_fused_bwd(axis, eps, res, cts):
+    x, gamma, beta, mean32, inv32 = res
+    dy, dmean_ct, dvar_ct = cts
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    red = _bn_reduce_axes(x, axis)
+    n = _np.prod([x.shape[i] for i in red]).astype(_np.float32)
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xc = xf - mean32.reshape(shape)
+    xhat = xc * inv32.reshape(shape)
+    # the two reductions BN backward actually needs, siblings over one
+    # joint (dy, x) read
+    sum_dy = jnp.sum(dyf, axis=red)
+    sum_dy_xhat = jnp.sum(dyf * xhat, axis=red)
+    g32 = gamma.astype(jnp.float32)
+    # dx for batch statistics: (g*inv) * (dy - mean(dy) - xhat*mean(dy*xhat))
+    dx32 = (g32 * inv32).reshape(shape) * (
+        dyf - (sum_dy / n).reshape(shape)
+        - xhat * (sum_dy_xhat / n).reshape(shape))
+    # cotangents on the returned batch mean/var (zero in normal training,
+    # where they only feed non-differentiated running-stat updates)
+    dx32 = (dx32 + (dmean_ct / n).reshape(shape)
+            + (2.0 / n) * xc * dvar_ct.reshape(shape))
+    return (dx32.astype(x.dtype), sum_dy_xhat.astype(gamma.dtype),
+            sum_dy.astype(beta.dtype))
+
+
+_bn_train_fused.defvjp(_bn_train_fused_fwd, _bn_train_fused_bwd)
+
+
 def _batch_norm(*args, eps=1e-3, momentum=0.9, fix_gamma=True,
                 use_global_stats=False, output_mean_var=False, axis=1,
                 cudnn_off=None, _training=False):
@@ -337,15 +411,17 @@ def _batch_norm(*args, eps=1e-3, momentum=0.9, fix_gamma=True,
     shape[axis] = x.shape[axis]
     rs = lambda a: a.reshape(shape)  # noqa: E731
     if _training and not use_global_stats:
+        if _os.environ.get("MXNET_TPU_BN_FUSED_BWD") == "1":
+            out, mean32, var32 = _bn_train_fused(axis, eps, x, gamma, beta)
+            mean, var = mean32.astype(x.dtype), var32.astype(x.dtype)
+            if output_mean_var:
+                return out, mean, var
+            return out
         # Single-pass statistics: E[x] and E[x^2] are sibling reductions
         # over one read of x (XLA emits one multi-output reduce fusion),
         # halving the HBM traffic of the two-pass mean/centered-var form.
         # Accumulate in fp32 regardless of activation dtype.
-        red = tuple(i for i in range(x.ndim) if i != axis)
-        xf = x.astype(jnp.float32)
-        mean32 = jnp.mean(xf, axis=red)
-        var32 = jnp.maximum(jnp.mean(xf * xf, axis=red)
-                            - mean32 * mean32, 0.0)
+        mean32, var32 = _bn_train_stats(x, axis)
         mean, var = mean32.astype(x.dtype), var32.astype(x.dtype)
     else:
         mean, var = mmean, mvar

@@ -1,0 +1,158 @@
+"""Pin the fused BatchNorm backward (MXNET_TPU_BN_FUSED_BWD=1) against
+the autodiff path.
+
+The fused path is an HBM-bandwidth lever for TPU training (two sibling
+reductions + one elementwise pass instead of autodiff's reduction chain;
+reference computes the same grouping in src/operator/nn/batch_norm.cu
+DoBNBackward). It must be numerically indistinguishable from the default
+path so it can be flipped on for benchmarking without a correctness
+question. These tests run on CPU so the lever is verified before any
+hardware window.
+"""
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.registry import _REGISTRY
+
+_BN = _REGISTRY["BatchNorm"].impl
+
+
+def _flag(on):
+    if on:
+        os.environ["MXNET_TPU_BN_FUSED_BWD"] = "1"
+    else:
+        os.environ.pop("MXNET_TPU_BN_FUSED_BWD", None)
+
+
+@pytest.fixture(autouse=True)
+def _restore_flag():
+    yield
+    os.environ.pop("MXNET_TPU_BN_FUSED_BWD", None)
+
+
+def _run(on, x, gamma, beta, axis, fix_gamma, dtype, jit):
+    """loss-style scalar reduction through training-mode BN; returns
+    (out, mean, var, dx, dgamma, dbeta) as float64 numpy."""
+    _flag(on)
+    c = x.shape[axis]
+    mmean = jnp.zeros(c, dtype)
+    mvar = jnp.ones(c, dtype)
+
+    def fwd(x, gamma, beta):
+        return _BN(x, gamma, beta, mmean, mvar, eps=1e-3,
+                   fix_gamma=fix_gamma, output_mean_var=True, axis=axis,
+                   _training=True)
+
+    def loss(x, gamma, beta):
+        out, mean, var = fwd(x, gamma, beta)
+        # a weighting that makes every element's gradient distinct
+        w = jnp.arange(out.size, dtype=jnp.float32).reshape(out.shape)
+        return jnp.sum(out.astype(jnp.float32) * jnp.sin(w))
+
+    gfn = jax.grad(loss, argnums=(0, 1, 2))
+    if jit:
+        fwd = jax.jit(fwd)
+        gfn = jax.jit(gfn)
+    out, mean, var = fwd(x, gamma, beta)
+    dx, dg, db = gfn(x, gamma, beta)
+    return [np.asarray(a, np.float64) for a in (out, mean, var, dx, dg, db)]
+
+
+@pytest.mark.parametrize("axis", [1, 3])
+@pytest.mark.parametrize("fix_gamma", [False, True])
+@pytest.mark.parametrize("jit", [False, True])
+def test_fused_matches_autodiff_f32(axis, fix_gamma, jit):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 3, 5, 6).astype(np.float32))
+    c = x.shape[axis]
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, c).astype(np.float32))
+    beta = jnp.asarray(rng.randn(c).astype(np.float32))
+    ref = _run(False, x, gamma, beta, axis, fix_gamma, jnp.float32, jit)
+    got = _run(True, x, gamma, beta, axis, fix_gamma, jnp.float32, jit)
+    names = ["out", "mean", "var", "dx", "dgamma", "dbeta"]
+    for name, r, g in zip(names, ref, got):
+        np.testing.assert_allclose(g, r, rtol=2e-4, atol=2e-4,
+                                   err_msg=name)
+    if fix_gamma:
+        assert np.all(got[4] == 0), "fix_gamma must zero dgamma"
+
+
+def test_fused_matches_autodiff_bf16():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 6, 4, 4)).astype(jnp.bfloat16)
+    gamma = jnp.asarray(rng.uniform(0.5, 1.5, 6)).astype(jnp.bfloat16)
+    beta = jnp.asarray(rng.randn(6)).astype(jnp.bfloat16)
+    ref = _run(False, x, gamma, beta, 1, False, jnp.bfloat16, True)
+    got = _run(True, x, gamma, beta, 1, False, jnp.bfloat16, True)
+    for name, r, g in zip(["out", "mean", "var", "dx", "dgamma", "dbeta"],
+                          ref, got):
+        # both paths accumulate stats/grads in fp32; bf16 rounding of the
+        # inputs/outputs is the only noise source
+        np.testing.assert_allclose(g, r, rtol=2e-2, atol=2e-2, err_msg=name)
+
+
+def test_fused_gluon_layer_end_to_end():
+    """Flag on/off must give identical training-step grads through a
+    conv+BN+relu Gluon block (the integration the bench exercises)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import functional_call, extract_params
+
+    def build():
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.Dense(5))
+        net.initialize()
+        x = mx.nd.ones((2, 3, 8, 8))
+        with mx.autograd.pause():
+            net(x)
+        return net
+
+    rng = np.random.RandomState(2)
+    xb = jnp.asarray(rng.randn(4, 3, 8, 8).astype(np.float32))
+
+    def grads(on):
+        _flag(on)
+        net = build()
+        params = extract_params(net)
+
+        def loss(params, x):
+            out, _aux = functional_call(net, params, x, training=True)
+            return jnp.sum(out ** 2)
+
+        g = jax.jit(jax.grad(loss))(params, xb)
+        return {k: np.asarray(v, np.float64) for k, v in g.items()}
+
+    ref, got = grads(False), grads(True)
+    # global name counters differ between the two builds (dense0 vs
+    # dense1); param ORDER is identical, so compare positionally
+    assert len(ref) == len(got)
+    for (rk, rv), (gk, gv) in zip(sorted(ref.items()), sorted(got.items())):
+        np.testing.assert_allclose(gv, rv, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"{rk} vs {gk}")
+
+
+def test_fused_second_order():
+    """grad-of-grad through the fused path stays differentiable (the
+    custom bwd is itself jax-traceable) and matches autodiff."""
+    x = jnp.asarray(np.random.RandomState(3).randn(3, 4).astype(np.float32))
+    gamma = jnp.ones(4)
+    beta = jnp.zeros(4)
+    mz, mv = jnp.zeros(4), jnp.ones(4)
+
+    def scalar(x):
+        out = _BN(x, gamma, beta, mz, mv, eps=1e-3, fix_gamma=False,
+                  axis=1, _training=True)
+        return jnp.sum(jnp.tanh(out))
+
+    def gg(on):
+        _flag(on)
+        return np.asarray(jax.grad(lambda x: jnp.sum(
+            jax.grad(scalar)(x) ** 2))(x), np.float64)
+
+    np.testing.assert_allclose(gg(True), gg(False), rtol=5e-4, atol=5e-5)
