@@ -48,8 +48,17 @@ class ImageRecordIterNative(DataIter):
                  num_parts=1, part_index=0, preprocess_threads=0,
                  label_width=1, seed=0, layout="NCHW",
                  data_name="data", label_name="softmax_label",
-                 last_batch_handle="pad"):
+                 last_batch_handle="pad", strict=None):
         super().__init__(batch_size)
+        # strict=True: a record that fails to decode (or has the wrong
+        # label_width) raises, matching the reference's CHECK semantics
+        # (src/io/iter_image_recordio_2.cc label-width CHECK / decode
+        # crash). Default (strict=False) warns loudly instead of the old
+        # silent zero-fill. Env override: MXNET_TPU_IMAGEPIPE_STRICT=1.
+        if strict is None:
+            strict = os.environ.get("MXNET_TPU_IMAGEPIPE_STRICT") == "1"
+        self._strict = bool(strict)
+        self._warned_errors = 0
         from ..native import imagepipe_lib
         lib = imagepipe_lib()
         if lib is None:
@@ -155,6 +164,7 @@ class ImageRecordIterNative(DataIter):
         if count <= 0:
             self._exhausted = True
             raise StopIteration
+        self._check_errors()
         self._cursor += 1
         pad = self._pad if self._cursor == self._nbatches else 0
         if self.label_width == 1:
@@ -172,6 +182,30 @@ class ImageRecordIterNative(DataIter):
     def error_count(self):
         """Records that failed to decode (zero-filled), cumulative."""
         return int(self._lib.ip_error_count(self._h))
+
+    @property
+    def last_error(self):
+        """Message from the most recent native decode/parse failure."""
+        msg = self._lib.ip_last_error(self._h)
+        return msg.decode(errors="replace") if msg else ""
+
+    def _check_errors(self):
+        """Surface native decode/parse failures instead of training on
+        zero-filled images (reference hard-fails here; advisor r4)."""
+        n = self.error_count
+        if n <= self._warned_errors:
+            return
+        detail = (f"{n} record(s) failed to decode/parse and were "
+                  f"zero-filled; last error: {self.last_error!r}")
+        if self._strict:
+            raise MXNetError(
+                detail + " (strict mode; pass strict=False or unset "
+                "MXNET_TPU_IMAGEPIPE_STRICT to tolerate)")
+        import logging
+        logging.getLogger("mxnet_tpu").warning(
+            "ImageRecordIterNative: %s — training data is corrupt or "
+            "label_width mismatches; set strict=True to raise", detail)
+        self._warned_errors = n
 
     def close(self):
         if getattr(self, "_h", None):

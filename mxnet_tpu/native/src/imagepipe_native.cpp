@@ -89,6 +89,7 @@ struct Pipe {
   bool shutdown = false;
   std::atomic<long> decode_errors{0};
   std::string last_error;
+  std::string last_error_snapshot;  // stable buffer for ip_last_error
 
   std::vector<std::thread> workers;
 
@@ -148,15 +149,18 @@ struct Pipe {
                  size_t(t.slot) * label_width;
     const uint8_t* payload;
     size_t len;
+    const char* why = nullptr;
     bool ok = RecordAt(t.offset, &payload, &len);
+    if (!ok) why = "bad record framing (magic/length/bounds)";
     IRHeader hdr{};
     size_t img_off = sizeof(IRHeader);
     if (ok && len >= sizeof(IRHeader)) {
       std::memcpy(&hdr, payload, sizeof(IRHeader));
       if (hdr.flag > 0) img_off += size_t(hdr.flag) * 4;
-      if (img_off > len) ok = false;
-    } else {
+      if (img_off > len) { ok = false; why = "header flag overruns record"; }
+    } else if (ok) {
       ok = false;
+      why = "record shorter than IRHeader";
     }
     // labels: scalar from header, or hdr.flag floats after it
     for (int i = 0; i < label_width; ++i) lab[i] = 0.f;
@@ -176,9 +180,16 @@ struct Pipe {
       img = cv::imdecode(raw, c == 1 ? cv::IMREAD_GRAYSCALE
                                      : cv::IMREAD_COLOR);
       ok = !img.empty();
+      if (!ok) why = "image decode failed (corrupt or unsupported codec)";
     }
     if (!ok) {
       decode_errors.fetch_add(1, std::memory_order_relaxed);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        last_error = "sample " + std::to_string(t.sample_index) +
+                     " (rec offset " + std::to_string(t.offset) + "): " +
+                     (why ? why : "unknown");
+      }
       std::memset(out, 0, SampleFloats() * sizeof(float));
       return;
     }
@@ -360,7 +371,13 @@ long ip_error_count(void* h) {
 }
 
 const char* ip_last_error(void* h) {
-  return static_cast<Pipe*>(h)->last_error.c_str();
+  // workers update last_error under mu; snapshot it under the same lock
+  // so the returned pointer stays stable for the (single-threaded)
+  // ctypes caller even while decode threads keep failing
+  Pipe* p = static_cast<Pipe*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->last_error_snapshot = p->last_error;
+  return p->last_error_snapshot.c_str();
 }
 
 void ip_destroy(void* h) { delete static_cast<Pipe*>(h); }

@@ -338,12 +338,19 @@ def _convert(node, ins, out, ctx):
             end = [int(p["end"]) if p.get("end") is not None else 2**31]
             step = [1]
         else:
-            begin = [0 if b is None else int(b)
-                     for b in p.get("begin", ())]
-            end = [2**31 if e is None else int(e)
-                   for e in p.get("end", ())]
             step = [1 if s is None else int(s)
-                    for s in (p.get("step") or [1] * len(begin))]
+                    for s in (p.get("step") or
+                              [1] * len(p.get("begin", ())))]
+            # open-ended (None) begin/end mean "the far edge in the
+            # step's direction", so the sentinels must follow the sign:
+            # ONNX clamps +INT_MAX to dim-1 and -INT_MIN to -1, which
+            # would make a reversed open slice start at 0 / end empty
+            begin = [(2**31 if step[i] < 0 else 0) if b is None
+                     else int(b)
+                     for i, b in enumerate(p.get("begin", ()))]
+            end = [(-2**31 if step[i] < 0 else 2**31) if e is None
+                   else int(e)
+                   for i, e in enumerate(p.get("end", ()))]
             axes = list(range(len(begin)))
         names = []
         for tag, vals in (("_starts", begin), ("_ends", end),

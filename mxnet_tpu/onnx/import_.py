@@ -281,7 +281,7 @@ def _make(op, ins, outs, name, attrs, sym_of, values, inits):
             end = [None] * nax
             step = [1] * nax
             for a, b, e, st in zip(axes, starts, ends, steps):
-                begin[a] = b
+                begin[a] = None if abs(b) >= 2**31 - 1 else b
                 end[a] = None if abs(e) >= 2**31 - 1 else e
                 step[a] = st
             out = mx.sym.slice(sym_of(ins[0]), begin=tuple(begin),
@@ -310,17 +310,36 @@ def _make(op, ins, outs, name, attrs, sym_of, values, inits):
                           mx.sym.Cast(sym_of(ins[1]), dtype="float32"),
                           axis=int(attrs.get("axis", 0)), name=name)
     elif op == "Resize":
-        scales = inits.get(ins[2]) if len(ins) > 2 else None
+        # opset-13 form: inputs are (X, roi, scales, sizes); only the
+        # scales form is supported — importing the sizes form with a
+        # guessed scale would silently build a wrong graph
+        if len(ins) > 3 and ins[3]:
+            raise NotImplementedError(
+                "ONNX Resize with a 'sizes' input is not supported; "
+                "re-export with 'scales'")
+        # opset-10 form is (X, scales); opset-11+ is (X, roi, scales)
+        scales_name = ins[1] if len(ins) == 2 else (
+            ins[2] if len(ins) > 2 else "")
+        scales = inits.get(scales_name) if scales_name else None
         mode = attrs.get("mode", b"nearest")
         mode = mode.decode() if isinstance(mode, bytes) else mode
-        s = float(scales[2]) if scales is not None and len(scales) >= 4 \
-            else 2.0
+        if scales is None or len(scales) < 4:
+            # guessing a scale would silently build a wrong graph
+            raise NotImplementedError(
+                "ONNX Resize needs a 4-element 'scales' initializer "
+                "(graph-computed scales are not supported)")
+        sh, sw = float(scales[2]), float(scales[3])
         if mode == "nearest":
-            out = mx.sym.UpSampling(sym_of(ins[0]), scale=int(s),
+            if sh != sw or sh != int(sh):
+                raise NotImplementedError(
+                    f"nearest Resize needs an integral uniform scale, "
+                    f"got H={sh} W={sw}")
+            out = mx.sym.UpSampling(sym_of(ins[0]), scale=int(sh),
                                     sample_type="nearest", name=name)
         else:
             out = mx.sym._contrib_BilinearResize2D(
-                sym_of(ins[0]), scale_height=s, scale_width=s, name=name)
+                sym_of(ins[0]), scale_height=sh, scale_width=sw,
+                name=name)
     elif op == "Where":
         out = mx.sym.where(sym_of(ins[0]), sym_of(ins[1]),
                            sym_of(ins[2]), name=name)

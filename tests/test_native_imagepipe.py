@@ -147,7 +147,7 @@ def test_mirror_flips_pixels(rec20):
     assert found_flip, "rand_mirror never produced a horizontal flip"
 
 
-def test_corrupt_record_zero_filled(tmp_path):
+def _write_bad_rec(tmp_path):
     prefix = str(tmp_path / "bad")
     rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
     header = recordio.IRHeader(0, 1.0, 0, 0)
@@ -156,13 +156,34 @@ def test_corrupt_record_zero_filled(tmp_path):
     rec.write_idx(1, recordio.pack_img(recordio.IRHeader(0, 2.0, 1, 0),
                                        img, quality=95, img_fmt=".jpg"))
     rec.close()
+    return prefix
+
+
+def test_corrupt_record_zero_filled_and_warned(tmp_path, caplog):
+    prefix = _write_bad_rec(tmp_path)
     it = ImageRecordIterNative(path_imgrec=prefix + ".rec",
                                data_shape=(3, 16, 16), batch_size=2)
-    batch = next(it)
+    with caplog.at_level("WARNING", logger="mxnet_tpu"):
+        batch = next(it)
     data = batch.data[0].asnumpy()
     assert np.all(data[0] == 0.0)          # corrupt -> zero-filled
     assert data[1].mean() > 100.0          # good record decoded
     assert it.error_count == 1
+    # silent zero-fill is not silent anymore (advisor r4): the first bad
+    # record must produce a visible warning carrying the native message
+    assert any("failed to decode" in r.message for r in caplog.records)
+    assert it.last_error != ""
+    it.close()
+
+
+def test_corrupt_record_strict_raises(tmp_path):
+    from mxnet_tpu.base import MXNetError
+    prefix = _write_bad_rec(tmp_path)
+    it = ImageRecordIterNative(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 16, 16), batch_size=2,
+                               strict=True)
+    with pytest.raises(MXNetError, match="failed to decode"):
+        next(it)
     it.close()
 
 

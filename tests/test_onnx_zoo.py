@@ -215,3 +215,61 @@ def test_import_general_gemm_and_constant():
     got = (got[0] if isinstance(got, list) else got).asnumpy()
     want = 0.5 * (A.T @ B) + 2.0 * C
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_roundtrip_reversed_open_slice():
+    """An open-ended reversed slice (step=-1, end=None) must export an
+    INT_MIN end sentinel — a positive sentinel is clamped to dim-1 by
+    ONNX for negative steps, yielding an empty result (advisor r4)."""
+    x = mx.sym.var("data")
+    out = mx.sym.slice(x, begin=(None, 1), end=(None, None),
+                       step=(-1, 2), name="rev")
+    out = mx.sym.relu(out, name="r")
+    _roundtrip(out, (4, 5))
+
+
+def test_import_resize_sizes_input_rejected():
+    """Resize with the opset-13 'sizes' input must refuse rather than
+    silently import a wrong graph (advisor r4)."""
+    from mxnet_tpu.onnx import _proto as P
+    from mxnet_tpu.onnx.export import _node, _tensor, _value_info
+    sizes = np.asarray([1, 3, 8, 8], np.int64)
+    nodes = [_node("Resize", ["x", "", "", "szs"], ["y"], "rs", [])]
+    graph = P.encode(
+        nodes
+        + [(2, P.LEN, "g")]
+        + [(5, P.LEN, _tensor("szs", sizes))]
+        + [(11, P.LEN, _value_info("x", (1, 3, 4, 4)))]
+        + [(12, P.LEN, _value_info("y", (1, 3, 8, 8)))])
+    model = P.encode([(1, P.VARINT, 8), (2, P.LEN, "t"),
+                      (7, P.LEN, graph),
+                      (8, P.LEN, P.encode([(1, P.LEN, ""),
+                                           (2, P.VARINT, 17)]))])
+    with pytest.raises(NotImplementedError, match="sizes"):
+        mx.onnx.import_model(model)
+
+
+def test_import_resize_nonuniform_bilinear():
+    """Non-uniform H/W scales must not collapse to the height scale
+    (advisor r4): 4x3 -> 8x9 via scales (2, 3) bilinear."""
+    from mxnet_tpu.onnx import _proto as P
+    from mxnet_tpu.onnx.export import (_attr, _node, _tensor,
+                                       _value_info)
+    scales = np.asarray([1.0, 1.0, 2.0, 3.0], np.float32)
+    nodes = [_node("Resize", ["x", "", "scl"], ["y"], "rs",
+                   [_attr("mode", 3, b"linear")])]
+    graph = P.encode(
+        nodes
+        + [(2, P.LEN, "g")]
+        + [(5, P.LEN, _tensor("scl", scales))]
+        + [(11, P.LEN, _value_info("x", (1, 2, 4, 3)))]
+        + [(12, P.LEN, _value_info("y", (1, 2, 8, 9)))])
+    model = P.encode([(1, P.VARINT, 8), (2, P.LEN, "t"),
+                      (7, P.LEN, graph),
+                      (8, P.LEN, P.encode([(1, P.LEN, ""),
+                                           (2, P.VARINT, 17)]))])
+    sym, args, aux = mx.onnx.import_model(model)
+    x = np.random.RandomState(0).randn(1, 2, 4, 3).astype(np.float32)
+    out = sym.eval_dict({"x": mx.nd.array(x), **args})
+    out = (out[0] if isinstance(out, list) else out).asnumpy()
+    assert out.shape == (1, 2, 8, 9)

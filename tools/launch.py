@@ -18,15 +18,41 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from mxnet_tpu.launch import main  # noqa: E402
 
-if __name__ == "__main__":
+def _filter_ps_flags(args):
+    """Strip ps-lite-only flags, but refuse to silently downgrade a
+    multi-host ssh/mpi launch to N local workers (advisor r4): the
+    TPU-build equivalent is one `mxnet_tpu.launch` per host with
+    --coordinator/--num-hosts/--host-rank."""
     argv = []
-    skip = False
-    for i, a in enumerate(sys.argv[1:]):
+    skip = None  # name of the flag whose value the next token is
+    for a in args:
         if skip:
-            skip = False
+            flag, skip = skip, None
+            if flag == "--launcher" and a not in ("local",):
+                sys.exit(f"tools/launch.py: --launcher {a} has no "
+                         "TPU-build equivalent (no parameter servers); "
+                         "run `python -m mxnet_tpu.launch` once per host "
+                         "with --coordinator/--num-hosts/--host-rank "
+                         "instead")
             continue
-        if a in ("-s", "--num-servers", "--launcher"):
-            skip = True          # accepted-and-ignored ps-lite flags
+        if a == "--launcher":
+            skip = a
+            continue
+        if a.startswith("--launcher="):
+            if a.split("=", 1)[1] not in ("local",):
+                sys.exit(f"tools/launch.py: {a} has no TPU-build "
+                         "equivalent (no parameter servers); run "
+                         "`python -m mxnet_tpu.launch` once per host with "
+                         "--coordinator/--num-hosts/--host-rank instead")
+            continue
+        if a in ("-s", "--num-servers"):
+            skip = a             # accepted-and-ignored ps-lite flag
+            continue
+        if a.startswith("--num-servers="):
             continue
         argv.append(a)
-    sys.exit(main(argv))
+    return argv
+
+
+if __name__ == "__main__":
+    sys.exit(main(_filter_ps_flags(sys.argv[1:])))
