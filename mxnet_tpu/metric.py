@@ -109,6 +109,14 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError()
 
+    def _accum(self, value, n=1):
+        """Add ``value`` over ``n`` instances to both the epoch-local and
+        the global (reset_local-surviving) tallies."""
+        self.sum_metric += value
+        self.global_sum_metric += value
+        self.num_inst += n
+        self.global_num_inst += n
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
@@ -167,13 +175,15 @@ class CompositeEvalMetric(EvalMetric):
             return ValueError(f"Metric index {index} is out of range 0 and "
                               f"{len(self.metrics)}")
 
+    @staticmethod
+    def _restrict(d, names):
+        if names is None:
+            return d
+        return OrderedDict((k, v) for k, v in d.items() if k in names)
+
     def update_dict(self, labels, preds):
-        if self.label_names is not None:
-            labels = OrderedDict([i for i in labels.items()
-                                  if i[0] in self.label_names])
-        if self.output_names is not None:
-            preds = OrderedDict([i for i in preds.items()
-                                 if i[0] in self.output_names])
+        labels = self._restrict(labels, self.label_names)
+        preds = self._restrict(preds, self.output_names)
         for metric in self.metrics:
             metric.update_dict(labels, preds)
 
@@ -301,126 +311,84 @@ _METRIC_REGISTRY["top_k_acc"] = TopKAccuracy
 
 
 class _BinaryClassificationMetrics:
-    """Confusion-matrix bookkeeping shared by F1/MCC (reference:
-    metric.py:576)."""
+    """Confusion bookkeeping shared by F1/MCC.
+
+    Where the reference (metric.py:576) maintains eight scalar counters,
+    the epoch-local and global tallies here are two 2x2 arrays indexed
+    ``[label, prediction]`` — one vectorised bincount per batch updates
+    the whole table, and every derived statistic reads off it."""
 
     def __init__(self):
-        self.true_positives = 0
-        self.false_negatives = 0
-        self.false_positives = 0
-        self.true_negatives = 0
-        self.global_true_positives = 0
-        self.global_false_negatives = 0
-        self.global_false_positives = 0
-        self.global_true_negatives = 0
+        self._local = numpy.zeros((2, 2), numpy.int64)
+        self._global = numpy.zeros((2, 2), numpy.int64)
 
     def update_binary_stats(self, label, pred):
         pred_np = _as_numpy(pred)
         label_np = _as_numpy(label).astype("int32")
-        pred_label = numpy.argmax(pred_np, axis=1)
         check_label_shapes(label_np, pred_np)
         if len(numpy.unique(label_np)) > 2:
             raise ValueError("%s currently only supports binary "
                              "classification." % self.__class__.__name__)
-        pred_true = (pred_label == 1)
-        pred_false = 1 - pred_true
-        label_true = (label_np == 1)
-        label_false = 1 - label_true
-        true_pos = (pred_true * label_true).sum()
-        false_pos = (pred_true * label_false).sum()
-        false_neg = (pred_false * label_true).sum()
-        true_neg = (pred_false * label_false).sum()
-        self.true_positives += true_pos
-        self.global_true_positives += true_pos
-        self.false_positives += false_pos
-        self.global_false_positives += false_pos
-        self.false_negatives += false_neg
-        self.global_false_negatives += false_neg
-        self.true_negatives += true_neg
-        self.global_true_negatives += true_neg
+        # collapse to {0,1}: class-1 is "positive", everything else
+        # (including argmax hits on extra columns) is "negative"
+        is_pos = (numpy.argmax(pred_np, axis=1).ravel() == 1)
+        truth = (label_np.ravel() == 1)
+        delta = numpy.bincount(2 * truth + is_pos,
+                               minlength=4).reshape(2, 2)
+        self._local += delta
+        self._global += delta
+
+    @staticmethod
+    def _prf(conf):
+        """(precision, recall, fscore) of a 2x2 [label, pred] table."""
+        tp = conf[1, 1]
+        prec = tp / conf[:, 1].sum() if conf[:, 1].any() else 0.0
+        rec = tp / conf[1, :].sum() if conf[1, :].any() else 0.0
+        f = 2 * prec * rec / (prec + rec) if prec + rec > 0 else 0.0
+        return float(prec), float(rec), float(f)
 
     @property
     def precision(self):
-        if self.true_positives + self.false_positives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_positives)
-        return 0.0
+        return self._prf(self._local)[0]
 
     @property
     def recall(self):
-        if self.true_positives + self.false_negatives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_negatives)
-        return 0.0
+        return self._prf(self._local)[1]
 
     @property
     def fscore(self):
-        if self.precision + self.recall > 0:
-            return 2 * self.precision * self.recall / (
-                self.precision + self.recall)
-        return 0.0
+        return self._prf(self._local)[2]
 
     @property
     def global_fscore(self):
-        if self.global_true_positives + self.global_false_positives > 0:
-            g_precision = float(self.global_true_positives) / (
-                self.global_true_positives + self.global_false_positives)
-        else:
-            g_precision = 0.0
-        if self.global_true_positives + self.global_false_negatives > 0:
-            g_recall = float(self.global_true_positives) / (
-                self.global_true_positives + self.global_false_negatives)
-        else:
-            g_recall = 0.0
-        if g_precision + g_recall > 0:
-            return 2 * g_precision * g_recall / (g_precision + g_recall)
-        return 0.0
+        return self._prf(self._global)[2]
 
     def matthewscc(self, use_global=False):
-        if use_global:
-            if not self.global_total_examples:
-                return 0.0
-            true_pos = float(self.global_true_positives)
-            false_pos = float(self.global_false_positives)
-            false_neg = float(self.global_false_negatives)
-            true_neg = float(self.global_true_negatives)
-        else:
-            if not self.total_examples:
-                return 0.0
-            true_pos = float(self.true_positives)
-            false_pos = float(self.false_positives)
-            false_neg = float(self.false_negatives)
-            true_neg = float(self.true_negatives)
-        terms = [(true_pos + false_pos), (true_pos + false_neg),
-                 (true_neg + false_pos), (true_neg + false_neg)]
-        denom = 1.0
-        for t in filter(lambda t: t != 0.0, terms):
-            denom *= t
-        return ((true_pos * true_neg) - (false_pos * false_neg)) / \
-            math.sqrt(denom)
+        conf = self._global if use_global else self._local
+        if not conf.any():
+            return 0.0
+        ((tn, fp), (fn, tp)) = conf.astype(numpy.float64)
+        # product of the four marginals, with empty marginals dropped
+        # (the reference's convention, metric.py:876) rather than the
+        # textbook 0-denominator
+        marginals = numpy.asarray([tp + fp, tp + fn, tn + fp, tn + fn])
+        denom = marginals[marginals != 0].prod()
+        return (tp * tn - fp * fn) / math.sqrt(denom)
 
     @property
     def total_examples(self):
-        return (self.false_negatives + self.false_positives
-                + self.true_negatives + self.true_positives)
+        return int(self._local.sum())
 
     @property
     def global_total_examples(self):
-        return (self.global_false_negatives + self.global_false_positives
-                + self.global_true_negatives + self.global_true_positives)
+        return int(self._global.sum())
 
     def reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
+        self._local[:] = 0
 
     def reset(self):
-        self.reset_stats()
-        self.global_false_positives = 0
-        self.global_false_negatives = 0
-        self.global_true_positives = 0
-        self.global_true_negatives = 0
+        self._local[:] = 0
+        self._global[:] = 0
 
 
 @register
@@ -476,23 +444,26 @@ class MCC(EvalMetric):
                          label_names=label_names)
 
     def update(self, labels, preds):
+        stats = self._metrics
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
-            self._metrics.update_binary_stats(label, pred)
+            stats.update_binary_stats(label, pred)
         if self._average == "macro":
-            self.sum_metric += self._metrics.matthewscc()
-            self.global_sum_metric += self._metrics.matthewscc(use_global=True)
+            # one coefficient sample per update() call: the local table
+            # restarts, the global one keeps accumulating
+            self.sum_metric += stats.matthewscc()
             self.num_inst += 1
+            self.global_sum_metric += stats.matthewscc(use_global=True)
             self.global_num_inst += 1
-            self._metrics.reset_stats()
+            stats.reset_stats()
         else:
-            self.sum_metric = (self._metrics.matthewscc()
-                               * self._metrics.total_examples)
-            self.global_sum_metric = (
-                self._metrics.matthewscc(use_global=True)
-                * self._metrics.global_total_examples)
-            self.num_inst = self._metrics.total_examples
-            self.global_num_inst = self._metrics.global_total_examples
+            # micro: one coefficient over every example seen, expressed
+            # as sum/count so get() recovers it unchanged
+            self.sum_metric = stats.matthewscc() * stats.total_examples
+            self.num_inst = stats.total_examples
+            self.global_sum_metric = (stats.matthewscc(use_global=True)
+                                      * stats.global_total_examples)
+            self.global_num_inst = stats.global_total_examples
 
     def reset(self):
         self.sum_metric = 0.0
@@ -688,84 +659,60 @@ _METRIC_REGISTRY["nll_loss"] = NegativeLogLikelihood
 
 @register
 class PearsonCorrelation(EvalMetric):
-    """Pearson correlation (reference: metric.py:1330)."""
+    """Pearson correlation (reference: metric.py:1330).
+
+    ``average='micro'`` computes one coefficient over every example
+    seen. Where the reference merges per-batch means/variances with a
+    Welford-style update, here the five raw moments (sums of x, y, x^2,
+    y^2, xy) are accumulated in float64 and the coefficient is formed
+    once at ``get()`` — the streaming state is a single vector."""
 
     def __init__(self, name="pearsonr", output_names=None, label_names=None,
                  average="macro"):
         self.average = average
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
-        if self.average == "micro":
-            self.reset_micro()
-
-    def reset_micro(self):
-        self._sse_p = 0
-        self._mean_p = 0
-        self._sse_l = 0
-        self._mean_l = 0
-        self._pred_nums = 0
-        self._label_nums = 0
-        self._conv = 0
 
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
         self.global_num_inst = 0
         self.global_sum_metric = 0.0
-        if getattr(self, "average", None) == "micro":
-            self.reset_micro()
-
-    def update_variance(self, new_values, *aggregate):
-        count = len(new_values)
-        mean = numpy.mean(new_values)
-        variance = numpy.sum((new_values - mean) ** 2)
-        count_a, mean_a, var_a = aggregate
-        delta = mean - mean_a
-        m_a = var_a * (count_a - 1)
-        M2 = m_a + variance + delta ** 2 * count_a * count / (count_a + count)
-        count_a += count
-        mean_a += delta * count / count_a
-        var_a = M2 / (count_a - 1)
-        return count_a, mean_a, var_a
-
-    def update_cov(self, label, pred):
-        self._conv = self._conv + numpy.sum(
-            (label - self._mean_l) * (pred - self._mean_p))
+        # n, sum_l, sum_p, sum_ll, sum_pp, sum_lp
+        self._moments = numpy.zeros(6, numpy.float64)
+        self._anchor = None
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
             check_label_shapes(label, pred, False, True)
-            label_np = _as_numpy(label).ravel().astype(numpy.float64)
-            pred_np = _as_numpy(pred).ravel().astype(numpy.float64)
+            lab = _as_numpy(label).ravel().astype(numpy.float64)
+            prd = _as_numpy(pred).ravel().astype(numpy.float64)
             if self.average == "macro":
-                pearson_corr = numpy.corrcoef(pred_np, label_np)[0, 1]
-                self.sum_metric += pearson_corr
-                self.global_sum_metric += pearson_corr
-                self.num_inst += 1
-                self.global_num_inst += 1
+                self._accum(numpy.corrcoef(prd, lab)[0, 1])
             else:
-                self.global_num_inst += 1
-                self.num_inst += 1
-                self._label_nums, self._mean_l, self._sse_l = \
-                    self.update_variance(label_np, self._label_nums,
-                                         self._mean_l, self._sse_l)
-                self.update_cov(label_np, pred_np)
-                self._pred_nums, self._mean_p, self._sse_p = \
-                    self.update_variance(pred_np, self._pred_nums,
-                                         self._mean_p, self._sse_p)
+                self._accum(0.0)  # the value lives in the moments
+                if self._anchor is None:
+                    # Pearson is shift-invariant; centering every batch
+                    # on the first batch's means keeps the accumulated
+                    # squares O(variance) instead of O(mean^2), so
+                    # large-mean data (timestamps, raw prices) does not
+                    # cancel away the float64 mantissa
+                    self._anchor = (lab.mean(), prd.mean())
+                lab = lab - self._anchor[0]
+                prd = prd - self._anchor[1]
+                self._moments += (lab.size, lab.sum(), prd.sum(),
+                                  lab @ lab, prd @ prd, lab @ prd)
 
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
         if self.average == "macro":
             return (self.name, self.sum_metric / self.num_inst)
-        n = self._label_nums
-        numerator = self._conv
-        denominator = (numpy.sqrt(self._sse_p * (n - 1))
-                       * numpy.sqrt(self._sse_l * (n - 1)))
-        pearson = numerator / denominator if denominator != 0 else float("nan")
-        return (self.name, pearson)
+        n, sl, sp, sll, spp, slp = self._moments
+        cov = n * slp - sl * sp
+        denom = numpy.sqrt((n * sll - sl * sl) * (n * spp - sp * sp))
+        return (self.name, cov / denom if denom != 0 else float("nan"))
 
 
 _METRIC_REGISTRY["pcc"] = PearsonCorrelation
@@ -818,20 +765,11 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             labels, preds = check_label_shapes(labels, preds, True)
         for pred, label in zip(preds, labels):
-            label_np = _as_numpy(label)
-            pred_np = _as_numpy(pred)
-            reval = self._feval(label_np, pred_np)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.global_sum_metric += sum_metric
-                self.num_inst += num_inst
-                self.global_num_inst += num_inst
-            else:
-                self.sum_metric += reval
-                self.global_sum_metric += reval
-                self.num_inst += 1
-                self.global_num_inst += 1
+            # feval returns either a bare value (counted as one
+            # instance) or a (sum, count) pair
+            result = self._feval(_as_numpy(label), _as_numpy(pred))
+            self._accum(*(result if isinstance(result, tuple)
+                          else (result,)))
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")

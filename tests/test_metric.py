@@ -1,0 +1,175 @@
+"""Direct oracle tests for mx.metric (reference:
+tests/python/unittest/test_metric.py).
+
+Round 5 rewrote the F1/MCC confusion bookkeeping and the Pearson
+micro-average streaming state in this repo's idiom; these pin every
+rewritten path against closed-form numpy oracles, plus the zoo basics.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric as M
+
+
+def _nd(a):
+    return mx.nd.array(np.asarray(a, np.float32))
+
+
+def _two_col(pos_prob):
+    """binary 'probabilities' with argmax == (p > .5)"""
+    p = np.asarray(pos_prob, np.float32)
+    return np.stack([1 - p, p], axis=1)
+
+
+LABELS = np.array([1, 0, 1, 1, 0, 0, 1, 0])
+PREDS = np.array([0.9, 0.8, 0.7, 0.2, 0.1, 0.6, 0.55, 0.3])
+# argmax>.5: pred_pos = [1,1,1,0,0,1,1,0] -> tp=3 fp=2 fn=1 tn=2
+
+
+def _f1(tp, fp, fn):
+    prec = tp / (tp + fp)
+    rec = tp / (tp + fn)
+    return 2 * prec * rec / (prec + rec)
+
+
+def _mcc(tp, fp, fn, tn):
+    denom = math.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    return (tp * tn - fp * fn) / denom
+
+
+def test_f1_micro_oracle():
+    m = M.F1(average="micro")
+    m.update([_nd(LABELS[:4])], [_nd(_two_col(PREDS[:4]))])
+    m.update([_nd(LABELS[4:])], [_nd(_two_col(PREDS[4:]))])
+    name, val = m.get()
+    assert name == "f1"
+    np.testing.assert_allclose(val, _f1(3, 2, 1), rtol=1e-6)
+
+
+def test_f1_macro_averages_per_update():
+    m = M.F1(average="macro")
+    m.update([_nd([1, 0])], [_nd(_two_col([0.9, 0.1]))])  # perfect: f1=1
+    m.update([_nd([1, 1])], [_nd(_two_col([0.9, 0.1]))])  # tp=1 fn=1: f1=2/3
+    np.testing.assert_allclose(m.get()[1], (1.0 + 2 / 3) / 2, rtol=1e-6)
+
+
+def test_f1_rejects_multiclass_labels():
+    m = M.F1()
+    with pytest.raises(ValueError, match="binary"):
+        m.update([_nd([0, 1, 2])], [_nd(_two_col([0.9, 0.1, 0.5]))])
+
+
+def test_mcc_micro_oracle():
+    m = M.MCC(average="micro")
+    m.update([_nd(LABELS[:5])], [_nd(_two_col(PREDS[:5]))])
+    m.update([_nd(LABELS[5:])], [_nd(_two_col(PREDS[5:]))])
+    np.testing.assert_allclose(m.get()[1], _mcc(3, 2, 1, 2), rtol=1e-6)
+
+
+def test_mcc_macro_and_global():
+    m = M.MCC(average="macro")
+    m.update([_nd(LABELS)], [_nd(_two_col(PREDS))])
+    want = _mcc(3, 2, 1, 2)
+    np.testing.assert_allclose(m.get()[1], want, rtol=1e-6)
+    # global tally survives reset_local
+    m.reset_local()
+    assert np.isnan(m.get()[1]) or m.get()[1] == 0.0 or m.num_inst == 0
+    np.testing.assert_allclose(m.get_global()[1], want, rtol=1e-6)
+
+
+def test_mcc_degenerate_all_one_class():
+    m = M.MCC()
+    m.update([_nd([1, 1, 1])], [_nd(_two_col([0.9, 0.8, 0.7]))])
+    # tp=3, everything else 0: empty marginals drop out of the product
+    # (reference convention), giving 3/sqrt(3*3) = 1? no: terms tp+fp=3,
+    # tp+fn=3, tn+fp=0(drop), tn+fn=0(drop) -> 3*0-0 / sqrt(9) = 1... tp*tn=0
+    # numerator tp*tn - fp*fn = 0 -> mcc 0
+    np.testing.assert_allclose(m.get()[1], 0.0, atol=1e-12)
+
+
+def test_pearson_macro_matches_corrcoef():
+    rng = np.random.RandomState(0)
+    lab, prd = rng.randn(20), rng.randn(20)
+    m = M.PearsonCorrelation()
+    m.update([_nd(lab)], [_nd(prd)])
+    np.testing.assert_allclose(m.get()[1], np.corrcoef(prd, lab)[0, 1],
+                               rtol=1e-6)
+
+
+def test_pearson_micro_streams_across_batches():
+    rng = np.random.RandomState(1)
+    lab = rng.randn(30)
+    prd = 0.6 * lab + 0.4 * rng.randn(30)
+    m = M.PearsonCorrelation(average="micro")
+    for i in range(0, 30, 7):  # uneven batch sizes
+        m.update([_nd(lab[i:i + 7])], [_nd(prd[i:i + 7])])
+    np.testing.assert_allclose(m.get()[1], np.corrcoef(prd, lab)[0, 1],
+                               rtol=1e-6)
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_pearson_micro_large_mean_stable():
+    """Raw-moment accumulation must not cancel away the signal when the
+    data's mean dwarfs its variance (code-review r5)."""
+    rng = np.random.RandomState(2)
+    lab = 1e8 + rng.randn(40)
+    prd = 1e8 + 0.5 * (lab - 1e8) + 0.5 * rng.randn(40)
+    m = M.PearsonCorrelation(average="micro")
+    for i in range(0, 40, 9):  # float64 numpy straight in: float32 NDArray
+        m.update([lab[i:i + 9]], [prd[i:i + 9]])  # would quantize 1e8 away
+    np.testing.assert_allclose(m.get()[1], np.corrcoef(prd, lab)[0, 1],
+                               rtol=1e-6)
+
+
+def test_custom_metric_scalar_and_tuple():
+    scalar = M.CustomMetric(lambda l, p: float(np.abs(l - p).mean()),
+                            name="mad")
+    scalar.update([_nd([1.0, 2.0])], [_nd([1.5, 1.0])])
+    np.testing.assert_allclose(scalar.get()[1], 0.75)
+    assert scalar.num_inst == 1
+
+    pair = M.CustomMetric(lambda l, p: (float(np.abs(l - p).sum()),
+                                        l.size), name="sad")
+    pair.update([_nd([1.0, 2.0])], [_nd([1.5, 1.0])])
+    pair.update([_nd([0.0])], [_nd([4.0])])
+    np.testing.assert_allclose(pair.get()[1], (1.5 + 4.0) / 3)
+    assert pair.num_inst == 3
+
+
+def test_composite_update_dict_filters_names():
+    acc = M.Accuracy(output_names=["out"], label_names=["lab"])
+    comp = M.CompositeEvalMetric([acc])
+    comp.update_dict(
+        {"lab": _nd([1, 0]), "other_lab": _nd([0, 0])},
+        {"out": _nd(_two_col([0.9, 0.1])), "junk": _nd(_two_col([0., 0.]))})
+    np.testing.assert_allclose(comp.get()[1][0], 1.0)
+
+
+def test_accuracy_and_topk():
+    a = M.Accuracy()
+    a.update([_nd([1, 0, 2])],
+             [_nd([[0.1, 0.8, 0.1], [0.9, 0.05, 0.05], [0.3, 0.4, 0.3]])])
+    np.testing.assert_allclose(a.get()[1], 2 / 3)
+    t = M.TopKAccuracy(top_k=2)
+    t.update([_nd([2])], [_nd([[0.3, 0.1, 0.25]])])  # 2nd-best hit
+    np.testing.assert_allclose(t.get()[1], 1.0)
+
+
+def test_perplexity_ignore_label():
+    p = M.Perplexity(ignore_label=0)
+    probs = np.array([[0.2, 0.8], [0.5, 0.5], [0.9, 0.1]], np.float32)
+    p.update([_nd([1, 0, 1])], [_nd(probs)])
+    want = math.exp(-(math.log(0.8) + math.log(0.1)) / 2)
+    np.testing.assert_allclose(p.get()[1], want, rtol=1e-6)
+
+
+def test_create_by_name_and_config():
+    m = M.create("mcc", average="micro")
+    assert isinstance(m, M.MCC)
+    cfg = M.create("accuracy").get_config()
+    assert cfg["metric"] == "Accuracy"
+    assert isinstance(M.create(["accuracy", "mae"]), M.CompositeEvalMetric)
