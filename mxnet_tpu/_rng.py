@@ -12,6 +12,8 @@ use this module — they thread explicit keys (see gluon.block rng plumbing).
 from __future__ import annotations
 
 import itertools
+import threading
+
 import jax
 
 _seed = 0
@@ -51,8 +53,9 @@ def host_rng():
 
 def next_key():
     global _base_key
-    if _trace_state is not None:
-        key, counter = _trace_state
+    ts = getattr(_trace_tls, "state", None)
+    if ts is not None:
+        key, counter = ts
         return jax.random.fold_in(key, next(counter))
     if _base_key is None:
         seed(0)
@@ -62,16 +65,16 @@ def next_key():
 # Trace override: while a CachedOp/hybridized block is being traced into
 # jit, next_key() must derive from a traced input key (a concrete key would
 # bake the dropout mask into the compiled program as a constant).
-_trace_state = None
+# Thread-local: a trace in one thread must not reroute another thread's
+# eager draws (thread-safe inference, reference cached_op_threadsafe.h:82).
+_trace_tls = threading.local()
 
 
 def push_trace_key(key):
-    global _trace_state
-    old = _trace_state
-    _trace_state = (key, itertools.count())
+    old = getattr(_trace_tls, "state", None)
+    _trace_tls.state = (key, itertools.count())
     return old
 
 
 def pop_trace_key(old):
-    global _trace_state
-    _trace_state = old
+    _trace_tls.state = old

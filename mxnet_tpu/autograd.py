@@ -15,6 +15,7 @@ The recording/train-mode scopes mirror the reference API exactly:
 from __future__ import annotations
 
 import itertools
+import threading
 import weakref
 from typing import Dict, List, Optional
 
@@ -29,18 +30,32 @@ __all__ = [
 
 # ---------------------------------------------------------------- state ----
 
-_RECORDING = False
-_TRAINING = False
+# Autograd mode and tape are THREAD-LOCAL, like the reference's
+# thread_local imperative state (reference: src/imperative/imperative.h
+# is_train_/is_recording_ are thread_local): a trace or a pause() in one
+# serving thread must not flip recording off for a training thread, and
+# concurrent recorders each get their own graph.
+class _AGState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape = _Tape()
+
+
 _SLOT = itertools.count()
 _SEQ = itertools.count()
+
+# leaf slot -> (weakref to NDArray, grad_req). PROCESS-global, unlike the
+# per-thread graph: attach_grad() commonly runs on the main thread while
+# backward() runs in a worker (GIL-atomic dict ops; entries die with the
+# array weakref).
+_LEAVES: Dict[int, tuple] = {}
 
 
 class _Tape:
     def __init__(self):
         self.nodes: List["_Node"] = []
         self.slot_producer: Dict[int, "_Node"] = {}
-        # leaf slot -> (weakref to NDArray, grad_req)
-        self.leaves: Dict[int, tuple] = {}
 
     def clear_graph(self):
         self.nodes = []
@@ -55,7 +70,11 @@ class _Tape:
                               if id(n) not in node_ids}
 
 
-_TAPE = _Tape()
+_STATE = _AGState()
+
+
+def _tape() -> "_Tape":
+    return _STATE.tape
 
 
 class _Node:
@@ -86,15 +105,16 @@ def new_slot() -> int:
 
 
 def register_leaf(slot: int, array, grad_req: str):
-    _TAPE.leaves[slot] = (weakref.ref(array), grad_req)
+    _LEAVES[slot] = (weakref.ref(array), grad_req)
 
 
 def record_node(vjp_fn, in_slots, out_slots, out_avals, fn=None,
                 xs=None) -> _Node:
     node = _Node(vjp_fn, in_slots, out_slots, out_avals, fn=fn, xs=xs)
-    _TAPE.nodes.append(node)
+    tape = _tape()
+    tape.nodes.append(node)
     for s in out_slots:
-        _TAPE.slot_producer[s] = node
+        tape.slot_producer[s] = node
     return node
 
 
@@ -105,23 +125,21 @@ class _Scope:
         self._rec, self._train = recording, training
 
     def __enter__(self):
-        global _RECORDING, _TRAINING
-        self._old = (_RECORDING, _TRAINING)
-        if self._rec and not _RECORDING:
+        self._old = (_STATE.recording, _STATE.training)
+        if self._rec and not _STATE.recording:
             # entering a fresh outermost recording scope: the previous
             # iteration's graph (if any survived without a backward) is
             # unreachable by user code now — drop it so vjp residuals don't
             # pin HBM across training iterations.
-            _TAPE.clear_graph()
+            _tape().clear_graph()
         if self._rec is not None:
-            _RECORDING = self._rec
+            _STATE.recording = self._rec
         if self._train is not None:
-            _TRAINING = self._train
+            _STATE.training = self._train
         return self
 
     def __exit__(self, *exc):
-        global _RECORDING, _TRAINING
-        _RECORDING, _TRAINING = self._old
+        _STATE.recording, _STATE.training = self._old
         return False
 
 
@@ -144,22 +162,20 @@ def predict_mode() -> _Scope:
 
 
 def is_recording() -> bool:
-    return _RECORDING
+    return _STATE.recording
 
 
 def is_training() -> bool:
-    return _TRAINING
+    return _STATE.training
 
 
 def set_recording(is_record: bool) -> bool:
-    global _RECORDING
-    prev, _RECORDING = _RECORDING, is_record
+    prev, _STATE.recording = _STATE.recording, is_record
     return prev
 
 
 def set_training(train: bool) -> bool:
-    global _TRAINING
-    prev, _TRAINING = _TRAINING, train
+    prev, _STATE.training = _STATE.training, train
     return prev
 
 
@@ -226,6 +242,7 @@ def _run_backward(heads, head_grads, retain_graph, create_graph=False):
         return old + new
 
     roots = []
+    tape = _tape()
     for h, hg in zip(heads, head_grads):
         slot = getattr(h, "_ag_slot", None)
         if slot is None:
@@ -235,7 +252,7 @@ def _run_backward(heads, head_grads, retain_graph, create_graph=False):
         g = (jnp.ones(h.shape, h.dtype) if hg is None
              else (hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)))
         grads[slot] = acc(grads.get(slot), g)
-        prod = _TAPE.slot_producer.get(slot)
+        prod = tape.slot_producer.get(slot)
         if prod is not None:
             roots.append(prod)
 
@@ -249,11 +266,11 @@ def _run_backward(heads, head_grads, retain_graph, create_graph=False):
         reachable.add(id(node))
         for s in node.in_slots:
             if s is not None:
-                p = _TAPE.slot_producer.get(s)
+                p = tape.slot_producer.get(s)
                 if p is not None and id(p) not in reachable:
                     stack.append(p)
 
-    ordered = sorted((n for n in _TAPE.nodes if id(n) in reachable),
+    ordered = sorted((n for n in tape.nodes if id(n) in reachable),
                      key=lambda n: n.seq, reverse=True)
     for node in ordered:
         cots = tuple(
@@ -277,7 +294,7 @@ def _run_backward(heads, head_grads, retain_graph, create_graph=False):
             grads[s] = acc(grads.get(s), g)
 
     if not retain_graph:
-        _TAPE.drop_nodes(reachable)
+        tape.drop_nodes(reachable)
     return grads
 
 
@@ -317,10 +334,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     python/mxnet/autograd.py backward → MXAutogradBackwardEx)."""
     grads = _run_backward(heads, head_grads, retain_graph)
     from .ndarray.ndarray import NDArray
-    for slot, (ref, req) in list(_TAPE.leaves.items()):
+    for slot, (ref, req) in list(_LEAVES.items()):
         arr = ref()
         if arr is None:
-            del _TAPE.leaves[slot]
+            _LEAVES.pop(slot, None)
             continue
         if slot in grads and req != "null":
             g = grads[slot]

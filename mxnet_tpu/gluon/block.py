@@ -473,6 +473,12 @@ class CachedOp:
         # snapshot once (reference CachedOp captures params at build time,
         # src/imperative/cached_op.cc); hybridize()/cast() rebuild me
         self._params_snapshot = None
+        # serializes the first-call trace per signature so concurrent
+        # callers never observe a half-populated _meta or another
+        # thread's parameter trace state (reference ships a dedicated
+        # CachedOpThreadSafe for this, src/imperative/cached_op_threadsafe.h:82;
+        # here the compiled path is lock-free and only tracing locks)
+        self._trace_lock = threading.Lock()
 
     def _trace_params(self):
         if self._params_snapshot is None:
@@ -518,6 +524,15 @@ class CachedOp:
             return tuple(primal) + tuple(aux_vals)
         return pure
 
+    def _run(self, jitfn, recording, key, pvals, xvals):
+        fn = lambda key, *a: jitfn(  # noqa: E731
+            key, a[:len(pvals)], a[len(pvals):])
+        if recording:
+            outs, vjp_fn = jax.vjp(fn, key, *pvals, *xvals)
+        else:
+            outs, vjp_fn = fn(key, *pvals, *xvals), None
+        return fn, outs, vjp_fn
+
     def __call__(self, *args):
         from ..ops.invoke import as_jax
         flat_in, in_fmt = _flatten_arrays(args)
@@ -534,34 +549,45 @@ class CachedOp:
                 f"hashable (got {opaque!r}); pass arrays or hashable "
                 "constants, or skip hybridize() for this block") from None
         params = self._trace_params()
-        if any(p._data is None and (p.shape is None or 0 in p.shape)
-               for p in params):
-            # deferred shapes unresolved: one eager warm-up pass infers
-            # them (≙ the reference's deferred-compute trace in
-            # _build_cache, block.py:978); predict mode so BN aux states
-            # are untouched
-            with _suspend_hybridization(self._block):
-                with autograd.pause(train_mode=False):
-                    self._block(*args)
-        for p in params:
-            p._finish_deferred_init()
-        pvals = tuple(p.data()._data for p in params)
-        xvals = tuple(as_jax(x) for x in arrays)
+        recording = autograd.is_recording()
         key = _rng.next_key()
 
-        jitfn = self._jits.get(cache_key)
-        if jitfn is None:
-            jitfn = jax.jit(self._make_pure(training, in_fmt, flags,
-                                            opaque, cache_key))
-            self._jits[cache_key] = jitfn
+        def _prologue():
+            # resolve deferred shapes/init, then snapshot leaf values
+            if any(p._data is None and (p.shape is None or 0 in p.shape)
+                   for p in params):
+                # deferred shapes unresolved: one eager warm-up pass
+                # infers them (≙ the reference's deferred-compute trace
+                # in _build_cache, block.py:978); predict mode so BN aux
+                # states are untouched
+                with _suspend_hybridization(self._block):
+                    with autograd.pause(train_mode=False):
+                        self._block(*args)
+            for p in params:
+                p._finish_deferred_init()
+            pvals = tuple(p.data()._data for p in params)
+            xvals = tuple(as_jax(x) for x in arrays)
+            return pvals, xvals
 
-        recording = autograd.is_recording()
-        fn = lambda key, *a: jitfn(  # noqa: E731
-            key, a[:len(pvals)], a[len(pvals):])
-        if recording:
-            outs, vjp_fn = jax.vjp(fn, key, *pvals, *xvals)
+        uninitialized = any(p._data is None for p in params)
+        jitfn = self._jits.get(cache_key)
+        if uninitialized or jitfn is None or cache_key not in self._meta:
+            # slow path: first call for this signature (or params still
+            # deferred). Serialize init + trace so concurrent callers
+            # never observe half-initialized params or a half-populated
+            # _meta; once traced, the compiled path below is lock-free.
+            with self._trace_lock:
+                pvals, xvals = _prologue()
+                jitfn = self._jits.get(cache_key)
+                if jitfn is None:
+                    jitfn = jax.jit(self._make_pure(training, in_fmt, flags,
+                                                    opaque, cache_key))
+                    self._jits[cache_key] = jitfn
+                fn, outs, vjp_fn = self._run(jitfn, recording, key,
+                                             pvals, xvals)
         else:
-            outs = fn(key, *pvals, *xvals)
+            pvals, xvals = _prologue()
+            fn, outs, vjp_fn = self._run(jitfn, recording, key, pvals, xvals)
 
         n_primal, out_fmt, single, aux_params = self._meta[cache_key]
         primal, aux = outs[:n_primal], outs[n_primal:]
@@ -602,24 +628,38 @@ class CachedOp:
         return grouped[0] if single else grouped
 
 
+class _SuspendTLS(threading.local):
+    def __init__(self):
+        self.blocks = set()
+
+
+_suspend_tls = _SuspendTLS()
+
+
 class _suspend_hybridization:
-    """Run block.forward with _active=False so the trace goes through the
-    eager path instead of recursively calling the CachedOp."""
+    """Run block.forward through the eager path instead of recursively
+    calling the CachedOp. The suspension is THREAD-LOCAL (a per-thread
+    set of suspended block ids, not a flip of the shared ``_active``
+    flag): while one thread traces, other threads serving the same net
+    must keep hitting the compiled path — flipping ``_active`` would
+    route them into the eager path mid-trace (thread-safe serving,
+    reference: src/imperative/cached_op_threadsafe.h:82)."""
 
     def __init__(self, block):
         self._block = block
-        self._saved = []
+        self._added = []
 
     def __enter__(self):
+        suspended = _suspend_tls.blocks
+
         def _save(b):
-            if isinstance(b, HybridBlock):
-                self._saved.append((b, b._active))
-                b._active = False
+            if isinstance(b, HybridBlock) and id(b) not in suspended:
+                suspended.add(id(b))
+                self._added.append(id(b))
         self._block.apply(_save)
 
     def __exit__(self, *exc):
-        for b, a in self._saved:
-            b._active = a
+        _suspend_tls.blocks.difference_update(self._added)
 
 
 class HybridBlock(Block):
@@ -669,7 +709,8 @@ class HybridBlock(Block):
         return super().__call__(*args, **kwargs)
 
     def forward(self, x, *args):
-        if self._active and not _TRACE_STACK:
+        if self._active and not _TRACE_STACK and \
+                id(self) not in _suspend_tls.blocks:
             # cached op resolves deferred init itself; don't touch params
             # on the hot path
             return self._get_cached_op()(x, *args)

@@ -14,6 +14,7 @@ namespacing). Differences from the reference, by design:
 """
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Optional
 
@@ -39,7 +40,31 @@ class DeferredInitializationError(MXNetError):
 # block is traced into jit, parameter reads must return tracer-backed
 # arrays and aux-state writes (BatchNorm running stats) must be captured as
 # extra jit outputs instead of touching concrete buffers.
-_TRACE_STACK = []
+#
+# Thread-local: concurrent inference from N Python threads (the reference
+# ships CachedOpThreadSafe for this, src/imperative/cached_op_threadsafe.h:82)
+# must not see another thread's in-progress trace.
+class _ThreadLocalStack(threading.local):
+    def __init__(self):
+        self._stack = []
+
+    def append(self, item):
+        self._stack.append(item)
+
+    def pop(self):
+        return self._stack.pop()
+
+    def __bool__(self):
+        return bool(self._stack)
+
+    def __len__(self):
+        return len(self._stack)
+
+    def __getitem__(self, idx):
+        return self._stack[idx]
+
+
+_TRACE_STACK = _ThreadLocalStack()
 
 
 class Parameter:
@@ -69,13 +94,23 @@ class Parameter:
         self._data: Optional[OrderedDict] = None
         self.grad_req = grad_req
         self._deferred_init = ()
-        self._trace_data = None  # tracer-backed NDArray during CachedOp trace
+        # per-thread tracer-backed NDArray during CachedOp trace: thread A
+        # tracing must not leak tracers into thread B's concurrent forward
+        self._trace_tls = threading.local()
         self.attributes = {}
         self._var = None
 
     def __repr__(self):
         return (f"Parameter {self.name} (shape={self.shape}, "
                 f"dtype={self.dtype})")
+
+    @property
+    def _trace_data(self):
+        return getattr(self._trace_tls, "value", None)
+
+    @_trace_data.setter
+    def _trace_data(self, v):
+        self._trace_tls.value = v
 
     # ------------------------------------------------------------- props --
     @property

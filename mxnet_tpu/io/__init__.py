@@ -1,3 +1,4 @@
 """Data iterators (reference: python/mxnet/io/)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, CSVIter, MXDataIter)  # noqa: F401
+                 PrefetchingIter, CSVIter, LibSVMIter,
+                 MXDataIter)  # noqa: F401

@@ -9,6 +9,7 @@ every legacy Module example feeds on it.
 """
 from __future__ import annotations
 
+import os
 from collections import namedtuple, OrderedDict
 
 import numpy as _np
@@ -347,6 +348,179 @@ class CSVIter(NDArrayIter):
         super().__init__(data, label, batch_size=batch_size, **kwargs)
 
 
+def _parse_libsvm(path, with_label=True):
+    """Parse one libsvm text file (or every file in a directory) into
+    (labels list-of-float-lists, rows list-of-[(idx, val)...]).
+    Zero-based, ascending indices (reference: src/io/iter_libsvm.cc:200
+    — same convention, stricter than upstream libsvm's one-based)."""
+    paths = [path]
+    if os.path.isdir(path):
+        paths = sorted(os.path.join(path, f) for f in os.listdir(path))
+    labels, rows = [], []
+    for p in paths:
+        with open(p) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                feats_at = 0
+                lab = []
+                # leading non-"i:v" tokens are the inline label(s);
+                # discarded when labels come from a separate file
+                while feats_at < len(parts) and \
+                        ":" not in parts[feats_at]:
+                    lab.append(float(parts[feats_at]))
+                    feats_at += 1
+                if not with_label:
+                    lab = []
+                row, prev = [], -1
+                for tok in parts[feats_at:]:
+                    try:
+                        i, v = tok.split(":", 1)
+                        i = int(i)
+                    except ValueError:
+                        raise ValueError(
+                            f"{p}:{lineno}: malformed libsvm token "
+                            f"{tok!r}")
+                    if i <= prev:
+                        raise ValueError(
+                            f"{p}:{lineno}: column indices must be "
+                            f"zero-based and ascending (got {i} after "
+                            f"{prev})")
+                    prev = i
+                    row.append((i, float(v)))
+                labels.append(lab)
+                rows.append(row)
+    return labels, rows
+
+
+class LibSVMIter(DataIter):
+    """Sparse-data iterator over libsvm-format text files; batches come
+    back as CSRNDArray (reference: src/io/iter_libsvm.cc:200
+    ``MXNET_REGISTER_IO_ITER(LibSVMIter)``).
+
+    ``data_libsvm`` may be a file or a directory (all files read, sorted).
+    When ``label_libsvm`` is not given, labels are the leading dense
+    values on each data line. Only ``round_batch=True`` semantics are
+    supported, as in the reference: a final partial batch wraps around to
+    the beginning of the data, and ``getpad()`` reports the wrapped
+    count. ``num_parts``/``part_index`` split rows contiguously.
+    """
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True,
+                 num_parts=1, part_index=0, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        if not round_batch:
+            raise ValueError(
+                "LibSVMIter only supports round_batch=True "
+                "(reference: src/io/iter_libsvm.cc round_batch check)")
+        if len(tuple(data_shape)) != 1:
+            raise ValueError("data_shape must be 1-D (num features)")
+        self._num_features = int(tuple(data_shape)[0])
+        labels, rows = _parse_libsvm(data_libsvm,
+                                     with_label=label_libsvm is None)
+        if label_libsvm is not None:
+            lab2, lrows = _parse_libsvm(label_libsvm, with_label=True)
+            if label_shape and len(tuple(label_shape)) == 1 and \
+                    tuple(label_shape)[0] > 1:
+                # dense multi-value label rows come from the sparse
+                # cols; a bare leading value only covers rows with no
+                # sparse entries
+                L = int(tuple(label_shape)[0])
+                dense = _np.zeros((len(lrows), L), _np.float32)
+                for r, row in enumerate(lrows):
+                    if lab2[r] and not row:
+                        dense[r, 0] = lab2[r][0]
+                    for i, v in row:
+                        dense[r, i] = v
+                self._labels = dense
+            else:
+                # scalar labels: a bare leading value or a sparse 0:v
+                # entry both denote the label
+                self._labels = _np.asarray(
+                    [l[0] if l else (row[0][1] if row else 0.0)
+                     for l, row in zip(lab2, lrows)], _np.float32)
+        else:
+            self._labels = _np.asarray(
+                [l[0] if l else 0.0 for l in labels], _np.float32)
+        if len(self._labels) != len(rows):
+            raise ValueError(
+                f"label rows ({len(self._labels)}) != data rows "
+                f"({len(rows)})")
+        # partition (not guaranteed even, like the reference)
+        n = len(rows)
+        if num_parts > 1:
+            # even split: every part gets floor/ceil rows, so no worker
+            # comes up empty while n >= num_parts
+            lo = part_index * n // num_parts
+            hi = (part_index + 1) * n // num_parts
+            rows = rows[lo:hi]
+            self._labels = self._labels[lo:hi]
+            n = len(rows)
+        if n == 0:
+            raise ValueError(f"no rows in {data_libsvm}")
+        for row in rows:
+            for i, _ in row:
+                if i >= self._num_features:
+                    raise ValueError(
+                        f"feature index {i} >= data_shape {data_shape}")
+        self._rows = rows
+        self._num_rows = n
+        self._data_name = data_name
+        self._label_name = label_name
+        self._cursor = 0
+        self._pad = 0
+        self._batch = None
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size, self._num_features))]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) + tuple(self._labels.shape[1:])
+        return [DataDesc(self._label_name, shp)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def iter_next(self):
+        if self._cursor >= self._num_rows:
+            return False
+        idx = [(self._cursor + k) % self._num_rows
+               for k in range(self.batch_size)]
+        self._pad = max(0, self._cursor + self.batch_size
+                        - self._num_rows)
+        self._cursor += self.batch_size
+        values, indices, indptr = [], [], [0]
+        for r in idx:
+            for i, v in self._rows[r]:
+                indices.append(i)
+                values.append(v)
+            indptr.append(len(values))
+        from ..ndarray.sparse import CSRNDArray
+        csr = CSRNDArray(
+            _np.asarray(values, _np.float32),
+            _np.asarray(indptr, _np.int64),
+            _np.asarray(indices, _np.int64),
+            (self.batch_size, self._num_features))
+        self._batch = (csr, nd_array(self._labels[idx]))
+        return True
+
+    def getdata(self):
+        return [self._batch[0]]
+
+    def getlabel(self):
+        return [self._batch[1]]
+
+    def getpad(self):
+        return self._pad
+
+
 def _pop_mean_std(kwargs):
     """mean_r/g/b + std_r/g/b channel kwargs -> (mean, std) tuples."""
     mean = std = None
@@ -444,6 +618,8 @@ def MXDataIter(iter_name, *args, **kwargs):
         return ImageIter(*args, **kwargs)
     if name == "CSVIter":
         return CSVIter(*args, **kwargs)
+    if name == "LibSVMIter":
+        return LibSVMIter(*args, **kwargs)
     if name in ("NDArrayIter", "MNISTIter"):
         return NDArrayIter(*args, **kwargs)
     raise MXNetError(

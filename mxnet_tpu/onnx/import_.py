@@ -39,17 +39,29 @@ def _parse_attrs(node_msg):
 
 def _parse_tensor(raw):
     t = P.decode(raw)
-    dims = [int(d) for d in t.get(1, [])]
-    if len(dims) == 1 and isinstance(dims[0], bytes):
-        dims = P.decode_packed_varints(dims[0])
+    # proto3 packs repeated int64 dims by default (one bytes blob);
+    # unpacked single-varint-per-field also appears in the wild
+    dims = []
+    for d in t.get(1, []):
+        if isinstance(d, bytes):
+            dims.extend(P.decode_packed_varints(d))
+        else:
+            dims.append(int(d))
     dt = _DT_NP[t.get(2, [TP_FLOAT])[0]]
     name = t.get(8, [b""])[0].decode()
     if 9 in t:                      # raw_data
         arr = _np.frombuffer(t[9][0], dt).reshape(dims)
-    elif 4 in t:                    # float_data
-        arr = _np.asarray(t[4], _np.float32).reshape(dims)
-    elif 7 in t:                    # int64_data
-        arr = _np.asarray(t[7], _np.int64).reshape(dims)
+    elif 4 in t:                    # float_data (packed or unpacked)
+        vals = t[4]
+        if vals and isinstance(vals[0], bytes):
+            vals = _np.concatenate(
+                [_np.frombuffer(v, "<f4") for v in vals])
+        arr = _np.asarray(vals, _np.float32).reshape(dims)
+    elif 7 in t:                    # int64_data (packed or unpacked)
+        vals = t[7]
+        if vals and isinstance(vals[0], bytes):
+            vals = [v for b in vals for v in P.decode_packed_varints(b)]
+        arr = _np.asarray(vals, _np.int64).reshape(dims)
     else:
         arr = _np.zeros(dims, dt)
     return name, arr

@@ -692,6 +692,14 @@ def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
     blank_label='last'). Gradient comes from JAX AD through the scan —
     no hand-written backward as in warp-ctc.
     """
+    # lengths arrive as params (not tensor inputs — symbol/register.py
+    # declares only data/label), so they may still be NDArrays: unwrap.
+    if data_lengths is not None:
+        data_lengths = jnp.asarray(
+            getattr(data_lengths, "_data", data_lengths)).astype(jnp.int32)
+    if label_lengths is not None:
+        label_lengths = jnp.asarray(
+            getattr(label_lengths, "_data", label_lengths)).astype(jnp.int32)
     T, B, A = data.shape
     logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
     blank = 0 if blank_label == "first" else A - 1

@@ -157,3 +157,87 @@ def test_launcher_tears_down_group_on_rank_failure(tmp_path):
     elapsed = _time.monotonic() - t0
     assert rc == 3, f"expected the dead rank's code, got {rc}"
     assert elapsed < 60, f"teardown took {elapsed:.0f}s (no fail-fast)"
+
+
+_MH_WORKER = textwrap.dedent("""
+    import os, sys, hashlib
+    import numpy as np
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, "__REPO__")
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    world = int(os.environ["MXNET_TPU_NUM_WORKERS"])
+    rank = int(os.environ["MXNET_TPU_RANK"])
+    host_rank = int(os.environ["TEST_HOST_RANK"])
+    per_host = int(os.environ["TEST_PER_HOST"])
+    # host-major rank assignment (reference: tools/launch.py:29 dmlc
+    # tracker hands worker ids out per host)
+    assert rank // per_host == host_rank, (rank, host_rank)
+    assert world == 2 * per_host, world
+
+    from mxnet_tpu.kvstore.tpu import init_process_group
+    init_process_group()
+    assert jax.process_count() == world, jax.process_count()
+
+    kv = mx.kv.create("dist_sync")
+    base = np.arange(8, dtype=np.float32)
+    kv.init("w", nd.array(np.zeros(8, np.float32)))
+    kv.push("w", nd.array(base * (rank + 1)))
+    out = nd.array(np.zeros(8, np.float32))
+    kv.pull("w", out=out)
+    got = out.asnumpy()
+    expect = base * sum(r + 1 for r in range(world))
+    np.testing.assert_array_equal(got, expect)
+    kv.barrier()
+    h = hashlib.sha256(np.ascontiguousarray(got).tobytes()).hexdigest()
+    with open(os.path.join("__OUT__", f"mh_result_{rank}.txt"), "w") as f:
+        f.write(f"rank={rank} hash={h}\\n")
+""")
+
+
+def test_multihost_launcher_emulation(tmp_path, monkeypatch):
+    """Two launcher invocations on one box emulate a 2-host x 2-proc
+    cluster sharing a coordinator (reference: tools/launch.py:29 ssh
+    bring-up, one `launch.py -n 2` per host): host-major rank
+    assignment and a byte-exact 4-way reduce across both "hosts"."""
+    import threading as _threading
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "mh_worker.py"
+    script.write_text(_MH_WORKER.replace("__REPO__", repo)
+                      .replace("__OUT__", str(tmp_path)))
+    monkeypatch.syspath_prepend(repo)
+    from mxnet_tpu.launch import launch, _free_port
+
+    per_host = 2
+    coordinator = f"127.0.0.1:{_free_port()}"
+    rcs = {}
+
+    def one_host(host_rank):
+        rcs[host_rank] = launch(
+            per_host, [sys.executable, str(script)],
+            coordinator=coordinator, num_hosts=2, host_rank=host_rank,
+            cpu=True, timeout=420,
+            env_extra={"TEST_HOST_RANK": str(host_rank),
+                       "TEST_PER_HOST": str(per_host)})
+
+    threads = [_threading.Thread(target=one_host, args=(k,))
+               for k in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rcs == {0: 0, 1: 0}, f"launcher rcs: {rcs}"
+
+    hashes = set()
+    for r in range(2 * per_host):
+        f = tmp_path / f"mh_result_{r}.txt"
+        assert f.exists(), f"rank {r} wrote no result"
+        hashes.add(f.read_text().split("hash=")[1].strip())
+    assert len(hashes) == 1, "ranks diverged across hosts"

@@ -1,0 +1,140 @@
+"""LibSVMIter: sparse libsvm text -> CSR batches.
+
+Reference: src/io/iter_libsvm.cc:200 (MXNET_REGISTER_IO_ITER(LibSVMIter));
+the first test is the reference docstring example, pinned exactly.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.sparse import CSRNDArray
+
+DOC_EXAMPLE = """1.0 0:0.5 2:1.2
+-2.0
+-3.0 0:0.6 1:2.4 2:1.2
+4 2:-1.2
+"""
+
+
+@pytest.fixture
+def doc_file(tmp_path):
+    p = tmp_path / "data.t"
+    p.write_text(DOC_EXAMPLE)
+    return str(p)
+
+
+def test_reference_docstring_example(doc_file):
+    it = mx.io.LibSVMIter(data_libsvm=doc_file, data_shape=(3,),
+                          batch_size=3)
+    b = next(it)
+    assert isinstance(b.data[0], CSRNDArray)
+    np.testing.assert_array_equal(
+        b.data[0].asnumpy(),
+        np.array([[0.5, 0.0, 1.2], [0.0, 0.0, 0.0], [0.6, 2.4, 1.2]],
+                 np.float32))
+    np.testing.assert_array_equal(b.label[0].asnumpy(), [1.0, -2.0, -3.0])
+    b2 = next(it)
+    # round_batch: wraps to the beginning, pad reports wrapped rows
+    np.testing.assert_array_equal(
+        b2.data[0].asnumpy(),
+        np.array([[0.0, 0.0, -1.2], [0.5, 0.0, 1.2], [0.0, 0.0, 0.0]],
+                 np.float32))
+    np.testing.assert_array_equal(b2.label[0].asnumpy(), [4.0, 1.0, -2.0])
+    assert b2.pad == 2
+    with pytest.raises(StopIteration):
+        next(it)
+    it.reset()
+    again = next(it)
+    np.testing.assert_array_equal(again.label[0].asnumpy(),
+                                  [1.0, -2.0, -3.0])
+
+
+def test_separate_label_file(tmp_path):
+    d = tmp_path / "d.t"
+    d.write_text("0 1:2.0\n0 0:1.0\n")
+    lf = tmp_path / "l.t"
+    lf.write_text("0:1.0 2:3.0\n1.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(d), data_shape=(2,),
+                          label_libsvm=str(lf), label_shape=(3,),
+                          batch_size=2)
+    b = next(it)
+    # sparse cols populate the dense label row; a bare value fills col 0
+    np.testing.assert_array_equal(
+        b.label[0].asnumpy(), [[1.0, 0.0, 3.0], [1.5, 0.0, 0.0]])
+    np.testing.assert_array_equal(
+        b.data[0].asnumpy(), [[0.0, 2.0], [1.0, 0.0]])
+
+
+def test_num_parts_partition(doc_file):
+    seen = []
+    for part in range(2):
+        it = mx.io.LibSVMIter(data_libsvm=doc_file, data_shape=(3,),
+                              batch_size=2, num_parts=2, part_index=part)
+        seen.extend(next(it).label[0].asnumpy().tolist())
+    assert sorted(seen) == [-3.0, -2.0, 1.0, 4.0]
+
+
+def test_directory_input(tmp_path):
+    (tmp_path / "a.t").write_text("1.0 0:1.0\n")
+    (tmp_path / "b.t").write_text("2.0 1:2.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(tmp_path), data_shape=(2,),
+                          batch_size=2)
+    b = next(it)
+    np.testing.assert_array_equal(b.data[0].asnumpy(),
+                                  [[1.0, 0.0], [0.0, 2.0]])
+    np.testing.assert_array_equal(b.label[0].asnumpy(), [1.0, 2.0])
+
+
+def test_provide_data_and_mxdataiter_dispatch(doc_file):
+    it = mx.io.MXDataIter("LibSVMIter", data_libsvm=doc_file,
+                          data_shape=(3,), batch_size=2)
+    assert it.provide_data[0].shape == (2, 3)
+    assert it.provide_label[0].shape == (2,)
+    assert isinstance(next(it).data[0], CSRNDArray)
+
+
+def test_malformed_input_rejected(tmp_path):
+    bad = tmp_path / "bad.t"
+    bad.write_text("1.0 2:1.0 1:2.0\n")  # non-ascending indices
+    with pytest.raises(ValueError, match="ascending"):
+        mx.io.LibSVMIter(data_libsvm=str(bad), data_shape=(3,),
+                         batch_size=1)
+    oob = tmp_path / "oob.t"
+    oob.write_text("1.0 5:1.0\n")
+    with pytest.raises(ValueError, match="feature index"):
+        mx.io.LibSVMIter(data_libsvm=str(oob), data_shape=(3,),
+                         batch_size=1)
+    with pytest.raises(ValueError, match="round_batch"):
+        mx.io.LibSVMIter(data_libsvm=str(tmp_path / "bad.t"),
+                         data_shape=(3,), batch_size=1,
+                         round_batch=False)
+
+
+def test_scalar_labels_in_sparse_form(tmp_path):
+    d = tmp_path / "d.t"
+    d.write_text("0:1.0\n1:2.0\n")
+    lf = tmp_path / "l.t"
+    lf.write_text("0:1.5\n0:2.5\n")  # labels as sparse 0:v entries
+    it = mx.io.LibSVMIter(data_libsvm=str(d), data_shape=(2,),
+                          label_libsvm=str(lf), batch_size=2)
+    np.testing.assert_array_equal(next(it).label[0].asnumpy(),
+                                  [1.5, 2.5])
+
+
+def test_num_parts_no_empty_part(tmp_path):
+    f = tmp_path / "d.t"
+    f.write_text("".join(f"{i}.0 0:1.0\n" for i in range(5)))
+    got = []
+    for part in range(4):  # 5 rows over 4 parts: every part non-empty
+        it = mx.io.LibSVMIter(data_libsvm=str(f), data_shape=(1,),
+                              batch_size=1, num_parts=4, part_index=part)
+        it_labels = []
+        while True:
+            try:
+                b = next(it)
+            except StopIteration:
+                break
+            if b.pad == 0:
+                it_labels.extend(b.label[0].asnumpy().tolist())
+        got.extend(it_labels)
+    assert sorted(got) == [0.0, 1.0, 2.0, 3.0, 4.0]

@@ -1,0 +1,227 @@
+"""Thread-safe concurrent inference.
+
+The reference ships a dedicated thread-safe cached op for multi-threaded
+serving (reference: src/imperative/cached_op_threadsafe.h:82, exercised
+by tests/cpp/thread_safety/thread_safety_test.cc): N C++ threads drive
+one CachedOp concurrently and outputs must match single-threaded runs.
+
+Here the compiled (post-trace) CachedOp path is lock-free — jax compiled
+executables are thread-safe — and only the first-call trace serializes
+(gluon/block.py CachedOp._trace_lock). These tests pin that contract:
+outputs from N Python threads hammering one hybridized net are
+bit-identical to serial execution, including when the very first call
+(the trace) races, and when two jit signatures race.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+N_THREADS = 4
+N_ITERS = 3
+
+
+def _make_net(seed=0):
+    mx.random.seed(seed)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
+    return net
+
+
+def _inputs(n, batch=2, size=16, seed=123):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal((batch, 3, size, size)).astype("float32")
+            for _ in range(n)]
+
+
+def _run_threads(n_threads, worker):
+    barrier = threading.Barrier(n_threads)
+    errors = []
+    threads = []
+
+    def wrapped(tid):
+        try:
+            barrier.wait()
+            worker(tid)
+        except BaseException:  # pragma: no cover - failure path
+            import traceback
+            errors.append((tid, traceback.format_exc()))
+
+    for t in range(n_threads):
+        th = threading.Thread(target=wrapped, args=(t,))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    assert not errors, f"worker exceptions: {errors}"
+
+
+def test_concurrent_inference_matches_serial():
+    net = _make_net()
+    xs = _inputs(N_THREADS)
+    serial = [net(nd.array(x)).asnumpy() for x in xs]  # also warms the jit
+
+    results = [[None] * N_ITERS for _ in range(N_THREADS)]
+
+    def worker(tid):
+        for i in range(N_ITERS):
+            results[tid][i] = net(nd.array(xs[tid])).asnumpy()
+
+    _run_threads(N_THREADS, worker)
+    for tid in range(N_THREADS):
+        for i in range(N_ITERS):
+            np.testing.assert_array_equal(results[tid][i], serial[tid])
+
+
+def test_concurrent_first_call_trace_races():
+    """The FIRST call from every thread simultaneously: the trace itself
+    races. All outputs must still be bit-identical to a serial run."""
+    ref_net = _make_net(seed=1)
+    xs = _inputs(N_THREADS, seed=7)
+    expected = [ref_net(nd.array(x)).asnumpy() for x in xs]
+
+    net = _make_net(seed=1)  # same seed -> identical params, cold jit
+    results = [None] * N_THREADS
+
+    def worker(tid):
+        results[tid] = net(nd.array(xs[tid])).asnumpy()
+
+    _run_threads(N_THREADS, worker)
+    for tid in range(N_THREADS):
+        np.testing.assert_array_equal(results[tid], expected[tid])
+
+
+def test_concurrent_mixed_signatures():
+    """Different batch shapes concurrently -> distinct jit signatures
+    being traced/executed at once."""
+    net = _make_net(seed=2)
+    shapes = [(1, 3, 16, 16), (2, 3, 16, 16), (3, 3, 16, 16),
+              (1, 3, 16, 16)]
+    rng = np.random.default_rng(5)
+    xs = [rng.standard_normal(s).astype("float32") for s in shapes]
+
+    results = [None] * len(shapes)
+
+    def worker(tid):
+        results[tid] = net(nd.array(xs[tid])).asnumpy()
+
+    _run_threads(len(shapes), worker)
+    serial = [net(nd.array(x)).asnumpy() for x in xs]
+    for tid in range(len(shapes)):
+        np.testing.assert_array_equal(results[tid], serial[tid])
+
+
+def test_trace_state_is_thread_local():
+    """An eager forward in one thread while another thread traces must
+    not observe tracer-backed parameter data."""
+    from mxnet_tpu.gluon import nn
+
+    class Slow(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.dense = nn.Dense(8)
+
+        def hybrid_forward(self, F, x, **params):
+            y = self.dense(x)
+            for _ in range(30):  # fat trace: widen the race window
+                y = y * 1.0 + 0.0
+            return y
+
+    mx.random.seed(3)
+    net = Slow()
+    net.initialize()
+    x = np.ones((2, 8), "float32")
+    eager_net_ok = []
+
+    def tracer(tid):
+        if tid == 0:
+            net.hybridize()
+            net(nd.array(x))
+        else:
+            for _ in range(20):
+                # plain (non-hybridized second net) eager math sharing
+                # the global rng/trace machinery
+                v = (nd.array(x) * 2.0).asnumpy()
+                eager_net_ok.append(bool(np.all(v == 2.0)))
+
+    _run_threads(2, tracer)
+    assert all(eager_net_ok)
+
+
+def test_recording_thread_unaffected_by_concurrent_trace():
+    """Thread A records a training step while thread B triggers a
+    first-call trace (whose pure() runs under autograd.pause): A's tape
+    must still capture gradients — autograd mode is thread-local
+    (reference: src/imperative/imperative.h thread_local is_recording_)."""
+    from mxnet_tpu.gluon import nn
+    import mxnet_tpu.autograd as ag
+
+    mx.random.seed(4)
+    traced = nn.HybridSequential()
+    with traced.name_scope():
+        traced.add(nn.Dense(64, activation="relu"), nn.Dense(64))
+    traced.initialize()
+    traced.hybridize()
+
+    grads = []
+    start = threading.Barrier(2)
+
+    def train_worker():
+        x = nd.array(np.ones((4, 8), "float32"))
+        x.attach_grad()
+        start.wait()
+        for _ in range(20):
+            with ag.record():
+                y = (x * x).sum()
+            y.backward()
+            grads.append(x.grad.asnumpy().copy())
+
+    def trace_worker():
+        start.wait()
+        for i in range(1, 5):
+            # each batch size is a fresh jit signature -> fresh trace,
+            # each trace wraps pure() in autograd.pause()
+            traced(nd.array(np.ones((i, 8), "float32")))
+
+    ths = [threading.Thread(target=train_worker),
+           threading.Thread(target=trace_worker)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert len(grads) == 20
+    for g in grads:
+        np.testing.assert_array_equal(
+            g, np.full((4, 8), 2.0, "float32"))
+
+
+def test_attach_grad_main_thread_backward_worker_thread():
+    """Leaves are process-global even though the autograd graph is
+    per-thread: params attached on the main thread get gradients from a
+    backward() run in a worker thread (the reference's AGInfo lives on
+    the NDArray itself, not in thread state)."""
+    import mxnet_tpu.autograd as ag
+
+    x = nd.array(np.ones(3, "float32"))
+    x.attach_grad()
+    done = []
+
+    def worker():
+        with ag.record():
+            y = (x * 2).sum()
+        y.backward()
+        done.append(True)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert done
+    np.testing.assert_array_equal(x.grad.asnumpy(),
+                                  np.full(3, 2.0, "float32"))
