@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Variable-length word-level LM with BucketingModule — PTB-style.
+
+Reference example: example/rnn/bucketing/lstm_bucketing.py
+(BucketSentenceIter + stacked LSTM symbol per bucket, shared params,
+Perplexity with ignore_label). Same shape here, zero egress: sentences
+come from an embedded corpus, each batch is assigned to the smallest
+bucket that fits, and `BucketingModule` generates/bind-shares one
+symbolic LSTM program per bucket length.
+
+TPU-first notes: each bucket key is one static-shape jitted program
+(bucketing exists precisely because XLA wants static shapes); params
+are shared across buckets by the module, so switching buckets never
+re-initializes. The LSTM is the fused lax.scan `sym.RNN` op.
+
+  python examples/bucketing_lm.py --epochs 5
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+
+CORPUS = """
+the cat sat on the mat
+a quick brown fox jumps over the lazy dog near the river bank
+we hold these truths to be self evident
+the rain in spain stays mainly in the plain
+to be or not to be that is the question asked by the prince
+all that glitters is not gold and all who wander are not lost
+the early bird catches the worm but the second mouse gets the cheese
+a journey of a thousand miles begins with a single step forward
+ask not what your country can do for you
+time flies like an arrow and fruit flies like a banana
+the pen is mightier than the sword in the long run
+actions speak louder than words ever could
+practice makes perfect when patience guides the hand
+knowledge speaks but wisdom listens to the quiet voice within
+""".strip().splitlines() * 6
+
+PAD = 0  # reserved id; SoftmaxOutput ignores it via use_ignore
+
+
+def build_vocab(lines):
+    words = sorted({w for ln in lines for w in ln.split()})
+    return {w: i + 1 for i, w in enumerate(words)}  # 0 is PAD
+
+
+def bucketize(lines, vocab, buckets, batch_size, seed):
+    """Return {bucket: (data (N,T) int32, label (N,T) int32)} batches."""
+    per_bucket = {b: [] for b in buckets}
+    for ln in lines:
+        ids = [vocab[w] for w in ln.split()]
+        if len(ids) < 2:
+            continue
+        b = next((b for b in buckets if len(ids) <= b + 1), None)
+        if b is None:
+            ids = ids[:buckets[-1] + 1]
+            b = buckets[-1]
+        x = ids[:-1] + [PAD] * (b - len(ids) + 1)
+        y = ids[1:] + [PAD] * (b - len(ids) + 1)
+        per_bucket[b].append((x, y))
+    rng = np.random.default_rng(seed)
+    batches = []
+    for b, rows in per_bucket.items():
+        rng.shuffle(rows)
+        for i in range(0, len(rows) - batch_size + 1, batch_size):
+            chunk = rows[i:i + batch_size]
+            data = np.array([r[0] for r in chunk], np.int32)
+            label = np.array([r[1] for r in chunk], np.int32)
+            batches.append((b, data, label))
+    rng.shuffle(batches)
+    return batches
+
+
+def make_sym_gen(vocab_size, num_embed, num_hidden, num_layers):
+    """Per-bucket symbol. The LSTM carry (`lstm_state`/`lstm_state_cell`)
+    comes in as *data*, zeroed every batch — the reference bucketing
+    example feeds init states the same way (init_states as input data),
+    which keeps them out of the parameter set."""
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")            # (B, T) int ids
+        label = mx.sym.var("softmax_label")  # (B, T)
+        emb = mx.sym.Embedding(data, input_dim=vocab_size,
+                               output_dim=num_embed, name="embed")
+        tnc = mx.sym.swapaxes(emb, 0, 1)     # fused RNN is TNC
+        params = mx.sym.var("lstm_parameters")
+        init_h = mx.sym.var("lstm_state")
+        init_c = mx.sym.var("lstm_state_cell")
+        out = mx.sym.RNN(tnc, params, init_h, init_c, state_size=num_hidden,
+                         num_layers=num_layers, mode="lstm",
+                         state_outputs=False, name="lstm")
+        out = mx.sym.swapaxes(out, 0, 1)                 # (B, T, H)
+        out = mx.sym.Reshape(out, shape=(-1, num_hidden))
+        fc = mx.sym.FullyConnected(out, num_hidden=vocab_size, name="pred")
+        flat_label = mx.sym.Reshape(label, shape=(-1,))
+        sm = mx.sym.SoftmaxOutput(fc, flat_label, use_ignore=True,
+                                  ignore_label=PAD, name="softmax")
+        return sm, ("data", "lstm_state", "lstm_state_cell"), \
+            ("softmax_label",)
+    return sym_gen
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-embed", type=int, default=32)
+    ap.add_argument("--num-layers", type=int, default=1)
+    ap.add_argument("--buckets", type=str, default="6,9,12,16")
+    args = ap.parse_args()
+
+    buckets = sorted(int(b) for b in args.buckets.split(","))
+    vocab = build_vocab(CORPUS)
+    vocab_size = len(vocab) + 1
+    B = args.batch_size
+
+    mod = mx.mod.BucketingModule(
+        make_sym_gen(vocab_size, args.num_embed, args.num_hidden,
+                     args.num_layers),
+        default_bucket_key=buckets[-1])
+    state_shape = (args.num_layers, B, args.num_hidden)
+    mod.bind(data_shapes=[("data", (B, buckets[-1])),
+                          ("lstm_state", state_shape),
+                          ("lstm_state_cell", state_shape)],
+             label_shapes=[("softmax_label", (B, buckets[-1]))])
+    mx.random.seed(0)
+    # the fused-RNN parameter vector is 1D, so route it to Uniform and
+    # everything else to Xavier (reference uses init.FusedRNN / Mixed)
+    mod.init_params(initializer=mx.initializer.Mixed(
+        [".*lstm_parameters", ".*"],
+        [mx.initializer.Uniform(0.08), mx.initializer.Xavier()]))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 3e-3})
+
+    zero_state = mx.nd.zeros(state_shape)
+    metric = mx.metric.Perplexity(ignore_label=PAD)
+    for epoch in range(args.epochs):
+        batches = bucketize(CORPUS, vocab, buckets, B, seed=epoch)
+        metric.reset()
+        for bkey, data, label in batches:
+            batch = mx.io.DataBatch(
+                data=[mx.nd.array(data), zero_state, zero_state],
+                label=[mx.nd.array(label)],
+                bucket_key=bkey,
+                provide_data=[mx.io.DataDesc("data", (B, bkey)),
+                              mx.io.DataDesc("lstm_state", state_shape),
+                              mx.io.DataDesc("lstm_state_cell",
+                                             state_shape)],
+                provide_label=[mx.io.DataDesc("softmax_label", (B, bkey))])
+            mod.forward(batch, is_train=True)
+            out = mod.get_outputs()[0]
+            flat = mx.nd.array(np.asarray(label).reshape(-1))
+            metric.update([flat], [out])
+            mod.backward()
+            mod.update()
+        name, ppl = metric.get()
+        print(f"epoch {epoch}: buckets={sorted({b for b, _, _ in batches})} "
+              f"{name} {ppl:.2f}")
+
+    assert np.isfinite(ppl) and ppl < vocab_size, "LM did not learn"
+    print("final perplexity:", round(float(ppl), 2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

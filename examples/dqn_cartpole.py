@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Deep Q-Network on a built-in CartPole — reinforcement learning.
+
+Reference example: example/reinforcement-learning/dqn (replay memory,
+target network, epsilon-greedy exploration). The gym dependency is
+replaced by a 40-line numpy CartPole with the standard dynamics
+(Barto-Sutton-Anderson '83 equations, the same ones gym implements),
+so the example is hermetic.
+
+TPU-first notes: the Q-network forward and the TD update are each one
+jitted program (hybridized net + gluon Trainer); the replay buffer is a
+preallocated numpy ring on host — RL's per-step env interaction is
+inherently host-side, the device sees only fixed-shape minibatches.
+
+  python examples/dqn_cartpole.py --episodes 60
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+import mxnet_tpu.autograd as ag  # noqa: E402
+
+
+class CartPole:
+    """Classic cart-pole balancing, episode ends on |x|>2.4, |th|>12deg
+    or 200 steps. reward +1 per step survived."""
+
+    GRAV, MCART, MPOLE, LEN, FORCE, TAU = 9.8, 1.0, 0.1, 0.5, 10.0, 0.02
+    X_LIM, TH_LIM, MAX_STEPS = 2.4, 12 * np.pi / 180, 200
+
+    def __init__(self, seed):
+        self.rng = np.random.default_rng(seed)
+        self.reset()
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, size=4).astype(np.float32)
+        self.t = 0
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        force = self.FORCE if action == 1 else -self.FORCE
+        mtot = self.MCART + self.MPOLE
+        pml = self.MPOLE * self.LEN
+        cos, sin = np.cos(th), np.sin(th)
+        tmp = (force + pml * thd ** 2 * sin) / mtot
+        thacc = (self.GRAV * sin - cos * tmp) / \
+            (self.LEN * (4.0 / 3.0 - self.MPOLE * cos ** 2 / mtot))
+        xacc = tmp - pml * thacc * cos / mtot
+        self.s = np.array([x + self.TAU * xd, xd + self.TAU * xacc,
+                           th + self.TAU * thd, thd + self.TAU * thacc],
+                          np.float32)
+        self.t += 1
+        done = (abs(self.s[0]) > self.X_LIM
+                or abs(self.s[2]) > self.TH_LIM
+                or self.t >= self.MAX_STEPS)
+        return self.s.copy(), 1.0, done
+
+
+class QNet(gluon.HybridBlock):
+    def __init__(self, n_actions=2, hidden=64, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.h1 = nn.Dense(hidden, activation="relu")
+            self.h2 = nn.Dense(hidden, activation="relu")
+            self.out = nn.Dense(n_actions)
+
+    def hybrid_forward(self, F, x):
+        return self.out(self.h2(self.h1(x)))
+
+
+def copy_params(src, dst):
+    """Hard target-network update (reference dqn: copyto between the
+    policy and target executors)."""
+    sp, dp = src.collect_params(), dst.collect_params()
+    for ks, kd in zip(sorted(sp.keys()), sorted(dp.keys())):
+        dp[kd].set_data(sp[ks].data())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--buffer", type=int, default=10000)
+    ap.add_argument("--gamma", type=float, default=0.99)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--target-sync", type=int, default=200)
+    ap.add_argument("--min-mean-reward", type=float, default=0.0,
+                    help="exit nonzero unless trailing-10 mean >= this")
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    env = CartPole(seed=1)
+    qnet, tnet = QNet(), QNet()
+    for net in (qnet, tnet):
+        net.initialize(init=mx.initializer.Xavier())
+        net.hybridize()
+        net(nd.zeros((1, 4)))
+    copy_params(qnet, tnet)
+    trainer = gluon.Trainer(qnet.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.HuberLoss()
+
+    N, B = args.buffer, args.batch_size
+    buf_s = np.zeros((N, 4), np.float32)
+    buf_a = np.zeros((N,), np.int32)
+    buf_r = np.zeros((N,), np.float32)
+    buf_s2 = np.zeros((N, 4), np.float32)
+    buf_d = np.zeros((N,), np.float32)
+    size, head, steps = 0, 0, 0
+
+    rng = np.random.default_rng(0)
+    rewards = []
+    for ep in range(args.episodes):
+        s = env.reset()
+        total = 0.0
+        eps = max(0.05, 1.0 - ep / (0.6 * args.episodes))
+        while True:
+            if rng.random() < eps:
+                a = int(rng.integers(2))
+            else:
+                q = qnet(nd.array(s[None])).asnumpy()
+                a = int(q.argmax())
+            s2, r, done = env.step(a)
+            buf_s[head], buf_a[head] = s, a
+            buf_r[head], buf_s2[head] = r, s2
+            buf_d[head] = float(done and env.t < env.MAX_STEPS)
+            head = (head + 1) % N
+            size = min(size + 1, N)
+            s = s2
+            total += r
+            steps += 1
+
+            if size >= B:
+                idx = rng.integers(0, size, size=B)
+                st = nd.array(buf_s[idx])
+                a_t = buf_a[idx]
+                # TD target from the frozen network
+                q2 = tnet(nd.array(buf_s2[idx])).asnumpy().max(axis=1)
+                tgt = buf_r[idx] + args.gamma * q2 * (1.0 - buf_d[idx])
+                with ag.record():
+                    qall = qnet(st)
+                    onehot = nd.array(
+                        np.eye(2, dtype=np.float32)[a_t])
+                    qsel = (qall * onehot).sum(axis=1)
+                    loss = loss_fn(qsel, nd.array(tgt)).mean()
+                loss.backward()
+                trainer.step(B)
+            if steps % args.target_sync == 0:
+                copy_params(qnet, tnet)
+            if done:
+                break
+        rewards.append(total)
+        if (ep + 1) % 10 == 0:
+            print(f"episode {ep + 1}: reward {total:.0f} "
+                  f"mean10 {np.mean(rewards[-10:]):.1f} eps {eps:.2f}")
+
+    mean10 = float(np.mean(rewards[-10:]))
+    print(f"final mean10 reward: {mean10:.1f}")
+    if mean10 < args.min_mean_reward:
+        print(f"FAIL: {mean10:.1f} < {args.min_mean_reward}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -675,6 +675,7 @@ class HybridBlock(Block):
         super().__init__(prefix=prefix, params=params)
         self._active = False
         self._cached_op = None
+        self._cached_op_lock = threading.Lock()
         self._flags = {}
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
@@ -687,10 +688,15 @@ class HybridBlock(Block):
                           static_shape=static_shape, **kwargs)
 
     def _get_cached_op(self):
+        # double-checked: without the lock two threads' first calls
+        # would build two CachedOps with independent _trace_locks,
+        # un-serializing the first-trace warm-up they exist to guard
         if self._cached_op is None:
-            self._cached_op = CachedOp(self, **{
-                k: v for k, v in self._flags.items()
-                if k in ("static_alloc", "static_shape")})
+            with self._cached_op_lock:
+                if self._cached_op is None:
+                    self._cached_op = CachedOp(self, **{
+                        k: v for k, v in self._flags.items()
+                        if k in ("static_alloc", "static_shape")})
         return self._cached_op
 
     def infer_shape(self, *args):

@@ -97,6 +97,10 @@ class Parameter:
         # per-thread tracer-backed NDArray during CachedOp trace: thread A
         # tracing must not leak tracers into thread B's concurrent forward
         self._trace_tls = threading.local()
+        # serializes deferred init: two threads' first forwards must not
+        # both draw+write this parameter (pickling is via __reduce__, so
+        # the lock never reaches a pickle stream)
+        self._init_lock = threading.Lock()
         self.attributes = {}
         self._var = None
 
@@ -198,8 +202,13 @@ class Parameter:
     def _finish_deferred_init(self):
         if not self._deferred_init:
             return
+        with self._init_lock:
+            if not self._deferred_init:   # another thread finished it
+                return
+            self._finish_deferred_init_locked()
+
+    def _finish_deferred_init_locked(self):
         init, ctx, default_init, data = self._deferred_init
-        self._deferred_init = ()
         assert self._shape is not None and all(self._shape), \
             f"Parameter {self.name} has unresolved shape {self._shape}"
         if data is None:
@@ -216,15 +225,21 @@ class Parameter:
         else:
             data = data.asnumpy() if isinstance(data, NDArray) else data
         self._init_impl(data, ctx)
+        # cleared only after _data exists: a racing thread that saw
+        # _deferred_init truthy blocks on the lock, re-checks, returns
+        self._deferred_init = ()
 
     def _init_impl(self, data, ctx_list):
-        self._data = OrderedDict()
+        # build fully, then publish: concurrent readers must never see a
+        # partially-filled ctx map
+        filled = OrderedDict()
         for c in ctx_list:
             arr = NDArray(_np.asarray(data, dtype=dtype_np(self.dtype)),
                           ctx=c)
             if self._grad_req != "null":
                 arr.attach_grad(self._grad_req)
-            self._data[c] = arr
+            filled[c] = arr
+        self._data = filled
 
     # -------------------------------------------------------------- data --
     def _get_primary(self):

@@ -14,7 +14,8 @@ import os
 import subprocess
 import threading
 
-__all__ = ["recordio_lib", "imagepipe_lib", "native_enabled"]
+__all__ = ["recordio_lib", "imagepipe_lib", "native_enabled",
+           "predict_lib_path", "predict_header_path"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "recordio_native.cpp")
@@ -22,6 +23,9 @@ _BUILD = os.path.join(_DIR, "_build")
 _SO = os.path.join(_BUILD, "librecordio_native.so")
 _IP_SRC = os.path.join(_DIR, "src", "imagepipe_native.cpp")
 _IP_SO = os.path.join(_BUILD, "libimagepipe_native.so")
+_PRED_SRC = os.path.join(_DIR, "src", "predict_c.cpp")
+_PRED_SO = os.path.join(_BUILD, "libmxtpu_predict.so")
+_PRED_HDR = os.path.join(_DIR, "include", "mxtpu_predict.h")
 
 _lock = threading.Lock()
 _lib = "unset"
@@ -131,6 +135,39 @@ def imagepipe_lib():
         lib.ip_destroy.argtypes = [ctypes.c_void_p]
         _ip_lib = lib
         return lib
+
+
+def _python_build_flags():
+    """(include_flags, link_flags) for embedding this interpreter
+    (what `python3-config --includes --ldflags --embed` reports)."""
+    import sysconfig
+    inc = ["-I" + sysconfig.get_path("include")]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_python_version()
+    link = []
+    if libdir:
+        link += ["-L" + libdir, "-Wl,-rpath," + libdir]
+    link += ["-lpython" + ver, "-ldl", "-lm"]
+    return inc, link
+
+
+def predict_header_path():
+    """Path of mxtpu_predict.h for C/C++ hosts to #include."""
+    return _PRED_HDR
+
+
+def predict_lib_path():
+    """Compile (once) and return the path of libmxtpu_predict.so — the
+    embed-from-C predict shim (reference: c_predict_api). Raises on a
+    missing toolchain rather than silently degrading: a C host has no
+    Python fallback to fall back to."""
+    with _lock:
+        if (not os.path.exists(_PRED_SO)
+                or os.path.getmtime(_PRED_SO) < os.path.getmtime(_PRED_SRC)):
+            inc, link = _python_build_flags()
+            _compile(_PRED_SRC, _PRED_SO, extra=(*inc, *link))
+    return _PRED_SO
 
 
 class NativeRecordReader:

@@ -1,0 +1,66 @@
+/* mxtpu_predict.h — embed-from-C inference over mx.deploy artifacts.
+ *
+ * Reference analogue: include/mxnet/c_predict_api.h (MXPredCreate /
+ * MXPredSetInput / MXPredForward / MXPredGetOutput). The reference
+ * loads a symbol-JSON + param blob into its own C++ executor; the
+ * TPU-native artifact is a serialized StableHLO program with params
+ * baked in (see mxnet_tpu/deploy.py), executed by JAX. This shim
+ * embeds a CPython interpreter so a plain C/C++ host — no Python code
+ * written by the user — can run that artifact. The embedded
+ * interpreter needs only `jax` + `numpy` importable, not mxnet_tpu,
+ * mirroring the reference amalgamation story (framework-free serving).
+ *
+ * All functions return 0 on success, -1 on failure;
+ * MXTpuPredGetLastError() describes the most recent failure.
+ * Handles are NOT thread-safe; create one per thread (the reference's
+ * MXPredCreateMultiThread contract) — the shim serializes interpreter
+ * access through the GIL internally.
+ */
+#ifndef MXTPU_PREDICT_H_
+#define MXTPU_PREDICT_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *MXTpuPredictorHandle;
+
+/* Load a .mxtpu artifact (written by mx.deploy.export_predictor).
+ * Initializes the embedded interpreter on first use. */
+int MXTpuPredCreate(const char *artifact_path, MXTpuPredictorHandle *out);
+
+/* Input geometry, parsed from the artifact header.
+ * *shape points at handle-owned memory, valid until MXTpuPredFree. */
+int MXTpuPredGetInputShape(MXTpuPredictorHandle h, const int64_t **shape,
+                           int *ndim);
+
+/* Run the program on `size` floats (must equal the input element
+ * count; the artifact's own dtype conversion is applied inside). */
+int MXTpuPredForward(MXTpuPredictorHandle h, const float *data, size_t size);
+
+/* Number of outputs of the last Forward. */
+int MXTpuPredGetNumOutputs(MXTpuPredictorHandle h, int *num);
+
+/* Shape of output `index` from the last Forward; handle-owned memory,
+ * valid until the next Forward or Free. */
+int MXTpuPredGetOutputShape(MXTpuPredictorHandle h, unsigned index,
+                            const int64_t **shape, int *ndim);
+
+/* Copy output `index` (as float32) into caller memory of `size`
+ * elements; `size` must equal the output element count. */
+int MXTpuPredGetOutput(MXTpuPredictorHandle h, unsigned index, float *data,
+                       size_t size);
+
+/* Last error message (thread-local static buffer, never NULL). */
+const char *MXTpuPredGetLastError(void);
+
+void MXTpuPredFree(MXTpuPredictorHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_PREDICT_H_ */

@@ -619,6 +619,18 @@ def _deduce_param_shapes(node, node_out, shape_of):
         put(1, data_shape)
     elif op == "LeakyReLU" and p.get("act_type") == "prelu":
         put(1, (data_shape[1],))
+    elif op == "RNN":
+        # reference: src/operator/rnn.cc RNNShape — parameters is the
+        # flat CuDNN-layout vector, states are (L*D, N, H); data is TNC
+        from ..ops.rnn import rnn_param_size
+        h = p.get("state_size", 0)
+        nl = p.get("num_layers", 1)
+        bi = bool(p.get("bidirectional", False))
+        mode = p.get("mode", "lstm")
+        put(1, (rnn_param_size(data_shape[-1], h, nl, mode, bi),))
+        state_shape = (nl * (2 if bi else 1), data_shape[1], h)
+        put(2, state_shape)
+        put(3, state_shape)
 
 
 _NAME_COUNTER: Dict[str, int] = {}

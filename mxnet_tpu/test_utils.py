@@ -23,7 +23,8 @@ from . import autograd
 __all__ = ["default_context", "rand_ndarray", "assert_almost_equal",
            "numeric_grad", "check_numeric_gradient",
            "check_eager_jit_consistency", "check_consistency", "same",
-           "almost_equal"]
+           "almost_equal", "check_symbolic_forward",
+           "check_symbolic_backward"]
 
 
 def default_context():
@@ -138,6 +139,105 @@ def check_numeric_gradient(op_name, inputs, kwargs=None, rtol=1e-2,
             arrs[i].grad, expected[i], rtol=rtol, atol=atol,
             names=(f"autograd_d{op_name if isinstance(op_name, str) else 'f'}"
                    f"/dx{i}", "numeric"))
+
+
+def _symbol_location(sym, location):
+    """Normalize the reference's location convention: a dict of
+    name->array, or a positional list matching list_arguments()."""
+    arg_names = sym.list_arguments()
+    if isinstance(location, dict):
+        unknown = set(location) - set(arg_names)
+        if unknown:
+            raise ValueError(f"location names {sorted(unknown)} are not "
+                             f"arguments of the symbol {arg_names}")
+        missing = set(arg_names) - set(location)
+        if missing:
+            raise ValueError(f"location is missing arrays for arguments "
+                             f"{sorted(missing)}")
+        loc = location
+    else:
+        if len(location) != len(arg_names):
+            raise ValueError(
+                f"expected {len(arg_names)} positional arrays for "
+                f"{arg_names}, got {len(location)}")
+        loc = dict(zip(arg_names, location))
+    return {k: (v if isinstance(v, NDArray) else nd.array(np.asarray(v)))
+            for k, v in loc.items()}
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-6,
+                           aux_states=None, equal_nan=False):
+    """Bind a symbol, run forward, compare each output against
+    ``expected`` (reference: test_utils.py:1130 check_symbolic_forward).
+
+    location: dict name->array or positional list; expected: list of
+    numpy arrays (one per output). Returns the outputs as numpy.
+    """
+    loc = _symbol_location(sym, location)
+    exe = sym.bind(args=loc, grad_req="null",
+                   aux_states={k: nd.array(np.asarray(v))
+                               for k, v in (aux_states or {}).items()})
+    outputs = exe.forward(is_train=False)
+    if len(outputs) != len(expected):
+        raise AssertionError(
+            f"symbol has {len(outputs)} outputs, expected list has "
+            f"{len(expected)}")
+    for i, (out, exp) in enumerate(zip(outputs, expected)):
+        assert_almost_equal(out, exp, rtol=rtol, atol=atol,
+                            names=(f"output[{i}]", f"expected[{i}]"),
+                            equal_nan=equal_nan)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-6, aux_states=None, grad_req="write",
+                            equal_nan=False):
+    """Bind a symbol, run forward+backward with ``out_grads``, compare
+    each requested input gradient against ``expected`` (reference:
+    test_utils.py:1187 check_symbolic_backward).
+
+    expected: dict name->array (only those names are checked) or a
+    positional list over list_arguments(). grad_req: str or dict; args
+    with req "null" are skipped. Returns the gradients as a dict.
+    """
+    loc = _symbol_location(sym, location)
+    arg_names = sym.list_arguments()
+    if isinstance(expected, dict):
+        unknown = set(expected) - set(arg_names)
+        if unknown:
+            raise ValueError(f"expected-grad names {sorted(unknown)} are "
+                             f"not arguments of the symbol {arg_names}")
+    else:
+        if len(expected) != len(arg_names):
+            raise ValueError(
+                f"expected {len(arg_names)} positional grad arrays for "
+                f"{arg_names}, got {len(expected)}")
+        expected = dict(zip(arg_names, expected))
+    if isinstance(grad_req, str):
+        req_of = {n: grad_req for n in arg_names}
+    else:
+        req_of = {n: grad_req.get(n, "null") for n in arg_names}
+    args_grad = {n: nd.zeros(loc[n].shape) for n in arg_names
+                 if req_of[n] != "null"}
+    exe = sym.bind(args=loc, args_grad=args_grad, grad_req=req_of,
+                   aux_states={k: nd.array(np.asarray(v))
+                               for k, v in (aux_states or {}).items()})
+    exe.forward(is_train=True)
+    if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+        out_grads = [out_grads]
+    if out_grads is not None:
+        out_grads = [g if isinstance(g, NDArray) else nd.array(np.asarray(g))
+                     for g in out_grads]
+    exe.backward(out_grads)
+    grads = {n: g.asnumpy() for n, g in exe.grad_dict.items()
+             if req_of.get(n, "null") != "null"}
+    for name, exp in expected.items():
+        if req_of.get(name, "null") == "null":
+            continue
+        assert_almost_equal(grads[name], exp, rtol=rtol, atol=atol,
+                            names=(f"grad[{name}]", f"expected[{name}]"),
+                            equal_nan=equal_nan)
+    return grads
 
 
 def check_eager_jit_consistency(op_name, inputs, kwargs=None, rtol=1e-5,

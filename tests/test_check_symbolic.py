@@ -1,0 +1,115 @@
+"""check_symbolic_forward / check_symbolic_backward oracles.
+
+Reference: python/mxnet/test_utils.py:1130 (check_symbolic_forward) and
+:1187 (check_symbolic_backward) — used pervasively by the reference op
+tests to pin a symbol's executor outputs/input-grads against numpy.
+These tests exercise the helpers themselves: correct values pass,
+wrong values raise, grad_req routing is honored.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (check_symbolic_forward,
+                                  check_symbolic_backward)
+
+
+def test_forward_elemwise():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    s = a * b + 2.0
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    check_symbolic_forward(s, {"a": x, "b": y}, [x * y + 2.0])
+    # positional location form
+    check_symbolic_forward(s, [x, y], [x * y + 2.0])
+
+
+def test_forward_fc_detects_wrong_expectation():
+    d = mx.sym.var("data")
+    s = mx.sym.FullyConnected(d, num_hidden=3, name="fc")
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 5).astype(np.float32)
+    w = rng.randn(3, 5).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    expected = x @ w.T + b
+    check_symbolic_forward(s, {"data": x, "fc_weight": w, "fc_bias": b},
+                           [expected], rtol=1e-4, atol=1e-5)
+    with pytest.raises(AssertionError):
+        check_symbolic_forward(
+            s, {"data": x, "fc_weight": w, "fc_bias": b},
+            [expected + 0.1], rtol=1e-4, atol=1e-5)
+
+
+def test_backward_product_rule():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    s = a * b
+    rng = np.random.RandomState(3)
+    x = rng.randn(4,).astype(np.float32)
+    y = rng.randn(4,).astype(np.float32)
+    og = rng.randn(4,).astype(np.float32)
+    grads = check_symbolic_backward(
+        s, {"a": x, "b": y}, [og], {"a": og * y, "b": og * x})
+    assert set(grads) == {"a", "b"}
+
+
+def test_backward_grad_req_null_skips():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    s = a * b
+    x = np.ones((2, 2), np.float32)
+    y = np.full((2, 2), 3.0, np.float32)
+    og = np.ones((2, 2), np.float32)
+    grads = check_symbolic_backward(
+        s, {"a": x, "b": y}, [og], {"a": og * y},
+        grad_req={"a": "write", "b": "null"})
+    assert "b" not in grads
+    # an expectation for a null-req arg is ignored, not compared
+    check_symbolic_backward(
+        s, {"a": x, "b": y}, [og],
+        {"a": og * y, "b": np.full((2, 2), 123.0, np.float32)},
+        grad_req={"a": "write", "b": "null"})
+
+
+def test_backward_wrong_grad_detected():
+    a = mx.sym.var("a")
+    s = mx.sym.exp(a)
+    x = np.random.RandomState(4).randn(5,).astype(np.float32)
+    og = np.ones((5,), np.float32)
+    check_symbolic_backward(s, {"a": x}, [og], {"a": np.exp(x)},
+                            rtol=1e-4, atol=1e-5)
+    with pytest.raises(AssertionError):
+        check_symbolic_backward(s, {"a": x}, [og], {"a": np.exp(x) * 1.1},
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_location_validation():
+    a = mx.sym.var("a")
+    s = a + 1.0
+    with pytest.raises(ValueError):
+        check_symbolic_forward(s, {"nope": np.ones(2, np.float32)},
+                               [np.ones(2, np.float32)])
+    with pytest.raises(ValueError):
+        check_symbolic_forward(s, [np.ones(2), np.ones(2)],
+                               [np.ones(2, np.float32)])
+
+
+def test_expected_grad_validation():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    s = a * b
+    x = np.ones((2,), np.float32)
+    og = np.ones((2,), np.float32)
+    # a typo'd expected name must raise, not pass vacuously
+    with pytest.raises(ValueError):
+        check_symbolic_backward(s, {"a": x, "b": x}, [og],
+                                {"a_typo": og})
+    # a positional expected list must cover every argument
+    with pytest.raises(ValueError):
+        check_symbolic_backward(s, {"a": x, "b": x}, [og], [og])

@@ -25,6 +25,13 @@ CASES = [
     ("train_mnist_mlp.py", ["--epochs", "1", "--batch-size", "32"]),
     ("char_lstm.py", ["--epochs", "1", "--seq-len", "8",
                       "--batch-size", "4"]),
+    ("lstm_ocr.py", ["--epochs", "1", "--num-samples", "32",
+                     "--batch-size", "16", "--width", "24"]),
+    ("dqn_cartpole.py", ["--episodes", "6", "--batch-size", "32"]),
+    ("multi_task.py", ["--epochs", "1", "--num-samples", "128",
+                       "--batch-size", "32"]),
+    ("bucketing_lm.py", ["--epochs", "1", "--batch-size", "4",
+                         "--buckets", "6,9"]),
     ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
                            "--num-classes", "4", "--image-shape",
                            "3,16,16", "--batch-size", "4",

@@ -48,8 +48,8 @@ TRACE_ROOT = os.path.join(REPO, "perf_traces")
 # parity protocol). Each entry: (tag, env overrides).
 CONFIGS = [
     ("bs128_bf16_nhwc", {}),
-    ("bs256_bf16_nhwc", {"BENCH_BATCH": "256"}),
     ("bs128_bf16_nhwc_bnfuse", {"MXNET_TPU_BN_FUSED_BWD": "1"}),
+    ("bs256_bf16_nhwc", {"BENCH_BATCH": "256"}),
     ("bs256_bf16_nhwc_bnfuse", {"BENCH_BATCH": "256",
                                 "MXNET_TPU_BN_FUSED_BWD": "1"}),
 ]
@@ -111,7 +111,28 @@ def run_bench(tag, env_overrides, timeout_s=1500):
 
 def _is_valid(rec):
     return (rec is not None and rec.get("value") is not None
-            and not rec.get("suspect") and "skipped" not in rec)
+            and not rec.get("suspect") and not rec.get("skipped"))
+
+
+def _captured_tags():
+    """Config tags that already produced a valid capture (from the
+    append-only log), so later windows spend their time on the
+    still-unmeasured lever configs instead of re-measuring."""
+    tags = set()
+    if not os.path.exists(LOG_PATH):
+        return tags
+    with open(LOG_PATH) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("event") == "bench" and rec.get("note") == "ok":
+                res = rec.get("result") or {}
+                if res.get("value") is not None and not res.get("suspect") \
+                        and not res.get("skipped"):
+                    tags.add(rec.get("tag"))
+    return tags
 
 
 def _maybe_update_best(rec):
@@ -132,10 +153,18 @@ def _maybe_update_best(rec):
 
 
 def capture_window():
-    """Tunnel is up: run the config queue until done or the tunnel dies."""
+    """Tunnel is up: run the config queue until done or the tunnel dies.
+    Already-captured configs are skipped; the big-batch configs get a
+    longer budget (XLA compile of the bs=256 program is slower)."""
     got_any = False
+    done = _captured_tags()
     for tag, env in CONFIGS:
-        rec, note = run_bench(tag, env)
+        if tag in done:
+            _log({"event": "bench_skip", "tag": tag,
+                  "note": "already captured"})
+            continue
+        rec, note = run_bench(tag, env,
+                              timeout_s=2400 if "256" in tag else 1500)
         entry = {"event": "bench", "tag": tag, "note": note}
         if rec is not None:
             entry["result"] = {k: rec.get(k) for k in
@@ -166,13 +195,17 @@ def main():
     deadline = time.time() + args.max_hours * 3600
     _log({"event": "start", "interval": args.interval,
           "max_hours": args.max_hours})
+    all_tags = {tag for tag, _ in CONFIGS}
     while time.time() < deadline:
+        if all_tags <= _captured_tags():
+            # every config has a valid capture and re-measurement is
+            # skipped — nothing left for this process to do
+            _log({"event": "all_captured"})
+            return
         info, err = probe(args.probe_timeout)
         if info is not None and info.get("platform") == "tpu":
             _log({"event": "tunnel_up", "kind": info.get("kind")})
             capture_window()
-            # after a full pass, keep polling — a later window with the
-            # same code can only improve the best record
             if args.once:
                 return
             time.sleep(max(args.interval, 600))
