@@ -55,7 +55,8 @@ _LAZY_MODULES = ("numpy", "numpy_extension", "symbol", "gluon", "module",
                  "callback", "test_utils", "util", "runtime", "amp",
                  "recordio", "executor", "monitor", "model", "operator",
                  "contrib", "onnx", "native", "library", "visualization",
-                 "error", "engine", "attribute", "name", "rtc", "deploy")
+                 "error", "engine", "attribute", "name", "rtc", "deploy",
+                 "rnn")
 
 
 

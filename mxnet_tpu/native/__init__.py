@@ -162,6 +162,10 @@ def predict_lib_path():
     embed-from-C predict shim (reference: c_predict_api). Raises on a
     missing toolchain rather than silently degrading: a C host has no
     Python fallback to fall back to."""
+    if not native_enabled():
+        raise RuntimeError(
+            "native components are disabled (MXNET_TPU_NATIVE=0); the C "
+            "predict shim cannot be built")
     with _lock:
         if (not os.path.exists(_PRED_SO)
                 or os.path.getmtime(_PRED_SO) < os.path.getmtime(_PRED_SRC)):

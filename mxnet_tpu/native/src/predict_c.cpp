@@ -256,15 +256,21 @@ extern "C" int MXTpuPredForward(MXTpuPredictorHandle h, const float *data,
     set_err_from_python("forward");
     return -1;
   }
-  Py_XDECREF(p->outputs);
-  p->outputs = outs;
-  p->out_shapes.clear();
+  // stage shapes fully before publishing: a mid-loop failure must leave
+  // the handle's previous outputs/shapes consistent, not half-swapped
+  std::vector<std::vector<int64_t>> shapes;
   Py_ssize_t n = PyList_Size(outs);
   for (Py_ssize_t i = 0; i < n; ++i) {
     std::vector<int64_t> s;
-    if (!fill_shape(PyList_GetItem(outs, i), &s)) return -1;
-    p->out_shapes.push_back(std::move(s));
+    if (!fill_shape(PyList_GetItem(outs, i), &s)) {
+      Py_DECREF(outs);
+      return -1;
+    }
+    shapes.push_back(std::move(s));
   }
+  Py_XDECREF(p->outputs);
+  p->outputs = outs;
+  p->out_shapes = std::move(shapes);
   return 0;
 }
 

@@ -644,9 +644,22 @@ def _auto_name(hint):
 
 def _make_node(opname, inputs, params, name=None, nout=1):
     op = get_op(opname)
+    n = op.nout
+    if n == -1:
+        # dynamic-output ops: the count is decided by static op params,
+        # so resolve it at node-build time — iteration/len/indexing on
+        # the symbol then work like the reference's multi-output symbols
+        if opname in ("split", "SliceChannel"):
+            n = int(params.get("num_outputs") or params.get("sections")
+                    or 1)
+        elif opname == "topk":
+            n = 2 if params.get("ret_typ") == "both" else 1
+        elif opname in ("_sample_multinomial", "sample_multinomial"):
+            n = 2 if params.get("get_prob") else 1
+        # unknown dynamic op: keep -1 (indexing still yields views)
     return Symbol(opname, params, inputs,
                   name or _auto_name(opname.lower().lstrip("_")),
-                  nout=op.nout)
+                  nout=n)
 
 
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
