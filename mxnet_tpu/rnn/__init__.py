@@ -9,8 +9,12 @@ from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,
                        ResidualCell, RNNCell, RNNParams,
                        SequentialRNNCell)
 from .io import BucketSentenceIter, encode_sentences
+from .rnn import (do_rnn_checkpoint, load_rnn_checkpoint, rnn_unroll,
+                  save_rnn_checkpoint)
 
 __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
            "FusedRNNCell", "SequentialRNNCell", "DropoutCell",
            "ModifierCell", "ResidualCell", "BidirectionalCell",
-           "BucketSentenceIter", "encode_sentences"]
+           "BucketSentenceIter", "encode_sentences", "rnn_unroll",
+           "save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]

@@ -85,6 +85,12 @@ class BucketSentenceIter(DataIter):
             print(f"WARNING: discarded {discarded} sentences longer than "
                   "the largest bucket.")
         keep = [i for i, rows in enumerate(binned) if rows]
+        if not keep:
+            raise ValueError(
+                "no bucket holds any sentence: auto-bucketing requires "
+                "some length to occur >= batch_size times, and sentences "
+                "longer than the largest bucket are discarded — pass "
+                "explicit `buckets` or lower batch_size")
         self.buckets = [buckets[i] for i in keep]
         self.data = [np.asarray(binned[i], dtype=dtype) for i in keep]
 

@@ -98,10 +98,19 @@ class BaseRNNCell:
             shape = tuple(batch_size if d == 0 else d
                           for d in info["shape"])
             name = f"{self._prefix}begin_state_{self._init_counter}"
-            if batch_size and func is None:
-                states.append(sym.zeros(shape=shape, name=name, **kwargs))
-            elif func is not None:
-                states.append(func(shape=shape, name=name, **kwargs))
+            if func is not None and not batch_size:
+                # a (0, H) literal would be a real zero-row array under
+                # XLA's static shapes and break far downstream — fail
+                # here with the remedy instead
+                raise ValueError(
+                    "begin_state(func=...) needs batch_size=<N> under "
+                    "static shapes; either pass batch_size, or omit "
+                    "func (states become named input variables), or "
+                    "let unroll(begin_state=None) derive zero states "
+                    "from the inputs")
+            if func is not None or batch_size:
+                make = func or sym.zeros
+                states.append(make(shape=shape, name=name, **kwargs))
             else:
                 states.append(sym.var(name, shape=None))
         return states
@@ -419,8 +428,7 @@ class FusedRNNCell(BaseRNNCell):
         from .. import ndarray as nd
         args = dict(args)
         w0 = args[f"{self._prefix}l0_i2h{self._gate_names[0]}_weight"]
-        input_size = (w0.shape if not hasattr(w0, "asnumpy")
-                      else w0.shape)[1]
+        input_size = w0.shape[1]
         flat = _np.zeros(self._param_size(input_size), _np.float32)
         for name, start, stop, shape in self._weight_slices(input_size):
             part = args.pop(name)

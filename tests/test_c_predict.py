@@ -132,7 +132,7 @@ def test_c_host_matches_python(artifact_and_host):
     p = subprocess.run(
         [str(host_bin), art_path, str(tmp / "input.bin"),
          str(tmp / "expected.bin")],
-        capture_output=True, text=True, timeout=600, env=env)
+        capture_output=True, text=True, timeout=900, env=env)
     assert p.returncode == 0, \
         f"C host rc={p.returncode}\n{p.stdout}\n{p.stderr}"
     assert "max_abs_diff" in p.stdout
@@ -146,7 +146,7 @@ def test_c_host_reports_bad_artifact(artifact_and_host, tmp_path):
     p = subprocess.run(
         [str(host_bin), str(bogus), str(tmp / "input.bin"),
          str(tmp / "expected.bin")],
-        capture_output=True, text=True, timeout=600, env=env)
+        capture_output=True, text=True, timeout=900, env=env)
     assert p.returncode == 3
     assert "not an mxnet_tpu predictor artifact" in p.stderr
 

@@ -32,6 +32,9 @@ CASES = [
                        "--batch-size", "32"]),
     ("bucketing_lm.py", ["--epochs", "1", "--batch-size", "4",
                          "--buckets", "6,9"]),
+    ("bi_lstm_sort.py", ["--epochs", "1", "--num-samples", "64",
+                         "--batch-size", "16", "--seq-len", "4",
+                         "--vocab", "8"]),
     ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
                            "--num-classes", "4", "--image-shape",
                            "3,16,16", "--batch-size", "4",

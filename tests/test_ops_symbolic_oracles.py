@@ -1,0 +1,219 @@
+"""Reference-style op tests: symbolic forward/backward vs numpy oracles.
+
+Reference: tests/python/unittest/test_operator.py uses
+check_symbolic_forward/check_symbolic_backward pervasively (e.g.
+test_fullyconnected, test_convolution_grouping, test_softmax). This
+file ports that testing style onto the new oracles in
+mxnet_tpu.test_utils — every case states the expected value/gradient in
+closed numpy form, independent of the op implementation.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (check_symbolic_forward,
+                                  check_symbolic_backward)
+
+RNG = np.random.RandomState(42)
+
+
+def test_fullyconnected_forward_backward():
+    B, I, H = 4, 7, 3
+    x = RNG.randn(B, I).astype(np.float32)
+    w = RNG.randn(H, I).astype(np.float32)
+    b = RNG.randn(H).astype(np.float32)
+    og = RNG.randn(B, H).astype(np.float32)
+
+    s = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=H, name="fc")
+    loc = {"data": x, "fc_weight": w, "fc_bias": b}
+    check_symbolic_forward(s, loc, [x @ w.T + b], rtol=1e-4, atol=1e-5)
+    check_symbolic_backward(
+        s, loc, [og],
+        {"data": og @ w, "fc_weight": og.T @ x, "fc_bias": og.sum(0)},
+        rtol=1e-4, atol=1e-4)
+
+
+def test_activation_backward():
+    x = RNG.randn(3, 5).astype(np.float32)
+    og = RNG.randn(3, 5).astype(np.float32)
+    s = mx.sym.Activation(mx.sym.var("data"), act_type="relu")
+    check_symbolic_forward(s, {"data": x}, [np.maximum(x, 0)])
+    check_symbolic_backward(s, {"data": x}, [og], {"data": og * (x > 0)})
+
+    s = mx.sym.Activation(mx.sym.var("data"), act_type="sigmoid")
+    sig = 1 / (1 + np.exp(-x))
+    check_symbolic_forward(s, {"data": x}, [sig], rtol=1e-5, atol=1e-6)
+    check_symbolic_backward(s, {"data": x}, [og],
+                            {"data": og * sig * (1 - sig)},
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_elemwise_binary_backward():
+    a = RNG.randn(2, 3).astype(np.float32)
+    b = RNG.randn(2, 3).astype(np.float32) + 2.5
+    og = RNG.randn(2, 3).astype(np.float32)
+    va, vb = mx.sym.var("a"), mx.sym.var("b")
+    check_symbolic_backward(va / vb, {"a": a, "b": b}, [og],
+                            {"a": og / b, "b": -og * a / b ** 2},
+                            rtol=1e-4, atol=1e-5)
+    check_symbolic_backward(va ** 2.0 + vb, {"a": a, "b": b}, [og],
+                            {"a": og * 2 * a, "b": og}, rtol=1e-4,
+                            atol=1e-5)
+
+
+def test_convolution_1x1_as_matmul():
+    # 1x1 conv == per-pixel matmul: closed-form oracle
+    B, C, H, W, F = 2, 3, 4, 4, 5
+    x = RNG.randn(B, C, H, W).astype(np.float32)
+    w = RNG.randn(F, C, 1, 1).astype(np.float32)
+    b = np.zeros(F, np.float32)
+    s = mx.sym.Convolution(mx.sym.var("data"), kernel=(1, 1), num_filter=F,
+                           name="conv")
+    want = np.einsum("bchw,fc->bfhw", x, w[:, :, 0, 0]).astype(np.float32)
+    check_symbolic_forward(
+        s, {"data": x, "conv_weight": w, "conv_bias": b}, [want],
+        rtol=1e-4, atol=1e-4)
+    og = RNG.randn(B, F, H, W).astype(np.float32)
+    check_symbolic_backward(
+        s, {"data": x, "conv_weight": w, "conv_bias": b}, [og],
+        {"data": np.einsum("bfhw,fc->bchw", og, w[:, :, 0, 0]),
+         "conv_weight": np.einsum("bfhw,bchw->fc", og, x)[..., None, None],
+         "conv_bias": og.sum((0, 2, 3))},
+        rtol=1e-3, atol=1e-3)
+
+
+def test_pooling_forward():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    s = mx.sym.Pooling(mx.sym.var("data"), kernel=(2, 2), stride=(2, 2),
+                       pool_type="max")
+    want = np.array([[[[5, 7], [13, 15]]]], np.float32)
+    check_symbolic_forward(s, {"data": x}, [want])
+    s = mx.sym.Pooling(mx.sym.var("data"), kernel=(2, 2), stride=(2, 2),
+                       pool_type="avg")
+    want = np.array([[[[2.5, 4.5], [10.5, 12.5]]]], np.float32)
+    check_symbolic_forward(s, {"data": x}, [want])
+
+
+def test_softmax_and_logsoftmax():
+    x = RNG.randn(3, 6).astype(np.float32)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    check_symbolic_forward(mx.sym.softmax(mx.sym.var("data")),
+                           {"data": x}, [p], rtol=1e-5, atol=1e-6)
+    check_symbolic_forward(mx.sym.log_softmax(mx.sym.var("data")),
+                           {"data": x}, [np.log(p)], rtol=1e-4, atol=1e-5)
+    # softmax jacobian: dL/dx = p*(og - sum(og*p))
+    og = RNG.randn(3, 6).astype(np.float32)
+    want = p * (og - (og * p).sum(-1, keepdims=True))
+    check_symbolic_backward(mx.sym.softmax(mx.sym.var("data")),
+                            {"data": x}, [og], {"data": want},
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_inference_forward():
+    B, C = 4, 3
+    x = RNG.randn(B, C, 2, 2).astype(np.float32)
+    gamma = RNG.rand(C).astype(np.float32) + 0.5
+    beta = RNG.randn(C).astype(np.float32)
+    mean = RNG.randn(C).astype(np.float32)
+    var = RNG.rand(C).astype(np.float32) + 0.5
+    s = mx.sym.BatchNorm(mx.sym.var("data"), fix_gamma=False, name="bn",
+                         use_global_stats=True, eps=1e-5)
+    want = (x - mean[:, None, None]) / np.sqrt(var[:, None, None] + 1e-5) \
+        * gamma[:, None, None] + beta[:, None, None]
+    check_symbolic_forward(
+        s, {"data": x, "bn_gamma": gamma, "bn_beta": beta},
+        [want.astype(np.float32)],
+        aux_states={"bn_moving_mean": mean, "bn_moving_var": var},
+        rtol=1e-4, atol=1e-4)
+
+
+def test_embedding_backward_scatter():
+    V, D = 6, 4
+    W = RNG.randn(V, D).astype(np.float32)
+    ids = np.array([[1, 3], [3, 5]], np.float32)
+    og = RNG.randn(2, 2, D).astype(np.float32)
+    s = mx.sym.Embedding(mx.sym.var("data"), input_dim=V, output_dim=D,
+                         name="emb")
+    check_symbolic_forward(s, {"data": ids, "emb_weight": W},
+                           [W[ids.astype(int)]])
+    want = np.zeros_like(W)
+    for b in range(2):
+        for t in range(2):
+            want[int(ids[b, t])] += og[b, t]
+    check_symbolic_backward(
+        s, {"data": ids, "emb_weight": W}, [og],
+        {"emb_weight": want}, grad_req={"data": "null",
+                                        "emb_weight": "write"},
+        rtol=1e-5, atol=1e-6)
+
+
+def test_reduce_ops_backward():
+    x = RNG.randn(3, 4).astype(np.float32)
+    og = np.float32(RNG.randn())
+    check_symbolic_backward(mx.sym.sum(mx.sym.var("a")), {"a": x},
+                            [np.asarray(og)], {"a": np.full_like(x, og)})
+    # mean spreads the cotangent
+    check_symbolic_backward(mx.sym.mean(mx.sym.var("a")), {"a": x},
+                            [np.asarray(og)],
+                            {"a": np.full_like(x, og / x.size)},
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_transpose_reshape_roundtrip_backward():
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    og = RNG.randn(4, 3, 2).astype(np.float32)
+    s = mx.sym.transpose(mx.sym.var("a"), axes=(2, 1, 0))
+    check_symbolic_forward(s, {"a": x}, [x.transpose(2, 1, 0)])
+    check_symbolic_backward(s, {"a": x}, [og],
+                            {"a": og.transpose(2, 1, 0)})
+
+
+def test_concat_split_backward():
+    a = RNG.randn(2, 3).astype(np.float32)
+    b = RNG.randn(2, 5).astype(np.float32)
+    og = RNG.randn(2, 8).astype(np.float32)
+    s = mx.sym.concat(mx.sym.var("a"), mx.sym.var("b"), dim=1)
+    check_symbolic_forward(s, {"a": a, "b": b},
+                           [np.concatenate([a, b], 1)])
+    check_symbolic_backward(s, {"a": a, "b": b}, [og],
+                            {"a": og[:, :3], "b": og[:, 3:]})
+
+
+def test_where_and_clip_backward():
+    x = RNG.randn(4, 4).astype(np.float32)
+    og = RNG.randn(4, 4).astype(np.float32)
+    s = mx.sym.clip(mx.sym.var("a"), a_min=-0.5, a_max=0.5)
+    inside = ((x > -0.5) & (x < 0.5)).astype(np.float32)
+    check_symbolic_forward(s, {"a": x}, [np.clip(x, -0.5, 0.5)])
+    check_symbolic_backward(s, {"a": x}, [og], {"a": og * inside})
+
+
+def test_dot_backward():
+    a = RNG.randn(3, 4).astype(np.float32)
+    b = RNG.randn(4, 5).astype(np.float32)
+    og = RNG.randn(3, 5).astype(np.float32)
+    s = mx.sym.dot(mx.sym.var("a"), mx.sym.var("b"))
+    check_symbolic_forward(s, {"a": a, "b": b}, [a @ b], rtol=1e-4,
+                           atol=1e-5)
+    check_symbolic_backward(s, {"a": a, "b": b}, [og],
+                            {"a": og @ b.T, "b": a.T @ og},
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_forward():
+    x = RNG.randn(4, 6).astype(np.float32)
+    gamma = RNG.rand(6).astype(np.float32) + 0.5
+    beta = RNG.randn(6).astype(np.float32)
+    mu = x.mean(-1, keepdims=True)
+    sd = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    want = (x - mu) / sd * gamma + beta
+    s = mx.sym.LayerNorm(mx.sym.var("data"), name="ln", eps=1e-5)
+    check_symbolic_forward(
+        s, {"data": x, "ln_gamma": gamma, "ln_beta": beta},
+        [want.astype(np.float32)], rtol=1e-4, atol=1e-4)

@@ -287,6 +287,38 @@ def test_bucket_sentence_iter():
     assert n >= 2 and seen == {5, 10}
 
 
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    """save unpacked / load re-packed (reference: rnn.py:32,62)."""
+    I, H = 3, 4
+    rng = np.random.RandomState(10)
+    flat = rng.randn(4 * H * (I + H) + 8 * H).astype(np.float32)
+    cell = rnn.FusedRNNCell(H, mode="lstm", prefix="c_")
+    out, _ = cell.unroll(2, mx.sym.var("data"), layout="NTC")
+    prefix = str(tmp_path / "model")
+    arg = {"c_parameters": mx.nd.array(flat)}
+    rnn.save_rnn_checkpoint(cell, prefix, 3, out, arg, {})
+    sym2, arg2, aux2 = rnn.load_rnn_checkpoint(cell, prefix, 3)
+    np.testing.assert_allclose(arg2["c_parameters"].asnumpy(), flat,
+                               atol=0)
+    # on disk the params are per-gate (interchangeable with unfused)
+    _, raw_args, _ = mx.model.load_checkpoint(prefix, 3)
+    assert "c_parameters" not in raw_args
+    assert "c_l0_i2h_i_weight" in raw_args
+
+
+def test_begin_state_guards():
+    cell = rnn.LSTMCell(4, prefix="bs_")
+    with pytest.raises(ValueError):
+        cell.begin_state(func=mx.sym.zeros)   # batch unknown -> (0, H)
+    states = cell.begin_state(func=mx.sym.zeros, batch_size=3)
+    assert len(states) == 2
+
+
+def test_bucket_iter_empty_raises():
+    with pytest.raises(ValueError):
+        rnn.BucketSentenceIter([[1, 2]] * 3, batch_size=32, buckets=[1])
+
+
 def test_encode_sentences():
     enc, vocab = rnn.encode_sentences([["a", "b"], ["b", "c"]],
                                       invalid_label=0, start_label=1)
