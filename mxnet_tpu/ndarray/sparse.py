@@ -317,29 +317,36 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     fall back to their dense form (XLA dense dot is the fast path on the
     MXU once density is nontrivial).
     """
+    from ..ops.invoke import apply_fn
     if isinstance(lhs, CSRNDArray) and not transpose_b:
-        dense = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
         rows = lhs._row_ids()
         cols = lhs._indices
         vals = lhs._values
-        if not transpose_a:
-            # out[r, :] += v * dense[c, :]
-            contrib = vals[:, None] * dense[cols]
-            out = jax.ops.segment_sum(contrib, rows,
-                                      num_segments=lhs._sshape[0])
-            return NDArray(out)
-        # out[c, :] += v * dense[r, :]
-        contrib = vals[:, None] * dense[rows]
-        out = jax.ops.segment_sum(contrib, cols,
-                                  num_segments=lhs._sshape[1])
-        return NDArray(out)
-    a = lhs._data if isinstance(lhs, NDArray) else jnp.asarray(lhs)
-    b = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
-    if transpose_a:
-        a = a.T
-    if transpose_b:
-        b = b.T
-    return NDArray(jnp.dot(a, b))
+        n_seg = lhs._sshape[1] if transpose_a else lhs._sshape[0]
+        gather = rows if transpose_a else cols
+        scatter = cols if transpose_a else rows
+        # the CSR structure is a constant of the closure; the dense rhs
+        # is a differentiable input, routed through apply_fn so the
+        # autograd tape sees the op (grad wrt rhs = csr.T @ dy via the
+        # jax.vjp of this same gather/segment-sum program)
+        def csr_dot(dense):
+            contrib = vals[:, None] * dense[gather]
+            return jax.ops.segment_sum(contrib, scatter,
+                                       num_segments=n_seg)
+        rhs_nd = rhs if isinstance(rhs, NDArray) else NDArray(
+            jnp.asarray(rhs))
+        return apply_fn(csr_dot, [rhs_nd])
+
+    def dense_dot(a, b):
+        if transpose_a:
+            a = a.T
+        if transpose_b:
+            b = b.T
+        return jnp.dot(a, b)
+
+    a_nd = lhs if isinstance(lhs, NDArray) else NDArray(jnp.asarray(lhs))
+    b_nd = rhs if isinstance(rhs, NDArray) else NDArray(jnp.asarray(rhs))
+    return apply_fn(dense_dot, [a_nd, b_nd])
 
 
 def add(lhs, rhs):

@@ -86,10 +86,15 @@ class BucketSentenceIter(DataIter):
                   "the largest bucket.")
         keep = [i for i, rows in enumerate(binned) if rows]
         if not keep:
+            if discarded:
+                raise ValueError(
+                    f"no bucket holds any sentence: all {discarded} "
+                    f"sentences are longer than the largest bucket "
+                    f"({buckets[-1] if buckets else 'none'}) — add a "
+                    "larger bucket")
             raise ValueError(
-                "no bucket holds any sentence: auto-bucketing requires "
-                "some length to occur >= batch_size times, and sentences "
-                "longer than the largest bucket are discarded — pass "
+                "no bucket holds any sentence: auto-bucketing keeps "
+                "only lengths occurring >= batch_size times — pass "
                 "explicit `buckets` or lower batch_size")
         self.buckets = [buckets[i] for i in keep]
         self.data = [np.asarray(binned[i], dtype=dtype) for i in keep]

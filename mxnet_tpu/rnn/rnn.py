@@ -17,10 +17,16 @@ __all__ = ["rnn_unroll", "save_rnn_checkpoint", "load_rnn_checkpoint",
            "do_rnn_checkpoint"]
 
 
-def rnn_unroll(cell, length, inputs=None, begin_state=None, layout="NTC"):
-    """Deprecated alias of ``cell.unroll`` (reference: rnn.py:26)."""
+def rnn_unroll(cell, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC"):
+    """Deprecated alias of ``cell.unroll`` (reference: rnn.py:26 — same
+    positional order, so legacy calls keep their meaning). The
+    ``input_prefix`` argument only ever named auto-created input
+    variables; our unroll names them from the cell prefix, so it is
+    accepted and ignored."""
     warnings.warn("rnn_unroll is deprecated; call cell.unroll directly.",
                   DeprecationWarning)
+    del input_prefix
     return cell.unroll(length, inputs=inputs, begin_state=begin_state,
                        layout=layout)
 

@@ -35,6 +35,11 @@ CASES = [
     ("bi_lstm_sort.py", ["--epochs", "1", "--num-samples", "64",
                          "--batch-size", "16", "--seq-len", "4",
                          "--vocab", "8"]),
+    ("sparse_linear_classification.py",
+     ["--epochs", "2", "--num-samples", "256", "--num-features", "100",
+      "--batch-size", "64", "--min-acc", "0.6"]),
+    ("vae_mnist.py", ["--epochs", "1", "--num-samples", "128",
+                      "--batch-size", "32", "--max-loss", "110"]),
     ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
                            "--num-classes", "4", "--image-shape",
                            "3,16,16", "--batch-size", "4",

@@ -64,6 +64,39 @@ def test_csr_roundtrip_and_spmm():
     assert not c2.densified
 
 
+def test_csr_dot_gradient_flows():
+    """Autograd through sparse.dot: grad wrt the dense rhs must equal
+    the dense-oracle csr.T @ dy (regression: the csr path used to build
+    its output outside the tape, silently returning zero grads —
+    surfaced by examples/sparse_linear_classification.py)."""
+    import mxnet_tpu.autograd as ag
+    rng = np.random.RandomState(0)
+    dense_lhs = (rng.rand(6, 8) < 0.3).astype(np.float32) * \
+        rng.randn(6, 8).astype(np.float32)
+    csr = mx.nd.sparse.csr_matrix(dense_lhs)
+    w = mx.nd.array(rng.randn(8, 3).astype(np.float32))
+    w.attach_grad()
+    dy = rng.randn(6, 3).astype(np.float32)
+    with ag.record():
+        out = mx.nd.sparse.dot(csr, w)
+        loss = (out * mx.nd.array(dy)).sum()
+    loss.backward()
+    np.testing.assert_allclose(out.asnumpy(), dense_lhs @ w.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(w.grad.asnumpy(), dense_lhs.T @ dy,
+                               rtol=1e-4, atol=1e-5)
+    # transposed: csr.T @ w2
+    w2 = mx.nd.array(rng.randn(6, 3).astype(np.float32))
+    w2.attach_grad()
+    dy2 = rng.randn(8, 3).astype(np.float32)
+    with ag.record():
+        out2 = mx.nd.sparse.dot(csr, w2, transpose_a=True)
+        loss2 = (out2 * mx.nd.array(dy2)).sum()
+    loss2.backward()
+    np.testing.assert_allclose(w2.grad.asnumpy(), dense_lhs @ dy2,
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_retain():
     r = sparse.row_sparse_array(
         (np.arange(6, dtype=np.float32).reshape(3, 2), [1, 4, 5]),
