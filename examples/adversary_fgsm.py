@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""FGSM adversarial examples — gradients with respect to the INPUT.
+
+Reference example: example/adversary/adversary_generation.ipynb (train
+a small net on MNIST, then perturb inputs along the sign of the input
+gradient and watch accuracy collapse). Uses the synthetic digit
+bitmaps; the interesting framework path is ``x.attach_grad()`` +
+``loss.backward()`` producing d(loss)/d(input) — most training code
+only ever pulls parameter gradients.
+
+  python examples/adversary_fgsm.py --epochs 6
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+import mxnet_tpu.autograd as ag  # noqa: E402
+
+from multi_task import make_digits  # noqa: E402
+
+
+def build_net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(16, 3, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    return net
+
+
+def accuracy(net, imgs, labels, batch):
+    hits = 0
+    for i in range(0, len(imgs), batch):
+        pred = net(nd.array(imgs[i:i + batch])).asnumpy().argmax(-1)
+        hits += int((pred == labels[i:i + batch]).sum())
+    return hits / len(imgs)
+
+
+def fgsm_perturb(net, loss_fn, imgs, labels, eps, batch):
+    """x_adv = clip(x + eps * sign(dL/dx))."""
+    out = np.empty_like(imgs)
+    for i in range(0, len(imgs), batch):
+        x = nd.array(imgs[i:i + batch])
+        x.attach_grad()
+        with ag.record():
+            loss = loss_fn(net(x), nd.array(labels[i:i + batch])).mean()
+        loss.backward()
+        step = np.sign(x.grad.asnumpy())
+        out[i:i + batch] = np.clip(imgs[i:i + batch] + eps * step, 0, 1)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-samples", type=int, default=1024)
+    ap.add_argument("--eps", type=float, default=0.15)
+    ap.add_argument("--min-drop", type=float, default=0.0,
+                    help="exit nonzero unless adversarial accuracy drops "
+                    "at least this much below clean accuracy")
+    args = ap.parse_args()
+
+    imgs, labels = make_digits(args.num_samples, seed=13)
+    ev_imgs, ev_labels = make_digits(256, seed=131)
+
+    mx.random.seed(0)
+    net = build_net()
+    net.initialize(init=mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    B = args.batch_size
+    n = (len(imgs) // B) * B
+    for epoch in range(args.epochs):
+        perm = np.random.default_rng(epoch).permutation(n)
+        for i in range(0, n, B):
+            idx = perm[i:i + B]
+            with ag.record():
+                loss = loss_fn(net(nd.array(imgs[idx])),
+                               nd.array(labels[idx])).mean()
+            loss.backward()
+            trainer.step(B)
+        print(f"epoch {epoch}: clean eval acc "
+              f"{accuracy(net, ev_imgs, ev_labels, B):.3f}")
+
+    clean = accuracy(net, ev_imgs, ev_labels, B)
+    adv_imgs = fgsm_perturb(net, loss_fn, ev_imgs, ev_labels, args.eps, B)
+    adv = accuracy(net, adv_imgs, ev_labels, B)
+    print(f"clean acc {clean:.3f} -> adversarial acc {adv:.3f} "
+          f"(eps={args.eps})")
+
+    if clean - adv < args.min_drop:
+        print(f"FAIL: accuracy drop {clean - adv:.3f} < {args.min_drop}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

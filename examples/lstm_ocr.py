@@ -88,12 +88,16 @@ def make_dataset(n, width, min_len, max_len, seed):
 
 class OCRNet(gluon.Block):
     """Columns of the image are the LSTM's time steps (reference:
-    example/ctc/lstm.py builds the same unrolled-over-width topology)."""
+    example/ctc/lstm.py builds the same unrolled-over-width topology).
+    Bidirectional context makes CTC alignment much easier to learn —
+    the emission column sees the whole glyph from both sides."""
 
-    def __init__(self, num_hidden=64, num_classes=11, **kw):
+    def __init__(self, num_hidden=64, num_classes=11, bidirectional=True,
+                 **kw):
         super().__init__(**kw)
         with self.name_scope():
-            self.lstm = rnn.LSTM(num_hidden, num_layers=2, layout="NTC")
+            self.lstm = rnn.LSTM(num_hidden, num_layers=2, layout="NTC",
+                                 bidirectional=bidirectional)
             self.out = nn.Dense(num_classes, flatten=False)
 
     def forward(self, x):           # x: (B, H, W)

@@ -2,16 +2,15 @@
 """Sparse logistic regression over libsvm data with row_sparse weights.
 
 Reference example: example/sparse/linear_classification/ (LibSVMIter +
-sparse embedding-style dot + dist kvstore row_sparse_pull). Same shape
-here: features arrive as CSR batches from ``mx.io.LibSVMIter``, the
-weight is a ``row_sparse`` parameter updated lazily (only rows touched
-by the batch), and `sparse.dot(csr, dense)` is the compute.
+sparse dot). Same shape here: features arrive as CSR batches from
+``mx.io.LibSVMIter`` and ``sparse.dot(csr, dense)`` is the compute;
+the weight itself is a small dense vector updated with plain SGD (the
+row_sparse lazy-update path is exercised separately by the gluon
+Trainer sparse tests, tests/test_sparse.py).
 
 TPU-first notes: XLA has no sparse buffers, so `sparse.dot` lowers to
-gather + segment-sum on the CSR coordinates — still one jitted program
-per batch shape; the lazy row update happens on the optimizer side
-(`lazy_update=True`, reference: optimizer SGD docs) exactly as the
-reference's sparse SGD does.
+gather + segment-sum on the CSR coordinates — one FLOP per stored
+nonzero, still one jitted program per batch shape.
 
   python examples/sparse_linear_classification.py --epochs 5
 """

@@ -330,7 +330,10 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         # autograd tape sees the op (grad wrt rhs = csr.T @ dy via the
         # jax.vjp of this same gather/segment-sum program)
         def csr_dot(dense):
-            contrib = vals[:, None] * dense[gather]
+            if dense.ndim == 1:           # matrix @ vector
+                contrib = vals * dense[gather]
+            else:
+                contrib = vals[:, None] * dense[gather]
             return jax.ops.segment_sum(contrib, scatter,
                                        num_segments=n_seg)
         rhs_nd = rhs if isinstance(rhs, NDArray) else NDArray(

@@ -86,12 +86,11 @@ class BucketSentenceIter(DataIter):
                   "the largest bucket.")
         keep = [i for i, rows in enumerate(binned) if rows]
         if not keep:
-            if discarded:
+            if buckets and discarded:
                 raise ValueError(
                     f"no bucket holds any sentence: all {discarded} "
                     f"sentences are longer than the largest bucket "
-                    f"({buckets[-1] if buckets else 'none'}) — add a "
-                    "larger bucket")
+                    f"({buckets[-1]}) — add a larger bucket")
             raise ValueError(
                 "no bucket holds any sentence: auto-bucketing keeps "
                 "only lengths occurring >= batch_size times — pass "

@@ -40,6 +40,8 @@ CASES = [
       "--batch-size", "64", "--min-acc", "0.6"]),
     ("vae_mnist.py", ["--epochs", "1", "--num-samples", "128",
                       "--batch-size", "32", "--max-loss", "110"]),
+    ("adversary_fgsm.py", ["--epochs", "2", "--num-samples", "256",
+                           "--batch-size", "64", "--min-drop", "0.02"]),
     ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
                            "--num-classes", "4", "--image-shape",
                            "3,16,16", "--batch-size", "4",

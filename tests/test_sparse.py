@@ -97,6 +97,26 @@ def test_csr_dot_gradient_flows():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_csr_dot_vector_rhs():
+    """csr @ 1-D vector keeps shape (m,) (regression: the 2-D-only
+    contraction silently produced (m, nnz))."""
+    rng = np.random.RandomState(1)
+    dense_lhs = (rng.rand(5, 7) < 0.4).astype(np.float32) * \
+        rng.randn(5, 7).astype(np.float32)
+    csr = mx.nd.sparse.csr_matrix(dense_lhs)
+    v = mx.nd.array(rng.randn(7).astype(np.float32))
+    out = mx.nd.sparse.dot(csr, v)
+    assert out.shape == (5,)
+    np.testing.assert_allclose(out.asnumpy(), dense_lhs @ v.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    vt = mx.nd.array(rng.randn(5).astype(np.float32))
+    out_t = mx.nd.sparse.dot(csr, vt, transpose_a=True)
+    assert out_t.shape == (7,)
+    np.testing.assert_allclose(out_t.asnumpy(),
+                               dense_lhs.T @ vt.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_retain():
     r = sparse.row_sparse_array(
         (np.arange(6, dtype=np.float32).reshape(3, 2), [1, 4, 5]),

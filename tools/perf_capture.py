@@ -196,6 +196,7 @@ def main():
     _log({"event": "start", "interval": args.interval,
           "max_hours": args.max_hours})
     all_tags = {tag for tag, _ in CONFIGS}
+    down_streak = 0
     while time.time() < deadline:
         if all_tags <= _captured_tags():
             # every config has a valid capture and re-measurement is
@@ -204,6 +205,9 @@ def main():
             return
         info, err = probe(args.probe_timeout)
         if info is not None and info.get("platform") == "tpu":
+            if down_streak:
+                _log({"event": "probe_down_end", "misses": down_streak})
+                down_streak = 0
             _log({"event": "tunnel_up", "kind": info.get("kind")})
             capture_window()
             if args.once:
@@ -211,7 +215,12 @@ def main():
             time.sleep(max(args.interval, 600))
         else:
             reason = err if info is None else f"platform={info['platform']}"
-            _log({"event": "probe_down", "reason": reason})
+            # coalesce: an audit log of hundreds of identical probe_down
+            # lines carries no information — log the first miss of a
+            # streak, then a summary when the tunnel returns
+            if down_streak == 0:
+                _log({"event": "probe_down", "reason": reason})
+            down_streak += 1
             if args.once:
                 return
             time.sleep(args.interval)
