@@ -22,10 +22,14 @@ on generated .rec files at a reduced image shape.
 import argparse
 import logging
 import math
+import os
+import sys
 
 import numpy as np
 
-import mxnet_tpu as mx
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
 
 
 # --------------------------------------------------------------- network --

@@ -61,6 +61,31 @@ import json, struct
 
 _MAGIC = b"MXTPUPRED1"
 
+_plat_union = []  # platforms requested by every artifact loaded so far
+
+def _sync_platforms(platforms):
+    # Site hooks (e.g. an accelerator-plugin sitecustomize) can override
+    # jax's platform selection at interpreter start, defeating
+    # JAX_PLATFORMS in our env AND making any backend query initialize
+    # an accelerator whose transport may be down (hanging this host
+    # process). Re-pin the config: the env var wins; otherwise restrict
+    # to the UNION of the platforms every loaded artifact needs (+cpu),
+    # so loading a cpu artifact first does not lock a later tpu
+    # artifact out of its backend.
+    import os
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        want = os.environ["JAX_PLATFORMS"]
+    else:
+        for p in [q.lower() for q in platforms] + ["cpu"]:
+            if p not in _plat_union:
+                _plat_union.append(p)
+        want = ",".join(_plat_union)
+    try:
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # backends already initialized: fall through to device pick
+
 def _pick_device(platforms):
     # the artifact is platform-specific (StableHLO lowered per backend);
     # run it on a device matching its export platform, regardless of the
@@ -73,7 +98,13 @@ def _pick_device(platforms):
                 return jax.local_devices(backend=name)[0]
             except Exception:
                 continue
-    return jax.local_devices(backend="cpu")[0]
+    if "cpu" in want:
+        return jax.local_devices(backend="cpu")[0]
+    # a clear error beats the downstream "exported for X used on Y"
+    raise RuntimeError(
+        "artifact was exported for platforms %r but no matching jax "
+        "device is available in this process (loaded-artifact platform "
+        "union: %r)" % (sorted(want), _plat_union or ["<env-pinned>"]))
 
 def load(path):
     import numpy as np
@@ -87,12 +118,14 @@ def load(path):
     off += 4
     meta = json.loads(blob[off:off + hlen].decode())
     exported = jexport.deserialize(blob[off + hlen:])
+    plats = getattr(exported, "platforms", ("cpu",))
+    _sync_platforms(plats)
     return {
         "meta": meta,
         "exported": exported,
         "shape": tuple(meta["input_shape"]),
         "dtype": meta["input_dtype"],
-        "device": _pick_device(getattr(exported, "platforms", ("cpu",))),
+        "device": _pick_device(plats),
     }
 
 def forward(pred, buf):

@@ -42,6 +42,7 @@ CASES = [
                       "--batch-size", "32", "--max-loss", "110"]),
     ("adversary_fgsm.py", ["--epochs", "2", "--num-samples", "256",
                            "--batch-size", "64", "--min-drop", "0.02"]),
+    ("ssd_detect.py", ["--steps", "2", "--batch-size", "2"]),
     ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
                            "--num-classes", "4", "--image-shape",
                            "3,16,16", "--batch-size", "4",
