@@ -52,6 +52,11 @@ CONFIGS = [
     ("bs256_bf16_nhwc", {"BENCH_BATCH": "256"}),
     ("bs256_bf16_nhwc_bnfuse", {"BENCH_BATCH": "256",
                                 "MXNET_TPU_BN_FUSED_BWD": "1"}),
+    # biggest batch the chip can hold once remat drops conv-input
+    # residency; overhead amortizes further if the HBM floor allows
+    ("bs512_bf16_nhwc_bnfuse_remat", {"BENCH_BATCH": "512",
+                                      "MXNET_TPU_BN_FUSED_BWD": "1",
+                                      "BENCH_REMAT": "dots"}),
 ]
 
 
