@@ -27,7 +27,10 @@ CORPUS = (
 ) * 12
 
 
-class CharLM(gluon.Block):
+class CharLM(gluon.HybridBlock):
+    """Hybrid so the scan-based LSTM compiles once per shape instead of
+    re-dispatching T steps eagerly every batch (see lstm_ocr.py)."""
+
     def __init__(self, vocab, hidden=64, **kw):
         super().__init__(**kw)
         with self.name_scope():
@@ -35,7 +38,7 @@ class CharLM(gluon.Block):
             self.lstm = rnn.LSTM(hidden, num_layers=1, layout="NTC")
             self.out = nn.Dense(vocab, flatten=False)
 
-    def forward(self, x):
+    def hybrid_forward(self, F, x):
         return self.out(self.lstm(self.emb(x)))
 
 
@@ -58,6 +61,7 @@ def main():
     mx.random.seed(0)
     net = CharLM(len(chars))
     net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 3e-3})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
