@@ -59,7 +59,7 @@ def test_example_runs(script, args):
     env["JAX_PLATFORMS"] = "cpu"
     p = subprocess.run(
         [sys.executable, os.path.join(EXAMPLES, script)] + list(args),
-        capture_output=True, text=True, timeout=420, env=env)
+        capture_output=True, text=True, timeout=600, env=env)
     assert p.returncode == 0, \
         f"{script} failed:\n{p.stdout[-2000:]}\n{p.stderr[-2000:]}"
 
@@ -72,7 +72,7 @@ def test_pipeline_parallel_example_runs():
         [sys.executable,
          os.path.join(EXAMPLES, "pipeline_parallel_resnet.py"),
          "--steps", "1"],
-        capture_output=True, text=True, timeout=500, env=env)
+        capture_output=True, text=True, timeout=700, env=env)
     assert p.returncode == 0, \
         f"pipeline example failed:\n{p.stdout[-2000:]}\n" \
         f"{p.stderr[-2000:]}"
