@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Multiclass SVM on digit bitmaps — the SVMOutput head.
+
+Reference example: example/svm_mnist/svm_mnist.py (an MLP whose output
+layer is ``SVMOutput`` — hinge loss with margin instead of softmax
+cross-entropy — trained with Module). Same structure on the synthetic
+digit bitmaps; exercises the symbolic SVMOutput op end to end, both
+L1 and squared (L2) hinge variants.
+
+  python examples/svm_digits.py --epochs 8 --min-acc 0.8
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+
+from multi_task import make_digits  # noqa: E402
+
+
+def build_sym(use_linear):
+    data = mx.sym.var("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    label = mx.sym.var("svm_label")
+    # regularization_coefficient scales the hinge subgradient itself
+    # (reference: src/operator/svm_output-inl.h) — 1.0 like the
+    # reference example, NOT a small weight-decay-style value
+    return mx.sym.SVMOutput(net, label, margin=1.0,
+                            regularization_coefficient=1.0,
+                            use_linear=use_linear, name="svm")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-samples", type=int, default=1024)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--hinge", choices=["l1", "l2"], default="l2")
+    ap.add_argument("--min-acc", type=float, default=0.0)
+    args = ap.parse_args()
+
+    imgs, labels = make_digits(args.num_samples, seed=17)
+    ev_imgs, ev_labels = make_digits(256, seed=171)
+
+    sym = build_sym(use_linear=args.hinge == "l1")
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("svm_label",))
+    B = args.batch_size
+    mod.bind(data_shapes=[("data", (B, 1, 12, 12))],
+             label_shapes=[("svm_label", (B,))])
+    mx.random.seed(0)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9})
+
+    metric = mx.metric.Accuracy()
+    n = (len(imgs) // B) * B
+    if n == 0 or (len(ev_imgs) // B) * B == 0:
+        ap.error(f"--batch-size {B} exceeds the train or eval set size")
+    acc = 0.0
+    for epoch in range(args.epochs):
+        perm = np.random.default_rng(epoch).permutation(n)
+        for i in range(0, n, B):
+            idx = perm[i:i + B]
+            batch = mx.io.DataBatch(
+                data=[mx.nd.array(imgs[idx])],
+                label=[mx.nd.array(labels[idx].astype(np.float32))])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        metric.reset()
+        for i in range(0, (len(ev_imgs) // B) * B, B):
+            batch = mx.io.DataBatch(
+                data=[mx.nd.array(ev_imgs[i:i + B])],
+                label=[mx.nd.array(
+                    ev_labels[i:i + B].astype(np.float32))])
+            mod.forward(batch, is_train=False)
+            metric.update([mx.nd.array(ev_labels[i:i + B])],
+                          mod.get_outputs())
+        acc = metric.get()[1]
+        print(f"epoch {epoch}: eval acc {acc:.3f} ({args.hinge} hinge)")
+
+    if acc < args.min_acc:
+        print(f"FAIL: accuracy {acc:.3f} < {args.min_acc}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,8 @@ CASES = [
     ("adversary_fgsm.py", ["--epochs", "2", "--num-samples", "256",
                            "--batch-size", "64", "--min-drop", "0.02"]),
     ("ssd_detect.py", ["--steps", "2", "--batch-size", "2"]),
+    ("svm_digits.py", ["--epochs", "3", "--num-samples", "256",
+                       "--batch-size", "64", "--min-acc", "0.15"]),
     ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
                            "--num-classes", "4", "--image-shape",
                            "3,16,16", "--batch-size", "4",
