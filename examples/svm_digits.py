@@ -68,7 +68,9 @@ def main():
         ap.error(f"--batch-size {B} exceeds the train or eval set size")
     acc = 0.0
     for epoch in range(args.epochs):
-        perm = np.random.default_rng(epoch).permutation(n)
+        # permute the FULL set then truncate, so the dropped tail
+        # rotates across epochs instead of excluding fixed samples
+        perm = np.random.default_rng(epoch).permutation(len(imgs))[:n]
         for i in range(0, n, B):
             idx = perm[i:i + B]
             batch = mx.io.DataBatch(

@@ -44,7 +44,15 @@ CASES = [
                            "--batch-size", "64", "--min-drop", "0.02"]),
     ("ssd_detect.py", ["--steps", "2", "--batch-size", "2"]),
     ("svm_digits.py", ["--epochs", "3", "--num-samples", "256",
-                       "--batch-size", "64", "--min-acc", "0.15"]),
+                       "--batch-size", "64", "--min-acc", "0.12"]),
+    # the L1-hinge branch is the other half of SVMOutput; pytest
+    # disambiguates the duplicate id with a numeric suffix
+    ("svm_digits.py", ["--epochs", "3", "--num-samples", "256",
+                       "--batch-size", "64", "--min-acc", "0.12",
+                       "--hinge", "l1"]),
+    ("multi_threaded_inference.py",
+     ["--threads", "4", "--requests", "2", "--batch-size", "2",
+      "--image-size", "32"]),
     ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
                            "--num-classes", "4", "--image-shape",
                            "3,16,16", "--batch-size", "4",
