@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Language model trained with noise-contrastive estimation.
+
+Reference example: example/nce-loss (word LM whose softmax is replaced
+by NCE — score the true next word against k noise samples, so the
+update cost is O(k) instead of O(vocab)). The binary-logistic NCE
+objective (Gutmann & Hyvarinen) with a unigram noise distribution;
+evaluation computes true perplexity with the full softmax, so the gate
+checks that the O(k) training objective actually learned the O(V)
+distribution.
+
+TPU-first notes: negative sample ids are drawn on host per batch and
+enter the jitted step as data — the scoring gathers
+(embedding rows of k+1 candidates) are O(B*(k+1)*H), MXU-friendly, and
+no (B, V) logits matrix is ever materialized during training.
+
+  python examples/nce_lm.py --epochs 10
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, nd  # noqa: E402
+from mxnet_tpu.gluon import nn, rnn  # noqa: E402
+import mxnet_tpu.autograd as ag  # noqa: E402
+
+from bucketing_lm import CORPUS, build_vocab  # noqa: E402
+
+
+class NCELM(gluon.Block):
+    """LSTM encoder + tied output embedding scored against sampled
+    candidates (train) or the full vocab (eval)."""
+
+    def __init__(self, vocab, embed=48, hidden=64, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.emb = nn.Embedding(vocab, embed)
+            self.lstm = rnn.LSTM(hidden, num_layers=1, layout="NTC")
+            self.proj = nn.Dense(embed, flatten=False)
+            self.out_w = self.params.get("out_weight",
+                                         shape=(vocab, embed))
+            self.out_b = self.params.get("out_bias", shape=(vocab,))
+
+    def encode(self, x):                      # (B, T) -> (B, T, E)
+        return self.proj(self.lstm(self.emb(x)))
+
+    def score_candidates(self, h, cand):
+        """h: (B,T,E); cand: (B,T,K) ids -> (B,T,K) logits."""
+        w = nd.Embedding(cand, self.out_w.data(),
+                         input_dim=self.out_w.shape[0],
+                         output_dim=self.out_w.shape[1])  # (B,T,K,E)
+        b = nd.Embedding(cand, self.out_b.data().reshape((-1, 1)),
+                         input_dim=self.out_b.shape[0], output_dim=1)
+        return (w * h.expand_dims(2)).sum(axis=-1) + b.squeeze(-1)
+
+    def full_logits(self, h):                 # eval only: (B,T,V)
+        return nd.dot(h, self.out_w.data().T) + self.out_b.data()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=8)
+    ap.add_argument("--num-negatives", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--max-ppl", type=float, default=float("inf"))
+    args = ap.parse_args()
+
+    vocab = build_vocab(CORPUS)
+    V = len(vocab) + 1
+    ids = np.array([vocab[w] for ln in CORPUS for w in ln.split()],
+                   np.int32)
+    T, B, K = args.seq_len, args.batch_size, args.num_negatives
+    nseq = (len(ids) - 1) // T
+    xs = ids[:nseq * T].reshape(nseq, T)
+    ys = ids[1:nseq * T + 1].reshape(nseq, T)
+    # unigram noise distribution
+    counts = np.bincount(ids, minlength=V).astype(np.float64)
+    q = counts / counts.sum()
+    log_kq = np.log(np.maximum(K * q, 1e-12)).astype(np.float32)
+
+    mx.random.seed(0)
+    net = NCELM(V)
+    net.initialize(init=mx.initializer.Xavier())
+    # standard NCE trick: start the output bias at -log V so initial
+    # scores are roughly normalized and sigma(s - log kq) is calibrated
+    net.out_b.set_data(nd.full((V,), -float(np.log(V))))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    rng = np.random.default_rng(0)
+    nb = (nseq // B) * B
+    ppl = float("inf")
+    for epoch in range(args.epochs):
+        # full permutation then truncate: the partial-batch tail
+        # rotates across epochs instead of excluding fixed sequences
+        perm = rng.permutation(nseq)[:nb]
+        total, count = 0.0, 0
+        for i in range(0, nb, B):
+            idx = perm[i:i + B]
+            x = nd.array(xs[idx])
+            y = ys[idx]                                   # (B,T)
+            neg = rng.choice(V, size=(B, T, K), p=q)      # noise ids
+            cand = np.concatenate([y[..., None], neg], -1)  # (B,T,1+K)
+            lkq = log_kq[cand]                            # (B,T,1+K)
+            with ag.record():
+                h = net.encode(x)
+                s = net.score_candidates(h, nd.array(cand))
+                delta = s - nd.array(lkq)
+                pos = delta[:, :, 0]
+                negd = delta[:, :, 1:]
+                # NCE: true sample classified as data, noise as noise;
+                # softrelu == stable softplus, log(1+exp(x)) sans overflow
+                loss = (nd.Activation(-pos, act_type="softrelu").sum()
+                        + nd.Activation(negd,
+                                        act_type="softrelu").sum()) \
+                    / (B * T)
+            loss.backward()
+            # loss is already a per-token mean; step(1) keeps the
+            # effective lr independent of --batch-size (Trainer
+            # rescales grads by 1/batch_size)
+            trainer.step(1)
+            total += float(loss.asnumpy())
+            count += 1
+
+        # true perplexity with the full softmax (eval-only O(V))
+        h = net.encode(nd.array(xs[:nb]))
+        logits = net.full_logits(h).asnumpy()
+        logp = logits - np.log(
+            np.exp(logits - logits.max(-1, keepdims=True)).sum(
+                -1, keepdims=True)) - logits.max(-1, keepdims=True)
+        ppl = float(np.exp(-np.mean(
+            np.take_along_axis(logp, ys[:nb][..., None], -1))))
+        print(f"epoch {epoch}: nce-loss {total / count:.4f} "
+              f"full-softmax ppl {ppl:.1f}")
+
+    if ppl > args.max_ppl:
+        print(f"FAIL: perplexity {ppl:.1f} > {args.max_ppl}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
