@@ -54,6 +54,8 @@ CASES = [
      ["--threads", "4", "--requests", "2", "--batch-size", "2",
       "--image-size", "32"]),
     ("nce_lm.py", ["--epochs", "3", "--max-ppl", "120"]),
+    ("rbm_digits.py", ["--epochs", "3", "--num-samples", "256",
+                       "--max-recon-err", "0.12"]),
     ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
                            "--num-classes", "4", "--image-shape",
                            "3,16,16", "--batch-size", "4",
