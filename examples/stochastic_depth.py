@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Stochastic-depth residual net: drop whole residual branches while
+training, keep them all (rescaled) at inference.
+
+Reference example: example/stochastic-depth (Huang et al. 2016 on a
+CIFAR ResNet). Each residual block's branch is gated by a Bernoulli
+survival draw during training — a linearly-decaying survival schedule
+from input to output — and scaled by its survival probability at eval.
+
+TPU-first notes: the gate multiplies the branch output by a per-batch
+scalar sample instead of branching with Python `if` — data-dependent
+control flow would force retraces, a multiply keeps one static XLA
+graph for every survival outcome.
+
+  python examples/stochastic_depth.py --epochs 6
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+import mxnet_tpu.autograd as ag  # noqa: E402
+
+from multi_task import make_digits  # noqa: E402
+
+
+class StochasticBlock(gluon.Block):
+    """Residual block whose branch survives with probability p_l."""
+
+    def __init__(self, channels, survival_p, **kw):
+        super().__init__(**kw)
+        self.survival_p = survival_p
+        with self.name_scope():
+            self.body = nn.Sequential()
+            self.body.add(nn.Conv2D(channels, 3, padding=1),
+                          nn.BatchNorm(),
+                          nn.Activation("relu"),
+                          nn.Conv2D(channels, 3, padding=1),
+                          nn.BatchNorm())
+
+    def forward(self, x):
+        branch = self.body(x)
+        if ag.is_training():
+            gate = float(np.random.random() < self.survival_p)
+            return nd.relu(x + gate * branch)
+        # eval: expected value of the gated branch
+        return nd.relu(x + self.survival_p * branch)
+
+
+class StochasticDepthNet(gluon.Block):
+    def __init__(self, num_blocks=6, channels=16, classes=10,
+                 final_survival=0.5, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.stem = nn.Conv2D(channels, 3, padding=1)
+            self.blocks = nn.Sequential()
+            for i in range(num_blocks):
+                # linear decay: early blocks almost always survive,
+                # deep blocks drop half the time (reference schedule)
+                p = 1.0 - (i + 1) / num_blocks * (1.0 - final_survival)
+                self.blocks.add(StochasticBlock(channels, p))
+            self.head = nn.Sequential()
+            self.head.add(nn.GlobalAvgPool2D(), nn.Flatten(),
+                          nn.Dense(classes))
+
+    def forward(self, x):
+        return self.head(self.blocks(self.stem(x)))
+
+
+def evaluate(net, imgs, labels, batch):
+    metric = mx.metric.Accuracy()
+    for i in range(0, len(imgs), batch):
+        out = net(nd.array(imgs[i:i + batch]))
+        metric.update([nd.array(labels[i:i + batch])], [out])
+    return metric.get()[1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-samples", type=int, default=1024)
+    ap.add_argument("--num-blocks", type=int, default=6)
+    ap.add_argument("--min-acc", type=float, default=0.0)
+    args = ap.parse_args()
+    if args.num_samples < args.batch_size:
+        ap.error("--num-samples must be >= --batch-size")
+
+    imgs, labels = make_digits(args.num_samples, seed=41)
+    ev_imgs, ev_labels = make_digits(256, seed=411)
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = StochasticDepthNet(num_blocks=args.num_blocks)
+    net.initialize(init=mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    B = args.batch_size
+    n = (len(imgs) // B) * B
+    acc = 0.0
+    for epoch in range(args.epochs):
+        perm = np.random.default_rng(epoch).permutation(len(imgs))[:n]
+        total = 0.0
+        for i in range(0, n, B):
+            idx = perm[i:i + B]
+            with ag.record():
+                loss = loss_fn(net(nd.array(imgs[idx])),
+                               nd.array(labels[idx])).mean()
+            loss.backward()
+            trainer.step(B)
+            total += float(loss.asnumpy())
+        acc = evaluate(net, ev_imgs, ev_labels, B)
+        print(f"epoch {epoch}: loss {total / (n // B):.4f} "
+              f"eval-acc {acc:.3f}")
+
+    if acc < args.min_acc:
+        print(f"FAIL: accuracy {acc:.3f} < {args.min_acc}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

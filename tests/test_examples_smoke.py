@@ -61,6 +61,8 @@ CASES = [
     # eval set), so a non-learning regression cannot pass it
     ("bayesian_sgld.py", ["--epochs", "100", "--burn-in", "70",
                           "--lr", "2e-4", "--max-rmse", "0.6"]),
+    ("stochastic_depth.py", ["--epochs", "5", "--num-samples", "1024",
+                             "--min-acc", "0.5"]),
     ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
                            "--num-classes", "4", "--image-shape",
                            "3,16,16", "--batch-size", "4",
