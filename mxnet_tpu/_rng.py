@@ -16,8 +16,28 @@ import threading
 
 import jax
 
+
+class _Counter:
+    """itertools.count with a readable position — checkpointing the RNG
+    requires knowing how many keys have been drawn so a restored
+    process replays the exact same stream."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, start=0):
+        self.value = start
+
+    def __next__(self):
+        v = self.value
+        self.value += 1
+        return v
+
+    def __iter__(self):
+        return self
+
+
 _seed = 0
-_counter = itertools.count()
+_counter = _Counter()
 _base_key = None
 
 
@@ -33,8 +53,21 @@ def seed(seed_state: int, ctx=None):
     global _seed, _base_key, _counter, _host_rng
     _seed = int(seed_state)
     _base_key = jax.random.key(_seed)
-    _counter = itertools.count()
+    _counter = _Counter()
     _host_rng = None
+
+
+def get_state():
+    """Snapshot the global RNG for checkpointing: (seed, #keys drawn).
+    JAX keys are counter-based, so this pair fully determines every
+    future draw — a restored process continues the identical stream."""
+    return {"seed": _seed, "draws": _counter.value}
+
+
+def set_state(state):
+    """Restore a snapshot taken by :func:`get_state`."""
+    seed(int(state["seed"]))
+    _counter.value = int(state["draws"])
 
 
 _host_rng = None

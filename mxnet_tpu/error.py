@@ -7,7 +7,8 @@ classes exist for API/except-clause compatibility.
 from .base import MXNetError
 
 __all__ = ["MXNetError", "InternalError", "ValueError", "TypeError",
-           "IndexError", "NotImplementedForSymbol", "register_error"]
+           "IndexError", "NotImplementedForSymbol",
+           "CheckpointCorruptError", "register_error"]
 
 
 class InternalError(MXNetError):
@@ -30,7 +31,17 @@ class NotImplementedForSymbol(MXNetError):
     pass
 
 
+class CheckpointCorruptError(InternalError):
+    """A serialized NDArray container / checkpoint failed validation
+    (bad magic, truncation, CRC mismatch). Recovery paths catch this to
+    fall back to the newest valid checkpoint."""
+
+
 _ERROR_REGISTRY = {"MXNetError": MXNetError}
+_ERROR_REGISTRY.update({
+    c.__name__: c for c in (InternalError, ValueError, TypeError,
+                            IndexError, NotImplementedForSymbol,
+                            CheckpointCorruptError)})
 
 
 def register_error(func_name=None, cls=None):

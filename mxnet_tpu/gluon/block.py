@@ -270,11 +270,16 @@ class Block:
     # ------------------------------------------------------------- state --
     def save_parameters(self, filename, deduplicate=False):
         """Save parameters to file (reference: block.py:433). Format is the
-        NDArray binary map — loadable by ``load_parameters``."""
+        NDArray binary map — loadable by ``load_parameters``. The write
+        is crash-safe: ``nd_save`` publishes via temp-file + fsync +
+        rename (resilience.atomic), so a SIGKILL mid-save leaves any
+        previous ``filename`` intact, never a torn file. Returns the
+        nd_save metadata (file/per-array CRC32s) for checkpoint
+        manifests."""
         params = self._collect_params_with_prefix()
         from ..ndarray import save as nd_save
         arg_dict = {key: val._get_primary() for key, val in params.items()}
-        nd_save(filename, arg_dict)
+        return nd_save(filename, arg_dict)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,

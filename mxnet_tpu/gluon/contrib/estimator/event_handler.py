@@ -20,7 +20,7 @@ __all__ = ["EventHandler", "TrainBegin", "TrainEnd", "EpochBegin",
            "EpochEnd", "BatchBegin", "BatchEnd", "StoppingHandler",
            "MetricHandler", "ValidationHandler", "LoggingHandler",
            "CheckpointHandler", "EarlyStoppingHandler",
-           "GradientUpdateHandler"]
+           "GradientUpdateHandler", "CheckpointOnPreemption"]
 
 
 class EventHandler:
@@ -302,6 +302,62 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
             logging.getLogger("mxnet_tpu.estimator").info(
                 "Early stop at epoch %d: best %s=%.4f",
                 self.stopped_epoch, self.monitor.get()[0], self.best)
+
+
+class CheckpointOnPreemption(TrainBegin, BatchEnd, TrainEnd):
+    """Preemption-aware checkpointing: a SIGTERM/SIGINT during training
+    triggers ONE final full-state checkpoint at the next step boundary,
+    then stops the training loop cleanly.
+
+    The signal itself only sets a flag (resilience.PreemptionGuard);
+    this handler polls it in ``batch_end`` — after the gradient update,
+    when params/optimizer state are consistent — writes a
+    resilience.checkpoint directory via ``trainer.save_state`` (plus the
+    net's parameters for trainers without full-state support), and sets
+    ``stop_training``. Resume with ``trainer.restore_state(ckpt_dir)``.
+
+    priority: runs after GradientUpdateHandler (-2000) so the step that
+    was in flight when the signal landed is fully applied before the
+    save.
+    """
+
+    def __init__(self, ckpt_dir, signals=None, priority=-1000):
+        from ....resilience import PreemptionGuard
+        self.ckpt_dir = ckpt_dir
+        self.priority = priority
+        kwargs = {} if signals is None else {"signals": signals}
+        self.guard = PreemptionGuard(**kwargs)
+        self.stop_training = False
+        self.current_batch = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.stop_training = False
+        self.current_batch = 0
+        self.guard.install()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if not self.guard.requested or self.stop_training:
+            return
+        self.logger.warning(
+            "Preemption signal %s received: checkpointing to %s and "
+            "stopping", self.guard.signum, self.ckpt_dir)
+        self._save(estimator)
+        self.stop_training = True
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.guard.uninstall()
+
+    def _save(self, estimator):
+        trainer = getattr(estimator, "trainer", None)
+        if trainer is not None and hasattr(trainer, "save_state"):
+            trainer.save_state(self.ckpt_dir)
+        else:
+            # fall back to params-only via the atomic nd.save path
+            os.makedirs(self.ckpt_dir, exist_ok=True)
+            estimator.net.save_parameters(
+                os.path.join(self.ckpt_dir, "preempt.params"))
 
 
 class GradientUpdateHandler(BatchEnd):

@@ -52,6 +52,7 @@ class Trainer:
         self._update_on_kvstore = None
         self._params_to_init = []
         self._contains_sparse_weight = False
+        self._step_count = 0
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -116,6 +117,9 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        self._step_count += 1
+        from ..resilience import faults
+        faults.on_step(self._step_count)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -180,7 +184,8 @@ class Trainer:
         # dump the optimizer itself only on the update-on-kvstore path
         # (reference trainer.py:470) — with param_dict pointing at live
         # Parameters, dump_optimizer would embed every weight in the file
-        with open(fname, "wb") as f:
+        from ..resilience.atomic import atomic_write
+        with atomic_write(fname) as f:
             f.write(self._updaters[0].get_states(
                 dump_optimizer=bool(self._update_on_kvstore)))
 
@@ -196,3 +201,96 @@ class Trainer:
         self._optimizer = self._updaters[0].optimizer
         self._optimizer.param_dict = {
             i: param for i, param in enumerate(self._params)}
+
+    # -------------------------------------------------- full-state ckpt --
+    def save_state(self, run_dir, step=None, epoch=None, keep=5):
+        """Commit the FULL training state to a crash-safe checkpoint
+        directory: parameter values, optimizer slots, AMP loss-scaler
+        state, global RNG position, and the step counter. Unlike
+        ``save_states`` (optimizer pickle only, reference parity), a
+        checkpoint written here plus ``restore_state`` resumes a run
+        bit-exactly across a process restart. Returns the checkpoint
+        path (None on non-zero ranks)."""
+        import pickle
+        from .. import _rng
+        from ..resilience import checkpoint as ckpt
+        if not self._kv_initialized:
+            self._init_kvstore()
+        # keyed by position, not name: gluon name prefixes auto-increment
+        # per process (dense0_ vs dense1_), so a restarted process could
+        # never match by name; position is what the optimizer state is
+        # keyed by anyway
+        arrays = {f"param:{i}": p._get_primary()
+                  for i, p in enumerate(self._params)
+                  if p._data is not None}
+        # the updater pickle holds only per-index slot arrays; the
+        # Adam-family bias-correction counters live on the Optimizer
+        # itself and must ride along or a resumed run diverges
+        blob = pickle.dumps({
+            "updater": self._updaters[0].get_states(dump_optimizer=False),
+            "optimizer": type(self._optimizer).__name__,
+            "index_update_count": dict(
+                self._optimizer._index_update_count),
+            "num_update": self._optimizer.num_update})
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        extra = {
+            "trainer": "gluon",
+            "step_count": self._step_count,
+            "rng": _rng.get_state(),
+            "scaler": scaler.state_dict() if scaler is not None else None,
+            "param_names": [p.name for p in self._params],
+        }
+        return ckpt.write_checkpoint(
+            run_dir, arrays,
+            step=self._step_count if step is None else step,
+            epoch=epoch, extra=extra,
+            blobs={ckpt.TRAINER_FILE: blob}, keep=keep)
+
+    def restore_state(self, run_dir):
+        """Restore from the newest VALID checkpoint under ``run_dir``
+        (corrupt/partial ones are skipped). Returns the manifest, whose
+        ``step``/``extra`` tell the training loop where to resume.
+        Raises ``mxnet_tpu.error.CheckpointCorruptError`` if nothing
+        restorable exists."""
+        import pickle
+        from .. import _rng, error
+        from ..resilience import checkpoint as ckpt
+        path, manifest = ckpt.latest_checkpoint(run_dir)
+        if path is None:
+            raise error.CheckpointCorruptError(
+                f"'{run_dir}': no restorable checkpoint found")
+        arrays = ckpt.read_arrays(path, manifest)
+        for i, p in enumerate(self._params):
+            key = f"param:{i}"
+            if key in arrays:
+                v = arrays[key]
+                if p._data is not None and p.shape != v.shape:
+                    raise error.InternalError(
+                        f"checkpoint '{path}' parameter #{i} "
+                        f"('{p.name}') has shape {v.shape}, trainer "
+                        f"expects {p.shape}")
+                p.set_data(v)
+            elif p._data is not None:
+                raise error.InternalError(
+                    f"checkpoint '{path}' is missing parameter #{i} "
+                    f"('{p.name}')")
+        blob = pickle.loads(ckpt.read_blob(path, ckpt.TRAINER_FILE,
+                                           manifest))
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._updaters[0].set_states(blob["updater"])
+        self._updaters[0].optimizer = self._optimizer
+        self._optimizer._index_update_count = {
+            int(k): int(v)
+            for k, v in blob.get("index_update_count", {}).items()}
+        self._optimizer.num_update = int(
+            blob.get("num_update", self._optimizer.num_update))
+        extra = manifest.get("extra", {})
+        self._step_count = int(extra.get("step_count",
+                                         manifest.get("step", 0)))
+        if extra.get("rng") is not None:
+            _rng.set_state(extra["rng"])
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None and extra.get("scaler") is not None:
+            scaler.load_state_dict(extra["scaler"])
+        return manifest

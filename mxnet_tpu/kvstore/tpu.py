@@ -29,12 +29,20 @@ _INITIALIZED = False
 
 
 def init_process_group(coordinator_address=None, num_processes=None,
-                       process_id=None):
+                       process_id=None, max_attempts=None):
     """Bootstrap multi-host collectives (≙ KVStore::InitPSEnv,
     include/mxnet/kvstore.h:324). When args are None, reads the
     MXNET_TPU_* env vars that ``python -m mxnet_tpu.launch`` sets
     (falling back to the reference's DMLC_* names); safe to call once
-    per process."""
+    per process.
+
+    The coordinator is routinely not up yet when workers start (rank 0
+    restarting after preemption, slow pod scheduling), so the connect is
+    retried with exponential backoff + per-rank jitter
+    (resilience.retry) instead of failing permanently on the first
+    refused connection. ``max_attempts`` defaults to
+    ``MXNET_TPU_INIT_RETRIES`` (env) or 8; the backoff is seeded by the
+    process rank so a preempted slice does not reconnect in lockstep."""
     import os
     global _INITIALIZED
     if _INITIALIZED:
@@ -54,10 +62,33 @@ def init_process_group(coordinator_address=None, num_processes=None,
     if process_id is None:
         process_id = int(os.environ.get("MXNET_TPU_RANK")
                          or os.environ.get("DMLC_WORKER_ID") or 0)
+    if max_attempts is None:
+        max_attempts = int(os.environ.get("MXNET_TPU_INIT_RETRIES", 8))
     if num_processes is not None and num_processes > 1:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
+        from ..resilience import call_with_retry, faults
+
+        def _connect():
+            faults.check("kvstore.init")
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes, process_id=process_id)
+            except Exception:
+                # initialize sets jax's global client/service state
+                # BEFORE the connect completes; without clearing it every
+                # retry would die on 'initialize should only be called
+                # once' instead of re-attempting the connect
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                raise
+
+        call_with_retry(
+            _connect,
+            retry_on=(OSError, ConnectionError, RuntimeError),
+            max_attempts=max_attempts, base_delay=0.5, max_delay=15.0,
+            seed=process_id)
     _INITIALIZED = True
 
 

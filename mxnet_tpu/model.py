@@ -18,26 +18,41 @@ __all__ = ["save_checkpoint", "load_checkpoint", "load_params",
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
-    """reference: model.py:403."""
+    """reference: model.py:403. The ``.params`` write is atomic
+    (``nd_save`` goes through resilience.atomic): a crash mid-save
+    leaves the previous epoch's file intact, never a torn one. Returns
+    the nd_save metadata (file/array CRCs) for manifest use."""
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json")
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
     param_name = f"{prefix}-{epoch:04d}.params"
-    nd_save(param_name, save_dict)
+    return nd_save(param_name, save_dict)
 
 
 def load_params(prefix, epoch):
-    """reference: model.py:429."""
-    save_dict = nd_load(f"{prefix}-{epoch:04d}.params")
+    """reference: model.py:429.
+
+    Malformed containers raise ``mxnet_tpu.error.CheckpointCorruptError``
+    (from ``nd_load``); keys without the ``arg:``/``aux:`` convention
+    raise ``mxnet_tpu.error.InternalError`` naming the key and file —
+    never silently dropped, never a bare KeyError/ValueError."""
+    from . import error
+    fname = f"{prefix}-{epoch:04d}.params"
+    save_dict = nd_load(fname)
+    if not isinstance(save_dict, dict):
+        raise error.InternalError(
+            f"'{fname}': contains unnamed arrays — not a checkpoint "
+            "saved by save_checkpoint")
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():
-        tp, name = k.split(":", 1)
-        if tp == "arg":
-            arg_params[name] = v
-        elif tp == "aux":
-            aux_params[name] = v
+        tp, _, name = k.partition(":")
+        if not _ or tp not in ("arg", "aux"):
+            raise error.InternalError(
+                f"'{fname}': key '{k}' has no 'arg:'/'aux:' prefix — "
+                "file was not produced by save_checkpoint or is corrupt")
+        (arg_params if tp == "arg" else aux_params)[name] = v
     return arg_params, aux_params
 
 

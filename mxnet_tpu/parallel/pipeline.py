@@ -35,7 +35,17 @@ import jax.flatten_util
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.6 jax keeps it in the experimental namespace
+    from jax.experimental.shard_map import shard_map
+
+# vma varying-ness annotation: identity on pre-0.6 jax, which has
+# no vma type system and needs no annotation
+_pvary = getattr(lax, "pvary", lambda x, axes: x)
+# pre-vma jax: its check_rep pass rejects per-rank switch/accum
+# patterns the pvary annotations would legitimize — disable it there
+_SM_KW = {} if hasattr(lax, "pvary") else {"check_rep": False}
 
 __all__ = ["pipeline_stage_loop", "pipeline_value_and_grad",
            "hetero_pipeline", "HeteroPipeline"]
@@ -60,8 +70,8 @@ def pipeline_stage_loop(stage_fn, n_microbatches: int, mesh: Mesh,
         params = jax.tree_util.tree_map(lambda a: a[0], params)
         rank = lax.axis_index(axis_name)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-        reg0 = lax.pvary(jnp.zeros_like(mbs[0]), (axis_name,))
-        out0 = lax.pvary(jnp.zeros_like(mbs), (axis_name,))
+        reg0 = _pvary(jnp.zeros_like(mbs[0]), (axis_name,))
+        out0 = _pvary(jnp.zeros_like(mbs), (axis_name,))
 
         def tick(carry, t):
             reg, out = carry
@@ -84,7 +94,7 @@ def pipeline_stage_loop(stage_fn, n_microbatches: int, mesh: Mesh,
 
     return shard_map(local, mesh=mesh,
                      in_specs=(P(axis_name), P()),
-                     out_specs=P())
+                     out_specs=P(), **_SM_KW)
 
 
 def pipeline_value_and_grad(stage_fn, loss_fn, n_microbatches: int,
@@ -224,8 +234,8 @@ class HeteroPipeline:
             rank = lax.axis_index(axis)
             perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
             mb_regs = jax.vmap(self._pack_act)(mbs)
-            reg0 = lax.pvary(jnp.zeros_like(mb_regs[0]), (axis,))
-            out0 = lax.pvary(jnp.zeros_like(mb_regs), (axis,))
+            reg0 = _pvary(jnp.zeros_like(mb_regs[0]), (axis,))
+            out0 = _pvary(jnp.zeros_like(mb_regs), (axis,))
 
             def tick(carry, t):
                 reg, out = carry
@@ -251,7 +261,7 @@ class HeteroPipeline:
 
         out = shard_map(local, mesh=self.mesh,
                         in_specs=(P(self.axis_name), P()),
-                        out_specs=P())(packed, mbs)
+                        out_specs=P(), **_SM_KW)(packed, mbs)
         return jax.vmap(lambda r: self._unpack_act(r, self.n_stages))(out)
 
     def value_and_grad(self, loss_fn):

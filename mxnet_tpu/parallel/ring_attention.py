@@ -18,7 +18,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.6 jax keeps it in the experimental namespace
+    from jax.experimental.shard_map import shard_map
+
+# vma varying-ness annotation: identity on pre-0.6 jax, which has
+# no vma type system and needs no annotation
+_pvary = getattr(lax, "pvary", lambda x, axes: x)
+# pre-vma jax: its check_rep pass rejects per-rank switch/accum
+# patterns the pvary annotations would legitimize — disable it there
+_SM_KW = {} if hasattr(lax, "pvary") else {"check_rep": False}
 
 __all__ = ["ring_attention", "sequence_shard"]
 
@@ -72,7 +82,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         l = jnp.zeros(qb.shape[:3], jnp.float32)
         # accumulators are device-varying (each sp-rank's differ): annotate
         # so the fori_loop carry type is stable under vma checking
-        o, m, l = (lax.pvary(a, (axis_name,)) for a in (o, m, l))
+        o, m, l = (_pvary(a, (axis_name,)) for a in (o, m, l))
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         def body(step, carry):
@@ -98,5 +108,5 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec)
+                   out_specs=spec, **_SM_KW)
     return fn(q, k, v)

@@ -118,6 +118,7 @@ class ShardedTrainer:
         self._step_count = 0
         self._rngkey = jax.random.key(0)
         self._params = None
+        self._restore_pending = None
 
     def _ensure_init(self, x):
         if self._params is not None:
@@ -150,6 +151,10 @@ class ShardedTrainer:
                     a._data if isinstance(a, NDArray) else a,
                     self._mesh, s), st,
                 is_leaf=lambda a: isinstance(a, NDArray))
+        self._specs = specs
+        if self._restore_pending is not None:
+            self._apply_restore(self._restore_pending)
+            self._restore_pending = None
 
     @property
     def params(self):
@@ -209,6 +214,8 @@ class ShardedTrainer:
         self._params, self._opt_states, loss = self._step_jit(
             self._params, self._opt_states, sub, t, xb, yb)
         self._step_count += 1
+        from ..resilience import faults
+        faults.on_step(self._step_count)
         if _spans_processes(self._mesh):
             # the loss is replicated; hand back this process's copy so
             # eager reads (asscalar) need no cross-host fetch
@@ -227,9 +234,125 @@ class ShardedTrainer:
         """Write trained parameters back into the Gluon block."""
         load_params(self._block, self._params)
 
+    # -------------------------------------------------- full-state ckpt --
+    def save_state(self, run_dir, epoch=None, keep=5):
+        """Commit the full sharded training state to a crash-safe
+        checkpoint directory (resilience.checkpoint layout): parameters,
+        every optimizer slot, the trainer's PRNG key, and the step
+        counter. Arrays are written as full host values (sharding is a
+        placement property, not a value property), so a checkpoint can
+        be restored under a different mesh/param_spec. Only process 0
+        writes. Returns the checkpoint path (None if uninitialized)."""
+        from ..resilience import checkpoint as ckpt
+        if self._params is None:
+            return None
+        # keyed by position in the sorted name list, not by raw name:
+        # gluon name prefixes auto-increment per process, so a restarted
+        # process re-creating the same architecture gets shifted names —
+        # sorted position is stable, and names ride in `extra` for
+        # diagnostics
+        arrays = {}
+        for idx, n in enumerate(self._names):
+            arrays[f"param:{idx}"] = NDArray(_to_host(self._params[n]))
+        opt_structs = []
+        for tidx, n in enumerate(self._trainable):
+            leaves = jax.tree_util.tree_leaves(self._opt_states[n])
+            opt_structs.append(len(leaves))
+            for i, leaf in enumerate(leaves):
+                arrays[f"opt:{tidx}:{i}"] = NDArray(_to_host(leaf))
+        extra = {
+            "trainer": "sharded",
+            "step_count": self._step_count,
+            "rng_key": _np.asarray(
+                jax.random.key_data(self._rngkey)).tolist(),
+            "opt_leaf_counts": opt_structs,
+            "param_names": list(self._names),
+        }
+        return ckpt.write_checkpoint(run_dir, arrays,
+                                     step=self._step_count, epoch=epoch,
+                                     extra=extra, keep=keep)
+
+    def restore_state(self, run_dir):
+        """Load the newest valid checkpoint under ``run_dir``. Before
+        the first step the restore is deferred and applied inside
+        ``_ensure_init`` (parameter shapes/specs only exist then); after
+        initialization it applies immediately. Either way the next
+        ``step()`` continues bit-exactly from the checkpointed state.
+        Returns the manifest."""
+        from .. import error
+        from ..resilience import checkpoint as ckpt
+        path, manifest = ckpt.latest_checkpoint(run_dir)
+        if path is None:
+            raise error.CheckpointCorruptError(
+                f"'{run_dir}': no restorable checkpoint found")
+        arrays = ckpt.read_arrays(path, manifest)
+        extra = manifest.get("extra", {})
+        state = {"arrays": arrays, "extra": extra}
+        if self._params is None:
+            self._restore_pending = state
+        else:
+            self._apply_restore(state)
+        return manifest
+
+    def _apply_restore(self, state):
+        arrays, extra = state["arrays"], state["extra"]
+        from .. import error
+        for idx, n in enumerate(self._names):
+            key = f"param:{idx}"
+            if key not in arrays:
+                raise error.InternalError(
+                    f"checkpoint is missing parameter #{idx} ('{n}')")
+            v = arrays[key]._data
+            if tuple(v.shape) != tuple(self._params[n].shape):
+                raise error.InternalError(
+                    f"checkpoint parameter #{idx} ('{n}') has shape "
+                    f"{tuple(v.shape)}, model expects "
+                    f"{tuple(self._params[n].shape)}")
+            self._params[n] = _to_global(v, self._mesh, self._specs[n])
+        counts = extra.get("opt_leaf_counts", [])
+        for tidx, n in enumerate(self._trainable):
+            leaves, treedef = jax.tree_util.tree_flatten(
+                self._opt_states[n])
+            want = int(counts[tidx]) if tidx < len(counts) \
+                else len(leaves)
+            if want != len(leaves):
+                raise error.InternalError(
+                    f"checkpoint optimizer state for '{n}' has {want} "
+                    f"slots, current optimizer expects {len(leaves)} — "
+                    "restore with the same optimizer family")
+            new_leaves = []
+            for i in range(len(leaves)):
+                key = f"opt:{tidx}:{i}"
+                if key not in arrays:
+                    raise error.InternalError(
+                        f"checkpoint is missing optimizer slot '{key}'")
+                new_leaves.append(_to_global(arrays[key]._data,
+                                             self._mesh, self._specs[n]))
+            self._opt_states[n] = jax.tree_util.tree_unflatten(
+                treedef, new_leaves)
+        self._step_count = int(extra.get("step_count", 0))
+        if extra.get("rng_key") is not None:
+            self._rngkey = jax.random.wrap_key_data(
+                jnp.asarray(_np.asarray(extra["rng_key"],
+                                        dtype=_np.uint32)))
+
 
 def _is_sharded(arr):
     try:
         return len(arr.devices()) > 1
     except Exception:
         return False
+
+
+def _to_host(arr):
+    """Full host value of a (possibly sharded) global array. Fully
+    addressable arrays are a plain device_get; multi-process global
+    arrays need the allgather (only the checkpoint writer pays it)."""
+    try:
+        addressable = arr.is_fully_addressable
+    except AttributeError:
+        addressable = True
+    if addressable:
+        return _np.asarray(jax.device_get(arr))
+    from jax.experimental import multihost_utils
+    return _np.asarray(multihost_utils.process_allgather(arr, tiled=True))

@@ -1,0 +1,34 @@
+"""mxnet_tpu.resilience — fault-tolerant training primitives.
+
+TPU slices get preempted; processes get SIGKILLed mid-write; the
+coordinator comes up late. This package makes those events survivable:
+
+- :mod:`.atomic` — crash-safe file publication (temp + fsync + rename);
+  every durable write in the repo (``nd.save``, checkpoints) uses it.
+- :mod:`.checkpoint` — manifest-validated checkpoint directories with
+  per-array CRC32, a ``LATEST`` pointer, and newest-valid fallback scan.
+- :mod:`.retry` — bounded exponential backoff with deterministic jitter.
+- :mod:`.preemption` — :class:`PreemptionGuard`: SIGTERM/SIGINT → flag
+  polled at step boundaries → final checkpoint + clean exit.
+- :mod:`.faults` — the fault-injection harness the tests use to prove
+  each recovery path actually recovers (kill write at byte N, scripted
+  transient OSErrors, SIGTERM at step K).
+
+See docs/RESILIENCE.md for the checkpoint layout and resume recipes.
+"""
+from . import atomic, faults, retry, preemption, checkpoint  # noqa: F401
+from .atomic import atomic_write, is_temp_path
+from .retry import RetryError, backoff_schedule, call_with_retry
+from .retry import retry as with_retry
+from .preemption import PreemptionGuard
+from .checkpoint import (CheckpointManager, write_checkpoint,
+                         latest_checkpoint, validate_checkpoint,
+                         read_arrays, prune_checkpoints)
+from .faults import InjectedCrash
+
+__all__ = ["atomic", "faults", "retry", "preemption", "checkpoint",
+           "atomic_write", "is_temp_path", "RetryError",
+           "backoff_schedule", "call_with_retry", "with_retry",
+           "PreemptionGuard", "CheckpointManager", "write_checkpoint",
+           "latest_checkpoint", "validate_checkpoint", "read_arrays",
+           "prune_checkpoints", "InjectedCrash"]

@@ -1,0 +1,287 @@
+"""Crash-safe checkpoint directories with validated manifests.
+
+Layout (one run directory, many checkpoints)::
+
+    run_dir/
+      ckpt-0000000042/
+        data.params        # NDArray container (atomic, per-array CRC32)
+        trainer.pkl        # optional opaque trainer blob (atomic)
+        MANIFEST.json      # written LAST, atomically — commit record
+      ckpt-0000000084/...
+      LATEST               # name of the newest committed checkpoint
+
+The manifest is the commit point: a checkpoint directory without a
+valid manifest (or whose files fail their CRC/size check) simply does
+not exist as far as readers are concerned. Because every file lands via
+``atomic_write`` and the manifest is written after the data it
+describes, a crash at ANY byte of the save leaves the previous
+checkpoint fully readable — :func:`latest_checkpoint` scans newest
+first and silently skips partial/corrupt directories.
+
+Manifest schema (``mxtpu-ckpt-v1``)::
+
+    {"format": "mxtpu-ckpt-v1", "step": 42, "epoch": 3,
+     "wall_time": 1722675300.1,
+     "files":  {"data.params": {"crc32": ..., "nbytes": ...}, ...},
+     "arrays": {"w": {"crc32":..., "nbytes":..., "shape": [..],
+                      "dtype": "float32"}, ...},
+     "extra":  {...}}           # trainer-specific (rng, scaler, ...)
+
+Checkpoint I/O is wrapped in bounded :mod:`.retry` so transient
+``OSError`` (NFS blips, scripted test faults) are survived; an injected
+crash is a ``BaseException`` and is never retried — a kill stays a kill.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from . import faults
+from .atomic import atomic_write, crc32_file, is_temp_path
+from .retry import call_with_retry
+
+__all__ = ["MANIFEST_NAME", "DATA_FILE", "TRAINER_FILE", "LATEST_NAME",
+           "CKPT_PREFIX", "FORMAT", "checkpoint_dirname",
+           "write_checkpoint", "validate_checkpoint", "list_checkpoints",
+           "latest_checkpoint", "read_arrays", "read_blob",
+           "prune_checkpoints", "CheckpointManager"]
+
+MANIFEST_NAME = "MANIFEST.json"
+DATA_FILE = "data.params"
+TRAINER_FILE = "trainer.pkl"
+LATEST_NAME = "LATEST"
+CKPT_PREFIX = "ckpt-"
+FORMAT = "mxtpu-ckpt-v1"
+
+_RETRY = dict(retry_on=(OSError,), max_attempts=4, base_delay=0.02,
+              max_delay=0.5)
+
+
+def _corrupt(msg):
+    from ..error import CheckpointCorruptError
+    return CheckpointCorruptError(msg)
+
+
+def checkpoint_dirname(step: int) -> str:
+    return f"{CKPT_PREFIX}{int(step):010d}"
+
+
+def _step_of(dirname: str):
+    try:
+        return int(dirname[len(CKPT_PREFIX):])
+    except (ValueError, IndexError):
+        return None
+
+
+# ---------------------------------------------------------------- write ----
+
+def write_checkpoint(run_dir, arrays, step, epoch=None, extra=None,
+                     blobs=None, keep=None):
+    """Commit one checkpoint under ``run_dir``; returns its path.
+
+    arrays : dict name -> NDArray (saved into ``data.params``)
+    blobs  : optional dict filename -> bytes (opaque sidecar files,
+             e.g. pickled optimizer state), each written atomically and
+             CRC-recorded in the manifest
+    extra  : JSON-serializable trainer metadata stored verbatim
+    keep   : if set, prune to the newest ``keep`` valid checkpoints
+
+    In multi-process runs only process 0 writes (checkpoints hold
+    replicated/global state; N identical writers would race on the same
+    files); other ranks return ``None``.
+    """
+    if _process_index() != 0:
+        return None
+    os.makedirs(run_dir, exist_ok=True)
+    ckpt = os.path.join(run_dir, checkpoint_dirname(step))
+    os.makedirs(ckpt, exist_ok=True)
+
+    def _write_all():
+        faults.check("checkpoint.write")
+        from ..ndarray import save as nd_save
+        files = {}
+        data_path = os.path.join(ckpt, DATA_FILE)
+        meta = nd_save(data_path, dict(arrays))
+        files[DATA_FILE] = {"crc32": meta["crc32"],
+                            "nbytes": meta["nbytes"]}
+        for fname, payload in (blobs or {}).items():
+            with atomic_write(os.path.join(ckpt, fname)) as f:
+                f.write(payload)
+            files[fname] = {"crc32": f.crc32, "nbytes": f.nbytes}
+        manifest = {"format": FORMAT, "step": int(step),
+                    "epoch": None if epoch is None else int(epoch),
+                    "wall_time": time.time(), "files": files,
+                    "arrays": meta["arrays"], "extra": extra or {}}
+        # the manifest write is the commit: everything above is invisible
+        # to readers until this rename lands
+        with atomic_write(os.path.join(ckpt, MANIFEST_NAME)) as f:
+            f.write(json.dumps(manifest, indent=1).encode())
+        return manifest
+
+    call_with_retry(_write_all, **_RETRY)
+    with atomic_write(os.path.join(run_dir, LATEST_NAME)) as f:
+        f.write(os.path.basename(ckpt).encode())
+    if keep is not None:
+        prune_checkpoints(run_dir, keep)
+    return ckpt
+
+
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+# ----------------------------------------------------------------- read ----
+
+def validate_checkpoint(ckpt_dir):
+    """Return the manifest of a committed, intact checkpoint; raise
+    :class:`~mxnet_tpu.error.CheckpointCorruptError` otherwise (missing
+    or unparsable manifest, missing files, size/CRC mismatch)."""
+    mpath = os.path.join(ckpt_dir, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        raise _corrupt(f"{ckpt_dir}: no {MANIFEST_NAME} — checkpoint was "
+                       "never committed (partial write?)")
+    try:
+        with open(mpath, "rb") as f:
+            manifest = json.loads(f.read().decode())
+    except (OSError, ValueError, UnicodeDecodeError) as exc:
+        raise _corrupt(f"{mpath}: unreadable manifest: {exc!r}") from exc
+    if manifest.get("format") != FORMAT:
+        raise _corrupt(f"{mpath}: unknown format "
+                       f"{manifest.get('format')!r}")
+    for fname, want in manifest.get("files", {}).items():
+        path = os.path.join(ckpt_dir, fname)
+        if not os.path.isfile(path):
+            raise _corrupt(f"{ckpt_dir}: missing file {fname}")
+        crc, n = crc32_file(path)
+        if n != int(want["nbytes"]) or crc != int(want["crc32"]):
+            raise _corrupt(
+                f"{path}: size/CRC mismatch (got {n}B crc {crc}, "
+                f"manifest says {want['nbytes']}B crc {want['crc32']})")
+    return manifest
+
+
+def list_checkpoints(run_dir):
+    """All checkpoint dirs under ``run_dir`` as ``[(step, path)]``,
+    newest first, committed or not (use :func:`validate_checkpoint` to
+    filter). Temp strays are skipped."""
+    out = []
+    try:
+        entries = os.listdir(run_dir)
+    except OSError:
+        return out
+    for name in entries:
+        if is_temp_path(name) or not name.startswith(CKPT_PREFIX):
+            continue
+        step = _step_of(name)
+        path = os.path.join(run_dir, name)
+        if step is not None and os.path.isdir(path):
+            out.append((step, path))
+    out.sort(reverse=True)
+    return out
+
+
+def latest_checkpoint(run_dir):
+    """Newest checkpoint that validates, as ``(path, manifest)``;
+    ``(None, None)`` if none. The newest-first scan is authoritative —
+    the ``LATEST`` pointer can be one save stale (writer killed between
+    the manifest commit and the pointer update) and is only consulted as
+    a last-resort fallback for non-``ckpt-*`` directory names."""
+    from ..error import CheckpointCorruptError
+    for _, path in list_checkpoints(run_dir):
+        try:
+            return path, validate_checkpoint(path)
+        except CheckpointCorruptError:
+            continue
+    latest = os.path.join(run_dir, LATEST_NAME)
+    if os.path.isfile(latest):
+        try:
+            with open(latest) as f:
+                cand = os.path.join(run_dir, f.read().strip())
+            return cand, validate_checkpoint(cand)
+        except (OSError, CheckpointCorruptError):
+            pass
+    return None, None
+
+
+def read_arrays(ckpt_dir, manifest=None, verify_arrays=False):
+    """Load ``data.params`` from a checkpoint.
+
+    When ``manifest`` comes from a just-run :func:`validate_checkpoint`
+    (the usual restore path), its whole-file CRC already covered every
+    byte of ``data.params``, so the per-array re-check is skipped by
+    default — restoring a large model reads the file once, not twice.
+    Pass ``verify_arrays=True`` to re-check each array anyway (e.g. when
+    the validation happened long before the read)."""
+    if manifest is None:
+        manifest = validate_checkpoint(ckpt_dir)
+    from ..ndarray import load as nd_load
+    return nd_load(os.path.join(ckpt_dir, DATA_FILE),
+                   manifest=manifest.get("arrays") if verify_arrays
+                   else None)
+
+
+def read_blob(ckpt_dir, fname, manifest=None):
+    """Read a sidecar blob, CRC-checked against the manifest."""
+    if manifest is None:
+        manifest = validate_checkpoint(ckpt_dir)
+    want = manifest.get("files", {}).get(fname)
+    path = os.path.join(ckpt_dir, fname)
+    with open(path, "rb") as f:
+        payload = f.read()
+    if want is not None:
+        import zlib
+        if len(payload) != int(want["nbytes"]) or \
+                zlib.crc32(payload) != int(want["crc32"]):
+            raise _corrupt(f"{path}: blob CRC mismatch")
+    return payload
+
+
+def prune_checkpoints(run_dir, keep: int):
+    """Delete all but the newest ``keep`` VALID checkpoints (invalid /
+    partial directories are always removed — they are unreadable noise a
+    crashed writer left behind)."""
+    from ..error import CheckpointCorruptError
+    import shutil
+    valid = []
+    for step, path in list_checkpoints(run_dir):
+        try:
+            validate_checkpoint(path)
+            valid.append(path)
+        except CheckpointCorruptError:
+            shutil.rmtree(path, ignore_errors=True)
+    for path in valid[keep:]:
+        shutil.rmtree(path, ignore_errors=True)
+
+
+class CheckpointManager:
+    """Convenience wrapper binding a run directory + retention policy.
+
+    >>> mgr = CheckpointManager(run_dir, keep=3)
+    >>> mgr.save(arrays, step=10, extra={"rng": ...})
+    >>> path, manifest = mgr.latest()
+    >>> arrays = mgr.load_arrays(path, manifest)
+    """
+
+    def __init__(self, run_dir, keep=5):
+        self.run_dir = os.fspath(run_dir)
+        self.keep = keep
+
+    def save(self, arrays, step, epoch=None, extra=None, blobs=None):
+        return write_checkpoint(self.run_dir, arrays, step, epoch=epoch,
+                                extra=extra, blobs=blobs, keep=self.keep)
+
+    def latest(self):
+        return latest_checkpoint(self.run_dir)
+
+    def load_arrays(self, ckpt_dir=None, manifest=None):
+        if ckpt_dir is None:
+            ckpt_dir, manifest = self.latest()
+            if ckpt_dir is None:
+                raise _corrupt(
+                    f"{self.run_dir}: no restorable checkpoint found")
+        return read_arrays(ckpt_dir, manifest)

@@ -1,0 +1,150 @@
+"""Deterministic fault-injection harness.
+
+The recovery paths in this subsystem (atomic writes, retry/backoff,
+preemption checkpoints) are only trustworthy if tests can *make* the
+faults happen. This module is the single switchboard: production code
+calls the hooks below (``check``, ``wrap_file``, ``on_step``) which are
+near-free no-ops until a test arms an injector, then:
+
+- ``kill_write_at(match, nbytes)`` aborts a file write after exactly N
+  bytes, leaving the partial temp file on disk — a simulated SIGKILL
+  mid-checkpoint (the atomic layer deliberately does NOT clean up on
+  :class:`InjectedCrash`, because a real dead process wouldn't).
+- ``script(site, [OSError(...), OSError(...), None])`` raises a scripted
+  exception sequence at a named call site — simulated transient I/O or
+  coordinator-connect failures, consumed one per call.
+- ``sigterm_at_step(k)`` delivers a real ``SIGTERM`` to this process the
+  k-th time a training step completes — the preemption drill.
+
+All schedules are explicit and deterministic: no randomness, no timers.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+__all__ = ["InjectedCrash", "FaultInjector", "active", "reset",
+           "kill_write_at", "script", "sigterm_at_step",
+           "check", "wrap_file", "on_step"]
+
+
+class InjectedCrash(BaseException):
+    """Simulated hard process death mid-write.
+
+    BaseException on purpose: library code that catches ``Exception``
+    (best-effort checkpoint handlers, cleanup paths) must not swallow a
+    simulated crash, exactly as it could not swallow a real SIGKILL.
+    """
+
+
+class _CountingFile:
+    """File proxy that counts written bytes and crashes at a threshold."""
+
+    def __init__(self, f, limit, injector):
+        self._f = f
+        self._limit = limit
+        self._written = 0
+        self._injector = injector
+
+    def write(self, data):
+        room = self._limit - self._written
+        if room <= 0:
+            raise InjectedCrash(
+                f"injected write kill at byte {self._limit}")
+        if len(data) > room:
+            self._f.write(data[:room])
+            self._f.flush()
+            self._written = self._limit
+            raise InjectedCrash(
+                f"injected write kill at byte {self._limit}")
+        self._f.write(data)
+        self._written += len(data)
+
+    def __getattr__(self, item):
+        return getattr(self._f, item)
+
+
+class FaultInjector:
+    """Holds the armed fault schedules. One global instance (``active``)
+    is consulted by the resilience hooks; tests arm it and ``reset()``
+    in teardown."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with getattr(self, "_lock", threading.Lock()):
+            self._write_kills = []        # [(substr, nbytes)]
+            self._scripts = {}            # site -> list of Exception|None
+            self._sigterm_step = None
+            self._step = 0
+            self.armed = False
+
+    # ------------------------------------------------------------- arm --
+    def kill_write_at(self, match: str, nbytes: int):
+        """Abort (InjectedCrash) any write to a path containing ``match``
+        after exactly ``nbytes`` bytes."""
+        with self._lock:
+            self._write_kills.append((match, int(nbytes)))
+            self.armed = True
+
+    def script(self, site: str, schedule):
+        """Raise the scheduled exceptions, in order, on successive calls
+        to ``check(site)``; ``None`` entries (and exhaustion) mean
+        success."""
+        with self._lock:
+            self._scripts.setdefault(site, []).extend(schedule)
+            self.armed = True
+
+    def sigterm_at_step(self, k: int):
+        """Deliver SIGTERM to this process when step count reaches k
+        (1-based, counted by ``on_step``)."""
+        with self._lock:
+            self._sigterm_step = int(k)
+            self._step = 0
+            self.armed = True
+
+    # ----------------------------------------------------------- hooks --
+    def check(self, site: str):
+        """Consume and raise the next scripted fault for ``site``."""
+        if not self.armed:
+            return
+        with self._lock:
+            sched = self._scripts.get(site)
+            exc = sched.pop(0) if sched else None
+        if exc is not None:
+            raise exc
+
+    def wrap_file(self, f, path: str):
+        """Return ``f`` or a crash-at-byte-N proxy if armed for ``path``."""
+        if not self.armed:
+            return f
+        with self._lock:
+            for match, nbytes in self._write_kills:
+                if match in str(path):
+                    return _CountingFile(f, nbytes, self)
+        return f
+
+    def on_step(self, step=None):
+        """Training-loop step hook; fires the scheduled SIGTERM."""
+        if not self.armed or self._sigterm_step is None:
+            return
+        with self._lock:
+            self._step += 1
+            fire = self._step == self._sigterm_step
+        if fire:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+active = FaultInjector()
+
+# Module-level conveniences bound to the global injector.
+reset = active.reset
+kill_write_at = active.kill_write_at
+script = active.script
+sigterm_at_step = active.sigterm_at_step
+check = active.check
+wrap_file = active.wrap_file
+on_step = active.on_step

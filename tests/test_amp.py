@@ -71,6 +71,59 @@ def test_loss_scaler_overflow_skips_update_and_halves_scale():
     assert not np.array_equal(net.weight.data().asnumpy(), w_before)
 
 
+def test_loss_scaler_recovery_doubles_after_scale_window():
+    """Full overflow→recovery cycle through the trainer: overflow halves
+    the scale and skips the update; after ``scale_window`` clean steps
+    the scale doubles back."""
+    amp.init(target_dtype="float16")
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    from mxnet_tpu.amp import LossScaler
+    amp.init_trainer(trainer, loss_scaler=LossScaler(
+        init_scale=2.0 ** 8, scale_window=3, target_dtype="float16"))
+    scaler = trainer._amp_loss_scaler
+    x = nd.array(np.ones((2, 4), np.float32))
+
+    def one_step(poison=False):
+        with ag.record():
+            loss = net(x).sum()
+        loss.backward()
+        if poison:
+            net.weight.grad()._data = net.weight.grad()._data * np.inf
+        trainer.step(2)
+
+    with pytest.warns(UserWarning, match="overflow"):
+        one_step(poison=True)
+    assert scaler.loss_scale == 2.0 ** 7          # halved
+    steps_before = trainer._step_count
+    for _ in range(3):                            # scale_window clean steps
+        one_step()
+    assert scaler.loss_scale == 2.0 ** 8          # doubled back
+    assert trainer._step_count == steps_before + 3  # none skipped
+
+
+def test_has_overflow_fused_single_reduction():
+    """has_overflow folds ALL grads into one jitted reduction: it must
+    flag a non-finite value in any parameter, and pass on clean grads."""
+    from mxnet_tpu.amp import LossScaler
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2))
+    net.initialize()
+    x = nd.array(np.ones((2, 4), np.float32))
+    with ag.record():
+        loss = net(x).sum()
+    loss.backward()
+    scaler = LossScaler(target_dtype="float16")
+    params = list(net.collect_params().values())
+    assert not scaler.has_overflow(params)
+    # poison ONE grad among many — still caught by the fused check
+    last = params[-1]
+    last.grad()._data = last.grad()._data * np.nan
+    assert scaler.has_overflow(params)
+
+
 def test_scale_loss_context_multiplies_by_scale():
     amp.init(target_dtype="float16")
     net = nn.Dense(2, in_units=2)

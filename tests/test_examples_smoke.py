@@ -84,6 +84,7 @@ def test_example_runs(script, args):
         f"{script} failed:\n{p.stdout[-2000:]}\n{p.stderr[-2000:]}"
 
 
+@pytest.mark.slow   # ~160s of XLA CPU compile for the 4-stage ResNet
 def test_pipeline_parallel_example_runs():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

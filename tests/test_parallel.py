@@ -348,6 +348,7 @@ def test_hetero_pipeline_matches_sequential_oracle():
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+@pytest.mark.slow   # ~200s of XLA CPU compile for the staged ResNet-18
 def test_hetero_pipeline_resnet18_stages():
     """A REAL model through the pipe: ResNet-18 split into 4 stages via
     gluon_pipeline_stages. Forward loss matches the sequential oracle to

@@ -1,0 +1,468 @@
+"""Fault-tolerance tests: crash-safe checkpoints, preemption resume,
+retry/backoff — every recovery path proven by injected faults
+(mxnet_tpu.resilience.faults). All tier-1: fast, CPU-only, in-process.
+"""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.autograd as ag
+from mxnet_tpu import error, nd, resilience as rz
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import checkpoint as ckpt_mod
+from mxnet_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _mlp(seed=7):
+    mx.nd.random.seed(seed)
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    return net
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(8, 4).astype(np.float32),
+            rs.randn(8, 2).astype(np.float32))
+
+
+def _train(net, trainer, n, data=None):
+    x, y = data or _batch()
+    for _ in range(n):
+        with ag.record():
+            loss = ((net(nd.array(x)) - nd.array(y)) ** 2).sum()
+        loss.backward()
+        trainer.step(x.shape[0])
+
+
+# ------------------------------------------------------------- atomic ----
+
+def test_atomic_write_publishes_on_success(tmp_path):
+    p = tmp_path / "f.bin"
+    with rz.atomic_write(str(p)) as f:
+        f.write(b"hello")
+    assert p.read_bytes() == b"hello"
+    assert f.crc32 != 0 and f.nbytes == 5
+    # no temp strays after a clean write
+    assert not [n for n in os.listdir(tmp_path) if rz.is_temp_path(n)]
+
+
+def test_atomic_write_crash_leaves_previous_contents(tmp_path):
+    p = tmp_path / "f.bin"
+    with rz.atomic_write(str(p)) as f:
+        f.write(b"version-one")
+    faults.kill_write_at("f.bin", 4)
+    with pytest.raises(rz.InjectedCrash):
+        with rz.atomic_write(str(p)) as f:
+            f.write(b"version-two-longer")
+    # the reader still sees the old version; the stray temp is marked
+    assert p.read_bytes() == b"version-one"
+    strays = [n for n in os.listdir(tmp_path) if rz.is_temp_path(n)]
+    assert strays, "crash should leave the partial temp file behind"
+
+
+def test_nd_save_killed_at_any_byte_never_corrupts(tmp_path):
+    """Golden crash sweep: kill the container write at many byte
+    offsets; a reader must ALWAYS see the previous intact file."""
+    path = str(tmp_path / "w.params")
+    old = {"w": nd.array([1.0, 2.0, 3.0]), "b": nd.array([[9.0]])}
+    meta = nd.save(path, old)
+    new = {"w": nd.array([4.0, 5.0, 6.0]), "b": nd.array([[-1.0]])}
+    for cut in range(0, meta["nbytes"] + 1, 13):
+        faults.kill_write_at("w.params", cut)
+        with pytest.raises(rz.InjectedCrash):
+            nd.save(path, new)
+        faults.reset()
+        back = nd.load(path, manifest=meta["arrays"])
+        assert np.array_equal(back["w"].asnumpy(), [1.0, 2.0, 3.0])
+    nd.save(path, new)   # clean write finally goes through
+    assert np.array_equal(nd.load(path)["w"].asnumpy(), [4.0, 5.0, 6.0])
+
+
+def test_block_save_parameters_is_atomic(tmp_path):
+    net = _mlp()
+    p = str(tmp_path / "net.params")
+    net.save_parameters(p)
+    before = net.weight.data().asnumpy().copy()
+    net.weight.set_data(nd.array(before + 1))
+    faults.kill_write_at("net.params", 10)
+    with pytest.raises(rz.InjectedCrash):
+        net.save_parameters(p)
+    faults.reset()
+    net2 = _mlp(seed=8)
+    net2.load_parameters(p)   # previous file must still be loadable
+    assert np.array_equal(net2.weight.data().asnumpy(), before)
+
+
+# ------------------------------------------------------- typed errors ----
+
+def test_load_rejects_truncated_file(tmp_path):
+    p = str(tmp_path / "t.params")
+    nd.save(p, {"w": nd.array([1.0, 2.0])})
+    raw = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(raw[:len(raw) - 3])
+    with pytest.raises(error.CheckpointCorruptError):
+        nd.load(p)
+
+
+def test_load_rejects_bad_magic(tmp_path):
+    p = str(tmp_path / "junk.params")
+    with open(p, "wb") as f:
+        f.write(b"\x00" * 64)
+    with pytest.raises(error.CheckpointCorruptError):
+        nd.load(p)
+
+
+def test_load_crc_mismatch_with_manifest(tmp_path):
+    p = str(tmp_path / "c.params")
+    meta = nd.save(p, {"w": nd.array([1.0, 2.0, 3.0])})
+    raw = bytearray(open(p, "rb").read())
+    raw[-2] ^= 0xFF   # flip a payload bit, sizes stay right
+    with open(p, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(error.CheckpointCorruptError):
+        nd.load(p, manifest=meta["arrays"])
+
+
+def test_model_load_params_typed_errors(tmp_path):
+    prefix = str(tmp_path / "m")
+    nd.save(f"{prefix}-0003.params", {"bogus_key": nd.array([1.0])})
+    with pytest.raises(error.InternalError, match="bogus_key"):
+        mx.model.load_params(prefix, 3)
+    # CheckpointCorruptError (a subclass of InternalError) on malformed
+    with open(f"{prefix}-0004.params", "wb") as f:
+        f.write(b"not a container")
+    with pytest.raises(error.CheckpointCorruptError):
+        mx.model.load_params(prefix, 4)
+
+
+# --------------------------------------------------- checkpoint dirs  ----
+
+def test_manager_skips_corrupt_and_falls_back(tmp_path):
+    run = str(tmp_path / "run")
+    mgr = rz.CheckpointManager(run, keep=10)
+    for s in (1, 2, 3):
+        mgr.save({"w": nd.array([float(s)])}, step=s)
+    # corrupt the newest checkpoint's payload after commit
+    newest = os.path.join(run, ckpt_mod.checkpoint_dirname(3),
+                          ckpt_mod.DATA_FILE)
+    with open(newest, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(size - 1)
+        f.write(b"\xff")
+    path, manifest = mgr.latest()
+    assert manifest["step"] == 2
+    assert np.array_equal(mgr.load_arrays(path, manifest)["w"].asnumpy(),
+                          [2.0])
+
+
+def test_crashed_save_ignored_previous_restorable(tmp_path):
+    run = str(tmp_path / "run")
+    mgr = rz.CheckpointManager(run)
+    mgr.save({"w": nd.array([1.0])}, step=1)
+    faults.kill_write_at(ckpt_mod.DATA_FILE, 25)
+    with pytest.raises(rz.InjectedCrash):
+        mgr.save({"w": nd.array([2.0])}, step=2)
+    faults.reset()
+    path, manifest = mgr.latest()
+    assert manifest["step"] == 1   # partial ckpt-…2 dir is invisible
+    # the partial directory exists on disk but never validates
+    partial = os.path.join(run, ckpt_mod.checkpoint_dirname(2))
+    assert os.path.isdir(partial)
+    with pytest.raises(error.CheckpointCorruptError):
+        rz.validate_checkpoint(partial)
+    # pruning clears the unreadable partial
+    rz.prune_checkpoints(run, keep=5)
+    assert not os.path.isdir(partial)
+
+
+def test_checkpoint_write_retries_transient_oserrors(tmp_path):
+    faults.script("checkpoint.write",
+                  [OSError("flaky-1"), OSError("flaky-2")])
+    run = str(tmp_path / "run")
+    path = rz.write_checkpoint(run, {"w": nd.array([5.0])}, step=7)
+    assert path is not None
+    _, manifest = rz.latest_checkpoint(run)
+    assert manifest["step"] == 7   # succeeded on the 3rd attempt
+
+
+def test_latest_pointer_stale_falls_back_to_scan(tmp_path):
+    run = str(tmp_path / "run")
+    mgr = rz.CheckpointManager(run)
+    mgr.save({"w": nd.array([1.0])}, step=1)
+    with open(os.path.join(run, ckpt_mod.LATEST_NAME), "w") as f:
+        f.write("ckpt-0000009999")   # points at nothing
+    path, manifest = rz.latest_checkpoint(run)
+    assert manifest is not None and manifest["step"] == 1
+
+
+def test_latest_pointer_behind_does_not_hide_newer(tmp_path):
+    """Writer killed between manifest commit and LATEST update: the
+    newer committed checkpoint must win over the stale pointer."""
+    run = str(tmp_path / "run")
+    mgr = rz.CheckpointManager(run)
+    mgr.save({"w": nd.array([1.0])}, step=1)
+    mgr.save({"w": nd.array([2.0])}, step=2)
+    with open(os.path.join(run, ckpt_mod.LATEST_NAME), "w") as f:
+        f.write(ckpt_mod.checkpoint_dirname(1))   # one save stale
+    path, manifest = rz.latest_checkpoint(run)
+    assert manifest["step"] == 2
+
+
+def test_verify_checkpoint_cli(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import verify_checkpoint
+    finally:
+        sys.path.pop(0)
+    run = str(tmp_path / "run")
+    mgr = rz.CheckpointManager(run)
+    mgr.save({"w": nd.array([1.0])}, step=1)
+    mgr.save({"w": nd.array([2.0])}, step=2)
+    assert verify_checkpoint.main([run, "--quiet"]) == 0
+    # corrupt everything → gate fails
+    for _, path in ckpt_mod.list_checkpoints(run):
+        os.remove(os.path.join(path, ckpt_mod.MANIFEST_NAME))
+    assert verify_checkpoint.main([run, "--quiet"]) == 1
+    assert verify_checkpoint.main([str(tmp_path / "nope"),
+                                   "--quiet"]) == 1
+
+
+# ----------------------------------------------------- retry/backoff  ----
+
+def test_backoff_schedule_deterministic_and_bounded():
+    a = rz.backoff_schedule(max_attempts=6, base_delay=0.1, max_delay=1.0,
+                            jitter=0.5, seed=3)
+    b = rz.backoff_schedule(max_attempts=6, base_delay=0.1, max_delay=1.0,
+                            jitter=0.5, seed=3)
+    c = rz.backoff_schedule(max_attempts=6, base_delay=0.1, max_delay=1.0,
+                            jitter=0.5, seed=4)
+    assert a == b            # same seed → identical schedule
+    assert a != c            # rank-seeded jitter decorrelates workers
+    assert len(a) == 5
+    for k, d in enumerate(a):
+        lo = min(0.1 * (2.0 ** k), 1.0)
+        assert lo <= d <= lo * 1.5   # jitter only ever lengthens, ≤50%
+
+
+def test_call_with_retry_schedule_and_exhaustion():
+    slept = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(rz.RetryError) as ei:
+        rz.call_with_retry(flaky, max_attempts=4, base_delay=0.1,
+                           seed=11, sleep=slept.append)
+    assert len(calls) == 4
+    assert slept == rz.backoff_schedule(max_attempts=4, base_delay=0.1,
+                                        seed=11)
+    assert isinstance(ei.value.last, OSError)
+    # non-matching exceptions are not retried
+    def bad():
+        calls.append(1)
+        raise KeyError("no")
+    calls.clear()
+    with pytest.raises(KeyError):
+        rz.call_with_retry(bad, max_attempts=4, sleep=slept.append)
+    assert len(calls) == 1
+
+
+def test_init_process_group_retries_transient_failures(monkeypatch):
+    from mxnet_tpu.kvstore import tpu as kvtpu
+    import jax
+
+    attempts = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: attempts.append(kw))
+    monkeypatch.setattr(kvtpu, "_INITIALIZED", False)
+    # retry sleeps must not slow the suite down
+    from mxnet_tpu.resilience import retry as retry_mod
+    monkeypatch.setattr(retry_mod.time, "sleep", lambda s: None)
+    faults.script("kvstore.init",
+                  [ConnectionRefusedError("coordinator not up"),
+                   OSError("still booting"),
+                   RuntimeError("barrier timeout")])
+    kvtpu.init_process_group(coordinator_address="127.0.0.1:9",
+                             num_processes=2, process_id=0)
+    assert len(attempts) == 1          # connected on the 4th attempt
+    assert kvtpu._INITIALIZED
+    monkeypatch.setattr(kvtpu, "_INITIALIZED", False)
+
+
+# ------------------------------------------------ trainer round-trips ----
+
+def test_gluon_trainer_restore_bit_exact(tmp_path):
+    run = str(tmp_path / "run")
+    netA = _mlp()
+    trA = mx.gluon.Trainer(netA.collect_params(), "adam",
+                           {"learning_rate": 0.05})
+    _train(netA, trA, 3)
+    assert trA.save_state(run) is not None
+    _train(netA, trA, 4)
+    wA = [p._get_primary().asnumpy() for p in trA._params]
+
+    netB = _mlp(seed=123)   # different init — restore must overwrite
+    trB = mx.gluon.Trainer(netB.collect_params(), "adam",
+                           {"learning_rate": 0.05})
+    manifest = trB.restore_state(run)
+    assert manifest["step"] == 3 and trB._step_count == 3
+    _train(netB, trB, 4)
+    wB = [p._get_primary().asnumpy() for p in trB._params]
+    for a, b in zip(wA, wB):
+        assert np.array_equal(a, b)   # bit-exact continuation
+
+
+def test_sharded_trainer_restore_bit_exact(tmp_path):
+    from mxnet_tpu.parallel import ShardedTrainer
+    run = str(tmp_path / "run")
+    x, y = _batch()
+
+    def make(seed):
+        mx.nd.random.seed(seed)
+        net = nn.Dense(2, in_units=4)
+        net.initialize()
+        return ShardedTrainer(net, lambda p, l: (p - l) ** 2, "adam",
+                              {"learning_rate": 0.05})
+
+    stA = make(9)
+    for _ in range(3):
+        stA.step(x, y)
+    assert stA.save_state(run) is not None
+    for _ in range(4):
+        stA.step(x, y)
+    pA = [np.asarray(stA.params[k]) for k in sorted(stA.params)]
+
+    stB = make(31)   # different init seed — restore must overwrite
+    manifest = stB.restore_state(run)   # deferred until first step
+    assert manifest["step"] == 3
+    for _ in range(4):
+        stB.step(x, y)
+    assert stB._step_count == 7
+    pB = [np.asarray(stB.params[k]) for k in sorted(stB.params)]
+    for a, b in zip(pA, pB):
+        assert np.array_equal(a, b)
+
+
+def test_rng_state_roundtrip():
+    from mxnet_tpu import _rng
+    _rng.seed(42)
+    _rng.next_key()
+    _rng.next_key()
+    st = _rng.get_state()
+    a = np.asarray(mx.ndarray.random.uniform(shape=(4,)).asnumpy())
+    _rng.seed(999)      # trash the stream
+    _rng.set_state(st)  # … and restore it
+    b = np.asarray(mx.ndarray.random.uniform(shape=(4,)).asnumpy())
+    assert np.array_equal(a, b)
+
+
+# --------------------------------------------------------- preemption ----
+
+def test_preemption_guard_flags_and_restores_handler():
+    prev = signal.getsignal(signal.SIGTERM)
+    with rz.PreemptionGuard() as guard:
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.requested
+        assert guard.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_sigterm_at_step_k_checkpoint_and_resume(tmp_path):
+    """The full preemption drill: SIGTERM lands mid-run at step K, the
+    loop checkpoints and exits cleanly; a restarted process restores and
+    finishes with params identical to an uninterrupted run."""
+    run = str(tmp_path / "run")
+    total, k = 7, 3
+
+    def preemptible_run():
+        net = _mlp()
+        tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                              {"learning_rate": 0.05})
+        with rz.PreemptionGuard() as guard:
+            done = 0
+            for _ in range(total):
+                _train(net, tr, 1)
+                done += 1
+                if guard.requested:   # poll at the step boundary
+                    tr.save_state(run)
+                    break
+        return net, tr, done
+
+    faults.sigterm_at_step(k)
+    net1, tr1, done1 = preemptible_run()
+    faults.reset()
+    assert done1 == k    # stopped right at the injected preemption
+    _, manifest = rz.latest_checkpoint(run)
+    assert manifest["step"] == k
+
+    # "restarted process": fresh net+trainer, restore, finish the run
+    net2 = _mlp(seed=55)
+    tr2 = mx.gluon.Trainer(net2.collect_params(), "adam",
+                           {"learning_rate": 0.05})
+    tr2.restore_state(run)
+    _train(net2, tr2, total - k)
+
+    # uninterrupted reference run
+    net3 = _mlp()
+    tr3 = mx.gluon.Trainer(net3.collect_params(), "adam",
+                           {"learning_rate": 0.05})
+    _train(net3, tr3, total)
+
+    for a, b in zip(tr2._params, tr3._params):
+        assert np.array_equal(a._get_primary().asnumpy(),
+                              b._get_primary().asnumpy())
+
+
+def test_estimator_checkpoint_on_preemption(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.gluon.contrib.estimator.event_handler import \
+        CheckpointOnPreemption
+    from mxnet_tpu.gluon import loss as gloss
+
+    run = str(tmp_path / "run")
+    mx.nd.random.seed(3)
+    net = nn.Dense(3, in_units=5)
+    net.initialize()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "sgd",
+                                             {"learning_rate": 0.1}))
+    rs = np.random.RandomState(1)
+    data = [(rs.randn(4, 5).astype(np.float32),
+             rs.randint(0, 3, (4,)).astype(np.float32))
+            for _ in range(6)]
+    handler = CheckpointOnPreemption(run)
+    faults.sigterm_at_step(2)
+    est.fit(train_data=data, epochs=3, event_handlers=[handler])
+    faults.reset()
+    assert handler.stop_training          # loop stopped early, cleanly
+    assert handler.current_batch < 18     # did not run all 3 epochs
+    path, manifest = rz.latest_checkpoint(run)
+    assert manifest is not None and manifest["step"] == 2
+    # and the checkpoint restores into a fresh trainer
+    net2 = _mlp(seed=77)
+    mx.nd.random.seed(4)
+    net2 = nn.Dense(3, in_units=5)
+    net2.initialize()
+    net2(nd.array(data[0][0]))   # materialize params
+    tr2 = mx.gluon.Trainer(net2.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+    tr2.restore_state(run)
+    assert tr2._step_count == 2

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Validate a resilience checkpoint run directory.
+
+Walks every ``ckpt-*`` directory under the given run dir, validates its
+manifest (presence, parsability, per-file size + CRC32), and prints a
+per-checkpoint verdict plus the newest restorable step. Exit code 0 if
+at least one checkpoint is restorable, 1 otherwise — usable as a
+pre-resume health gate in launch scripts:
+
+    python tools/verify_checkpoint.py /ckpts/run1          # report
+    python tools/verify_checkpoint.py /ckpts/run1 --quiet  # gate only
+
+See docs/RESILIENCE.md for the layout and manifest schema.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", help="checkpoint run directory "
+                                    "(contains ckpt-*/ subdirs)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="no per-checkpoint report, just the exit code")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        from mxnet_tpu.error import CheckpointCorruptError
+        from mxnet_tpu.resilience import checkpoint as ckpt
+    except ModuleNotFoundError:   # running from outside the repo root
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from mxnet_tpu.error import CheckpointCorruptError
+        from mxnet_tpu.resilience import checkpoint as ckpt
+
+    def say(*a):
+        if not args.quiet:
+            print(*a)
+
+    entries = ckpt.list_checkpoints(args.run_dir)
+    if not entries:
+        say(f"{args.run_dir}: no ckpt-* directories found")
+        return 1
+
+    newest_ok = None
+    for step, path in entries:   # newest first
+        try:
+            manifest = ckpt.validate_checkpoint(path)
+        except CheckpointCorruptError as exc:
+            say(f"  CORRUPT  {os.path.basename(path)}  ({exc})")
+            continue
+        n_arrays = len(manifest.get("arrays", {}))
+        n_bytes = sum(int(f["nbytes"])
+                      for f in manifest.get("files", {}).values())
+        say(f"  OK       {os.path.basename(path)}  step={manifest['step']}"
+            f"  epoch={manifest.get('epoch')}  arrays={n_arrays}"
+            f"  bytes={n_bytes}")
+        if newest_ok is None:
+            newest_ok = manifest
+
+    if newest_ok is None:
+        say(f"{args.run_dir}: NO restorable checkpoint")
+        return 1
+    say(f"newest restorable step: {newest_ok['step']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
