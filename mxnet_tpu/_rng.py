@@ -20,16 +20,21 @@ import jax
 class _Counter:
     """itertools.count with a readable position — checkpointing the RNG
     requires knowing how many keys have been drawn so a restored
-    process replays the exact same stream."""
+    process replays the exact same stream. Locked: unlike
+    itertools.count's C-level __next__, a Python read-modify-write is
+    not atomic under the GIL, and concurrent eager draws (the threaded
+    inference paths) must never hand two threads the same position."""
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self, start=0):
         self.value = start
+        self._lock = threading.Lock()
 
     def __next__(self):
-        v = self.value
-        self.value += 1
+        with self._lock:
+            v = self.value
+            self.value += 1
         return v
 
     def __iter__(self):

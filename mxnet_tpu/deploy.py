@@ -30,10 +30,17 @@ __all__ = ["export_predictor", "load_predictor", "Predictor"]
 _MAGIC = b"MXTPUPRED1"
 
 
-def export_predictor(net, example_input, path=None, training=False):
+def export_predictor(net, example_input, path=None, training=False,
+                     poly_batch=False):
     """Serialize a gluon block's forward (params baked in) to a
     self-contained artifact. ``example_input``: NDArray/ndarray fixing
     the input shape/dtype. Returns the bytes; writes ``path`` if given.
+
+    With ``poly_batch=True`` the leading (batch) dimension is exported
+    symbolically (``jax.export`` shape polymorphism): the loaded
+    predictor then accepts ANY batch size, compiling once per distinct
+    size it sees — the property ``mxnet_tpu.serving`` relies on to run
+    a fixed bucket set with zero steady-state recompiles.
     """
     import jax
     from jax import export as jexport
@@ -52,11 +59,16 @@ def export_predictor(net, example_input, path=None, training=False):
         out, _ = functional_call(net, params, inp, training=training)
         return out
 
+    spec_shape = x.shape
+    if poly_batch:
+        spec_shape = tuple(jexport.symbolic_shape("b")) \
+            + tuple(x.shape[1:])
     exp = jexport.export(jax.jit(fwd))(
-        jax.ShapeDtypeStruct(x.shape, x.dtype))
+        jax.ShapeDtypeStruct(spec_shape, x.dtype))
     blob = exp.serialize()
     header = json.dumps({
         "input_shape": list(x.shape), "input_dtype": str(x.dtype),
+        "poly_batch": bool(poly_batch),
         "format": "jax.export/stablehlo",
     }).encode()
     artifact = _MAGIC + struct.pack("<I", len(header)) + header + blob
@@ -67,9 +79,19 @@ def export_predictor(net, example_input, path=None, training=False):
 
 
 class Predictor:
-    """Loaded artifact (reference: MXPredCreate/MXPredForward)."""
+    """Loaded artifact (reference: MXPredCreate/MXPredForward).
 
-    def __init__(self, artifact):
+    The exported computation is wrapped in ONE ``jax.jit`` at load
+    time, so repeated ``predict`` calls hit the jit cache instead of
+    re-tracing the deserialized module per call — the difference
+    between a serving path and a demo. ``donate_input=True`` lets XLA
+    reuse the input buffer's device memory for outputs (worth it for
+    large activations on accelerators; some backends cannot honor it
+    and fall back with a warning).
+    """
+
+    def __init__(self, artifact, donate_input=False):
+        import jax
         from jax import export as jexport
         if isinstance(artifact, str):
             with open(artifact, "rb") as f:
@@ -81,18 +103,35 @@ class Predictor:
         off += 4
         self.meta = json.loads(artifact[off:off + hlen].decode())
         self._exported = jexport.deserialize(artifact[off + hlen:])
+        self._call = jax.jit(
+            self._exported.call,
+            donate_argnums=(0,) if donate_input else ())
 
     @property
     def input_shape(self):
         return tuple(self.meta["input_shape"])
 
+    @property
+    def poly_batch(self):
+        """True when exported batch-polymorphic (any leading dim)."""
+        return bool(self.meta.get("poly_batch", False))
+
+    def jit_cache_size(self):
+        """Number of compiled programs behind this predictor — one per
+        distinct input shape seen (one total for fixed-shape
+        artifacts)."""
+        try:
+            return self._call._cache_size()
+        except Exception:      # cache introspection is jax-internal
+            return -1
+
     def predict(self, x):
         import jax.numpy as jnp
-        out = self._exported.call(jnp.asarray(x))
+        out = self._call(jnp.asarray(x))
         return _np.asarray(out)
 
     __call__ = predict
 
 
-def load_predictor(path_or_bytes):
-    return Predictor(path_or_bytes)
+def load_predictor(path_or_bytes, donate_input=False):
+    return Predictor(path_or_bytes, donate_input=donate_input)

@@ -716,6 +716,32 @@ class HybridBlock(Block):
         self._cached_op = None
         super().cast(dtype)
 
+    def serve(self, example_input=None, **server_kwargs):
+        """Serve this block's forward directly (no export step) through
+        a :class:`mxnet_tpu.serving.ModelServer`: dynamic micro-batching
+        of concurrent requests, bucket padding, warmup pre-compiles.
+
+        ``example_input`` (a single sample, NO batch dim) resolves any
+        deferred parameter shapes and pins the server's item
+        shape/dtype so ``warmup()`` works before the first request.
+        Returns an **unstarted** server — call ``start()`` (or use it
+        as a context manager)::
+
+            with net.serve(example_input=x0, max_batch_size=16) as srv:
+                srv.warmup()
+                fut = srv.submit(x0)
+        """
+        from ..serving import ModelServer
+        if example_input is not None:
+            ex = _np.asarray(example_input._data
+                             if isinstance(example_input, NDArray)
+                             else example_input)
+            with autograd.pause(train_mode=False):
+                self(NDArray(ex[None]))       # resolve deferred shapes
+            server_kwargs.setdefault("item_shape", ex.shape)
+            server_kwargs.setdefault("dtype", ex.dtype)
+        return ModelServer(self, **server_kwargs)
+
     def __call__(self, *args, **kwargs):
         return super().__call__(*args, **kwargs)
 

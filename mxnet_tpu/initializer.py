@@ -19,7 +19,7 @@ from . import _rng
 
 __all__ = ["InitDesc", "Initializer", "register", "create", "Zero", "One",
            "Constant", "Uniform", "Normal", "Orthogonal", "Xavier",
-           "MSRAPrelu", "Bilinear", "LSTMBias", "Mixed", "Load"]
+           "MSRAPrelu", "Bilinear", "LSTMBias", "FusedRNN", "Mixed", "Load"]
 
 _INIT_REGISTRY = {}
 
@@ -36,6 +36,11 @@ def create(name, **kwargs):
         return name
     if name is None:
         return Uniform()
+    if name.startswith("["):
+        # an Initializer.dumps() payload: ["classname", {kwargs}] — the
+        # form ``sym.var(init=...)`` stores in the ``__init__`` attr
+        klass, dumped_kwargs = json.loads(name)
+        return _INIT_REGISTRY[klass.lower()](**dumped_kwargs)
     return _INIT_REGISTRY[name.lower()](**kwargs)
 
 
@@ -288,6 +293,55 @@ class LSTMBias(Initializer):
 
     _init_default = _init_weight
     _init_bias = _init_weight
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize the flat packed parameter vector of a fused RNN op
+    (reference: python/mxnet/initializer.py FusedRNN): weights go
+    through ``init`` (or the global initializer when None), biases are
+    zeroed, and — for LSTM — every forget-gate bias slice is set to
+    ``forget_bias``. This is how ``FusedRNNCell(forget_bias=...)``
+    reaches the packed vector without a forward-time add."""
+
+    def __init__(self, init=None, num_hidden=0, num_layers=1, mode="lstm",
+                 bidirectional=False, forget_bias=1.0):
+        if init is not None and not isinstance(init, str):
+            init = init.dumps()
+        super().__init__(init=init, num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = create(init) if init is not None else None
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn.rnn_cell import FusedRNNCell
+        name = str(desc)
+        prefix = name[:-len("parameters")] \
+            if name.endswith("parameters") else name
+        cell = FusedRNNCell(
+            self._num_hidden, num_layers=self._num_layers,
+            mode=self._mode, bidirectional=self._bidirectional,
+            forget_bias=self._forget_bias, prefix=prefix)
+        flat = arr.reshape(-1)
+        input_size = cell._infer_input_size(flat.size)
+        inner = self._init or getattr(desc, "global_init", None) or Xavier()
+        for pname, start, stop, shape in cell._weight_slices(input_size):
+            buf = _np.zeros(shape, dtype=flat.dtype)
+            if pname.endswith("_bias"):
+                if self._mode == "lstm" and pname.endswith("_f_bias"):
+                    buf[:] = self._forget_bias
+            else:
+                inner(InitDesc(pname), buf)
+            flat[start:stop] = buf.reshape(-1)
+        arr[:] = flat.reshape(arr.shape)
+
+    _init_default = _init_weight
 
 
 @register

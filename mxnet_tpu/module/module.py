@@ -122,13 +122,19 @@ class Module(BaseModule):
         assert self.binded, "call bind before initializing the parameters"
         if initializer is None and (arg_params is None):
             initializer = init_mod.Uniform(0.01)
+        # variable attrs (e.g. the ``__init__`` recorded by
+        # ``sym.var(init=...)``) ride along on the InitDesc so cells can
+        # pin per-parameter initializers (reference: module.py _impl
+        # building InitDesc(name, attrs))
+        attrs = self._symbol.attr_dict() \
+            if hasattr(self._symbol, "attr_dict") else {}
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
                 arg_params[name].copyto(arr)
             elif initializer is not None:
                 buf = arr.asnumpy().copy()
-                initializer(init_mod.InitDesc(name), buf)
+                initializer(init_mod.InitDesc(name, attrs.get(name)), buf)
                 arr._data = _np_to_jax(buf)
             elif not allow_missing:
                 raise RuntimeError(
@@ -141,7 +147,7 @@ class Module(BaseModule):
                 aux_params[name].copyto(arr)
             elif initializer is not None:
                 buf = arr.asnumpy().copy()
-                initializer(init_mod.InitDesc(name), buf)
+                initializer(init_mod.InitDesc(name, attrs.get(name)), buf)
                 arr._data = _np_to_jax(buf)
         self.params_initialized = True
 

@@ -29,7 +29,8 @@ import time
 from collections import Counter
 
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
-           "scope", "state", "profiler_set_config", "profiler_set_state"]
+           "scope", "host_scope", "state", "scopes_enabled",
+           "profiler_set_config", "profiler_set_state"]
 
 _config = {
     "filename": "profile_output",
@@ -132,6 +133,20 @@ class scope:
 
     def __exit__(self, *exc):
         return self._ctx.__exit__(*exc)
+
+
+def host_scope(name):
+    """Host-timeline span: a ``jax.profiler.TraceAnnotation`` when a
+    trace is running, else a free no-op. ``scope`` annotates *device*
+    ops at trace (jit) time; already-compiled runtime phases — serving
+    batch assembly/dispatch, checkpoint IO — happen on the host after
+    tracing, so they need a host-side annotation instead. Usable on any
+    thread (the serving worker annotates each micro-batch with it)."""
+    import contextlib
+    if _state != "run":
+        return contextlib.nullcontext()
+    import jax
+    return jax.profiler.TraceAnnotation(name)
 
 
 def _load_trace_events():

@@ -39,10 +39,29 @@ class PreemptionGuard:
                  callback=None):
         self._signals = tuple(signals)
         self._callback = callback
+        self._callbacks = []
         self._event = threading.Event()
         self._prev = {}
         self._installed = False
         self.signum = None
+
+    def add_callback(self, fn):
+        """Register an extra ``fn(signum)`` to run when a watched signal
+        lands. Same rule as the constructor ``callback``: it executes
+        INSIDE the signal handler, so it must be trivial and must not
+        take locks (set a flag, bump a counter). Consumers that need
+        real work on preemption — e.g. ``serving.ModelServer``'s
+        graceful drain — should instead poll :attr:`requested` /
+        :meth:`wait` from their own thread. Returns ``fn`` so it can be
+        used as a decorator."""
+        self._callbacks.append(fn)
+        return fn
+
+    def remove_callback(self, fn):
+        try:
+            self._callbacks.remove(fn)
+        except ValueError:
+            pass
 
     # --------------------------------------------------------- install --
     def install(self):
@@ -73,6 +92,8 @@ class PreemptionGuard:
         self._event.set()
         if self._callback is not None:
             self._callback(signum)
+        for fn in tuple(self._callbacks):
+            fn(signum)
         prev = self._prev.get(signum)
         # default_int_handler raises KeyboardInterrupt at the interrupted
         # instruction — chaining it would abort mid-step, defeating the

@@ -234,10 +234,17 @@ class LSTMCell(BaseRNNCell):
     def __init__(self, num_hidden, forget_bias=1.0, prefix="lstm_",
                  params=None):
         super().__init__(prefix=prefix, params=params)
+        from .. import initializer as init_mod
         self._num_hidden = num_hidden
         self._forget_bias = forget_bias
         self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
+        # forget_bias lives in the default i2h_bias initializer
+        # (reference: rnn_cell.py:426 init.LSTMBias), NOT in the forward
+        # pass — a forward-time add on top of checkpointed biases would
+        # double-apply it and break fused/unfused and reference-trained
+        # checkpoint agreement
+        self._iB = self.params.get(
+            "i2h_bias", init=init_mod.LSTMBias(forget_bias=forget_bias))
         self._hW = self.params.get("h2h_weight")
         self._hB = self.params.get("h2h_bias")
 
@@ -261,7 +268,7 @@ class LSTMCell(BaseRNNCell):
             name=f"{name}h2h")
         i, f, g, o = sym.split(gates, num_outputs=4, axis=-1)
         i = sym.sigmoid(i)
-        f = sym.sigmoid(f + self._forget_bias)
+        f = sym.sigmoid(f)
         g = sym.tanh(g)
         o = sym.sigmoid(o)
         next_c = f * c + i * g
@@ -324,7 +331,16 @@ class FusedRNNCell(BaseRNNCell):
         self._dropout = dropout
         self._forget_bias = forget_bias
         self._get_next_state = get_next_state
-        self._parameters = self.params.get("parameters")
+        from .. import initializer as init_mod
+        # forget_bias reaches the packed vector through its default
+        # initializer (reference: rnn_cell.py:563 init.FusedRNN); the op
+        # itself never re-adds it
+        self._parameters = self.params.get(
+            "parameters",
+            init=init_mod.FusedRNN(None, num_hidden=num_hidden,
+                                   num_layers=num_layers, mode=mode,
+                                   bidirectional=bidirectional,
+                                   forget_bias=forget_bias))
 
     @property
     def state_info(self):
@@ -397,6 +413,21 @@ class FusedRNNCell(BaseRNNCell):
                               self._num_layers, self._mode,
                               self._bidirectional)
 
+    def _infer_input_size(self, flat_size):
+        """Invert ``_param_size`` for the layer-0 input width given the
+        flat packed vector's length."""
+        g = len(self._gate_names)
+        h = self._num_hidden
+        d = 2 if self._bidirectional else 1
+        per_rest = (self._num_layers - 1) * d * (g * h * (h * d + h)
+                                                 + 2 * g * h)
+        layer0 = flat_size - per_rest
+        input_size = (layer0 - d * (g * h * h + 2 * g * h)) // (d * g * h)
+        assert self._param_size(input_size) == flat_size, \
+            f"parameter vector size {flat_size} does not match any " \
+            f"input width for this cell"
+        return input_size
+
     def unpack_weights(self, args):
         """Split the flat '<prefix>parameters' vector into the per-gate
         arrays unfuse()'s cells bind (reference: rnn_cell.py:638)."""
@@ -407,17 +438,7 @@ class FusedRNNCell(BaseRNNCell):
             return args
         flat = args.pop(key)
         flat = flat.asnumpy() if hasattr(flat, "asnumpy") else flat
-        g = len(self._gate_names)
-        h = self._num_hidden
-        d = 2 if self._bidirectional else 1
-        # invert rnn_param_size for the input width (layer-0 block)
-        per_rest = (self._num_layers - 1) * d * (g * h * (h * d + h)
-                                                 + 2 * g * h)
-        layer0 = flat.size - per_rest
-        input_size = (layer0 - d * (g * h * h + 2 * g * h)) // (d * g * h)
-        assert self._param_size(input_size) == flat.size, \
-            f"parameter vector size {flat.size} does not match any " \
-            f"input width for this cell"
+        input_size = self._infer_input_size(flat.size)
         for name, start, stop, shape in self._weight_slices(input_size):
             args[name] = nd.array(flat[start:stop].reshape(shape))
         return args
@@ -486,8 +507,8 @@ class FusedRNNCell(BaseRNNCell):
         make = {"rnn_relu": lambda p: RNNCell(self._num_hidden, "relu", p),
                 "rnn_tanh": lambda p: RNNCell(self._num_hidden, "tanh", p),
                 # forget_bias=0: the packed vector already holds the
-                # trained biases; adding the constructor offset would
-                # diverge from the fused op's math
+                # trained biases, so a fresh init of the unfused cells
+                # must not re-apply the forget-gate offset
                 "lstm": lambda p: LSTMCell(self._num_hidden,
                                            forget_bias=0.0, prefix=p),
                 "gru": lambda p: GRUCell(self._num_hidden, prefix=p)

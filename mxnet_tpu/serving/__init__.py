@@ -1,0 +1,33 @@
+"""mxnet_tpu.serving — TPU-native inference serving runtime.
+
+The serving-side counterpart of :mod:`mxnet_tpu.resilience`: where that
+package keeps *training* alive across faults, this one turns a trained
+model (a ``deploy.Predictor`` artifact or a live gluon block) into a
+production request path:
+
+- :class:`ModelServer` — dynamic micro-batching of concurrent
+  single-sample requests (max batch + max queue delay);
+- :mod:`.bucketing` — pad micro-batches to a fixed set of bucket
+  sizes (powers of two up to max batch) so steady-state serving never
+  triggers an XLA recompile; ``warmup()`` pre-compiles every bucket;
+- :mod:`.telemetry` — queue depth, wait time, padded-waste fraction,
+  p50/p95/p99 latency, throughput, and a process-global XLA compile
+  counter; per-batch JSON-lines event log; host-timeline spans via
+  ``mx.profiler`` when a trace is running;
+- graceful drain on shutdown or preemption
+  (``ModelServer.attach_preemption_guard`` +
+  ``resilience.PreemptionGuard``): stop admitting, flush the queue,
+  resolve every in-flight Future, exit.
+
+See docs/SERVING.md for architecture, bucketing math and env vars.
+"""
+from .batching import MicroBatchQueue, Request, ServerClosed
+from .bucketing import (bucket_sizes, pick_bucket, pad_batch,
+                        waste_fraction)
+from .server import ModelServer
+from .telemetry import (CompileCounter, EventLog, ServingStats,
+                        compile_count)
+
+__all__ = ["ModelServer", "ServerClosed", "MicroBatchQueue", "Request",
+           "bucket_sizes", "pick_bucket", "pad_batch", "waste_fraction",
+           "CompileCounter", "EventLog", "ServingStats", "compile_count"]

@@ -1,0 +1,112 @@
+"""Dynamic micro-batching queue: coalesce concurrent single requests.
+
+Requests arrive one at a time from many threads; the accelerator wants
+them in batches. The queue admits single-item requests and a worker
+pops *micro-batches*: it blocks until at least one request is waiting,
+then keeps collecting until either ``max_batch`` items are in hand or
+``max_delay`` has elapsed since the oldest waiting request was enqueued
+(the TensorFlow-Serving batching discipline: batch_timeout_micros +
+max_batch_size). Under load the delay never binds — batches fill
+instantly; at low rate a lone request waits at most ``max_delay``.
+
+Each request carries a :class:`concurrent.futures.Future`; the worker
+resolves it with the request's output rows (or an exception), so
+callers block only on their own result, never on the batch.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+__all__ = ["ServerClosed", "Request", "MicroBatchQueue"]
+
+
+class ServerClosed(RuntimeError):
+    """Raised by submit() once admission is closed (drain/shutdown)."""
+
+
+class Request:
+    __slots__ = ("x", "future", "t_enqueue", "t_dequeue")
+
+    def __init__(self, x):
+        self.x = x
+        self.future = Future()
+        self.t_enqueue = time.monotonic()
+        self.t_dequeue = None
+
+    @property
+    def wait_s(self):
+        """Queue time: enqueue -> picked into a micro-batch."""
+        if self.t_dequeue is None:
+            return 0.0
+        return self.t_dequeue - self.t_enqueue
+
+
+class MicroBatchQueue:
+    """Thread-safe FIFO with micro-batch pop semantics."""
+
+    def __init__(self):
+        self._q = collections.deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._closed = False
+
+    # -------------------------------------------------------- producer --
+    def submit(self, x):
+        """Enqueue one request; returns its Future."""
+        req = Request(x)
+        with self._lock:
+            if self._closed:
+                raise ServerClosed(
+                    "server is draining; no new requests admitted")
+            self._q.append(req)
+            self._nonempty.notify_all()
+        return req.future
+
+    # -------------------------------------------------------- consumer --
+    def get_batch(self, max_batch, max_delay_s):
+        """Pop the next micro-batch (list of Requests).
+
+        Blocks until at least one request is available, then waits up to
+        ``max_delay_s`` past the OLDEST request's enqueue time for the
+        batch to fill to ``max_batch``. Returns ``[]`` only when the
+        queue is closed and empty — the worker's exit signal.
+        """
+        with self._lock:
+            while not self._q:
+                if self._closed:
+                    return []
+                # untimed: submit() and close() both notify under this
+                # lock, so no wakeup can be missed and an idle worker
+                # sleeps instead of polling
+                self._nonempty.wait()
+            deadline = self._q[0].t_enqueue + max_delay_s
+            while len(self._q) < max_batch and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._nonempty.wait(timeout=remaining)
+            n = min(len(self._q), max_batch)
+            now = time.monotonic()
+            batch = []
+            for _ in range(n):
+                req = self._q.popleft()
+                req.t_dequeue = now
+                batch.append(req)
+            return batch
+
+    # ----------------------------------------------------------- state --
+    def close(self):
+        """Stop admitting; queued requests still get served."""
+        with self._lock:
+            self._closed = True
+            self._nonempty.notify_all()
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def depth(self):
+        return len(self._q)

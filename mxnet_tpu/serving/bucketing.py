@@ -1,0 +1,75 @@
+"""Shape bucketing: pad ragged request batches to a fixed bucket set.
+
+XLA compiles one program per input shape. A serving queue that hands the
+model whatever batch size happens to be waiting (1, 3, 7, 5, ...) turns
+steady-state traffic into a stream of recompiles — each one far slower
+than the inference it was meant to serve. The classic fix (TensorFlow
+Serving's BatchingSession, and the same insight behind ragged TPU
+inference kernels) is to admit only a small fixed set of batch shapes:
+pad every micro-batch up to the nearest *bucket* (powers of two up to
+the max batch size) and pre-compile every bucket once at startup. After
+``warmup()`` the jit cache holds every shape the server can ever emit,
+so no request can trigger a compile.
+
+Padding rows are zeros; because rows of a batched forward pass are
+computed independently, the padded rows change nothing about the real
+rows (the tier-1 suite pins this bit-exactly), and the only cost is the
+wasted FLOPs of the pad — tracked per batch as ``padded_waste`` so the
+bucket set can be tuned against real traffic.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["bucket_sizes", "pick_bucket", "pad_batch", "waste_fraction"]
+
+
+def bucket_sizes(max_batch, min_bucket=1):
+    """Powers of two from ``min_bucket`` up to ``max_batch``; a
+    non-power-of-two ``max_batch`` is appended as the top bucket."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if min_bucket < 1 or min_bucket > max_batch:
+        raise ValueError(
+            f"min_bucket must be in [1, {max_batch}], got {min_bucket}")
+    out = []
+    b = 1
+    while b <= max_batch:
+        if b >= min_bucket:
+            out.append(b)
+        b *= 2
+    if not out or out[-1] != max_batch:
+        out.append(max_batch)
+    return out
+
+
+def pick_bucket(n, buckets):
+    """Smallest bucket >= n. ``buckets`` must be sorted ascending."""
+    if n < 1:
+        raise ValueError(f"batch size must be >= 1, got {n}")
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(
+        f"batch of {n} exceeds the largest bucket {buckets[-1]}; the "
+        "batcher must cap micro-batches at max(buckets)")
+
+
+def pad_batch(rows, bucket):
+    """Zero-pad a stacked ``(n, *item)`` batch up to ``(bucket, *item)``.
+
+    Returns the padded array (the input itself when ``n == bucket``, so
+    the full-bucket fast path copies nothing).
+    """
+    n = rows.shape[0]
+    if n == bucket:
+        return rows
+    if n > bucket:
+        raise ValueError(f"batch of {n} does not fit bucket {bucket}")
+    pad = np.zeros((bucket - n,) + rows.shape[1:], dtype=rows.dtype)
+    return np.concatenate([rows, pad], axis=0)
+
+
+def waste_fraction(n, bucket):
+    """Fraction of the bucket's rows that are padding."""
+    return (bucket - n) / float(bucket)

@@ -1,0 +1,324 @@
+"""ModelServer: TPU-native inference serving over a bucketed jit cache.
+
+The runtime layer between "a Predictor artifact / trained HybridBlock"
+and "heavy concurrent traffic":
+
+- many threads call :meth:`ModelServer.submit` (or the blocking
+  :meth:`predict`) with ONE sample each;
+- a single worker thread pops micro-batches from the
+  :class:`~.batching.MicroBatchQueue` (max batch size + max queue
+  delay), pads them to the nearest shape bucket
+  (:mod:`~.bucketing`), and runs ONE jitted program per bucket;
+- :meth:`warmup` pre-compiles every bucket so steady-state serving
+  never hits an XLA compile (asserted in tier-1 via the
+  :mod:`~.telemetry` compile counter);
+- :meth:`shutdown` (and the ``resilience.PreemptionGuard`` integration
+  :meth:`attach_preemption_guard`) drains gracefully: close admission,
+  flush the queue, resolve every in-flight Future, then exit.
+
+Config resolution order: constructor arg > ``MXNET_TPU_SERVE_*`` env
+var > default. Env vars: ``MXNET_TPU_SERVE_MAX_BATCH`` (8),
+``MXNET_TPU_SERVE_MAX_DELAY_MS`` (2.0), ``MXNET_TPU_SERVE_BUCKETS``
+(comma-separated, default powers of two up to max batch),
+``MXNET_TPU_SERVE_EVENT_LOG`` (JSONL path, off by default).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from .batching import MicroBatchQueue, ServerClosed
+from .bucketing import bucket_sizes, pick_bucket, pad_batch, waste_fraction
+from .telemetry import ServingStats, EventLog, compile_count
+
+__all__ = ["ModelServer", "ServerClosed"]
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+def _env_buckets():
+    v = os.environ.get("MXNET_TPU_SERVE_BUCKETS")
+    if not v:
+        return None
+    return sorted(int(b) for b in v.split(",") if b.strip())
+
+
+class ModelServer:
+    """Serve single-sample requests from many threads through one
+    dynamically-batched, bucket-padded, pre-compiled forward fn.
+
+    ``model`` may be:
+
+    - a :class:`mxnet_tpu.deploy.Predictor` (load path for ``.mxtpu``
+      artifacts; must be batch-polymorphic — exported with
+      ``poly_batch=True`` — unless the bucket set is exactly the
+      artifact's fixed batch size);
+    - a gluon ``(Hybrid)Block`` — served directly via
+      ``parallel.functional_call`` under ``jax.jit`` with the current
+      parameter values;
+    - any callable ``fn(batch) -> batch`` of numpy arrays (tests,
+      custom backends).
+
+    Requests are single samples of shape ``item_shape`` (no batch
+    dim). The server owns one worker thread; jit dispatch is serialized
+    by design — batching, not thread fan-out, is the throughput lever.
+    """
+
+    def __init__(self, model, max_batch_size=None, max_delay_ms=None,
+                 buckets=None, item_shape=None, dtype=None,
+                 event_log=None, name="serve"):
+        if buckets is None:
+            buckets = _env_buckets()
+        if max_batch_size is None:
+            max_batch_size = (max(buckets) if buckets
+                              else _env_int("MXNET_TPU_SERVE_MAX_BATCH", 8))
+        if max_delay_ms is None:
+            max_delay_ms = _env_float("MXNET_TPU_SERVE_MAX_DELAY_MS", 2.0)
+        if buckets is None:
+            buckets = bucket_sizes(max_batch_size)
+        buckets = sorted(set(buckets))
+        if max_batch_size > max(buckets):
+            raise ValueError(
+                f"max_batch_size {max_batch_size} exceeds the largest "
+                f"bucket {max(buckets)}")
+        self.name = name
+        self.max_batch_size = max_batch_size
+        self.max_delay_s = max_delay_ms / 1e3
+        self.buckets = buckets
+        self._item_shape = tuple(item_shape) if item_shape else None
+        self._dtype = np.dtype(dtype) if dtype else None
+        self._fn = self._build_fn(model)
+        self._queue = MicroBatchQueue()
+        self._stats = ServingStats()
+        self._events = (EventLog(event_log) if event_log is not None
+                        else EventLog.from_env())
+        self._worker = None
+        self._started = False
+        self._abort = False
+        self._drained = threading.Event()
+        self._guard_watcher = None
+        self._guard_stop = threading.Event()
+
+    # ---------------------------------------------------------- backend --
+    def _build_fn(self, model):
+        """Normalize ``model`` to ``fn(np (b, *item)) -> np (b, *out)``."""
+        from .. import deploy as deploy_mod
+        if isinstance(model, deploy_mod.Predictor):
+            if not model.poly_batch:
+                fixed = model.input_shape[0]
+                if self.buckets != [fixed]:
+                    raise ValueError(
+                        "fixed-shape predictor artifact (batch "
+                        f"{fixed}) cannot serve buckets "
+                        f"{self.buckets}; re-export with "
+                        "export_predictor(..., poly_batch=True) or set "
+                        f"buckets=[{fixed}]")
+            if self._item_shape is None:
+                self._item_shape = tuple(model.input_shape[1:])
+            if self._dtype is None:
+                self._dtype = np.dtype(model.meta["input_dtype"])
+            self._jit_handle = model
+            return model.predict
+        try:
+            from ..gluon.block import Block
+        except Exception:            # pragma: no cover - import cycles
+            Block = ()
+        if isinstance(model, Block):
+            import jax
+            from ..parallel import functional_call, extract_params
+            params = dict(extract_params(model))
+
+            def _fwd(p, x):
+                out, _ = functional_call(model, p, x, training=False)
+                return out
+
+            jfn = jax.jit(_fwd)
+            self._jit_handle = jfn
+
+            def fn(batch):
+                return np.asarray(jfn(params, batch))
+            return fn
+        if callable(model):
+            self._jit_handle = None
+            return model
+        raise TypeError(f"cannot serve model of type {type(model)!r}")
+
+    # -------------------------------------------------------- lifecycle --
+    def start(self):
+        if self._started:
+            return self
+        self._started = True
+        self._worker = threading.Thread(
+            target=self._serve_loop, name=f"mxtpu-{self.name}-worker",
+            daemon=True)
+        self._worker.start()
+        self._events.emit("start", name=self.name, buckets=self.buckets,
+                          max_batch=self.max_batch_size,
+                          max_delay_ms=self.max_delay_s * 1e3)
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    @property
+    def running(self):
+        return self._started and not self._queue.closed
+
+    # ----------------------------------------------------------- warmup --
+    def warmup(self):
+        """Pre-compile every bucket's program. Returns
+        {bucket: seconds}. After this, steady-state serving cannot
+        recompile: every shape the worker can emit is in the jit cache
+        (pinned by the tier-1 compile-counter test)."""
+        if self._item_shape is None or self._dtype is None:
+            raise RuntimeError(
+                "warmup() needs item_shape/dtype — pass them to the "
+                "constructor (they are inferred automatically for "
+                "Predictor backends)")
+        timings = {}
+        for b in self.buckets:
+            zeros = np.zeros((b,) + self._item_shape, dtype=self._dtype)
+            t0 = time.monotonic()
+            out = self._fn(zeros)
+            np.asarray(out)
+            timings[b] = time.monotonic() - t0
+            self._events.emit("warmup", bucket=b, seconds=timings[b])
+        return timings
+
+    # ----------------------------------------------------------- submit --
+    def submit(self, x):
+        """Enqueue one sample (shape ``item_shape``); returns a Future
+        resolving to this sample's output row."""
+        x = np.asarray(x)
+        if self._item_shape is None:
+            self._item_shape = x.shape
+        if self._dtype is None:
+            self._dtype = x.dtype
+        if x.shape != self._item_shape:
+            raise ValueError(
+                f"request shape {x.shape} != item shape "
+                f"{self._item_shape} (requests are single samples; the "
+                "server owns the batch dimension)")
+        if not self._started:
+            raise RuntimeError("server not started; call start()")
+        fut = self._queue.submit(x)
+        self._stats.record_submit()
+        self._stats.record_queue_depth(self._queue.depth())
+        return fut
+
+    def predict(self, x, timeout=None):
+        """Blocking single-sample inference through the batcher."""
+        return self.submit(x).result(timeout=timeout)
+
+    # ------------------------------------------------------------ stats --
+    def stats(self):
+        """Snapshot of serving counters (see ServingStats.snapshot),
+        plus the process-global XLA compile count."""
+        snap = self._stats.snapshot()
+        snap["compiles"] = compile_count()
+        snap["buckets"] = list(self.buckets)
+        return snap
+
+    # ------------------------------------------------------------ drain --
+    def shutdown(self, drain=True, timeout=None):
+        """Stop admitting; with ``drain`` serve everything queued, else
+        fail queued requests with ServerClosed. Idempotent."""
+        if not self._started:
+            return
+        if not drain:
+            # fail queued work fast: the worker resolves the remaining
+            # requests with ServerClosed instead of running the model
+            self._abort = True
+        self._queue.close()
+        self._events.emit("drain_begin", queued=self._queue.depth())
+        if self._worker is not None:
+            self._worker.join(timeout=timeout)
+        self._guard_stop.set()
+        self._drained.set()
+        self._events.emit("stop", **{k: v for k, v in self.stats().items()
+                                     if not isinstance(v, dict)})
+        self._events.close()
+
+    close = shutdown
+
+    def attach_preemption_guard(self, guard, poll_s=0.05):
+        """Drain on preemption: once ``guard`` (a
+        ``resilience.PreemptionGuard``) reports a SIGTERM/SIGINT, stop
+        admitting, flush the queue, and resolve every in-flight Future.
+        The watcher is a daemon thread polling the guard's sticky flag —
+        nothing runs inside the signal handler itself (the guard's
+        design rule)."""
+        if self._guard_watcher is not None:
+            return self
+
+        def _watch():
+            while not self._guard_stop.is_set():
+                if guard.wait(poll_s):
+                    self._events.emit("preempted", signum=guard.signum)
+                    self.shutdown(drain=True)
+                    return
+
+        self._guard_watcher = threading.Thread(
+            target=_watch, name=f"mxtpu-{self.name}-preempt-watch",
+            daemon=True)
+        self._guard_watcher.start()
+        return self
+
+    # ------------------------------------------------------ worker loop --
+    def _serve_loop(self):
+        from .. import profiler
+        while True:
+            batch = self._queue.get_batch(self.max_batch_size,
+                                          self.max_delay_s)
+            if not batch:
+                return  # closed and empty
+            if self._abort:
+                exc = ServerClosed("server shut down without drain")
+                for req in batch:
+                    req.future.set_exception(exc)
+                self._stats.record_failure(len(batch))
+                continue
+            self._stats.record_queue_depth(self._queue.depth())
+            n = len(batch)
+            bucket = pick_bucket(n, self.buckets)
+            rows = np.stack([r.x for r in batch]).astype(
+                self._dtype, copy=False)
+            padded = pad_batch(rows, bucket)
+            t0 = time.monotonic()
+            try:
+                with profiler.host_scope(
+                        f"mxnet_tpu.serving/{self.name}/bucket{bucket}"):
+                    out = np.asarray(self._fn(padded))
+            except Exception as exc:    # resolve, never hang callers
+                for req in batch:
+                    req.future.set_exception(exc)
+                self._stats.record_failure(n)
+                self._events.emit("batch_error", n=n, bucket=bucket,
+                                  error=repr(exc))
+                continue
+            service_s = time.monotonic() - t0
+            for i, req in enumerate(batch):
+                req.future.set_result(out[i])
+            self._stats.record_batch(
+                n, bucket, [r.wait_s for r in batch], service_s)
+            self._events.emit(
+                "batch", n=n, bucket=bucket,
+                waste=waste_fraction(n, bucket),
+                service_ms=service_s * 1e3,
+                max_wait_ms=max(r.wait_s for r in batch) * 1e3,
+                queue_depth=self._queue.depth())

@@ -1,0 +1,220 @@
+"""Serving telemetry: compile counting, latency percentiles, event log.
+
+Three independent pieces:
+
+- :func:`compile_count` / :class:`CompileCounter` — a process-global
+  XLA compile counter fed by jax.monitoring's
+  ``/jax/core/compile/backend_compile_duration`` event, which fires
+  exactly once per backend (XLA) compilation anywhere in the process.
+  This is the hook the bucketing contract is asserted with: after
+  ``warmup()`` the counter must not move, no matter how ragged the
+  request sizes get.
+- :class:`ServingStats` — thread-safe counters + a bounded latency
+  reservoir; ``snapshot()`` returns the queue depth, wait times,
+  padded-waste fraction, p50/p95/p99 latency and throughput.
+- :class:`EventLog` — JSON-lines event sink (one dict per line, ``ts``
+  stamped) for offline analysis; the server emits per-batch records and
+  lifecycle events into it. Pairs with ``mx.profiler``: when a trace is
+  running the same batch spans appear on the host timeline via
+  ``profiler.host_scope``.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+__all__ = ["compile_count", "CompileCounter", "ServingStats", "EventLog"]
+
+# ------------------------------------------------------ compile counter --
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compiles = 0
+_listener_installed = False
+_listener_lock = threading.Lock()
+
+
+def _install_listener():
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        import jax.monitoring
+
+        def _on_event_duration(name, duration_secs, **kwargs):
+            global _compiles
+            if name == _COMPILE_EVENT:
+                _compiles += 1
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+        _listener_installed = True
+
+
+def compile_count():
+    """Number of XLA backend compilations since the hook was installed.
+
+    Only deltas are meaningful: compiles that happened before the first
+    call are not counted (the listener installs lazily).
+    """
+    _install_listener()
+    return _compiles
+
+
+class CompileCounter:
+    """Context manager measuring XLA compiles inside its block::
+
+        with CompileCounter() as cc:
+            server.predict(x)
+        assert cc.count == 0
+    """
+
+    def __init__(self):
+        self._start = None
+        self.count = 0
+
+    def __enter__(self):
+        self._start = compile_count()
+        return self
+
+    def __exit__(self, *exc):
+        self.count = compile_count() - self._start
+        return False
+
+
+# -------------------------------------------------------------- stats --
+class _Reservoir:
+    """Bounded sample of recent values with percentile queries."""
+
+    def __init__(self, maxlen=8192):
+        self._d = collections.deque(maxlen=maxlen)
+
+    def add(self, v):
+        self._d.append(v)
+
+    def percentile(self, p):
+        if not self._d:
+            return 0.0
+        s = sorted(self._d)
+        k = min(len(s) - 1, max(0, int(round((p / 100.0) * (len(s) - 1)))))
+        return s[k]
+
+    def __len__(self):
+        return len(self._d)
+
+
+class ServingStats:
+    """Aggregated serving counters; every method is thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._t_start = time.monotonic()
+            self._requests_submitted = 0
+            self._requests_completed = 0
+            self._requests_failed = 0
+            self._batches = 0
+            self._rows = 0
+            self._padded_rows = 0
+            self._batch_size_sum = 0
+            self._wait = _Reservoir()
+            self._latency = _Reservoir()
+            self._service = _Reservoir()
+            self._queue_depth = 0
+            self._bucket_hits = collections.Counter()
+
+    # ------------------------------------------------------- recording --
+    def record_submit(self):
+        with self._lock:
+            self._requests_submitted += 1
+
+    def record_queue_depth(self, depth):
+        with self._lock:
+            self._queue_depth = depth
+
+    def record_batch(self, n, bucket, wait_s_each, service_s):
+        """One executed micro-batch: n real rows padded to ``bucket``."""
+        with self._lock:
+            self._batches += 1
+            self._rows += n
+            self._padded_rows += bucket - n
+            self._batch_size_sum += n
+            self._bucket_hits[bucket] += 1
+            self._service.add(service_s)
+            for w in wait_s_each:
+                self._wait.add(w)
+                self._latency.add(w + service_s)
+            self._requests_completed += n
+
+    def record_failure(self, n):
+        with self._lock:
+            self._requests_failed += n
+
+    # -------------------------------------------------------- snapshot --
+    def snapshot(self):
+        with self._lock:
+            elapsed = max(time.monotonic() - self._t_start, 1e-9)
+            total_slots = self._rows + self._padded_rows
+            return {
+                "requests_submitted": self._requests_submitted,
+                "requests_completed": self._requests_completed,
+                "requests_failed": self._requests_failed,
+                "batches": self._batches,
+                "queue_depth": self._queue_depth,
+                "avg_batch_size": (self._batch_size_sum / self._batches
+                                   if self._batches else 0.0),
+                "padded_waste": (self._padded_rows / total_slots
+                                 if total_slots else 0.0),
+                "bucket_hits": dict(self._bucket_hits),
+                "throughput_rps": self._requests_completed / elapsed,
+                "wait_ms": {
+                    "p50": self._wait.percentile(50) * 1e3,
+                    "p95": self._wait.percentile(95) * 1e3,
+                    "p99": self._wait.percentile(99) * 1e3,
+                },
+                "latency_ms": {
+                    "p50": self._latency.percentile(50) * 1e3,
+                    "p95": self._latency.percentile(95) * 1e3,
+                    "p99": self._latency.percentile(99) * 1e3,
+                },
+                "service_ms": {
+                    "p50": self._service.percentile(50) * 1e3,
+                    "p95": self._service.percentile(95) * 1e3,
+                    "p99": self._service.percentile(99) * 1e3,
+                },
+            }
+
+
+# ----------------------------------------------------------- event log --
+class EventLog:
+    """Append-only JSON-lines sink. ``path`` may come from the
+    ``MXNET_TPU_SERVE_EVENT_LOG`` env var; a None path makes every emit
+    a no-op so call sites need no guards."""
+
+    def __init__(self, path=None):
+        self._lock = threading.Lock()
+        self._f = open(path, "a", buffering=1) if path else None
+
+    @classmethod
+    def from_env(cls):
+        return cls(os.environ.get("MXNET_TPU_SERVE_EVENT_LOG") or None)
+
+    def emit(self, event, **fields):
+        if self._f is None:
+            return
+        rec = {"ts": time.time(), "event": event}
+        rec.update(fields)
+        line = json.dumps(rec, sort_keys=True)
+        with self._lock:
+            if self._f is not None:
+                self._f.write(line + "\n")
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

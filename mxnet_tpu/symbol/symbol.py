@@ -63,6 +63,15 @@ class Symbol:
     def list_attr(self):
         return dict(self._attr)
 
+    def attr_dict(self):
+        """Attributes of every node in the graph keyed by node name,
+        omitting attr-less nodes (reference: symbol.py attr_dict)."""
+        out = {}
+        for s in self._topo():
+            if s._attr:
+                out[s._name] = dict(s._attr)
+        return out
+
     def _is_var(self):
         return self._op is None and self._out_index is None
 
@@ -478,10 +487,14 @@ class Symbol:
         idx = {id(s): i for i, s in enumerate(order)}
         nodes = []
         for s in order:
+            # op nodes carry their op params; variable nodes carry
+            # their attrs (e.g. the ``__init__`` initializer record) —
+            # the same "attrs" slot the reference format uses for both
+            src = s._params if s._op is not None else s._attr
             nodes.append({
                 "op": s._op or "null",
                 "name": s._name,
-                "attrs": {k: json.dumps(v) for k, v in s._params.items()},
+                "attrs": {k: json.dumps(v) for k, v in src.items()},
                 "inputs": [[idx[id(i)], i._out_index or 0, 0]
                            for i in s._inputs],
                 "nout": s._nout,
@@ -664,12 +677,19 @@ def _make_node(opname, inputs, params, name=None, nout=1):
 
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
         dtype=None, init=None, stype=None, **kwargs):
-    """Create a symbolic variable (reference: symbol.py var)."""
+    """Create a symbolic variable (reference: symbol.py var). ``init``
+    (an Initializer or its ``dumps()`` string) is recorded as the
+    ``__init__`` attr so bind-time initialization honors it (reference:
+    symbol.py var attr handling + initializer.py InitDesc dispatch)."""
     s = Symbol(None, None, [], name, attr=attr)
     if shape is not None:
         s._shape_hint = tuple(shape)
     if dtype is not None:
         s._dtype_hint = dtype
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        s._attr["__init__"] = init
     return s
 
 
@@ -696,7 +716,9 @@ def load_json(json_str):
     built: List[Symbol] = []
     for nd_ in nodes:
         if nd_["op"] == "null":
-            s = var(nd_["name"])
+            s = var(nd_["name"],
+                    attr={k: json.loads(v) for k, v in
+                          nd_.get("attrs", {}).items()})
         else:
             ins = []
             for (i, oi, _) in nd_["inputs"]:

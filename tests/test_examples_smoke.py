@@ -53,6 +53,8 @@ CASES = [
     ("multi_threaded_inference.py",
      ["--threads", "4", "--requests", "2", "--batch-size", "2",
       "--image-size", "32"]),
+    ("serve_predictor.py", ["--threads", "4", "--requests", "8",
+                            "--max-batch", "4", "--feature-dim", "16"]),
     ("nce_lm.py", ["--epochs", "3", "--max-ppl", "120"]),
     ("rbm_digits.py", ["--epochs", "3", "--num-samples", "256",
                        "--max-recon-err", "0.12"]),
@@ -82,6 +84,23 @@ def test_example_runs(script, args):
         capture_output=True, text=True, timeout=600, env=env)
     assert p.returncode == 0, \
         f"{script} failed:\n{p.stdout[-2000:]}\n{p.stderr[-2000:]}"
+
+
+def test_serve_bench_smoke():
+    """tools/serve_bench.py --smoke: the closed-loop load generator
+    must complete losslessly with zero recompiles during load (it
+    exits 1 otherwise)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    tools = os.path.join(os.path.dirname(EXAMPLES), "tools")
+    p = subprocess.run(
+        [sys.executable, os.path.join(tools, "serve_bench.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert p.returncode == 0, \
+        f"serve_bench --smoke failed:\n{p.stdout[-2000:]}\n" \
+        f"{p.stderr[-2000:]}"
+    assert "SMOKE PASS" in p.stdout
 
 
 @pytest.mark.slow   # ~160s of XLA CPU compile for the 4-stage ResNet

@@ -241,6 +241,72 @@ def test_fused_unpack_pack_roundtrip():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
+def test_lstm_forget_bias_in_initializer_not_forward():
+    """forget_bias lives in the default i2h_bias initializer
+    (init.LSTMBias), never in the forward pass: with identical explicit
+    weights, cells built with different forget_bias settings must
+    compute identical outputs — otherwise checkpoint-trained biases get
+    the offset double-applied."""
+    B, T, I, H = 2, 3, 4, 5
+    rng = np.random.RandomState(11)
+    args = {"data": rng.randn(B, T, I).astype(np.float32),
+            "fb_i2h_weight": rng.randn(4 * H, I).astype(np.float32) * .3,
+            "fb_i2h_bias": rng.randn(4 * H).astype(np.float32) * .1,
+            "fb_h2h_weight": rng.randn(4 * H, H).astype(np.float32) * .3,
+            "fb_h2h_bias": rng.randn(4 * H).astype(np.float32) * .1}
+    outs = []
+    for fb in (0.0, 1.0, 5.0):
+        cell = rnn.LSTMCell(H, forget_bias=fb, prefix="fb_")
+        out, _ = cell.unroll(T, mx.sym.var("data"), layout="NTC",
+                             merge_outputs=True)
+        got = out.eval_dict(dict(args))
+        outs.append((got[0] if isinstance(got, list) else got).asnumpy())
+    np.testing.assert_array_equal(outs[0], outs[1])
+    np.testing.assert_array_equal(outs[0], outs[2])
+
+
+def test_lstm_cell_default_init_sets_forget_bias():
+    """Bind + init through Module: the i2h_bias variable's __init__
+    attr (init.LSTMBias) must produce [0, forget_bias, 0, 0] gate
+    blocks while other params follow the global initializer."""
+    B, T, I, H = 2, 2, 3, 4
+    cell = rnn.LSTMCell(H, forget_bias=1.5, prefix="mb_")
+    out, _ = cell.unroll(T, mx.sym.var("data"), layout="NTC",
+                         merge_outputs=True)
+    assert out.attr_dict()["mb_i2h_bias"]["__init__"] == \
+        mx.initializer.LSTMBias(forget_bias=1.5).dumps()
+    mod = mx.module.Module(out, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (B, T, I))], for_training=False)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    args, _ = mod.get_params()
+    b = args["mb_i2h_bias"].asnumpy()
+    np.testing.assert_array_equal(b[H:2 * H], 1.5)
+    np.testing.assert_array_equal(b[:H], 0.0)
+    np.testing.assert_array_equal(b[2 * H:], 0.0)
+    assert np.abs(args["mb_i2h_weight"].asnumpy()).max() <= 0.1
+
+
+def test_fused_cell_default_init_sets_forget_bias():
+    """FusedRNNCell's packed vector gets init.FusedRNN: forget-gate
+    bias slices = forget_bias, other biases zero, weight blocks from
+    the global initializer — so forget_bias is honored instead of
+    silently ignored."""
+    B, T, I, H = 2, 2, 3, 4
+    cell = rnn.FusedRNNCell(H, mode="lstm", prefix="mf_",
+                            forget_bias=2.0)
+    out, _ = cell.unroll(T, mx.sym.var("data"), layout="NTC")
+    mod = mx.module.Module(out, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (B, T, I))], for_training=False)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    args, _ = mod.get_params()
+    un = cell.unpack_weights({"mf_parameters": args["mf_parameters"]})
+    np.testing.assert_array_equal(un["mf_l0_i2h_f_bias"].asnumpy(), 2.0)
+    np.testing.assert_array_equal(un["mf_l0_h2h_f_bias"].asnumpy(), 2.0)
+    np.testing.assert_array_equal(un["mf_l0_i2h_i_bias"].asnumpy(), 0.0)
+    w = un["mf_l0_i2h_i_weight"].asnumpy()
+    assert 0.0 < np.abs(w).max() <= 0.1
+
+
 def test_encode_sentences_fixed_vocab_guard():
     _, vocab = rnn.encode_sentences([["a", "b"]], invalid_label=0,
                                     start_label=1)

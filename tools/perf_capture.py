@@ -33,6 +33,7 @@ import argparse
 import datetime
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -157,6 +158,14 @@ def _maybe_update_best(rec):
     return False
 
 
+def _tag_batch(tag):
+    """Batch size from a ``bsN_...`` config tag (0 when absent). A bare
+    substring test ("256" in tag) would misclassify tags like
+    ``bs512_bf16_nhwc_bnfuse_remat`` into the short compile budget."""
+    m = re.match(r"bs(\d+)", tag)
+    return int(m.group(1)) if m else 0
+
+
 def capture_window():
     """Tunnel is up: run the config queue until done or the tunnel dies.
     Already-captured configs are skipped; the big-batch configs get a
@@ -169,7 +178,8 @@ def capture_window():
                   "note": "already captured"})
             continue
         rec, note = run_bench(tag, env,
-                              timeout_s=2400 if "256" in tag else 1500)
+                              timeout_s=2400 if _tag_batch(tag) >= 256
+                              else 1500)
         entry = {"event": "bench", "tag": tag, "note": note}
         if rec is not None:
             entry["result"] = {k: rec.get(k) for k in

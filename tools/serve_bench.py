@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for mxnet_tpu.serving.ModelServer.
+
+Each of ``--concurrency`` client threads keeps exactly one request in
+flight (closed loop): submit, wait, repeat. Reported at the end: request
+throughput, latency percentiles (p50/p95/p99 end-to-end and queue wait),
+average batch size, padded-waste fraction, and the XLA compile count
+observed DURING the measured window (0 is the healthy steady state —
+warmup pre-compiles every bucket).
+
+Serve an exported artifact::
+
+    python tools/serve_bench.py --model model.mxtpu --concurrency 16
+
+or, with no --model, a small built-in MLP exported in-process (self
+-contained benchmarking / CI)::
+
+    python tools/serve_bench.py --smoke
+
+``--smoke`` runs a tiny configuration and exit(1)s unless the run was
+recompile-free and lossless — wired into tier-1 via
+tests/test_examples_smoke.py.
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, serving  # noqa: E402
+import mxnet_tpu.autograd as ag  # noqa: E402
+
+
+def _builtin_predictor(item_dim=32, classes=8):
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(classes))
+    net.initialize()
+    x = np.zeros((1, item_dim), np.float32)
+    with ag.pause():
+        net(nd.array(x))
+    blob = mx.deploy.export_predictor(net, x, poly_batch=True)
+    return mx.deploy.load_predictor(blob)
+
+
+def run(args):
+    if args.model:
+        pred = mx.deploy.load_predictor(args.model)
+        if not pred.poly_batch:
+            print("warning: fixed-shape artifact; forcing single bucket "
+                  f"[{pred.input_shape[0]}]", file=sys.stderr)
+            args.buckets = str(pred.input_shape[0])
+            args.max_batch = pred.input_shape[0]
+    else:
+        pred = _builtin_predictor()
+    item_shape = tuple(pred.input_shape[1:])
+    dtype = np.dtype(pred.meta["input_dtype"])
+    buckets = ([int(b) for b in args.buckets.split(",")]
+               if args.buckets else None)
+
+    srv = serving.ModelServer(pred, max_batch_size=args.max_batch,
+                              max_delay_ms=args.max_delay_ms,
+                              buckets=buckets, name="bench")
+    srv.start()
+    warm = srv.warmup()
+
+    rng = np.random.RandomState(0)
+    inputs = [rng.randn(*item_shape).astype(dtype)
+              for _ in range(min(64, args.requests))]
+    per_thread = args.requests // args.concurrency
+    errors = []
+
+    def client(tid):
+        try:
+            for i in range(per_thread):
+                srv.predict(inputs[(tid + i) % len(inputs)], timeout=120)
+        except Exception as exc:
+            errors.append(repr(exc))
+
+    with serving.CompileCounter() as cc:
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    srv.shutdown()     # joins the worker: stats below are final
+    stats = srv.stats()
+
+    report = {
+        "requests": per_thread * args.concurrency,
+        "concurrency": args.concurrency,
+        "buckets": stats["buckets"],
+        "warmup_s": {str(k): round(v, 4) for k, v in warm.items()},
+        "throughput_rps": round(stats["throughput_rps"], 2),
+        "latency_ms": {k: round(v, 3)
+                       for k, v in stats["latency_ms"].items()},
+        "wait_ms": {k: round(v, 3) for k, v in stats["wait_ms"].items()},
+        "avg_batch_size": round(stats["avg_batch_size"], 2),
+        "padded_waste": round(stats["padded_waste"], 4),
+        "compiles_during_load": cc.count,
+        "completed": stats["requests_completed"],
+        "failed": stats["requests_failed"],
+        "errors": errors[:5],
+    }
+    print(json.dumps(report, indent=1))
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    ap.add_argument("--model", default=None,
+                    help=".mxtpu artifact path (default: built-in MLP)")
+    ap.add_argument("--requests", type=int, default=512,
+                    help="total requests across all clients")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop client threads")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    ap.add_argument("--buckets", default=None,
+                    help="comma-separated bucket sizes "
+                         "(default: powers of two up to max batch)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CI run; fail on recompiles or lost "
+                         "requests")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.requests = min(args.requests, 64)
+        args.concurrency = min(args.concurrency, 4)
+        args.max_batch = min(args.max_batch, 4)
+
+    report = run(args)
+
+    if args.smoke:
+        ok = (report["compiles_during_load"] == 0
+              and report["failed"] == 0
+              and report["completed"] == report["requests"]
+              and report["throughput_rps"] > 0)
+        print("SMOKE", "PASS" if ok else "FAIL")
+        sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
