@@ -353,6 +353,14 @@ def main():
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    # Count XLA compiles for the registry snapshot the capture daemon
+    # turns into BENCH_rNN.json; install before any tracing happens.
+    try:
+        from mxnet_tpu.observability import install_jax_monitoring_bridge
+        install_jax_monitoring_bridge()
+    except Exception:
+        pass
+
     try:
         r = bench_resnet(dtype, layout, batch, train_iters, infer_iters,
                          stem_s2d=stem_s2d)
@@ -426,6 +434,30 @@ def main():
     if suspect:
         out["suspect"] = True
     out["extra"] = extra
+
+    # Mirror the headline numbers into the observability registry and
+    # flush the MXNET_TPU_METRICS_LOG snapshot (if enabled) so the
+    # capture daemon can read step time / examples-per-sec / compile
+    # count from the same source every other subsystem reports to.
+    try:
+        from mxnet_tpu.observability import get_registry
+        reg = get_registry()
+        reg.gauge("mxtpu_bench_step_seconds",
+                  "Per-step train time of the last bench run.").set(
+            r["train_dt"])
+        reg.gauge("mxtpu_bench_examples_per_sec",
+                  "Train throughput of the last bench run.").set(
+            r["train_img_s"])
+        reg.gauge("mxtpu_bench_infer_examples_per_sec",
+                  "Inference throughput of the last bench run.").set(
+            r["infer_img_s"])
+        if mfu is not None:
+            reg.gauge("mxtpu_bench_mfu_percent",
+                      "Model FLOP utilization of the last bench run."
+                      ).set(mfu)
+        reg.write_snapshot()
+    except Exception:
+        pass
     print(json.dumps(out))
 
 

@@ -56,7 +56,7 @@ _LAZY_MODULES = ("numpy", "numpy_extension", "symbol", "gluon", "module",
                  "recordio", "executor", "monitor", "model", "operator",
                  "contrib", "onnx", "native", "library", "visualization",
                  "error", "engine", "attribute", "name", "rtc", "deploy",
-                 "rnn", "resilience", "serving")
+                 "rnn", "resilience", "serving", "observability")
 
 
 

@@ -17,7 +17,7 @@ from ...loss import Loss as GluonLoss
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
                             TrainBegin, TrainEnd, MetricHandler,
                             StoppingHandler, LoggingHandler,
-                            GradientUpdateHandler)
+                            GradientUpdateHandler, StepTimerHandler)
 
 __all__ = ["Estimator"]
 
@@ -146,6 +146,8 @@ class Estimator:
         if not any(isinstance(h, MetricHandler) for h in handlers):
             handlers.append(MetricHandler(
                 self.train_metrics + [self.train_loss_metric]))
+        if not any(isinstance(h, StepTimerHandler) for h in handlers):
+            handlers.append(StepTimerHandler())
         from .event_handler import ValidationHandler
         if val_data is not None and \
                 not any(isinstance(h, ValidationHandler)

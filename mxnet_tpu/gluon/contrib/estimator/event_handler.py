@@ -20,7 +20,8 @@ __all__ = ["EventHandler", "TrainBegin", "TrainEnd", "EpochBegin",
            "EpochEnd", "BatchBegin", "BatchEnd", "StoppingHandler",
            "MetricHandler", "ValidationHandler", "LoggingHandler",
            "CheckpointHandler", "EarlyStoppingHandler",
-           "GradientUpdateHandler", "CheckpointOnPreemption"]
+           "GradientUpdateHandler", "CheckpointOnPreemption",
+           "StepTimerHandler"]
 
 
 class EventHandler:
@@ -358,6 +359,52 @@ class CheckpointOnPreemption(TrainBegin, BatchEnd, TrainEnd):
             os.makedirs(self.ckpt_dir, exist_ok=True)
             estimator.net.save_parameters(
                 os.path.join(self.ckpt_dir, "preempt.params"))
+
+
+class StepTimerHandler(TrainBegin, EpochBegin, BatchBegin, BatchEnd):
+    """Step-time telemetry for the estimator loop, driving an
+    ``observability.StepTimer``: the gap between one ``batch_end`` and
+    the next ``batch_begin`` is input-pipeline wait, ``batch_begin`` to
+    ``batch_end`` is compute (forward/backward/metrics + the trainer
+    update, which GradientUpdateHandler at priority -2000 runs before
+    this handler's batch_end at -100). Added by default in
+    ``Estimator.fit`` — metrics cost ~1us/batch and the step-time
+    breakdown (``mxtpu_training_step_seconds``,
+    ``data_wait_seconds``, ``compute_seconds``,
+    ``examples_per_sec``) is the substrate every perf report reads.
+    """
+
+    def __init__(self, timer=None, priority=-100):
+        self.priority = priority
+        self._timer = timer
+
+    @property
+    def timer(self):
+        if self._timer is None:
+            from ....observability import StepTimer
+            self._timer = StepTimer()
+        return self._timer
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.timer  # create eagerly so fit always registers the series
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        # epoch-end work (validation passes, checkpoints) must not be
+        # billed as input-pipeline wait of the next epoch's first step
+        self.timer._last_end = None
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        self.timer.begin_step()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        batch = kwargs.get("batch")
+        n = None
+        if batch is not None:
+            try:
+                n = len(batch[0])
+            except Exception:
+                n = None
+        self.timer.end_step(batch_size=n)
 
 
 class GradientUpdateHandler(BatchEnd):

@@ -53,6 +53,7 @@ class Trainer:
         self._params_to_init = []
         self._contains_sparse_weight = False
         self._step_count = 0
+        self._obs = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -110,13 +111,69 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    def _obs_metrics(self):
+        if self._obs is None:
+            import os
+            from ..observability import get_registry
+            reg = get_registry()
+            self._obs = {
+                "steps": reg.counter(
+                    "mxtpu_training_optimizer_steps_total",
+                    "Trainer.step calls (allreduce + update)."),
+                "secs": reg.histogram(
+                    "mxtpu_training_optimizer_step_seconds",
+                    "Time inside Trainer.step (allreduce + update)."),
+                "examples": reg.counter(
+                    "mxtpu_training_examples_total",
+                    "Examples processed (sum of Trainer.step "
+                    "batch sizes)."),
+                "grad_norm": reg.gauge(
+                    "mxtpu_training_grad_norm",
+                    "Global L2 gradient norm of the last step "
+                    "(MXNET_TPU_METRICS_GRAD_NORM=1 only; costs a "
+                    "host sync)."),
+                "want_grad_norm": os.environ.get(
+                    "MXNET_TPU_METRICS_GRAD_NORM") == "1",
+            }
+        return self._obs
+
+    def _observe_grad_norm(self, obs):
+        """Global L2 norm over all gradients — opt-in: the asnumpy()
+        fetch forces a device sync, which pipelined training loops must
+        not pay by default. Only the primary grad copy is normed: after
+        ``_allreduce_grads`` every device copy holds the same reduced
+        value, so summing all copies would inflate the norm by
+        sqrt(num_devices). (With ``update_on_kvstore`` the local copy is
+        the pre-reduction gradient — the norm is then per-worker, not
+        global.)"""
+        import numpy as _np
+        total = 0.0
+        for param in self._params:
+            if param.grad_req == "null" or param._data is None:
+                continue
+            g = param.list_grad()[0]
+            a = _np.asarray(g.asnumpy(), dtype=_np.float64)
+            total += float((a * a).sum())
+        obs["grad_norm"].set(total ** 0.5)
+
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + optimizer update (reference: trainer.py:329)."""
+        import time as _time
         if not self._kv_initialized:
             self._init_kvstore()
+        obs = self._obs_metrics()
+        t0 = _time.monotonic()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
+        if obs["want_grad_norm"]:
+            try:
+                self._observe_grad_norm(obs)
+            except Exception:
+                pass
         self._update(ignore_stale_grad)
+        obs["secs"].observe(_time.monotonic() - t0)
+        obs["steps"].inc()
+        obs["examples"].inc(batch_size)
         self._step_count += 1
         from ..resilience import faults
         faults.on_step(self._step_count)

@@ -13,6 +13,43 @@ from ..ndarray import NDArray
 
 __all__ = ["KVStoreBase", "KVStoreLocal", "create"]
 
+def _collective_obs():
+    """Shared-registry collective metrics, labeled by store type so
+    single-host reduces and cross-host (tpu) allreduces stay separable
+    in one exposition. Allreduce latency shares the registry's default
+    edges minus the 60s tail (a collective that slow is a hang)."""
+    from ..observability import get_registry
+    from ..observability.registry import DEFAULT_TIME_BUCKETS
+    _allreduce_buckets = DEFAULT_TIME_BUCKETS[:-1]
+    reg = get_registry()
+    return {
+        "count": reg.counter(
+            "mxtpu_kvstore_allreduce_total",
+            "Gradient reduce operations (one per key group pushed).",
+            ("store",)),
+        "bytes": reg.counter(
+            "mxtpu_kvstore_allreduce_bytes_total",
+            "Payload bytes entering the reduce (one contribution per "
+            "replica).", ("store",)),
+        "secs": reg.histogram(
+            "mxtpu_kvstore_allreduce_seconds",
+            "Host wall time of one push (local reduce + collective "
+            "dispatch).", ("store",), buckets=_allreduce_buckets),
+    }
+
+
+def _nd_nbytes(v):
+    """Best-effort payload size of one pushed value."""
+    try:
+        data = getattr(v, "_values", None)
+        data = data if data is not None else getattr(v, "_data", None)
+        if data is not None and hasattr(data, "nbytes"):
+            return int(data.nbytes)
+        import numpy as _np
+        return int(_np.prod(v.shape) * _np.dtype(v.dtype).itemsize)
+    except Exception:
+        return 0
+
 
 class KVStoreBase:
     """Abstract key-value store interface
@@ -75,6 +112,18 @@ class KVStoreLocal(KVStoreBase):
         self._str_keys = False
         self._compressor = None
         self._residuals = {}
+        self._obs_cache = None
+
+    def _obs_children(self):
+        """Per-instance cache of this store's collective metric
+        children — push is on the training hot path, so the registry
+        lock is taken once per store lifetime, not per step."""
+        if self._obs_cache is None:
+            obs = _collective_obs()
+            st = self.type
+            self._obs_cache = {k: obs[k].labels(store=st)
+                               for k in ("count", "bytes", "secs")}
+        return self._obs_cache
 
     # --- classic API (reference include/mxnet/kvstore.h) ---------------
     def init(self, key, value):
@@ -85,9 +134,15 @@ class KVStoreLocal(KVStoreBase):
                     NDArray(v)
 
     def push(self, key, value, priority=0):
+        import time as _time
         from ..ndarray.sparse import RowSparseNDArray, add as _sp_add
         keys, values = _key_value(key, value)
+        obs = self._obs_children()
+        t0 = _time.monotonic()
+        groups = 0
         for k, vlist in _group(keys, values):
+            groups += 1
+            obs["bytes"].inc(sum(_nd_nbytes(v) for v in vlist))
             if self._compressor is not None and \
                     not any(isinstance(v, RowSparseNDArray) for v in vlist):
                 # quantize each worker's contribution with its own error-
@@ -112,6 +167,8 @@ class KVStoreLocal(KVStoreBase):
                     if not isinstance(reduced, RowSparseNDArray) else \
                     RowSparseNDArray(reduced._values, reduced._indices,
                                      reduced._sshape)
+        obs["count"].inc(groups)
+        obs["secs"].observe(_time.monotonic() - t0)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out)

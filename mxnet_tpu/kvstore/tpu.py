@@ -85,7 +85,7 @@ def init_process_group(coordinator_address=None, num_processes=None,
                 raise
 
         call_with_retry(
-            _connect,
+            _connect, op="kvstore.init",
             retry_on=(OSError, ConnectionError, RuntimeError),
             max_attempts=max_attempts, base_delay=0.5, max_delay=15.0,
             seed=process_id)
@@ -140,10 +140,14 @@ class KVStoreTPU(KVStoreLocal):
         return NDArray(host)
 
     def push(self, key, value, priority=0):
+        import time as _time
         keys, values = _kv(key, value)
-        from .base import _group
+        from .base import _group, _nd_nbytes
+        obs = self._obs_children()
+        t0 = _time.monotonic()
         local = []                      # [(key, locally-reduced NDArray)]
         for k, vlist in _group(keys, values):
+            obs["bytes"].inc(sum(_nd_nbytes(v) for v in vlist))
             reduced = vlist[0]
             if len(vlist) > 1:
                 acc = vlist[0]._data
@@ -169,6 +173,8 @@ class KVStoreTPU(KVStoreLocal):
                 self._updater(k, reduced, self._store[k])
             else:
                 self._store[k] = reduced.copy()
+        obs["count"].inc(len(local))
+        obs["secs"].observe(_time.monotonic() - t0)
 
     def _batched_reduce(self, local):
         """One cross-process reduce for many keys: ravel + concat per

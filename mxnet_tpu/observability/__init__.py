@@ -1,0 +1,43 @@
+"""mxnet_tpu.observability — unified runtime observability.
+
+One process-wide :class:`MetricsRegistry` (counters, gauges, fixed-edge
+histograms — mergeable across hosts) that every subsystem reports
+through under the ``mxtpu_<subsystem>_<metric>`` naming scheme:
+
+================  ====================================================
+subsystem         instrumented where
+================  ====================================================
+``training``      :class:`StepTimer` (step wall time, data-wait vs
+                  compute split, examples/sec) wired into
+                  ``gluon.Trainer`` / the estimator's
+                  ``StepTimerHandler``; optimizer-step timing and the
+                  optional grad-norm gauge in ``Trainer.step``
+``xla``           compile count/duration + cache hits via the
+                  :mod:`jax.monitoring` bridge (:mod:`.jaxmon`)
+``resilience``    checkpoint write/restore duration, bytes, retry
+                  counts (``mxnet_tpu.resilience``)
+``kvstore``       allreduce count/bytes/duration
+                  (``mxnet_tpu.kvstore``)
+``serving``       request/batch counters, wait/service/latency
+                  histograms, queue depth
+                  (``mxnet_tpu.serving.telemetry``)
+================  ====================================================
+
+Exporters (both zero-dependency):
+
+- ``get_registry().expose()`` — Prometheus text exposition;
+- ``get_registry().write_snapshot()`` — JSONL snapshot, gated by
+  ``MXNET_TPU_METRICS_LOG`` (+ periodic via
+  ``MXNET_TPU_METRICS_INTERVAL``); rendered by
+  ``tools/metrics_dump.py``.
+
+See docs/OBSERVABILITY.md for the metric catalog.
+"""
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       DEFAULT_TIME_BUCKETS, get_registry)
+from .steptimer import StepTimer
+from .jaxmon import compile_count, install_jax_monitoring_bridge
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "DEFAULT_TIME_BUCKETS", "get_registry", "StepTimer",
+           "compile_count", "install_jax_monitoring_bridge"]

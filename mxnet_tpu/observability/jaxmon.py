@@ -1,0 +1,92 @@
+"""jax.monitoring → MetricsRegistry bridge.
+
+XLA compilation is the dominant hidden cost on TPU (a new input shape
+mid-serving or mid-training stalls the program for seconds), but jax
+only surfaces it through ``jax.monitoring`` callback events. This
+bridge turns those events into first-class registry metrics so compile
+behaviour lands in the same exposition as step timing and serving
+latency:
+
+- ``mxtpu_xla_compile_total``        counter — backend (XLA) compiles
+- ``mxtpu_xla_compile_seconds``      histogram — per-compile duration
+- ``mxtpu_xla_cache_hits_total``     counter — compilation-cache hits
+- ``mxtpu_xla_events_total{event=}`` counter — every other monitoring
+  event, by (low-cardinality) event name
+
+The backend-compile event fires exactly once per XLA compilation
+anywhere in the process, which is what makes "zero recompiles after
+warmup" assertable; :func:`mxnet_tpu.serving.telemetry.compile_count`
+is a thin view over the counter registered here.
+
+Install is idempotent and lazy — nothing imports jax until the first
+caller needs the bridge.
+"""
+from __future__ import annotations
+
+import threading
+
+from .registry import get_registry
+
+__all__ = ["install_jax_monitoring_bridge", "compile_count",
+           "COMPILE_EVENT"]
+
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# Compiles run 10ms .. minutes; the default latency edges top out too
+# low to resolve them.
+_COMPILE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                    10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+
+_installed = False
+_lock = threading.Lock()
+
+
+def _metrics():
+    reg = get_registry()
+    return (
+        reg.counter("mxtpu_xla_compile_total",
+                    "XLA backend compilations since bridge install."),
+        reg.histogram("mxtpu_xla_compile_seconds",
+                      "Duration of each XLA backend compilation.",
+                      buckets=_COMPILE_BUCKETS),
+        reg.counter("mxtpu_xla_cache_hits_total",
+                    "jax compilation-cache hits."),
+        reg.counter("mxtpu_xla_events_total",
+                    "Other jax.monitoring events by name.", ("event",)),
+    )
+
+
+def install_jax_monitoring_bridge():
+    """Register the jax.monitoring listeners once per process. Safe to
+    call from anywhere (serving warmup, bench, tests); only deltas
+    after the first install are meaningful."""
+    global _installed
+    with _lock:
+        if _installed:
+            return get_registry()
+        import jax.monitoring
+        compile_total, compile_secs, cache_hits, events = _metrics()
+
+        def _on_duration(name, duration_secs, **kw):
+            if name == COMPILE_EVENT:
+                compile_total.inc()
+                compile_secs.observe(duration_secs)
+
+        def _on_event(name, **kw):
+            if "cache_hit" in name:
+                cache_hits.inc()
+            else:
+                events.labels(event=name).inc()
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        jax.monitoring.register_event_listener(_on_event)
+        _installed = True
+        return get_registry()
+
+
+def compile_count():
+    """Process-global XLA compile count (installs the bridge lazily, so
+    compiles before the first call are not counted)."""
+    install_jax_monitoring_bridge()
+    return int(get_registry()
+               .counter("mxtpu_xla_compile_total").value)

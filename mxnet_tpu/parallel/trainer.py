@@ -200,8 +200,32 @@ class ShardedTrainer:
         donate = (0, 1) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
+    def _obs_metrics(self):
+        obs = getattr(self, "_obs", None)
+        if obs is None:
+            from ..observability import get_registry
+            reg = get_registry()
+            obs = self._obs = {
+                "steps": reg.counter(
+                    "mxtpu_training_sharded_steps_total",
+                    "ShardedTrainer SPMD steps dispatched."),
+                "secs": reg.histogram(
+                    "mxtpu_training_sharded_step_seconds",
+                    "Host-side dispatch time of one SPMD step (async: "
+                    "excludes on-device time unless the loss is "
+                    "fetched)."),
+                "examples": reg.counter(
+                    "mxtpu_training_examples_total",
+                    "Examples processed (sum of Trainer.step "
+                    "batch sizes)."),
+            }
+        return obs
+
     def step(self, x, y):
         """One SPMD training step; returns the (replicated) scalar loss."""
+        import time as _time
+        obs = self._obs_metrics()
+        t0 = _time.monotonic()
         self._ensure_init(x)
         if self._step_jit is None:
             self._step_jit = self._build_step()
@@ -214,6 +238,12 @@ class ShardedTrainer:
         self._params, self._opt_states, loss = self._step_jit(
             self._params, self._opt_states, sub, t, xb, yb)
         self._step_count += 1
+        obs["secs"].observe(_time.monotonic() - t0)
+        obs["steps"].inc()
+        try:
+            obs["examples"].inc(int(x.shape[0]))
+        except Exception:
+            pass
         from ..resilience import faults
         faults.on_step(self._step_count)
         if _spans_processes(self._mesh):

@@ -163,36 +163,81 @@ def _load_trace_events():
     return events
 
 
-def dumps(reset=False, format_="table"):
-    """Aggregate stats from the captured trace (reference: profiler.py:194
-    dumps): per-op-name total/count/avg device time, sorted by total.
+_DEVICE_HINTS = ("device", "tpu", "gpu", "accelerator")
+_HOST_HINTS = ("cpu", "host", "python", "thread")
 
-    Must be called after set_state('stop'); returns a printable table
-    (or the raw {name: (total_us, count)} dict with format_='dict').
+
+def _lane_of(pname):
+    """Classify a trace process lane as 'device', 'host' or 'unknown'.
+
+    The old heuristic was a bare ``"cpu" in name`` substring test, which
+    silently classified every lane matching NEITHER hint set (e.g. a
+    plugin runtime's worker lanes) as device time and corrupted the op
+    table. Unknown lanes are now an explicit third class: excluded from
+    the device table, reported separately."""
+    p = pname.lower()
+    if any(h in p for h in _DEVICE_HINTS):
+        return "device"
+    if any(h in p for h in _HOST_HINTS):
+        return "host"
+    return "unknown"
+
+
+def dumps(reset=False, format_="table", lane=None):
+    """Aggregate stats from the captured trace (reference: profiler.py:194
+    dumps): per-op-name total/count/avg time, sorted by total.
+
+    Must be called after set_state('stop'). ``lane`` selects which
+    timeline lanes feed the table:
+
+    - ``None`` (default) — device lanes, falling back to host+unknown
+      when the capture has no device lane (CPU-only backends);
+    - ``'device'`` / ``'host'`` / ``'unknown'`` — exactly that class;
+    - ``'both'`` (``format_='dict'`` only) — ``{lane: {"ops": {name:
+      (total_us, count)}, "total_us": float, "count": int}}`` for all
+      three classes, so host and device totals can be compared without
+      re-parsing the trace.
+
+    Returns a printable table, or with ``format_='dict'`` the raw
+    ``{name: (total_us, count)}`` mapping.
     """
     events = _load_trace_events()
     pids = {e["pid"]: e["args"].get("name", "")
             for e in events
             if e.get("ph") == "M" and e.get("name") == "process_name"}
-    def aggregate(device_only):
+
+    def aggregate(lanes):
         tot, cnt = Counter(), Counter()
         for e in events:
             if e.get("ph") != "X" or "dur" not in e:
                 continue
-            pname = pids.get(e.get("pid"), "")
-            if device_only and "cpu" in pname.lower() \
-                    and "device" not in pname.lower():
-                continue  # host lanes excluded from the op table
+            if _lane_of(pids.get(e.get("pid"), "")) not in lanes:
+                continue
             key = e["name"].split(".")[0]
             tot[key] += e["dur"]
             cnt[key] += 1
         return tot, cnt
 
-    # prefer accelerator lanes; on a CPU-only backend everything runs on
-    # host lanes, so fall back to them
-    tot, cnt = aggregate(device_only=True)
-    if not tot:
-        tot, cnt = aggregate(device_only=False)
+    if lane == "both":
+        if format_ != "dict":
+            raise ValueError("lane='both' requires format_='dict'")
+        out = {}
+        for cls in ("device", "host", "unknown"):
+            tot, cnt = aggregate({cls})
+            out[cls] = {"ops": {k: (tot[k], cnt[k]) for k in tot},
+                        "total_us": float(sum(tot.values())),
+                        "count": int(sum(cnt.values()))}
+        return out
+    if lane is not None:
+        if lane not in ("device", "host", "unknown"):
+            raise ValueError(f"invalid lane {lane!r}")
+        tot, cnt = aggregate({lane})
+    else:
+        # prefer accelerator lanes; on a CPU-only backend everything
+        # runs on host (or unclassifiable) lanes, so fall back to them
+        tot, cnt = aggregate({"device"})
+        if not tot:
+            tot, cnt = aggregate({"host", "unknown"})
     if format_ == "dict":
         return {k: (tot[k], cnt[k]) for k in tot}
     lines = [f"{'Name':<48} {'Total(us)':>12} {'Count':>8} {'Avg(us)':>10}"]

@@ -57,6 +57,47 @@ FORMAT = "mxtpu-ckpt-v1"
 _RETRY = dict(retry_on=(OSError,), max_attempts=4, base_delay=0.02,
               max_delay=0.5)
 
+# Checkpoint IO runs ms (tiny test nets) to minutes (sharded LLM state).
+_CKPT_SECONDS_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                         0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                         120.0, 300.0)
+
+
+def _obs():
+    """Checkpoint metrics on the shared registry (created lazily so
+    importing resilience never drags observability setup in)."""
+    from ..observability import get_registry
+    reg = get_registry()
+    return {
+        "write_secs": reg.histogram(
+            "mxtpu_resilience_checkpoint_write_seconds",
+            "Wall time of one committed checkpoint write (data + "
+            "manifest + LATEST pointer).", buckets=_CKPT_SECONDS_BUCKETS),
+        "writes": reg.counter(
+            "mxtpu_resilience_checkpoint_writes_total",
+            "Checkpoints committed by this process."),
+        "write_bytes": reg.counter(
+            "mxtpu_resilience_checkpoint_bytes_written_total",
+            "Bytes committed across all checkpoint files."),
+        "last_step": reg.gauge(
+            "mxtpu_resilience_checkpoint_last_step",
+            "Step of the most recently committed checkpoint."),
+        "restore_secs": reg.histogram(
+            "mxtpu_resilience_checkpoint_restore_seconds",
+            "Wall time of one checkpoint array read (validated).",
+            buckets=_CKPT_SECONDS_BUCKETS),
+        "restores": reg.counter(
+            "mxtpu_resilience_checkpoint_restores_total",
+            "Checkpoint array reads completed."),
+        "read_bytes": reg.counter(
+            "mxtpu_resilience_checkpoint_bytes_read_total",
+            "Bytes read back from checkpoint data files."),
+        "corrupt": reg.counter(
+            "mxtpu_resilience_checkpoint_corrupt_total",
+            "Checkpoint directories skipped as partial/corrupt during "
+            "newest-valid scans."),
+    }
+
 
 def _corrupt(msg):
     from ..error import CheckpointCorruptError
@@ -93,6 +134,8 @@ def write_checkpoint(run_dir, arrays, step, epoch=None, extra=None,
     """
     if _process_index() != 0:
         return None
+    obs = _obs()
+    t0 = time.monotonic()
     os.makedirs(run_dir, exist_ok=True)
     ckpt = os.path.join(run_dir, checkpoint_dirname(step))
     os.makedirs(ckpt, exist_ok=True)
@@ -119,9 +162,14 @@ def write_checkpoint(run_dir, arrays, step, epoch=None, extra=None,
             f.write(json.dumps(manifest, indent=1).encode())
         return manifest
 
-    call_with_retry(_write_all, **_RETRY)
+    manifest = call_with_retry(_write_all, op="checkpoint.write", **_RETRY)
     with atomic_write(os.path.join(run_dir, LATEST_NAME)) as f:
         f.write(os.path.basename(ckpt).encode())
+    obs["write_secs"].observe(time.monotonic() - t0)
+    obs["writes"].inc()
+    obs["write_bytes"].inc(sum(int(rec["nbytes"]) for rec in
+                               manifest.get("files", {}).values()))
+    obs["last_step"].set(int(step))
     if keep is not None:
         prune_checkpoints(run_dir, keep)
     return ckpt
@@ -196,6 +244,7 @@ def latest_checkpoint(run_dir):
         try:
             return path, validate_checkpoint(path)
         except CheckpointCorruptError:
+            _obs()["corrupt"].inc()
             continue
     latest = os.path.join(run_dir, LATEST_NAME)
     if os.path.isfile(latest):
@@ -219,10 +268,18 @@ def read_arrays(ckpt_dir, manifest=None, verify_arrays=False):
     the validation happened long before the read)."""
     if manifest is None:
         manifest = validate_checkpoint(ckpt_dir)
+    obs = _obs()
+    t0 = time.monotonic()
     from ..ndarray import load as nd_load
-    return nd_load(os.path.join(ckpt_dir, DATA_FILE),
-                   manifest=manifest.get("arrays") if verify_arrays
-                   else None)
+    out = nd_load(os.path.join(ckpt_dir, DATA_FILE),
+                  manifest=manifest.get("arrays") if verify_arrays
+                  else None)
+    obs["restore_secs"].observe(time.monotonic() - t0)
+    obs["restores"].inc()
+    data_rec = manifest.get("files", {}).get(DATA_FILE)
+    if data_rec:
+        obs["read_bytes"].inc(int(data_rec["nbytes"]))
+    return out
 
 
 def read_blob(ckpt_dir, fname, manifest=None):

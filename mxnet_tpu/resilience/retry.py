@@ -43,16 +43,32 @@ def backoff_schedule(max_attempts=5, base_delay=0.05, max_delay=2.0,
     return out
 
 
+def _retry_metrics():
+    from ..observability import get_registry
+    reg = get_registry()
+    return (reg.counter("mxtpu_resilience_retry_total",
+                        "Individual retries of transient-fault-guarded "
+                        "operations, by operation.", ("op",)),
+            reg.counter("mxtpu_resilience_retry_exhausted_total",
+                        "Operations that failed every retry attempt, "
+                        "by operation.", ("op",)))
+
+
 def call_with_retry(fn, *args, retry_on=(OSError,), max_attempts=5,
                     base_delay=0.05, max_delay=2.0, factor=2.0,
                     jitter=0.5, seed=0, sleep=None, on_retry=None,
-                    **kwargs):
+                    op=None, **kwargs):
     """Call ``fn(*args, **kwargs)``, retrying on ``retry_on`` exceptions
     up to ``max_attempts`` total attempts with the
     :func:`backoff_schedule` delays. ``sleep`` is injectable so tests
     run instantly; ``on_retry(attempt, exc, delay)`` observes each
     failure. Raises :class:`RetryError` (chained to the last failure)
-    when exhausted; non-matching exceptions propagate immediately."""
+    when exhausted; non-matching exceptions propagate immediately.
+
+    Every retry (and every exhaustion) increments the shared-registry
+    counters ``mxtpu_resilience_retry[_exhausted]_total{op=...}``; ``op``
+    defaults to the wrapped function's name. The happy path — success on
+    attempt 1 — records nothing and pays no registry cost."""
     if sleep is None:
         sleep = time.sleep   # late-bound: tests stub time.sleep
     delays = backoff_schedule(max_attempts, base_delay, max_delay,
@@ -66,9 +82,19 @@ def call_with_retry(fn, *args, retry_on=(OSError,), max_attempts=5,
             if attempt == max_attempts:
                 break
             delay = delays[attempt - 1]
+            try:
+                _retry_metrics()[0].labels(
+                    op=op or getattr(fn, "__name__", "?")).inc()
+            except Exception:
+                pass
             if on_retry is not None:
                 on_retry(attempt, exc, delay)
             sleep(delay)
+    try:
+        _retry_metrics()[1].labels(
+            op=op or getattr(fn, "__name__", "?")).inc()
+    except Exception:
+        pass
     raise RetryError(max_attempts, last) from last
 
 

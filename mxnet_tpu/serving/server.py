@@ -100,7 +100,7 @@ class ModelServer:
         self._dtype = np.dtype(dtype) if dtype else None
         self._fn = self._build_fn(model)
         self._queue = MicroBatchQueue()
-        self._stats = ServingStats()
+        self._stats = ServingStats(server=name)
         self._events = (EventLog(event_log) if event_log is not None
                         else EventLog.from_env())
         self._worker = None

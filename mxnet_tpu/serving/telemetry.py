@@ -1,17 +1,23 @@
 """Serving telemetry: compile counting, latency percentiles, event log.
 
-Three independent pieces:
+Backed by the shared :mod:`mxnet_tpu.observability` registry since the
+observability PR: every counter/histogram here is a registry series
+under ``mxtpu_serving_*`` (labeled by server name), so serving stats
+land in the same Prometheus exposition as training step timing,
+checkpoint IO and XLA compile metrics.
 
-- :func:`compile_count` / :class:`CompileCounter` — a process-global
-  XLA compile counter fed by jax.monitoring's
-  ``/jax/core/compile/backend_compile_duration`` event, which fires
-  exactly once per backend (XLA) compilation anywhere in the process.
-  This is the hook the bucketing contract is asserted with: after
-  ``warmup()`` the counter must not move, no matter how ragged the
-  request sizes get.
-- :class:`ServingStats` — thread-safe counters + a bounded latency
-  reservoir; ``snapshot()`` returns the queue depth, wait times,
-  padded-waste fraction, p50/p95/p99 latency and throughput.
+Three pieces:
+
+- :func:`compile_count` / :class:`CompileCounter` — process-global XLA
+  compile counter, now a view over the observability jax.monitoring
+  bridge (``mxtpu_xla_compile_total``). This is the hook the bucketing
+  contract is asserted with: after ``warmup()`` the counter must not
+  move, no matter how ragged the request sizes get.
+- :class:`ServingStats` — thread-safe counters + BOUNDED fixed-edge
+  latency histograms (memory is O(bucket edges) forever — raw sample
+  reservoirs grew with load); ``snapshot()`` returns the queue depth,
+  wait times, padded-waste fraction, p50/p95/p99 latency and
+  throughput, same schema as before the registry migration.
 - :class:`EventLog` — JSON-lines event sink (one dict per line, ``ts``
   stamped) for offline analysis; the server emits per-batch records and
   lifecycle events into it. Pairs with ``mx.profiler``: when a trace is
@@ -20,46 +26,16 @@ Three independent pieces:
 """
 from __future__ import annotations
 
-import collections
 import json
 import os
 import threading
 import time
 
+from ..observability import get_registry
+from ..observability.jaxmon import compile_count
+from ..observability.registry import DEFAULT_TIME_BUCKETS
+
 __all__ = ["compile_count", "CompileCounter", "ServingStats", "EventLog"]
-
-# ------------------------------------------------------ compile counter --
-_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-_compiles = 0
-_listener_installed = False
-_listener_lock = threading.Lock()
-
-
-def _install_listener():
-    global _listener_installed
-    with _listener_lock:
-        if _listener_installed:
-            return
-        import jax.monitoring
-
-        def _on_event_duration(name, duration_secs, **kwargs):
-            global _compiles
-            if name == _COMPILE_EVENT:
-                _compiles += 1
-
-        jax.monitoring.register_event_duration_secs_listener(
-            _on_event_duration)
-        _listener_installed = True
-
-
-def compile_count():
-    """Number of XLA backend compilations since the hook was installed.
-
-    Only deltas are meaningful: compiles that happened before the first
-    call are not counted (the listener installs lazily).
-    """
-    _install_listener()
-    return _compiles
 
 
 class CompileCounter:
@@ -84,109 +60,178 @@ class CompileCounter:
 
 
 # -------------------------------------------------------------- stats --
-class _Reservoir:
-    """Bounded sample of recent values with percentile queries."""
 
-    def __init__(self, maxlen=8192):
-        self._d = collections.deque(maxlen=maxlen)
+# Serving latencies on CPU tests run ~100us; on a loaded TPU server the
+# tail can reach seconds. The shared registry edges (minus the 60s top
+# edge no sane request latency reaches) keep wait/service/latency
+# directly comparable with every other subsystem's histograms.
+_LATENCY_BUCKETS = DEFAULT_TIME_BUCKETS[:-1]
 
-    def add(self, v):
-        self._d.append(v)
+# Each live ServingStats needs its own label children or two same-named
+# servers in one process would zero and then merge each other's series.
+# A name whose previous holder is gone (garbage-collected — the common
+# server-restart pattern) is RE-USED, so dashboards keyed on
+# {server="x"} follow the restarted server instead of reading a frozen
+# series; only a name whose holder is still alive gets a "#N" suffix.
+_NAME_HOLDERS = {}     # label -> weakref to the ServingStats holding it
+_NAME_LOCK = threading.Lock()
 
-    def percentile(self, p):
-        if not self._d:
-            return 0.0
-        s = sorted(self._d)
-        k = min(len(s) - 1, max(0, int(round((p / 100.0) * (len(s) - 1)))))
-        return s[k]
 
-    def __len__(self):
-        return len(self._d)
+def _claim_server_label(name, holder):
+    import weakref
+    with _NAME_LOCK:
+        label = name
+        n = 1
+        while True:
+            ref = _NAME_HOLDERS.get(label)
+            if ref is None or ref() is None:
+                _NAME_HOLDERS[label] = weakref.ref(holder)
+                return label
+            n += 1
+            label = f"{name}#{n}"
 
 
 class ServingStats:
-    """Aggregated serving counters; every method is thread-safe."""
+    """Aggregated serving counters; every method is thread-safe.
 
-    def __init__(self):
+    All series live on the shared registry labeled
+    ``{server="<name>"}``. A restarted server (previous instance
+    garbage-collected) re-claims its name — its children are reset and
+    continue under the same label; a name still held by a LIVE instance
+    gets a ``#N`` suffix instead, so concurrent same-named servers
+    never share or reset each other's children. ``snapshot()`` reads
+    this instance's own label children, while the exposition keeps the
+    one-scrape view across every server the process ran.
+    """
+
+    def __init__(self, server="serve", registry=None):
+        self._reg = registry if registry is not None else get_registry()
+        self._server = _claim_server_label(str(server), self)
+        r, lbl = self._reg, ("server",)
+        s = {"server": self._server}
+        self._submitted = r.counter(
+            "mxtpu_serving_requests_submitted_total",
+            "Requests accepted into the batching queue.", lbl).labels(**s)
+        self._completed = r.counter(
+            "mxtpu_serving_requests_completed_total",
+            "Requests resolved with a result.", lbl).labels(**s)
+        self._failed = r.counter(
+            "mxtpu_serving_requests_failed_total",
+            "Requests resolved with an error.", lbl).labels(**s)
+        self._batches = r.counter(
+            "mxtpu_serving_batches_total",
+            "Micro-batches executed.", lbl).labels(**s)
+        self._rows = r.counter(
+            "mxtpu_serving_rows_total",
+            "Real (unpadded) rows executed.", lbl).labels(**s)
+        self._padded = r.counter(
+            "mxtpu_serving_padded_rows_total",
+            "Pad rows executed (bucket size minus real rows).",
+            lbl).labels(**s)
+        self._queue_depth = r.gauge(
+            "mxtpu_serving_queue_depth",
+            "Requests waiting in the batching queue.", lbl).labels(**s)
+        self._wait = r.histogram(
+            "mxtpu_serving_wait_seconds",
+            "Per-request queue wait before dispatch.", lbl,
+            buckets=_LATENCY_BUCKETS).labels(**s)
+        self._service = r.histogram(
+            "mxtpu_serving_service_seconds",
+            "Per-batch model execution time.", lbl,
+            buckets=_LATENCY_BUCKETS).labels(**s)
+        self._latency = r.histogram(
+            "mxtpu_serving_latency_seconds",
+            "Per-request end-to-end latency (wait + service).", lbl,
+            buckets=_LATENCY_BUCKETS).labels(**s)
+        # no throughput gauge: a gauge only updated on snapshot() reads
+        # stale from a pure scrape; rate(requests_completed_total) is
+        # the scrape-side equivalent, snapshot() computes it locally
+        self._hits_metric = r.counter(
+            "mxtpu_serving_bucket_hits_total",
+            "Micro-batches dispatched per shape bucket.",
+            ("server", "bucket"))
         self._lock = threading.Lock()
+        self._bucket_hits = {}
         self.reset()
 
     def reset(self):
         with self._lock:
             self._t_start = time.monotonic()
-            self._requests_submitted = 0
-            self._requests_completed = 0
-            self._requests_failed = 0
-            self._batches = 0
-            self._rows = 0
-            self._padded_rows = 0
-            self._batch_size_sum = 0
-            self._wait = _Reservoir()
-            self._latency = _Reservoir()
-            self._service = _Reservoir()
-            self._queue_depth = 0
-            self._bucket_hits = collections.Counter()
+            for c in (self._submitted, self._completed, self._failed,
+                      self._batches, self._rows, self._padded,
+                      self._queue_depth, self._wait, self._service,
+                      self._latency):
+                c.reset()
+            # include bucket-hit children left by a previous holder of
+            # this (re-claimed) server label, not just our own dict
+            for child in self._hits_metric.children():
+                if child.labels_dict.get("server") == self._server:
+                    child.reset()
+            self._bucket_hits = {}
+
+    def _hit_child(self, bucket):
+        child = self._bucket_hits.get(bucket)
+        if child is None:
+            child = self._hits_metric.labels(server=self._server,
+                                             bucket=bucket)
+            self._bucket_hits[bucket] = child
+        return child
 
     # ------------------------------------------------------- recording --
     def record_submit(self):
-        with self._lock:
-            self._requests_submitted += 1
+        self._submitted.inc()
 
     def record_queue_depth(self, depth):
-        with self._lock:
-            self._queue_depth = depth
+        self._queue_depth.set(depth)
 
     def record_batch(self, n, bucket, wait_s_each, service_s):
         """One executed micro-batch: n real rows padded to ``bucket``."""
         with self._lock:
-            self._batches += 1
-            self._rows += n
-            self._padded_rows += bucket - n
-            self._batch_size_sum += n
-            self._bucket_hits[bucket] += 1
-            self._service.add(service_s)
+            self._batches.inc()
+            self._rows.inc(n)
+            self._padded.inc(bucket - n)
+            self._hit_child(bucket).inc()
+            self._service.observe(service_s)
             for w in wait_s_each:
-                self._wait.add(w)
-                self._latency.add(w + service_s)
-            self._requests_completed += n
+                self._wait.observe(w)
+                self._latency.observe(w + service_s)
+            self._completed.inc(n)
 
     def record_failure(self, n):
-        with self._lock:
-            self._requests_failed += n
+        self._failed.inc(n)
 
     # -------------------------------------------------------- snapshot --
     def snapshot(self):
         with self._lock:
             elapsed = max(time.monotonic() - self._t_start, 1e-9)
-            total_slots = self._rows + self._padded_rows
+            rows = self._rows.value
+            padded = self._padded.value
+            batches = self._batches.value
+            completed = self._completed.value
+            total_slots = rows + padded
             return {
-                "requests_submitted": self._requests_submitted,
-                "requests_completed": self._requests_completed,
-                "requests_failed": self._requests_failed,
-                "batches": self._batches,
-                "queue_depth": self._queue_depth,
-                "avg_batch_size": (self._batch_size_sum / self._batches
-                                   if self._batches else 0.0),
-                "padded_waste": (self._padded_rows / total_slots
+                "requests_submitted": int(self._submitted.value),
+                "requests_completed": int(completed),
+                "requests_failed": int(self._failed.value),
+                "batches": int(batches),
+                "queue_depth": int(self._queue_depth.value),
+                "avg_batch_size": (rows / batches if batches else 0.0),
+                "padded_waste": (padded / total_slots
                                  if total_slots else 0.0),
-                "bucket_hits": dict(self._bucket_hits),
-                "throughput_rps": self._requests_completed / elapsed,
-                "wait_ms": {
-                    "p50": self._wait.percentile(50) * 1e3,
-                    "p95": self._wait.percentile(95) * 1e3,
-                    "p99": self._wait.percentile(99) * 1e3,
-                },
-                "latency_ms": {
-                    "p50": self._latency.percentile(50) * 1e3,
-                    "p95": self._latency.percentile(95) * 1e3,
-                    "p99": self._latency.percentile(99) * 1e3,
-                },
-                "service_ms": {
-                    "p50": self._service.percentile(50) * 1e3,
-                    "p95": self._service.percentile(95) * 1e3,
-                    "p99": self._service.percentile(99) * 1e3,
-                },
+                "bucket_hits": {b: int(c.value)
+                                for b, c in self._bucket_hits.items()
+                                if c.value},
+                "throughput_rps": completed / elapsed,
+                "wait_ms": self._pcts(self._wait),
+                "latency_ms": self._pcts(self._latency),
+                "service_ms": self._pcts(self._service),
             }
+
+    @staticmethod
+    def _pcts(hist):
+        return {"p50": hist.percentile(50) * 1e3,
+                "p95": hist.percentile(95) * 1e3,
+                "p99": hist.percentile(99) * 1e3}
 
 
 # ----------------------------------------------------------- event log --

@@ -103,6 +103,23 @@ def test_serve_bench_smoke():
     assert "SMOKE PASS" in p.stdout
 
 
+def test_metrics_dump_smoke():
+    """tools/metrics_dump.py --smoke: the observability exposition path
+    (registry -> 4-subsystem instrumentation -> Prometheus text ->
+    JSONL round-trip) must hold end to end (it exits 1 otherwise)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    tools = os.path.join(os.path.dirname(EXAMPLES), "tools")
+    p = subprocess.run(
+        [sys.executable, os.path.join(tools, "metrics_dump.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert p.returncode == 0, \
+        f"metrics_dump --smoke failed:\n{p.stdout[-2000:]}\n" \
+        f"{p.stderr[-2000:]}"
+    assert "SMOKE PASS" in p.stdout
+
+
 @pytest.mark.slow   # ~160s of XLA CPU compile for the 4-stage ResNet
 def test_pipeline_parallel_example_runs():
     env = dict(os.environ)

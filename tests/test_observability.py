@@ -1,0 +1,523 @@
+"""Unified runtime observability (mxnet_tpu.observability).
+
+Pins the contracts of the metrics substrate every subsequent perf PR
+reports through:
+
+- registry correctness: concurrent increments, fixed-edge histogram
+  bucket math, valid Prometheus text exposition;
+- StepTimer on a real 2-step gluon.Trainer loop (step wall time,
+  data-wait vs compute split, examples counters);
+- the jax.monitoring bridge (XLA compile count/duration as metrics,
+  serving.compile_count parity);
+- serving telemetry after the registry migration: same snapshot
+  schema, counters exact, and BOUNDED memory — percentiles come from
+  fixed-edge histograms, not ever-growing sample lists;
+- the acceptance criterion: ONE expose() call carrying training,
+  serving, resilience-checkpoint and XLA-compile metrics produced by a
+  single in-process run.
+"""
+import json
+import os
+import re
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.autograd as ag
+from mxnet_tpu.observability import (MetricsRegistry, StepTimer,
+                                     get_registry,
+                                     install_jax_monitoring_bridge)
+from mxnet_tpu.observability.registry import DEFAULT_TIME_BUCKETS
+
+
+# ------------------------------------------------------ registry core --
+
+def test_counter_concurrent_increments():
+    reg = MetricsRegistry()
+    c = reg.counter("t_hits_total", "hits")
+    def worker():
+        for _ in range(1000):
+            c.inc()
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_histogram_concurrent_observe():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", "lat", buckets=(0.1, 1.0))
+    def worker():
+        for i in range(500):
+            h.observe(0.05 if i % 2 else 0.5)
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 2000
+    # le=0.1 bucket holds exactly the 0.05 observations
+    assert h._need_default().bucket_counts()[0] == 1000
+
+
+def test_histogram_bucket_math():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_h_seconds", "h", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 1.5, 3.0, 9.0):
+        h.observe(v)
+    child = h._need_default()
+    # le semantics are inclusive: 1.0 lands in the first bucket
+    assert child._counts == [2, 1, 1, 1]
+    assert child.bucket_counts() == [2, 3, 4, 5]   # cumulative + (+Inf)
+    assert h.count == 5
+    assert h.sum == pytest.approx(15.0)
+    # percentiles are monotone and clamped to the observed range
+    ps = [h.percentile(p) for p in (1, 25, 50, 75, 95, 99.9)]
+    assert all(a <= b + 1e-12 for a, b in zip(ps, ps[1:]))
+    assert ps[0] >= 0.5 - 1e-12
+    assert ps[-1] <= 9.0 + 1e-12
+    # empty histogram percentile is defined
+    assert reg.histogram("t_empty_seconds", buckets=(1.0,)) \
+        .percentile(99) == 0.0
+    # interpolation never overshoots the observed range: samples
+    # clustered just past a wide bucket's lower edge must not report
+    # a tail half-way up the bucket
+    hc = reg.histogram("t_clamp_seconds", buckets=(1.0, 100.0))
+    for _ in range(100):
+        hc.observe(1.5)
+    assert hc.percentile(50) == pytest.approx(1.5)
+    assert hc.percentile(99) == pytest.approx(1.5)
+
+
+def test_histogram_memory_is_bounded():
+    """The whole point of fixed-edge histograms: state size never grows
+    with the number of observations."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t_flat_seconds", "flat")
+    child = h._need_default()
+    h.observe(0.01)
+    size_before = len(child._counts)
+    for i in range(10000):
+        h.observe((i % 100) / 1000.0)
+    assert len(child._counts) == size_before
+    assert h.count == 10001
+    # no per-sample storage anywhere on the child
+    for v in vars(child).values():
+        assert not isinstance(v, (list, tuple)) or \
+            len(v) <= len(DEFAULT_TIME_BUCKETS) + 1
+
+
+def test_registry_idempotent_and_type_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("t_x_total", "x")
+    assert reg.counter("t_x_total") is a
+    with pytest.raises(ValueError):
+        reg.gauge("t_x_total")
+    h = reg.histogram("t_y_seconds", buckets=(1.0, 2.0))
+    assert reg.histogram("t_y_seconds") is h
+    with pytest.raises(ValueError):
+        reg.histogram("t_y_seconds", buckets=(1.0, 3.0))
+    c = reg.counter("t_l_total", "l", ("op",))
+    with pytest.raises(ValueError):
+        reg.counter("t_l_total", labelnames=("other",))
+    with pytest.raises(ValueError):
+        c.inc()            # labeled metric needs .labels(...)
+    c.labels(op="a").inc(2)
+    c.labels(op="b").inc(3)
+    assert c.labels(op="a").value == 2
+
+
+def _parse_exposition(text):
+    """Minimal independent validator of Prometheus text format."""
+    name_re = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    sample_re = re.compile(
+        r"^(%s)(\{%s=\"(?:[^\"\\]|\\.)*\"(?:,%s=\"(?:[^\"\\]|\\.)*\")*\})?"
+        r" ([+-]?(?:\d+\.?\d*(?:e[+-]?\d+)?|inf|nan))$"
+        % (name_re, name_re, name_re), re.IGNORECASE)
+    samples = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            assert re.match(r"^# (HELP|TYPE) %s .+$" % name_re, line), line
+            continue
+        m = sample_re.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        samples[(m.group(1), m.group(2) or "")] = float(m.group(3))
+    return samples
+
+
+def test_expose_prometheus_format():
+    reg = MetricsRegistry()
+    reg.counter("t_req_total", "requests\nserved", ("server",)) \
+        .labels(server='a"b\\c').inc(3)
+    reg.gauge("t_depth", "queue depth").set(2.5)
+    h = reg.histogram("t_ms_seconds", "lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose()
+    samples = _parse_exposition(text)
+    assert samples[("t_depth", "")] == 2.5
+    # escaped label survives the round trip
+    assert any(n == "t_req_total" and 'a\\"b\\\\c' in l
+               for n, l in samples)
+    # histogram invariants: cumulative buckets, +Inf == count
+    b1 = samples[("t_ms_seconds_bucket", '{le="0.1"}')]
+    b2 = samples[("t_ms_seconds_bucket", '{le="1"}')]
+    binf = samples[("t_ms_seconds_bucket", '{le="+Inf"}')]
+    assert (b1, b2, binf) == (1, 2, 3)
+    assert samples[("t_ms_seconds_count", "")] == 3
+    assert samples[("t_ms_seconds_sum", "")] == pytest.approx(5.55)
+    # HELP newline is escaped, not emitted raw
+    assert "requests\\nserved" in text
+
+
+def test_non_finite_values_do_not_break_exporters(tmp_path):
+    """A diverged run (grad_norm = inf/nan) must not kill the scrape:
+    expose() emits the Prometheus +Inf/NaN tokens and write_snapshot
+    stays strict JSON."""
+    reg = MetricsRegistry()
+    reg.gauge("t_diverged").set(float("inf"))
+    reg.gauge("t_nan").set(float("nan"))
+    reg.counter("t_ok_total").inc(3)
+    text = reg.expose()
+    assert "t_diverged +Inf" in text
+    assert "t_nan NaN" in text
+    samples = _parse_exposition(text)
+    assert samples[("t_ok_total", "")] == 3
+    path = str(tmp_path / "m.jsonl")
+    reg.write_snapshot(path)
+    rec = json.loads(open(path).read())      # strict JSON parses
+    assert rec["metrics"]["t_diverged"]["series"][0]["value"] \
+        == "Infinity"
+    assert float(rec["metrics"]["t_nan"]["series"][0]["value"]) != \
+        float(rec["metrics"]["t_nan"]["series"][0]["value"])   # NaN
+
+
+def test_snapshot_jsonl_roundtrip(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("t_a_total").inc(7)
+    reg.histogram("t_b_seconds", buckets=(1.0,)).observe(0.5)
+    path = str(tmp_path / "metrics.jsonl")
+    assert reg.write_snapshot(path) == path
+    reg.counter("t_a_total").inc(1)
+    reg.write_snapshot(path)
+    lines = [json.loads(s) for s in
+             open(path).read().strip().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["metrics"]["t_a_total"]["series"][0]["value"] == 7
+    assert lines[1]["metrics"]["t_a_total"]["series"][0]["value"] == 8
+    hist = lines[1]["metrics"]["t_b_seconds"]["series"][0]
+    assert hist["counts"] == [1, 1] and hist["count"] == 1
+    # env-gated default: no path, no env -> no-op
+    assert MetricsRegistry().write_snapshot() in (
+        None, os.environ.get("MXNET_TPU_METRICS_LOG"))
+
+
+# ------------------------------------------------------- step timer --
+
+def _train_two_steps(timer):
+    from mxnet_tpu.gluon import nn, Trainer
+    from mxnet_tpu.gluon.loss import L2Loss
+    mx.random.seed(11)
+    net = nn.Dense(4)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1})
+    loss_fn = L2Loss()
+    rs = np.random.RandomState(3)
+    for _ in range(2):
+        x = nd.array(rs.randn(8, 3).astype(np.float32))
+        y = nd.array(rs.randn(8, 4).astype(np.float32))
+        with timer.step(batch_size=8):
+            with ag.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+    return trainer
+
+
+def test_steptimer_two_step_trainer_loop():
+    reg = get_registry()
+    steps0 = reg.counter("mxtpu_training_steps_total").value
+    opt0 = reg.counter("mxtpu_training_optimizer_steps_total").value
+    ex0 = reg.counter("mxtpu_training_examples_total").value
+    n_step0 = reg.histogram("mxtpu_training_step_seconds").count
+    n_wait0 = reg.histogram("mxtpu_training_data_wait_seconds").count
+    n_comp0 = reg.histogram("mxtpu_training_compute_seconds").count
+
+    timer = StepTimer()
+    _train_two_steps(timer)
+
+    assert reg.counter("mxtpu_training_steps_total").value - steps0 == 2
+    assert reg.counter(
+        "mxtpu_training_optimizer_steps_total").value - opt0 == 2
+    assert reg.counter(
+        "mxtpu_training_examples_total").value - ex0 == 16
+    assert reg.histogram(
+        "mxtpu_training_step_seconds").count - n_step0 == 2
+    assert reg.histogram(
+        "mxtpu_training_data_wait_seconds").count - n_wait0 == 2
+    assert reg.histogram(
+        "mxtpu_training_compute_seconds").count - n_comp0 == 2
+    # compute + wait == step (within float tolerance), compute dominates
+    # a tight loop, and the split gauges are in range
+    assert reg.gauge("mxtpu_training_examples_per_sec").value > 0
+    frac = reg.gauge("mxtpu_training_data_fraction").value
+    assert 0.0 <= frac <= 1.0
+    assert reg.histogram(
+        "mxtpu_training_optimizer_step_seconds").count >= 2
+
+
+def test_steptimer_failed_step_not_recorded():
+    reg = get_registry()
+    timer = StepTimer()
+    n0 = reg.histogram("mxtpu_training_step_seconds").count
+    with pytest.raises(RuntimeError):
+        with timer.step(batch_size=4):
+            raise RuntimeError("boom")
+    assert reg.histogram("mxtpu_training_step_seconds").count == n0
+    with timer.step(batch_size=4):
+        pass
+    assert reg.histogram("mxtpu_training_step_seconds").count == n0 + 1
+
+
+def test_grad_norm_gauge_opt_in(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_METRICS_GRAD_NORM", "1")
+    reg = get_registry()
+    _train_two_steps(StepTimer())
+    assert reg.gauge("mxtpu_training_grad_norm").value > 0
+
+
+def test_estimator_default_step_timer_handler():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.gluon.contrib.estimator.event_handler import \
+        StepTimerHandler
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    mx.random.seed(5)
+    net = nn.Dense(3)
+    net.initialize()
+    est = Estimator(net, SoftmaxCrossEntropyLoss())
+    handlers = est._prepare_handlers(None, 1, None, None)
+    assert any(isinstance(h, StepTimerHandler) for h in handlers)
+    reg = get_registry()
+    steps0 = reg.counter("mxtpu_training_steps_total").value
+    rs = np.random.RandomState(0)
+    data = [(rs.randn(4, 6).astype(np.float32),
+             rs.randint(0, 3, (4,)).astype(np.float32))
+            for _ in range(2)]
+    est.fit(data, epochs=1)
+    assert reg.counter("mxtpu_training_steps_total").value - steps0 == 2
+
+
+# --------------------------------------------------- jax.monitoring --
+
+def test_jax_monitoring_compile_bridge():
+    import jax
+    import jax.numpy as jnp
+    reg = install_jax_monitoring_bridge()
+    assert reg is get_registry()
+    c0 = reg.counter("mxtpu_xla_compile_total").value
+    d0 = reg.histogram("mxtpu_xla_compile_seconds").count
+
+    @jax.jit
+    def fresh(x):
+        return x * 3.14159 + 42.0          # unique program
+
+    fresh(jnp.ones((3, 3))).block_until_ready()
+    c1 = reg.counter("mxtpu_xla_compile_total").value
+    assert c1 - c0 >= 1
+    assert reg.histogram("mxtpu_xla_compile_seconds").count - d0 >= 1
+    assert reg.histogram("mxtpu_xla_compile_seconds").sum > 0
+    # cached second call must not count
+    fresh(jnp.ones((3, 3))).block_until_ready()
+    assert reg.counter("mxtpu_xla_compile_total").value == c1
+
+
+def test_serving_compile_count_is_bridge_view():
+    from mxnet_tpu import serving
+    import jax
+    import jax.numpy as jnp
+    reg = get_registry()
+    assert serving.compile_count() == int(
+        reg.counter("mxtpu_xla_compile_total").value)
+    with serving.CompileCounter() as cc:
+        jax.jit(lambda x: x - 7.125)(jnp.ones(4)).block_until_ready()
+    assert cc.count >= 1
+
+
+# ------------------------------------------------ serving telemetry --
+
+def test_serving_stats_parity_after_migration():
+    """Same snapshot schema and exact counter values as the
+    pre-registry ServingStats."""
+    from mxnet_tpu.serving.telemetry import ServingStats
+    st = ServingStats(server="parity")
+    st.record_submit()
+    st.record_submit()
+    st.record_submit()
+    st.record_queue_depth(2)
+    st.record_batch(2, 4, [0.001, 0.003], 0.002)
+    st.record_batch(1, 1, [0.010], 0.004)
+    st.record_failure(1)
+    snap = st.snapshot()
+    assert snap["requests_submitted"] == 3
+    assert snap["requests_completed"] == 3
+    assert snap["requests_failed"] == 1
+    assert snap["batches"] == 2
+    assert snap["queue_depth"] == 2
+    assert snap["avg_batch_size"] == pytest.approx(1.5)
+    assert snap["padded_waste"] == pytest.approx(2 / 5)
+    assert snap["bucket_hits"] == {4: 1, 1: 1}
+    assert snap["throughput_rps"] > 0
+    for key in ("wait_ms", "latency_ms", "service_ms"):
+        p = snap[key]
+        assert set(p) == {"p50", "p95", "p99"}
+        assert 0 <= p["p50"] <= p["p95"] <= p["p99"]
+    # the same numbers are visible in the shared exposition
+    text = get_registry().expose()
+    assert 'mxtpu_serving_requests_submitted_total{server="parity"} 3' \
+        in text
+    st.reset()
+    assert st.snapshot()["requests_submitted"] == 0
+    assert st.snapshot()["bucket_hits"] == {}
+
+
+def test_serving_stats_memory_flat_over_10k_requests():
+    """Regression for the unbounded-reservoir bug: percentile state must
+    not grow with sustained load."""
+    from mxnet_tpu.serving.telemetry import ServingStats
+    st = ServingStats(server="flood")
+    st.record_batch(1, 1, [0.001], 0.001)
+    hist_sizes = [len(st._wait._counts), len(st._latency._counts),
+                  len(st._service._counts)]
+    for i in range(10000):
+        st.record_submit()
+        st.record_batch(1, 1, [(i % 97) / 10000.0], 0.0005)
+    assert [len(st._wait._counts), len(st._latency._counts),
+            len(st._service._counts)] == hist_sizes
+    # and nothing sample-shaped accumulated on the instance
+    for v in vars(st).values():
+        assert not isinstance(v, (list, tuple)) or len(v) < 64
+    snap = st.snapshot()
+    assert snap["requests_completed"] == 10001
+    assert snap["latency_ms"]["p50"] <= snap["latency_ms"]["p99"]
+
+
+def test_serving_stats_label_lifecycle():
+    """Concurrent same-named servers are isolated behind #N suffixes;
+    a RESTARTED server (previous instance collected) re-claims its
+    label with fresh children, so dashboards keyed on the name follow
+    the restart instead of a frozen series."""
+    import gc
+    from mxnet_tpu.serving.telemetry import ServingStats
+    a = ServingStats(server="lifecycle")
+    a.record_batch(2, 2, [0.001, 0.001], 0.001)
+    b = ServingStats(server="lifecycle")      # a still alive -> suffix
+    assert a._server == "lifecycle" and b._server == "lifecycle#2"
+    b.record_batch(1, 1, [0.001], 0.001)
+    assert a.snapshot()["requests_completed"] == 2   # untouched by b
+    del a
+    gc.collect()
+    c = ServingStats(server="lifecycle")      # holder gone -> re-claim
+    assert c._server == "lifecycle"
+    snap = c.snapshot()                       # fresh, not frozen at 2
+    assert snap["requests_completed"] == 0
+    assert snap["bucket_hits"] == {}
+
+
+# ------------------------------------------------------ acceptance --
+
+def test_single_exposition_covers_four_subsystems(tmp_path):
+    """One in-process run -> one expose() carrying training, serving,
+    resilience-checkpoint and XLA-compile series (the PR's acceptance
+    criterion), all in valid Prometheus text format."""
+    from mxnet_tpu import serving
+    install_jax_monitoring_bridge()
+    trainer = _train_two_steps(StepTimer())
+    trainer.save_state(str(tmp_path / "run"))
+    trainer.restore_state(str(tmp_path / "run"))
+    srv = serving.ModelServer(lambda b: b * 2.0, buckets=[1, 2],
+                              max_delay_ms=1.0, item_shape=(3,),
+                              dtype="float32").start()
+    srv.warmup()
+    futs = [srv.submit(np.full(3, i, np.float32)) for i in range(4)]
+    for f in futs:
+        f.result(timeout=60)
+    srv.shutdown()
+
+    text = get_registry().expose()
+    samples = _parse_exposition(text)        # valid exposition
+    for prefix in ("mxtpu_training_", "mxtpu_serving_",
+                   "mxtpu_resilience_checkpoint_", "mxtpu_xla_compile_"):
+        assert any(name.startswith(prefix) for name, _ in samples), \
+            f"no {prefix}* series in exposition"
+    # and the checkpoint write/restore instrumentation saw real IO
+    reg = get_registry()
+    assert reg.counter(
+        "mxtpu_resilience_checkpoint_writes_total").value >= 1
+    assert reg.counter(
+        "mxtpu_resilience_checkpoint_restores_total").value >= 1
+    assert reg.counter(
+        "mxtpu_resilience_checkpoint_bytes_written_total").value > 0
+    assert reg.histogram(
+        "mxtpu_resilience_checkpoint_write_seconds").count >= 1
+
+
+def test_kvstore_allreduce_metrics():
+    from mxnet_tpu import kvstore as kvs
+    reg = get_registry()
+    kv = kvs.create("local")
+    v = nd.array(np.ones((4, 5), np.float32))
+    kv.init(0, v)
+    c = reg.counter("mxtpu_kvstore_allreduce_total", labelnames=("store",))
+    b = reg.counter("mxtpu_kvstore_allreduce_bytes_total",
+                    labelnames=("store",))
+    c0 = c.labels(store="device").value
+    b0 = b.labels(store="device").value
+    kv.push(0, [nd.array(np.ones((4, 5), np.float32)),
+                nd.array(np.ones((4, 5), np.float32))])
+    assert c.labels(store="device").value - c0 == 1
+    assert b.labels(store="device").value - b0 == 2 * 4 * 5 * 4
+    assert reg.histogram("mxtpu_kvstore_allreduce_seconds",
+                         labelnames=("store",)) \
+        .labels(store="device").count >= 1
+
+
+def test_retry_metrics():
+    from mxnet_tpu.resilience.retry import call_with_retry, RetryError
+    reg = get_registry()
+    retries = reg.counter("mxtpu_resilience_retry_total",
+                          labelnames=("op",))
+    exhausted = reg.counter("mxtpu_resilience_retry_exhausted_total",
+                            labelnames=("op",))
+    r0 = retries.labels(op="obs.test").value
+    e0 = exhausted.labels(op="obs.test").value
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("blip")
+        return "ok"
+
+    assert call_with_retry(flaky, op="obs.test", max_attempts=4,
+                           sleep=lambda s: None) == "ok"
+    assert retries.labels(op="obs.test").value - r0 == 2
+    with pytest.raises(RetryError):
+        call_with_retry(lambda: (_ for _ in ()).throw(OSError("x")),
+                        op="obs.test", max_attempts=2,
+                        sleep=lambda s: None)
+    assert exhausted.labels(op="obs.test").value - e0 == 1

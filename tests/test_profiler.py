@@ -56,6 +56,56 @@ def test_profiler_capture_and_dumps(tmp_path):
         assert count > 0 and total >= 0
 
 
+def test_dumps_lane_classification(tmp_path, monkeypatch):
+    """Lane heuristic regression: process lanes whose name matches
+    neither the device nor the host hints are 'unknown' — they must not
+    be silently counted as device time (the old substring test did
+    exactly that) — and lane='both' exposes totals for every class."""
+    from mxnet_tpu import profiler
+
+    out = str(tmp_path / "prof_lanes")
+    trace = os.path.join(out, "plugins", "profile", "run")
+    os.makedirs(trace, exist_ok=True)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "name": "process_name", "pid": 3,
+         "args": {"name": "plugin-worker"}},     # neither hint set
+        {"ph": "X", "pid": 1, "name": "fusion.1", "dur": 100.0},
+        {"ph": "X", "pid": 2, "name": "memcpy", "dur": 30.0},
+        {"ph": "X", "pid": 3, "name": "mystery_op", "dur": 7.0},
+    ]
+    import gzip as _gzip
+    import json as _json
+    with _gzip.open(os.path.join(trace, "x.trace.json.gz"), "wt") as f:
+        _json.dump({"traceEvents": events}, f)
+    profiler.set_config(filename=out)
+
+    # device table holds ONLY the device lane (the old heuristic let
+    # the unknown lane's 7us leak in)
+    dev = profiler.dumps(format_="dict")
+    assert dev == {"fusion": (100.0, 1)}
+    both = profiler.dumps(format_="dict", lane="both")
+    assert both["device"]["total_us"] == 100.0
+    assert both["host"]["ops"] == {"memcpy": (30.0, 1)}
+    assert both["unknown"]["ops"] == {"mystery_op": (7.0, 1)}
+    assert both["unknown"]["count"] == 1
+    assert profiler.dumps(format_="dict", lane="host") == \
+        {"memcpy": (30.0, 1)}
+    with pytest.raises(ValueError):
+        profiler.dumps(lane="both")          # needs format_='dict'
+    with pytest.raises(ValueError):
+        profiler.dumps(format_="dict", lane="bogus")
+    # a capture with no device lane falls back to host+unknown
+    with _gzip.open(os.path.join(trace, "x.trace.json.gz"), "wt") as f:
+        _json.dump({"traceEvents": [e for e in events
+                                    if e.get("pid") != 1]}, f)
+    cpu_only = profiler.dumps(format_="dict")
+    assert cpu_only == {"memcpy": (30.0, 1), "mystery_op": (7.0, 1)}
+
+
 def test_profiler_pause_resume_and_config_validation(tmp_path):
     from mxnet_tpu import profiler
 

@@ -1,0 +1,271 @@
+"""Render mxnet_tpu observability snapshots — zero-dependency exporter CLI.
+
+A process instrumented with ``MXNET_TPU_METRICS_LOG=<path>`` appends
+JSONL registry snapshots (one line per dump: final-at-exit, plus every
+``MXNET_TPU_METRICS_INTERVAL`` seconds). This tool turns that file back
+into something readable:
+
+    python tools/metrics_dump.py run/metrics.jsonl              # table
+    python tools/metrics_dump.py run/metrics.jsonl --format prom
+    python tools/metrics_dump.py run/metrics.jsonl --format json
+
+``--format prom`` re-emits Prometheus text exposition (what a live
+``registry.expose()`` scrape would have returned at snapshot time), so
+offline captures and live scrapes are interchangeable downstream.
+
+``--smoke`` runs the full path in-process — instrument a 2-step
+training loop, a checkpoint write, a micro-batched serving burst and
+the XLA compile bridge, then snapshot → JSONL → reload → exposition →
+validate — and prints ``SMOKE PASS``. Wired into tier-1 CI
+(tests/test_examples_smoke.py) so the exposition path is exercised on
+every run.
+"""
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_NAME_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+# quote-aware label block: a '}' INSIDE a quoted label value is legal
+# exposition (the exporter does not escape it), so the block cannot be
+# matched with a naive [^}]*
+_LABEL_RE = r'%s="(?:[^"\\]|\\.)*"' % _NAME_RE
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>%s)(?:\{(?P<labels>%s(?:,%s)*)\})? (?P<value>\S+)$"
+    % (_NAME_RE, _LABEL_RE, _LABEL_RE))
+
+
+def parse_exposition(text):
+    """Validate Prometheus text exposition; return {(name, labels): value}.
+
+    Raises ValueError on any malformed line — this is the checker the
+    smoke path and the tier-1 tests assert the exporter against.
+    """
+    samples = {}
+    typed = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            if len(parts) < 4 or not re.fullmatch(_NAME_RE, parts[2]):
+                raise ValueError(f"line {ln}: malformed comment: {line!r}")
+            if parts[1] == "TYPE":
+                if parts[3] not in ("counter", "gauge", "histogram",
+                                    "summary", "untyped"):
+                    raise ValueError(f"line {ln}: bad type {parts[3]!r}")
+                typed[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {ln}: malformed sample: {line!r}")
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            raise ValueError(f"line {ln}: bad value: {line!r}")
+        labels = m.group("labels") or ""
+        pairs = tuple(sorted(re.findall(
+            r'(%s)="((?:[^"\\]|\\.)*)"' % _NAME_RE, labels)))
+        key = (m.group("name"), pairs)      # label order canonicalized
+        if key in samples:
+            raise ValueError(f"line {ln}: duplicate series: {line!r}")
+        samples[key] = value
+    return samples
+
+
+# ------------------------------------------------------ JSONL loading --
+
+def load_snapshots(path):
+    """Every parsable snapshot line of a metrics JSONL file."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metrics" in rec:
+                out.append(rec)
+    return out
+
+
+def render_prom(metrics):
+    """Rebuild the Prometheus exposition from one snapshot's ``metrics``
+    dict (the inverse of MetricsRegistry.snapshot, matching expose())."""
+    from mxnet_tpu.observability.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    for name, rec in sorted(metrics.items()):
+        for series in rec.get("series", []):
+            labels = series.get("labels", {})
+            names = tuple(sorted(labels))
+            if rec["type"] == "counter":
+                parent = reg.counter(name, rec.get("help", ""), names)
+            elif rec["type"] == "gauge":
+                parent = reg.gauge(name, rec.get("help", ""), names)
+            else:
+                parent = reg.histogram(name, rec.get("help", ""), names,
+                                       buckets=series["buckets"])
+            child = parent.labels(**{k: labels[k] for k in names})
+            if rec["type"] == "histogram":
+                # de-cumulate the stored counts back into the child
+                prev = 0
+                for cum, edge_i in zip(series["counts"],
+                                       range(len(series["counts"]))):
+                    child._counts[edge_i] = cum - prev
+                    prev = cum
+                child._sum = float(series["sum"])
+                child._count = series["count"]
+            else:
+                child._value = float(series["value"])
+    return reg.expose()
+
+
+def render_table(metrics):
+    lines = [f"{'metric':<56} {'type':>10} {'value':>16}"]
+    lines.append("-" * 86)
+    for name, rec in sorted(metrics.items()):
+        for series in rec.get("series", []):
+            labels = series.get("labels", {})
+            lname = name + ("{%s}" % ",".join(
+                f"{k}={v}" for k, v in sorted(labels.items()))
+                if labels else "")
+            if rec["type"] == "histogram":
+                val = (f"n={series['count']} "
+                       f"sum={float(series['sum']):.6g}")
+            else:
+                val = f"{float(series['value']):.6g}"
+            lines.append(f"{lname:<56} {rec['type']:>10} {val:>16}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------- smoke --
+
+def smoke():
+    """End-to-end exercise of registry → instrumentation → exporters.
+
+    Touches four subsystems in one process (the acceptance criterion of
+    the observability PR): training step timer, resilience checkpoint,
+    serving, XLA compile bridge — then checks that one expose() call
+    carries all of them and that the JSONL snapshot round-trips.
+    """
+    import tempfile
+    import numpy as np
+    sys.path.insert(0, REPO)
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serving
+    from mxnet_tpu.gluon import nn, Trainer
+    from mxnet_tpu.gluon.loss import L2Loss
+    import mxnet_tpu.autograd as ag
+    from mxnet_tpu.observability import (get_registry, StepTimer,
+                                         install_jax_monitoring_bridge)
+
+    install_jax_monitoring_bridge()
+    mx.random.seed(0)
+
+    # training: 2 timed Trainer steps
+    net = nn.Dense(4)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1})
+    loss_fn = L2Loss()
+    timer = StepTimer()
+    x = nd.array(np.random.RandomState(0).randn(8, 3).astype(np.float32))
+    y = nd.array(np.random.RandomState(1).randn(8, 4).astype(np.float32))
+    for _ in range(2):
+        with timer.step(batch_size=8):
+            with ag.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+
+    # resilience: one checkpoint commit + restore
+    with tempfile.TemporaryDirectory() as run_dir:
+        trainer.save_state(run_dir)
+        trainer.restore_state(run_dir)
+
+    # serving: a padded micro-batch burst through a callable backend
+    srv = serving.ModelServer(lambda b: b * 2.0, buckets=[1, 2, 4],
+                              max_delay_ms=1.0, item_shape=(3,),
+                              dtype="float32").start()
+    srv.warmup()
+    futs = [srv.submit(np.full(3, i, np.float32)) for i in range(5)]
+    for f in futs:
+        f.result(timeout=60)
+    srv.shutdown()
+
+    reg = get_registry()
+    text = reg.expose()
+    samples = parse_exposition(text)          # must be valid exposition
+    for subsystem in ("mxtpu_training_", "mxtpu_serving_",
+                      "mxtpu_resilience_checkpoint_",
+                      "mxtpu_xla_compile_"):
+        if not any(name.startswith(subsystem)
+                   for name, _ in samples):
+            print(f"SMOKE FAIL: no {subsystem}* metric in exposition")
+            return 1
+    if samples[("mxtpu_training_steps_total", ())] < 2:
+        print("SMOKE FAIL: step timer did not count 2 steps")
+        return 1
+
+    # JSONL round-trip through the env-gated writer
+    with tempfile.TemporaryDirectory() as d:
+        log = os.path.join(d, "metrics.jsonl")
+        reg.write_snapshot(log)
+        snaps = load_snapshots(log)
+        if len(snaps) != 1:
+            print("SMOKE FAIL: JSONL snapshot did not round-trip")
+            return 1
+        rendered = parse_exposition(render_prom(snaps[-1]["metrics"]))
+        if rendered != samples:
+            print("SMOKE FAIL: JSONL-rendered exposition != live scrape")
+            return 1
+    print(f"SMOKE PASS ({len(samples)} series, "
+          f"{len({n for n, _ in samples})} metrics)")
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Render MXNET_TPU_METRICS_LOG JSONL snapshots.")
+    ap.add_argument("path", nargs="?",
+                    help="metrics JSONL file (default: "
+                         "$MXNET_TPU_METRICS_LOG)")
+    ap.add_argument("--format", choices=("table", "prom", "json"),
+                    default="table")
+    ap.add_argument("--index", type=int, default=-1,
+                    help="which snapshot line to render (default: last)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the in-process end-to-end exporter check")
+    args = ap.parse_args()
+
+    if args.smoke:
+        sys.exit(smoke())
+    path = args.path or os.environ.get("MXNET_TPU_METRICS_LOG")
+    if not path:
+        ap.error("no path given and MXNET_TPU_METRICS_LOG unset")
+    snaps = load_snapshots(path)
+    if not snaps:
+        print(f"{path}: no snapshots", file=sys.stderr)
+        sys.exit(1)
+    snap = snaps[args.index]
+    if args.format == "json":
+        print(json.dumps(snap, indent=1, sort_keys=True))
+    elif args.format == "prom":
+        sys.path.insert(0, REPO)
+        print(render_prom(snap["metrics"]), end="")
+    else:
+        print(f"# snapshot ts={snap.get('ts')} "
+              f"({args.index} of {len(snaps)})")
+        print(render_table(snap["metrics"]))
+
+
+if __name__ == "__main__":
+    main()

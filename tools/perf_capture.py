@@ -90,6 +90,10 @@ def run_bench(tag, env_overrides, timeout_s=1500):
     env = os.environ.copy()
     env.update(env_overrides)
     env["BENCH_PROFILE"] = trace_dir
+    # bench.py flushes its observability registry (step time, img/s,
+    # XLA compile count) here; emit_bench_snapshot reads it back
+    metrics_log = os.path.join(trace_dir, "metrics.jsonl")
+    env["MXNET_TPU_METRICS_LOG"] = metrics_log
     # The daemon already proved the backend is up; keep bench's own
     # probe short so a tunnel that died between probe and launch fails
     # fast instead of eating the window.
@@ -110,9 +114,81 @@ def run_bench(tag, env_overrides, timeout_s=1500):
         return None, "unparseable bench output"
     rec["_capture"] = {
         "tag": tag, "env": env_overrides, "trace_dir": trace_dir,
-        "captured_at": _now(),
+        "metrics_log": metrics_log, "captured_at": _now(),
     }
     return rec, "ok"
+
+
+# ----------------------------------------------- bench trajectory ----
+
+def _last_metrics_snapshot(path):
+    """Last registry snapshot of a MXNET_TPU_METRICS_LOG file (the
+    JSONL bench.py appends at exit), or {} — parsing shared with
+    tools/metrics_dump.py so the two tools can never drift."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from metrics_dump import load_snapshots
+    finally:
+        sys.path.pop(0)
+    try:
+        snaps = load_snapshots(path)
+    except OSError:
+        return {}
+    return snaps[-1]["metrics"] if snaps else {}
+
+
+def _metric_value(snap, name):
+    for series in snap.get(name, {}).get("series", []):
+        if "value" in series:
+            return series["value"]
+    return None
+
+
+def _next_bench_round():
+    top = 0
+    for fname in os.listdir(REPO):
+        m = re.match(r"BENCH_r(\d+)\.json$", fname)
+        if m:
+            top = max(top, int(m.group(1)))
+    return top + 1
+
+
+def emit_bench_snapshot(rec):
+    """Write the next BENCH_rNN.json from a valid capture: the headline
+    value plus the registry-sourced step time / examples-per-sec / XLA
+    compile count, so the bench trajectory is populated from the same
+    metrics pipeline every subsystem reports through. Returns the path
+    (None for invalid captures)."""
+    if not _is_valid(rec):
+        return None
+    cap = rec.get("_capture", {})
+    snap = _last_metrics_snapshot(cap.get("metrics_log", ""))
+    extra = rec.get("extra", {})
+    step_s = _metric_value(snap, "mxtpu_bench_step_seconds")
+    img_s = _metric_value(snap, "mxtpu_bench_examples_per_sec")
+    if img_s is None:
+        img_s = extra.get("train_img_s")
+    compiles = _metric_value(snap, "mxtpu_xla_compile_total")
+    nn = _next_bench_round()
+    path = os.path.join(REPO, f"BENCH_r{nn:02d}.json")
+    with open(path, "w") as f:
+        json.dump({
+            "round": nn,
+            "source": "tools/perf_capture.py (observability registry)",
+            "captured_at": cap.get("captured_at", _now()),
+            "tag": cap.get("tag"),
+            "metric": rec.get("metric"),
+            "value": rec.get("value"),
+            "unit": rec.get("unit"),
+            "vs_baseline": rec.get("vs_baseline"),
+            "step_time_s": step_s,
+            "examples_per_sec": img_s,
+            "xla_compiles": compiles,
+            "device_kind": extra.get("device_kind"),
+            "metrics_log": cap.get("metrics_log"),
+        }, f, indent=1)
+        f.write("\n")
+    return path
 
 
 def _is_valid(rec):
@@ -186,6 +262,10 @@ def capture_window():
                                ("metric", "value", "unit", "suspect",
                                 "skipped")}
             entry["new_best"] = _maybe_update_best(rec)
+            try:
+                entry["bench_snapshot"] = emit_bench_snapshot(rec)
+            except Exception as exc:  # noqa: BLE001 — never kill a window
+                entry["bench_snapshot_error"] = repr(exc)
             got_any = got_any or _is_valid(rec)
             if rec.get("skipped"):
                 _log(entry)
