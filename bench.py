@@ -73,14 +73,22 @@ def _cost_flops(compiled):
         return None
 
 
-def _timed_reps(run_n, n, reps=3):
+def _timed_reps(run_n, n, reps=3, step_timer=None, examples_per_rep=None):
     """run_n(n) executes n chained steps and ends with a host fetch;
-    returns the median per-step time across reps."""
+    returns the median per-step time across reps. ``step_timer`` (an
+    observability.StepTimer) brackets each rep — the timed unit is one
+    whole n-step rep — with ``examples_per_rep`` (= batch * n) feeding
+    the examples/sec gauge, so the bench reports the same data-wait/
+    compute split production training does."""
     times = []
     for _ in range(reps):
+        if step_timer is not None:
+            step_timer.begin_step()
         t0 = time.perf_counter()
         run_n(n)
         times.append((time.perf_counter() - t0) / n)
+        if step_timer is not None:
+            step_timer.end_step(batch_size=examples_per_rep)
     return sorted(times)[len(times) // 2]
 
 
@@ -227,7 +235,15 @@ def bench_resnet(dtype, layout, batch, train_iters, infer_iters,
         float(probe)  # single host fetch == real synchronisation
 
     run_train(train_iters)  # warmup
-    train_dt = _timed_reps(run_train, train_iters)
+    try:
+        from mxnet_tpu.observability import StepTimer
+        # subsystem bench_loop: mxtpu_bench_step_seconds is already a
+        # gauge (headline mirror below), the timer needs histograms
+        timer = StepTimer(subsystem="bench_loop")
+    except Exception:
+        timer = None
+    train_dt = _timed_reps(run_train, train_iters, step_timer=timer,
+                           examples_per_rep=batch * train_iters)
     train_img_s = batch / train_dt
     final_loss = float(loss)
     assert np.isfinite(final_loss), "training diverged"
@@ -333,7 +349,13 @@ def main():
     stem_s2d = os.environ.get("BENCH_S2D", "1") != "0" and layout == "NHWC"
 
     # ---- backend availability gate (before touching jax in-process) -----
-    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
+    # MXNET_TPU_BENCH_INIT_TIMEOUT caps how long backend init may take
+    # before the run is recorded as skipped (the TPU tunnel being down
+    # makes jax.devices() hang rather than raise); BENCH_PROBE_TIMEOUT is
+    # the legacy alias.
+    probe_timeout = int(
+        os.environ.get("MXNET_TPU_BENCH_INIT_TIMEOUT")
+        or os.environ.get("BENCH_PROBE_TIMEOUT") or 180)
     info, err = _probe_backend(probe_timeout)
     if info is None:
         print(json.dumps(_skip_record(batch, dtype, layout,
@@ -416,6 +438,26 @@ def main():
         "final_loss": round(r["final_loss"], 4),
         "timing": "chained-deps+host-fetch, median of 3 reps",
     }
+    # Step-time split + dispatch accounting (same registry series the
+    # training StepTimer feeds): data_fraction ~0 here because batches
+    # are pre-staged — the number production loops should converge to
+    # with DevicePrefetchIter; the timed train unit is ONE compiled scan
+    # over all steps, so host dispatches per optimizer step is 1/iters.
+    try:
+        from mxnet_tpu.observability import get_registry
+        _reg = get_registry()
+        extra["data_fraction"] = round(
+            float(_reg.gauge("mxtpu_bench_loop_data_fraction").value), 6)
+        extra["dispatch"] = {
+            "train_dispatches_per_step": round(1.0 / train_iters, 6),
+            "update_dispatches_per_step": 0,  # folded into the scan body
+            "xla_compiles": int(
+                _reg.counter("mxtpu_xla_compile_total").value),
+            "xla_cache_hits": int(
+                _reg.counter("mxtpu_xla_cache_hits_total").value),
+        }
+    except Exception:
+        pass
     if notes:
         extra["notes"] = notes
 

@@ -42,6 +42,14 @@ from . import _rng
 from .ndarray import random  # noqa: F401
 from .ndarray import NDArray  # noqa: F401
 
+# Persistent XLA compilation cache: opt-in via MXNET_TPU_COMPILE_CACHE=1
+# (+ MXNET_TPU_COMPILE_CACHE_DIR). Configured at import so the first
+# compile of the process already reads/writes the cache.
+from .runtime import _configure_compile_cache_from_env as _ccc
+
+_ccc()
+del _ccc
+
 
 def _lazy(name):
     import importlib

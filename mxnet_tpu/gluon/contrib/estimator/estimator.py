@@ -96,15 +96,41 @@ class Estimator:
 
     # -------------------------------------------------------------- fit --
     def fit(self, train_data, val_data=None, epochs=None,
-            event_handlers=None, batches=None):
+            event_handlers=None, batches=None, device_prefetch=None):
         """Train for ``epochs`` epochs or ``batches`` batches
-        (reference: fit:326)."""
+        (reference: fit:326).
+
+        ``device_prefetch``: batches to stage onto device ahead of the
+        step from a background thread (overlapping H2D with compute);
+        defaults to ``MXNET_TPU_DATA_PREFETCH`` (0 = off). A source
+        that already device-prefetches (e.g. a ``DataLoader`` with the
+        same env default) keeps its own depth — the source wins, no
+        second staging thread is stacked. The StepTimerHandler's
+        ``mxtpu_training_data_fraction`` gauge shows the effect."""
         if epochs is None and batches is None:
             epochs = 1
         handlers = self._prepare_handlers(val_data, epochs, batches,
                                           event_handlers)
         train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
             train_end = self._categorize(handlers)
+
+        from ...data.prefetch import (DevicePrefetchIter,
+                                      default_prefetch_depth)
+        explicit = device_prefetch is not None
+        if device_prefetch is None:
+            device_prefetch = default_prefetch_depth()
+        if device_prefetch and device_prefetch > 0:
+            # sources with their own device-prefetch policy (DataLoader)
+            # win over the ambient env default — including an explicit
+            # opt-out (device_prefetch=0 at the loader) — and an already-
+            # active stager is never double-wrapped
+            active = isinstance(train_data, DevicePrefetchIter) or \
+                getattr(train_data, "_device_prefetch", 0) > 0
+            managed = isinstance(train_data, DevicePrefetchIter) or \
+                hasattr(train_data, "_device_prefetch")
+            if (explicit and not active) or (not explicit and not managed):
+                train_data = DevicePrefetchIter(train_data,
+                                                depth=device_prefetch)
 
         self.stop_training = False
         for h in train_begin:

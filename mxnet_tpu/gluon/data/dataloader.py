@@ -76,13 +76,23 @@ class _ThreadFetcher:
 
 
 class DataLoader:
-    """Mini-batch iterator over a Dataset (reference: dataloader.py:443)."""
+    """Mini-batch iterator over a Dataset (reference: dataloader.py:443).
+
+    ``prefetch`` counts batches fetched ahead of the consumer: with
+    ``num_workers>0`` it bounds the in-flight pool requests (default
+    ``2*num_workers``); with ``num_workers=0`` an explicit value spins a
+    background thread that batchifies ahead (default 0 = fully
+    synchronous). ``device_prefetch`` additionally stages ready batches
+    onto the device from a background thread, ``device_prefetch`` deep,
+    so the next batch's H2D copy overlaps the current step's compute —
+    defaults to ``MXNET_TPU_DATA_PREFETCH`` (0 = off).
+    """
 
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
                  batchify_fn=None, num_workers=0, pin_memory=False,
                  pin_device_id=0, prefetch=None, thread_pool=False,
-                 timeout=120):
+                 timeout=120, device_prefetch=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._thread_pool = thread_pool
@@ -108,7 +118,14 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._num_workers = max(0, num_workers)
         self._batchify_fn = batchify_fn or default_batchify_fn
-        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        # an explicit prefetch= must win even when num_workers=0 (it used
+        # to be silently zeroed by the `or` default in that case)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        from .prefetch import default_prefetch_depth
+        self._device_prefetch = max(0, device_prefetch
+                                    if device_prefetch is not None
+                                    else default_prefetch_depth())
         self._pool = None
         self._fetch = _ThreadFetcher(self._dataset)
         if self._num_workers > 0:
@@ -154,6 +171,20 @@ class DataLoader:
                     self._pool = ThreadPool(self._num_workers)
 
     def __iter__(self):
+        batches = self._iter_batches()
+        if self._device_prefetch > 0:
+            from .prefetch import DevicePrefetchIter
+            batches = iter(DevicePrefetchIter(
+                batches, depth=self._device_prefetch))
+        elif self._pool is None and self._prefetch > 0:
+            # single-process path: honor an explicit prefetch= request
+            # with a host-side batchify-ahead thread (no device staging)
+            from .prefetch import DevicePrefetchIter
+            batches = iter(DevicePrefetchIter(
+                batches, depth=self._prefetch, stage=False))
+        yield from batches
+
+    def _iter_batches(self):
         if self._pool is None:
             for batch_idx in self._batch_sampler:
                 yield self._batchify_fn(
@@ -163,7 +194,7 @@ class DataLoader:
         # prefetcher: iter_prefetcher.h / dataloader _MultiWorkerIter)
         batches = iter(self._batch_sampler)
         inflight = []
-        for _ in range(self._prefetch):
+        for _ in range(max(1, self._prefetch)):
             idx = next(batches, None)
             if idx is None:
                 break

@@ -1,0 +1,185 @@
+"""DevicePrefetchIter: background host→device staging, N batches deep.
+
+The reference hides host-side input latency with the C++ prefetcher
+decorator (src/io/iter_prefetcher.h) feeding pinned staging buffers. The
+TPU-native analogue: a background thread that pulls batches from any
+iterable and runs ``jax.device_put`` on them ahead of the consumer, with a
+bounded queue providing N-deep double buffering — the next batch's H2D
+copy (and host batchify work) overlaps the current step's compute instead
+of serializing in front of it. PR 3's StepTimer ``data_fraction`` gauge is
+the before/after meter.
+
+Opt-in everywhere it is wired (``DataLoader(device_prefetch=...)``,
+``io.PrefetchingIter(device_prefetch=True)``, the estimator), with
+``MXNET_TPU_DATA_PREFETCH=<depth>`` as the ambient default.
+
+Ordering and error transparency are contractual: batches come out in
+exactly the source order, and an exception raised by the source surfaces
+in the consumer at the position it occurred.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+
+from ...ndarray import NDArray
+
+__all__ = ["DevicePrefetchIter", "stage_batch", "default_prefetch_depth"]
+
+_DONE = object()
+
+
+def default_prefetch_depth():
+    """Ambient device-prefetch depth: MXNET_TPU_DATA_PREFETCH (batches),
+    0/unset = off."""
+    try:
+        return max(0, int(os.environ.get("MXNET_TPU_DATA_PREFETCH", "0")
+                          or 0))
+    except ValueError:
+        return 0
+
+
+def _resolve_device(ctx):
+    if ctx is None:
+        return None
+    if hasattr(ctx, "jax_device"):     # mxnet_tpu Context
+        return ctx.jax_device
+    return ctx                          # already a jax.Device
+
+
+def stage_batch(batch, device=None):
+    """Recursively ``device_put`` the NDArray / jax-array leaves of a
+    batch structure (list/tuple/dict/DataBatch). Other leaf types (numpy,
+    scalars, strings) pass through untouched — staging must not change
+    what the consumer receives, only where the arrays live."""
+    import jax
+    if isinstance(batch, NDArray):
+        from ...ndarray.sparse import BaseSparseNDArray
+        if isinstance(batch, BaseSparseNDArray):
+            # pass through untouched: reading ._data would densify the
+            # batch, defeating sparse pipelines downstream
+            return batch
+        return NDArray(jax.device_put(batch._data, device))
+    if isinstance(batch, jax.Array):
+        return jax.device_put(batch, device)
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(stage_batch(b, device) for b in batch)
+    if isinstance(batch, dict):
+        return {k: stage_batch(v, device) for k, v in batch.items()}
+    data = getattr(batch, "data", None)
+    label = getattr(batch, "label", None)
+    if isinstance(data, (list, tuple)):
+        # io.DataBatch-shaped object: stage its payloads in place
+        # (label may be None — inference batches — or a tuple)
+        batch.data = [stage_batch(d, device) for d in data]
+        if isinstance(label, (list, tuple)):
+            batch.label = [stage_batch(l, device) for l in label]
+        return batch
+    return batch
+
+
+def _metrics():
+    from ...observability import get_registry
+    reg = get_registry()
+    return {
+        "batches": reg.counter(
+            "mxtpu_data_prefetch_batches_total",
+            "Batches staged onto device by a prefetch thread."),
+        "depth": reg.gauge(
+            "mxtpu_data_prefetch_depth",
+            "Configured double-buffer depth of the newest prefetcher."),
+        "fill": reg.gauge(
+            "mxtpu_data_prefetch_queue_fill",
+            "Staged batches waiting at the last consumer read (0 = the "
+            "consumer is data-bound, depth = fully hidden)."),
+        "wait": reg.histogram(
+            "mxtpu_data_prefetch_wait_seconds",
+            "Consumer time blocked waiting for a staged batch."),
+    }
+
+
+class DevicePrefetchIter:
+    """Wrap any batch iterable with background device staging.
+
+    Parameters
+    ----------
+    source : iterable of batches (re-iterable sources give a fresh
+        producer thread per ``__iter__``)
+    depth : queue depth in batches (default: env
+        ``MXNET_TPU_DATA_PREFETCH`` or 2)
+    ctx : Context / jax.Device to stage onto (default: the arrays'
+        default placement)
+    stage : False turns this into a plain host-side prefetch thread
+        (batches are queued as produced, no device_put) — what
+        ``DataLoader(prefetch=N, num_workers=0)`` uses.
+    """
+
+    def __init__(self, source, depth=None, ctx=None, stage=True):
+        if depth is None:
+            depth = default_prefetch_depth() or 2
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._source = source
+        self._depth = depth
+        self._device = _resolve_device(ctx)
+        self._stage = stage
+        # the mxtpu_data_prefetch_* series mean DEVICE staging; a plain
+        # host-side prefetch thread (stage=False) must not feed them
+        self._obs = _metrics() if stage else None
+        if self._obs is not None:
+            self._obs["depth"].set(depth)
+
+    def __iter__(self):
+        q = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+        src = iter(self._source)
+        device, do_stage, obs = self._device, self._stage, self._obs
+
+        def producer():
+            try:
+                for item in src:
+                    if do_stage:
+                        item = stage_batch(item, device)
+                        obs["batches"].inc()  # obs present when staging
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                item = _DONE
+            except BaseException as e:  # surfaced in the consumer
+                item = e
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        worker = threading.Thread(target=producer, daemon=True,
+                                  name="mxtpu-device-prefetch")
+        worker.start()
+        try:
+            while True:
+                t0 = time.monotonic()
+                item = q.get()
+                if obs is not None:
+                    obs["wait"].observe(time.monotonic() - t0)
+                    obs["fill"].set(q.qsize())
+                if item is _DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # consumer abandoned the iterator (break / exception / GC):
+            # unblock and retire the producer
+            stop.set()
+
+    def __len__(self):
+        return len(self._source)

@@ -298,9 +298,25 @@ class Parameter:
             init, ctx, default_init, _ = self._deferred_init
             self._deferred_init = (init, ctx, default_init, data)
             return
+        import jax
+        import jax.numpy as jnp
         src = data if isinstance(data, NDArray) else NDArray(data)
+        # value-copy semantics (reference set_data: dst[:]=src): when the
+        # source is backed by a live jax buffer, a same-device device_put
+        # shares it, and the source's owner must not observe this
+        # parameter's subsequent in-place (donated) optimizer updates.
+        # Host-sourced data and cross-device placements already
+        # materialize fresh buffers — only same-device targets must copy.
+        try:
+            src_devs = src._data.devices() \
+                if isinstance(data, (NDArray, jax.Array)) else frozenset()
+        except Exception:
+            src_devs = frozenset()  # tracer-backed source cannot alias
         for c in list(self._data):
-            self._data[c] = NDArray(src._data, ctx=c, dtype=self.dtype)
+            arr = NDArray(src._data, ctx=c, dtype=self.dtype)
+            if c.jax_device in src_devs:
+                arr._data = jnp.copy(arr._data)
+            self._data[c] = arr
             if self._grad_req != "null":
                 self._data[c].attach_grad(self._grad_req)
 

@@ -15,6 +15,30 @@ from .parameter import Parameter
 
 __all__ = ["Trainer"]
 
+_TREE_SUM = None
+
+
+def _tree_sum_jit():
+    """One jitted program summing each parameter's per-context replicas
+    (input: tuple over params of tuple over ctx of arrays, all staged on
+    one device). jit re-traces per (structure, shapes) signature, so one
+    callable serves every model."""
+    global _TREE_SUM
+    if _TREE_SUM is None:
+        import jax
+
+        def _tree_sum(gs_lists):
+            out = []
+            for gs in gs_lists:
+                total = gs[0]
+                for g in gs[1:]:
+                    total = total + g
+                out.append(total)
+            return out
+
+        _TREE_SUM = jax.jit(_tree_sum)
+    return _TREE_SUM
+
 
 class Trainer:
     """Optimizer driver over a ParameterDict
@@ -54,6 +78,7 @@ class Trainer:
         self._contains_sparse_weight = False
         self._step_count = 0
         self._obs = None
+        self._fused = None  # lazy optimizer.fused.FusedUpdater
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -134,6 +159,19 @@ class Trainer:
                     "host sync)."),
                 "want_grad_norm": os.environ.get(
                     "MXNET_TPU_METRICS_GRAD_NORM") == "1",
+                "upd_dispatch": reg.counter(
+                    "mxtpu_trainer_update_dispatch_total",
+                    "Compiled optimizer-update program launches "
+                    "(fused path: 1 per step regardless of parameter "
+                    "count)."),
+                "upd_fused": reg.counter(
+                    "mxtpu_trainer_update_fused_total",
+                    "Trainer.step updates applied as one fused, "
+                    "buffer-donating dispatch."),
+                "upd_fallback": reg.counter(
+                    "mxtpu_trainer_update_fallback_total",
+                    "Trainer.step updates that ran the per-param loop, "
+                    "by reason.", ("reason",)),
             }
         return self._obs
 
@@ -156,21 +194,59 @@ class Trainer:
             total += float((a * a).sum())
         obs["grad_norm"].set(total ** 0.5)
 
+    def _fused_updater(self):
+        if self._fused is None:
+            from ..optimizer.fused import FusedUpdater
+            self._fused = FusedUpdater(self._optimizer, self._updaters[0])
+        return self._fused
+
+    def _fold_reduce_ok(self, obs, fused_reason):
+        """True when the gradient reduce can be folded into the fused
+        update program (allreduce + update = one dispatch). Requires the
+        fused path to be eligible (``fused_reason is None``), the
+        grad-norm observer off (it reads the reduced gradients in
+        place), and a reduce the compiled step can express: per-context
+        replicas with no kvstore, or an attached in-process store whose
+        reduce is a plain sum."""
+        if self._update_on_kvstore or obs["want_grad_norm"]:
+            return False
+        if fused_reason is not None:
+            return False
+        replicated = any(
+            p.grad_req != "null" and p._data is not None
+            and len(p._data) > 1 for p in self._params)
+        if self._kvstore is None:
+            return replicated
+        return replicated and getattr(
+            self._kvstore, "fused_reduce_compatible", False)
+
     def step(self, batch_size, ignore_stale_grad=False):
-        """allreduce + optimizer update (reference: trainer.py:329)."""
+        """allreduce + optimizer update (reference: trainer.py:329).
+
+        On the fused path this is ONE compiled dispatch; when the
+        reduce folds in (multi-context, plain-sum store), the summed
+        gradient exists only inside the program — ``param.list_grad()``
+        afterwards holds the per-context partials. Readers of reduced
+        gradients should set ``MXNET_TPU_FUSED_UPDATE=0`` (see
+        docs/PERFORMANCE.md)."""
         import time as _time
         if not self._kv_initialized:
             self._init_kvstore()
         obs = self._obs_metrics()
         t0 = _time.monotonic()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
+        fused_reason = self._fused_updater().why_ineligible(
+            self._params, ignore_stale_grad)
+        fold = self._fold_reduce_ok(obs, fused_reason)
+        if not fold:
+            self._allreduce_grads()
         if obs["want_grad_norm"]:
             try:
                 self._observe_grad_norm(obs)
             except Exception:
                 pass
-        self._update(ignore_stale_grad)
+        self._update(ignore_stale_grad, _fold_reduce=fold,
+                     _fused_reason=fused_reason)
         obs["secs"].observe(_time.monotonic() - t0)
         obs["steps"].inc()
         obs["examples"].inc(batch_size)
@@ -189,23 +265,36 @@ class Trainer:
         self._allreduce_grads()
 
     def _allreduce_grads(self):
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
-            if self._kvstore is not None:
+        if self._kvstore is not None:
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null":
+                    continue
                 self._kvstore.push(i, param.list_grad(), priority=-i)
                 if not self._update_on_kvstore:
                     self._kvstore.pull(i, param.list_grad(), priority=-i)
-            else:
-                grads = param.list_grad()
-                if len(grads) > 1:
-                    # sum over contexts then broadcast (reference
-                    # Comm*::Reduce, src/kvstore/comm.h:122)
-                    total = grads[0]
-                    for g in grads[1:]:
-                        total = total + g.as_in_context(total.context)
-                    for g in grads:
-                        g[:] = total.as_in_context(g.context)
+            return
+        # sum over contexts then broadcast (reference Comm*::Reduce,
+        # src/kvstore/comm.h:122) — ONE compiled tree-level sum over every
+        # parameter's replicas instead of an O(n_params * n_ctx) chain of
+        # `total = total + g` adds and per-grad copy-backs
+        work = [param for param in self._params
+                if param.grad_req != "null" and param._data is not None
+                and len(param._data) > 1]
+        if not work:
+            return
+        import jax
+        primary = work[0].list_grad()[0].context.jax_device
+        staged = tuple(
+            tuple(g._data if g.context.jax_device == primary
+                  else jax.device_put(g._data, primary)
+                  for g in param.list_grad())
+            for param in work)
+        totals = _tree_sum_jit()(staged)
+        for param, total in zip(work, totals):
+            for g in param.list_grad():
+                dev = g.context.jax_device
+                g._data = total if dev == primary \
+                    else jax.device_put(total, dev)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -217,14 +306,31 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
-    def _update(self, ignore_stale_grad=False):
+    def _update(self, ignore_stale_grad=False, _fold_reduce=False,
+                _fused_reason="unchecked"):
         if self._update_on_kvstore:
             for i, param in enumerate(self._params):
                 if param.grad_req == "null":
                     continue
                 self._kvstore.pull(i, param.list_data(), priority=-i)
             return
+        obs = self._obs_metrics()
+        fused = self._fused_updater()
+        reason = _fused_reason if _fused_reason != "unchecked" else \
+            fused.why_ineligible(self._params, ignore_stale_grad)
+        if reason is None:
+            if fused.step(self._params, fold_reduce=_fold_reduce):
+                launched = getattr(fused, "last_dispatches", 1)
+                obs["upd_dispatch"].inc(launched)
+                obs["upd_fused"].inc(launched)
+                return
+            reason = fused.last_fallback_reason or "runtime"
+        if _fold_reduce:
+            # the reduce was deferred into the (not-taken) fused program
+            self._allreduce_grads()
+        obs["upd_fallback"].labels(reason=reason).inc()
         updater = self._updaters[0]
+        dispatches = 0
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -232,6 +338,8 @@ class Trainer:
                 continue
             for w, g in zip(param.list_data(), param.list_grad()):
                 updater(i, g, w)
+                dispatches += 1
+        obs["upd_dispatch"].inc(dispatches)
 
     def save_states(self, fname):
         """Save optimizer/updater states (reference: trainer.py:470)."""
@@ -258,6 +366,7 @@ class Trainer:
         self._optimizer = self._updaters[0].optimizer
         self._optimizer.param_dict = {
             i: param for i, param in enumerate(self._params)}
+        self._fused = None  # the optimizer object may have been replaced
 
     # -------------------------------------------------- full-state ckpt --
     def save_state(self, run_dir, step=None, epoch=None, keep=5):

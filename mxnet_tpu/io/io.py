@@ -265,9 +265,15 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Thread-prefetching wrapper (reference: io.py:367 — C++ prefetcher
-    decorator src/io/iter_prefetcher.h)."""
+    decorator src/io/iter_prefetcher.h).
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    ``device_prefetch=True`` (or ``MXNET_TPU_DATA_PREFETCH`` set) also
+    stages each prefetched batch onto the device from the worker thread,
+    so the H2D copy overlaps the consumer's compute — the TPU-native
+    completion of the reference prefetcher's pinned-staging behavior."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 device_prefetch=None):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -276,6 +282,10 @@ class PrefetchingIter(DataIter):
         self.provide_data = iters[0].provide_data
         self.provide_label = iters[0].provide_label
         self.batch_size = iters[0].batch_size
+        if device_prefetch is None:
+            from ..gluon.data.prefetch import default_prefetch_depth
+            device_prefetch = default_prefetch_depth() > 0
+        self._device_prefetch = bool(device_prefetch)
         self._queue = None
         self._worker = None
         self._stop = None
@@ -287,13 +297,21 @@ class PrefetchingIter(DataIter):
         q = queue.Queue(maxsize=2)
         stop = threading.Event()
         src = self.iters[0]
+        do_stage = self._device_prefetch
 
         def worker():
             while not stop.is_set():
                 try:
                     item = src.next()
+                    if do_stage and item is not None:
+                        from ..gluon.data.prefetch import stage_batch
+                        item = stage_batch(item)
                 except StopIteration:
                     item = None
+                except BaseException as e:
+                    # forward to the consumer (a dead producer with no
+                    # sentinel would leave next() blocked forever)
+                    item = e
                 # bounded put that re-checks stop so reset() can't
                 # deadlock/race with a blocked producer
                 while not stop.is_set():
@@ -302,7 +320,7 @@ class PrefetchingIter(DataIter):
                         break
                     except queue.Full:
                         continue
-                if item is None:
+                if item is None or isinstance(item, BaseException):
                     return
 
         self._queue, self._stop = q, stop
@@ -313,6 +331,8 @@ class PrefetchingIter(DataIter):
         batch = self._queue.get()
         if batch is None:
             raise StopIteration
+        if isinstance(batch, BaseException):
+            raise batch
         return batch
 
     def reset(self):

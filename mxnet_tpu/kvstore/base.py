@@ -214,6 +214,15 @@ class KVStoreLocal(KVStoreBase):
                     o._data = jnp.zeros(src.shape, vals.dtype)\
                         .at[rows].set(vals)
 
+    @property
+    def fused_reduce_compatible(self):
+        """True when this store's reduce is a plain in-process sum that
+        ``gluon.Trainer`` may fold into its fused update program (one
+        compiled allreduce+update dispatch). False once a server-side
+        updater or gradient compression is attached — those must see the
+        gradients on the push path."""
+        return self._updater is None and self._compressor is None
+
     def set_updater(self, updater):
         self._updater = updater
 

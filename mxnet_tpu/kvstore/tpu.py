@@ -124,6 +124,14 @@ class KVStoreTPU(KVStoreLocal):
                 out_shardings=NamedSharding(self._mesh,
                                             PartitionSpec()))
 
+    @property
+    def fused_reduce_compatible(self):
+        """Foldable into the trainer's fused update only while the store
+        is effectively single-process (the reduce is then a plain local
+        sum); a multi-process psum must stay on the push path."""
+        return (jax.process_count() == 1
+                and self._updater is None and self._compressor is None)
+
     def _reduce_across_processes(self, value):
         """Cross-host reduce: identity for one process; otherwise a
         compiled psum over a one-device-per-process mesh."""

@@ -13,6 +13,7 @@ autograd taping happens (reference: ``Imperative::RecordOp``).
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -21,7 +22,8 @@ import jax.numpy as jnp
 from .. import autograd, _rng
 from .registry import Operator, get as get_op
 
-__all__ = ["apply_op", "apply_fn", "wrap_out", "as_jax"]
+__all__ = ["apply_op", "apply_fn", "wrap_out", "as_jax",
+           "TRACED_HYPERPARAMS"]
 
 import numpy as _np
 
@@ -189,6 +191,85 @@ def _embedding_sparse_grad(op, inputs, params):
     return result
 
 
+# ---------------------------------------------------------------------------
+# Single-dispatch optimizer-op path.
+#
+# Per-step hyperparameters that enter compiled update programs as TRACED
+# scalars (weak-typed, exactly like an eager Python-float operand) so lr/wd/
+# momentum schedules and LossScaler rescale changes never trigger a
+# recompile. Floats OUTSIDE this set (clip_gradient, clip_weights, lower/
+# upper bounds) stay static because the impls branch on them in Python.
+TRACED_HYPERPARAMS = frozenset({"lr", "wd", "momentum", "rescale_grad"})
+
+_MUTATES_JIT_CACHE = {}
+
+# Set by optimizer.fused while it records an update program: apply_op hands
+# mutates-op invocations to the recorder instead of executing them, so one
+# host pass over the per-param updater yields the op sequence + scalar
+# hyperparameter values that the fused single-dispatch program replays.
+_FUSED_RECORDER = threading.local()
+
+
+def _is_dynamic(v):
+    return isinstance(v, jax.core.Tracer) or isinstance(v, jax.Array)
+
+
+def _split_hyper(params):
+    """(static kwargs, traced keys, traced values) for one mutates-op call.
+    Only plain floats under TRACED_HYPERPARAMS become traced; everything
+    else (bools, ints, None, structural floats) is baked into the compiled
+    program and keys the jit cache."""
+    static, tkeys, tvals = [], [], []
+    for k in sorted(params):
+        v = params[k]
+        if k in TRACED_HYPERPARAMS and isinstance(v, (float, _np.floating)) \
+                and not isinstance(v, bool):
+            tkeys.append(k)
+            tvals.append(float(v))
+        else:
+            static.append((k, v))
+    return tuple(static), tuple(tkeys), tvals
+
+
+def _mutates_jit(op, static_kw, traced_keys):
+    key = (op.name, static_kw, traced_keys)
+    fn = _MUTATES_JIT_CACHE.get(key)
+    if fn is None:
+        skw = dict(static_kw)
+        impl, keys = op.impl, traced_keys
+
+        def call(xs, tvals):
+            kw = dict(skw)
+            kw.update(zip(keys, tvals))
+            return impl(*xs, **kw)
+
+        fn = jax.jit(call)
+        _MUTATES_JIT_CACHE[key] = fn
+    return fn
+
+
+def _run_mutates(op, xs, params):
+    """Execute a mutates (optimizer update) op as ONE compiled dispatch.
+
+    The impl runs under jax.jit with TRACED_HYPERPARAMS floats passed as
+    weak-typed traced scalars: numerics are identical to handing the impl a
+    Python float, there is one XLA execution instead of one per jnp
+    primitive, and a changed lr/momentum/rescale value reuses the compiled
+    program. Falls back to the direct eager impl when a hyperparameter is
+    itself a tracer/array (op invoked under an outer trace with traced
+    hyperparams, e.g. parallel.ShardedTrainer) or an int (lamb's ``t``
+    would bake a new program every step)."""
+    for v in params.values():
+        if _is_dynamic(v) or (isinstance(v, int) and not isinstance(v, bool)):
+            return op.impl(*xs, **params)
+    static_kw, tkeys, tvals = _split_hyper(params)
+    try:
+        hash(static_kw)
+    except TypeError:
+        return op.impl(*xs, **params)
+    return _mutates_jit(op, static_kw, tkeys)(xs, tuple(tvals))
+
+
 def apply_op(op, inputs: Sequence, params: Optional[dict] = None, out=None):
     """Invoke a registered op on NDArray inputs."""
     if not isinstance(op, Operator):
@@ -204,11 +285,15 @@ def apply_op(op, inputs: Sequence, params: Optional[dict] = None, out=None):
         params["_training"] = autograd.is_training()
 
     if op.mutates:
+        recorder = getattr(_FUSED_RECORDER, "rec", None)
+        if recorder is not None:
+            return recorder.record(op, inputs, params)
         # optimizer-style in-place update: impl returns the new values of the
         # mutated inputs; rebind their buffers (reference: kWriteInplace ops
         # like sgd_update, src/operator/optimizer_op.cc)
         xs = tuple(as_jax(i) for i in inputs)
-        outs = op.impl(*xs, **params) if not op.variadic else op.impl(list(xs), **params)
+        outs = _run_mutates(op, xs, params) if not op.variadic \
+            else op.impl(list(xs), **params)
         outs_t = (outs,) if not isinstance(outs, (tuple, list)) else tuple(outs)
         results = []
         for k, m in enumerate(op.mutates):

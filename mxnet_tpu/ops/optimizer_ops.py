@@ -11,6 +11,17 @@ preserving the reference's in-place (kWriteInplace) API contract.
 
 All ops apply the reference's common pre-processing: grad = rescale_grad *
 grad, optionally clipped to [-clip_gradient, clip_gradient], plus wd.
+
+Dispatch contract (ops/invoke.py): every mutates op here executes as ONE
+compiled program (invoke._run_mutates), and the whole-trainer fused apply
+(optimizer/fused.py) replays the same impls inside a single jitted,
+buffer-donating step. Float kwargs in ``invoke.TRACED_HYPERPARAMS`` (lr,
+wd, momentum, rescale_grad) arrive as traced scalars so per-step schedules
+never recompile — impls must only use them ARITHMETICALLY. Kwargs an impl
+branches on in Python (clip_gradient/clip_weights/lower/upper_bound,
+bias_correction) stay static and re-key the compile cache when changed;
+an int-valued kwarg (lamb phase1's ``t``) keeps that op on the direct
+eager path so it does not bake one program per step.
 """
 from __future__ import annotations
 

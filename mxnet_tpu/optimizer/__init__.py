@@ -4,8 +4,10 @@ from .optimizer import (Optimizer, register, create, SGD, NAG, Adam, AdamW,
                         Ftrl, LAMB, LARS, DCASGD, SGLD, Signum, SignSGD,
                         LBSGD, GroupAdaGrad, Test)
 from .updater import Updater, get_updater
+from .fused import FusedUpdater, fusable
 
 __all__ = ["Optimizer", "register", "create", "Updater", "get_updater",
+           "FusedUpdater", "fusable",
            "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta", "Adamax",
            "Nadam", "RMSProp", "FTML", "Ftrl", "LAMB", "LARS", "DCASGD",
            "SGLD", "Signum", "SignSGD", "LBSGD", "GroupAdaGrad", "Test"]

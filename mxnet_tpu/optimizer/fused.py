@@ -1,0 +1,347 @@
+"""Fused, buffer-donating optimizer apply over the whole parameter set.
+
+The eager ``gluon.Trainer`` used to dispatch one XLA execution per
+parameter per step (the reference's op-by-op dependency-engine schedule,
+python/mxnet/gluon/trainer.py:436 ``_update``); for a model with hundreds
+of parameters the step is dominated by dispatch overhead rather than math.
+This module turns the whole update into ONE ``jax.jit`` call with donated
+weight/slot buffers — the same fused-step discipline
+``parallel.ShardedTrainer`` applies to the SPMD path, and the analogue of
+the reference's multi-tensor ``multi_sgd_*`` kernels (which existed to
+amortize CUDA launches the same way).
+
+How it stays bit-exact with the per-param loop
+----------------------------------------------
+Every step, the per-param updater is driven once in *record mode*: the
+``ops.invoke`` chokepoint hands each mutates-op invocation (op, input
+roles, kwargs) to a recorder instead of executing it. All host-side
+bookkeeping — update counts, lr scheduling, Adam bias correction, lr/wd
+multipliers, LossScaler rescale — runs exactly as in the loop, in float64
+on host. The recorded program is then replayed inside one jitted function
+whose per-call hyperparameters (``TRACED_HYPERPARAMS``: lr, wd, momentum,
+rescale_grad) enter as weak-typed traced scalars, deduplicated by value.
+Because the eager loop also executes each update op as one jitted program
+(invoke._run_mutates) with the same traced/static kwarg split, the fused
+program contains the very same XLA subgraph per parameter — outputs are
+bitwise identical, and an lr/wd/rescale change never recompiles.
+
+The compiled step is cached on (optimizer class, recorded op sequence with
+static kwargs, state tree structure, scalar slot pattern); momentum/beta
+changes re-key and retrace, per-step scalars do not. Weights and optimizer
+slots are donated, so the update writes in place in HBM (old buffers are
+freed — holders of aliases into parameter storage must re-read via
+``param.data()``).
+
+Fallback: sparse/row_sparse gradients, ``ignore_stale_grad``, optimizers
+whose update needs host syncs or per-call Python state (LARS, LBSGD, SGLD,
+Nadam, DCASGD, LAMB), generic multi-precision (master-weight casts happen
+outside the op chokepoint), and ``MXNET_TPU_FUSED_UPDATE=0`` all fall back
+to the per-param loop.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.tree_util as _tu
+
+from ..ndarray import NDArray
+from ..ops import invoke as _invoke
+from ..ops.registry import get as get_op
+from . import optimizer as _opt
+
+__all__ = ["FusedUpdater", "fusable"]
+
+# Optimizers whose dense update routes ALL device math through registered
+# mutates ops (apply_op) with no host sync / per-call Python state: the
+# recorded program is a complete, replayable description of the step.
+# Excluded and why: LAMB (int ``t`` kwarg would bake a program per step),
+# LARS/LBSGD (host .asscalar() norm sync), SGLD (fresh host RNG draw per
+# call), Nadam (mutates self.m_schedule per call), DCASGD/AdaDelta/Adamax/
+# FTML/GroupAdaGrad/Test (eager NDArray arithmetic outside the chokepoint).
+_FUSABLE_TYPES = (_opt.SGD, _opt.NAG, _opt.Adam, _opt.AdamW, _opt.AdaGrad,
+                  _opt.RMSProp, _opt.Ftrl, _opt.Signum, _opt.SignSGD)
+
+
+def fusable(optimizer):
+    """True when this optimizer instance is eligible for the fused path."""
+    if type(optimizer) not in _FUSABLE_TYPES:
+        return False
+    if optimizer.multi_precision and type(optimizer) is not _opt.SGD:
+        # the generic mp path casts master weights outside apply_op; only
+        # SGD overrides update_multi_precision with mp_sgd_* fused ops
+        return False
+    return True
+
+
+class _Recorder:
+    """Captures the per-param update as (op, input roles, kwargs) entries.
+
+    ``roles`` maps id(NDArray) -> ('w'|'g'|'s', position). Scalar
+    hyperparameters under TRACED_HYPERPARAMS are assigned value-deduped
+    slots; everything else is static and part of the program signature.
+    """
+
+    def __init__(self, roles):
+        self.roles = roles
+        self.program = []       # (op_name, roles, static_kw, tkeys, slots)
+        self.slot_values = []   # per-step scalar feed, deduped by value
+        self._slot_of = {}
+        self.ok = True
+
+    def record(self, op, inputs, params):
+        entry_roles = []
+        for x in inputs:
+            r = self.roles.get(id(x))
+            if r is None:
+                self.ok = False  # op touched a buffer we don't track
+            entry_roles.append(r)
+        static_kw, tkeys, tvals = _invoke._split_hyper(params)
+        for v in static_kw:
+            if _invoke._is_dynamic(v[1]):
+                self.ok = False
+            if isinstance(v[1], int) and not isinstance(v[1], bool):
+                self.ok = False  # per-step int (lamb t) would bake a program
+        slots = []
+        for kname, v in zip(tkeys, tvals):
+            # dedupe per (kwarg name, value): sharing one traced scalar
+            # across params is the point, but merging DIFFERENT
+            # hyperparams that momentarily coincide in value would re-key
+            # the program (a recompile) the step they collide/diverge
+            slot = self._slot_of.get((kname, v))
+            if slot is None:
+                slot = self._slot_of[(kname, v)] = len(self.slot_values)
+                self.slot_values.append(v)
+            slots.append(slot)
+        self.program.append((op.name, tuple(entry_roles), static_kw,
+                             tkeys, tuple(slots)))
+        results = [inputs[m] for m in op.mutates]
+        return results[0] if len(results) == 1 else tuple(results)
+
+
+class FusedUpdater:
+    """One-dispatch optimizer apply for ``gluon.Trainer``.
+
+    ``step(work, ...)`` either applies the whole update as a single
+    compiled, buffer-donating XLA execution and returns True, or returns
+    False so the caller runs the per-param loop.
+    """
+
+    # consecutive dispatch failures (with inputs intact) tolerated before
+    # the fused path is disabled for this trainer; trace failures on a
+    # fresh signature are deterministic and disable immediately
+    MAX_EXEC_FAILURES = 3
+
+    def __init__(self, optimizer, updater):
+        self._optimizer = optimizer
+        self._updater = updater
+        self._cache = {}
+        self._disabled = None  # sticky reason once declared unfusable
+        self._exec_failures = 0
+        self.last_dispatches = 0
+        self.last_fallback_reason = None
+
+    # ------------------------------------------------------ eligibility --
+    def why_ineligible(self, params, ignore_stale_grad):
+        """None if fusable now, else a short reason label."""
+        if os.environ.get("MXNET_TPU_FUSED_UPDATE", "1") == "0":
+            return "env_disabled"
+        if self._disabled is not None:
+            return self._disabled
+        if ignore_stale_grad:
+            return "ignore_stale_grad"
+        if not fusable(self._optimizer):
+            return "optimizer"
+        from ..ndarray.sparse import RowSparseNDArray
+        for param in params:
+            if param.grad_req == "null" or param._data is None:
+                continue
+            for g in param.list_grad():
+                if isinstance(g, RowSparseNDArray):
+                    return "sparse_grad"
+        return None
+
+    # ------------------------------------------------------------- step --
+    def step(self, params, fold_reduce=False):
+        """Apply one fused update over ``params`` (list of Parameters).
+
+        fold_reduce: gradients still hold per-context values; the
+        compiled program sums them before the update and the new weight
+        is broadcast to every context afterwards (allreduce + update in
+        one dispatch). Note this applies ONE update on the reduced
+        gradient — correct data-parallel semantics — where the per-param
+        loop re-runs the stateful update per replica against shared slot
+        state (which diverges replicas under momentum/Adam); bit-exact
+        loop equivalence is a single-context property.
+        """
+        opt, upd = self._optimizer, self._updater
+        self.last_dispatches = 0
+        self.last_fallback_reason = None
+        work = []   # (trainer index, Parameter)
+        for i, param in enumerate(params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            work.append((i, param))
+        if not work:
+            return True  # nothing to update: handled, zero dispatches
+        if not fold_reduce and any(len(p.list_data()) > 1
+                                   for _, p in work):
+            # per-context replicas with an external reducer: the loop's
+            # per-ctx update semantics are kept (fold handles the rest)
+            self.last_fallback_reason = "replicated"
+            return False
+
+        # states must exist before the roles map is built (the updater
+        # would create them lazily mid-recording otherwise)
+        for i, param in work:
+            w = param.list_data()[0]
+            if i not in upd.states:
+                upd.states[i] = opt.create_state_multi_precision(i, w)
+                upd.states_synced[i] = True
+            elif not upd.states_synced[i]:
+                upd.states[i] = upd.sync_state_context(upd.states[i],
+                                                       w.context)
+                upd.states_synced[i] = True
+
+        # roles: id(NDArray) -> buffer slot in the compiled program
+        roles = {}
+        weight_nds, grad_nds, state_nds, state_defs = [], [], [], []
+        for k, (i, param) in enumerate(work):
+            w = param.list_data()[0]
+            g = param.list_grad()[0]
+            roles[id(w)] = ("w", k)
+            roles[id(g)] = ("g", k)
+            leaves, treedef = _tu.tree_flatten(upd.states[i])
+            for leaf in leaves:
+                if not isinstance(leaf, NDArray):
+                    self._disabled = "state_leaf"
+                    self.last_fallback_reason = "state_leaf"
+                    return False
+                roles[id(leaf)] = ("s", len(state_nds))
+                state_nds.append(leaf)
+            state_defs.append(treedef)
+            weight_nds.append(w)
+            grad_nds.append(g)
+
+        # ---- phase A: drive the per-param updater once on host ----------
+        # All counters/schedulers/bias corrections advance exactly as in
+        # the loop; device work is captured instead of executed.
+        rec = _Recorder(roles)
+        _invoke._FUSED_RECORDER.rec = rec
+        try:
+            for k, (i, param) in enumerate(work):
+                upd(i, grad_nds[k], weight_nds[k])
+        finally:
+            _invoke._FUSED_RECORDER.rec = None
+        if not rec.ok:
+            self._disabled = "unrecordable"
+            self.last_fallback_reason = "unrecordable"
+            self._rollback_counts(work)
+            return False
+
+        key = (type(opt), tuple(rec.program),
+               tuple(state_defs), len(work), fold_reduce)
+        fn = self._cache.get(key)
+        first_call = fn is None
+        if first_call:
+            fn = self._build(rec.program, state_defs, len(work),
+                             len(state_nds))
+            self._cache[key] = fn
+
+        weights = [w._data for w in weight_nds]
+        if fold_reduce:
+            primary = weight_nds[0].context.jax_device
+            grads = [tuple(jax.device_put(g._data, primary)
+                           for g in work[k][1].list_grad())
+                     for k in range(len(work))]
+        else:
+            grads = [g._data for g in grad_nds]
+        states = [s._data for s in state_nds]
+        scalars = tuple(rec.slot_values)
+
+        try:
+            new_w, new_s = fn(weights, grads, states, scalars)
+        except Exception:
+            if any(w.is_deleted() for w in weights) or \
+                    any(s.is_deleted() for s in states):
+                raise  # donation consumed the buffers: nothing to fall
+                       # back onto — surface the real failure
+            # trace- or dispatch-time failure with inputs intact (e.g.
+            # aliased parameter buffers donated twice): the per-param
+            # loop can still run this step
+            import warnings
+            warnings.warn(
+                "fused optimizer apply failed; Trainer falls back to the "
+                "per-param update loop", stacklevel=3)
+            self._cache.pop(key, None)
+            if first_call:
+                # tracing is deterministic — this signature will never work
+                self._disabled = "trace_failed"
+            else:
+                # dispatch errors may be transient (device pressure):
+                # retry a few steps before giving up on the fused path
+                self._exec_failures += 1
+                if self._exec_failures >= self.MAX_EXEC_FAILURES:
+                    self._disabled = "exec_failed"
+            self.last_fallback_reason = self._disabled or "exec_failed"
+            self._rollback_counts(work)
+            return False
+
+        for k, (i, param) in enumerate(work):
+            replicas = param.list_data()
+            replicas[0]._data = new_w[k]
+            for other in replicas[1:]:
+                other._data = jax.device_put(
+                    new_w[k], other.context.jax_device)
+        for leaf, data in zip(state_nds, new_s):
+            leaf._data = data
+        self._exec_failures = 0  # only consecutive failures disable
+        self.last_dispatches = 1
+        return True
+
+    def _rollback_counts(self, work):
+        """Undo phase A's count/num_update advance so the fallback loop
+        (which re-runs the updater) does not double-count the step."""
+        opt = self._optimizer
+        for i, _ in work:
+            if i in opt._index_update_count:
+                opt._index_update_count[i] -= 1
+        counts = [c for c in opt._index_update_count.values()
+                  if isinstance(c, (int, float))]
+        opt.num_update = max([opt.begin_num_update] + counts)
+
+    # ------------------------------------------------------------ build --
+    def _build(self, program, state_defs, n_params, n_state_leaves):
+        entries = [(get_op(name), entry_roles, dict(static_kw), tkeys, slots)
+                   for name, entry_roles, static_kw, tkeys, slots in program]
+
+        def fused(weights, grads, state_leaves, scalars):
+            bufs = {}
+            for k, w in enumerate(weights):
+                bufs[("w", k)] = w
+            for k, g in enumerate(grads):
+                if isinstance(g, (tuple, list)):
+                    # folded allreduce: sum the per-context replicas
+                    # (reference Comm*::Reduce) inside the same program
+                    total = g[0]
+                    for extra in g[1:]:
+                        total = total + extra
+                    g = total
+                bufs[("g", k)] = g
+            for j, s in enumerate(state_leaves):
+                bufs[("s", j)] = s
+            for op, entry_roles, static_kw, tkeys, slots in entries:
+                kw = dict(static_kw)
+                for kname, slot in zip(tkeys, slots):
+                    kw[kname] = scalars[slot]
+                outs = op.impl(*(bufs[r] for r in entry_roles), **kw)
+                outs_t = (outs,) if not isinstance(outs, (tuple, list)) \
+                    else tuple(outs)
+                for oi, m in enumerate(op.mutates):
+                    bufs[entry_roles[m]] = outs_t[oi]
+            return ([bufs[("w", k)] for k in range(n_params)],
+                    [bufs[("s", j)] for j in range(n_state_leaves)])
+
+        # donate weights + optimizer slots: the update writes in place in
+        # HBM; gradients are NOT donated (backward accumulates into them)
+        return jax.jit(fused, donate_argnums=(0, 2))

@@ -1,0 +1,351 @@
+"""Fused single-dispatch trainer update: bit-exactness vs the per-param
+loop, no-recompile lr scheduling, buffer donation, dispatch-count
+regression, fold-the-allreduce, and the persistent compile cache."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import Trainer
+from mxnet_tpu.gluon.parameter import Parameter
+from mxnet_tpu.observability import get_registry, \
+    install_jax_monitoring_bridge
+
+
+def _make_params(n=7, seed=0, ctx=None):
+    rng = np.random.RandomState(seed)
+    params = []
+    for i in range(n):
+        shape = (3 + (i % 5), 4)
+        p = Parameter(f"p{i}", shape=shape)
+        p.initialize(init=mx.initializer.Constant(0), ctx=ctx)
+        p.set_data(mx.nd.NDArray(rng.randn(*shape).astype(np.float32)))
+        params.append(p)
+    return params
+
+
+def _set_grads(params, seed):
+    rng = np.random.RandomState(seed)
+    for p in params:
+        for g in p.list_grad():
+            g[:] = mx.nd.NDArray(rng.randn(*p.shape).astype(np.float32))
+
+
+def _run(monkeypatch, opt, opt_args, fused, steps=5, lr_seq=None,
+         batch_seq=None, scaler=None):
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "1" if fused else "0")
+    params = _make_params()
+    trainer = Trainer(params, opt, dict(opt_args))
+    if scaler is not None:
+        from mxnet_tpu import amp
+        amp.init_trainer(trainer, loss_scaler=scaler())
+    for s in range(steps):
+        if lr_seq:
+            trainer.set_learning_rate(lr_seq[s % len(lr_seq)])
+        _set_grads(params, 100 + s)
+        trainer.step(batch_seq[s % len(batch_seq)] if batch_seq else 32)
+    return [p.data().asnumpy().copy() for p in params], trainer
+
+
+@pytest.mark.parametrize("opt,args", [
+    ("sgd", {"learning_rate": 0.05}),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 1e-3, "wd": 1e-3}),
+    ("adamw", {"learning_rate": 1e-3}),
+])
+def test_fused_bitexact(monkeypatch, opt, args):
+    """The fused dispatch must produce bit-identical weights AND
+    optimizer slot state vs the per-param loop, across lr changes and
+    batch-size (rescale_grad) changes — Adam's bias-correction step
+    counter included."""
+    lr_seq = [0.05, 0.02, 0.05, 0.01]
+    batch_seq = [32, 16, 64]
+    a, tr_a = _run(monkeypatch, opt, args, True, lr_seq=lr_seq,
+                   batch_seq=batch_seq)
+    b, tr_b = _run(monkeypatch, opt, args, False, lr_seq=lr_seq,
+                   batch_seq=batch_seq)
+    for i, (wa, wb) in enumerate(zip(a, b)):
+        assert (wa == wb).all(), f"param {i} differs (not bit-exact)"
+    assert tr_a._optimizer._index_update_count == \
+        tr_b._optimizer._index_update_count
+    assert tr_a._optimizer.num_update == tr_b._optimizer.num_update
+    # optimizer slots (momentum / mean / var) must match bitwise too
+    sa, sb = tr_a._updaters[0].states, tr_b._updaters[0].states
+    assert sorted(sa) == sorted(sb)
+    import jax
+    for k in sa:
+        for la, lb in zip(jax.tree_util.tree_leaves(sa[k]),
+                          jax.tree_util.tree_leaves(sb[k])):
+            assert (la.asnumpy() == lb.asnumpy()).all(), \
+                f"state {k} differs"
+
+
+def test_fused_bitexact_with_loss_scaler(monkeypatch):
+    """LossScaler rescale enters the compiled step as a traced scalar;
+    scaled runs stay bit-exact with the loop."""
+    from mxnet_tpu.amp import LossScaler
+    mk = lambda: LossScaler(init_scale=64.0, target_dtype="float16")  # noqa: E731
+    a, _ = _run(monkeypatch, "sgd",
+                {"learning_rate": 0.05, "momentum": 0.9}, True, scaler=mk)
+    b, _ = _run(monkeypatch, "sgd",
+                {"learning_rate": 0.05, "momentum": 0.9}, False, scaler=mk)
+    for wa, wb in zip(a, b):
+        assert (wa == wb).all()
+
+
+def test_lr_change_does_not_recompile(monkeypatch):
+    """After the first step compiles the fused program, lr / batch-size
+    changes must reuse it (asserted via the jax.monitoring compile
+    counter)."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "1")
+    install_jax_monitoring_bridge()
+    reg = get_registry()
+    params = _make_params(n=5, seed=3)
+    trainer = Trainer(params, "adam", {"learning_rate": 1e-3})
+    _set_grads(params, 0)
+    trainer.step(8)  # warm-up: compiles the fused program
+    compiles = reg.counter("mxtpu_xla_compile_total")
+    fused = reg.counter("mxtpu_trainer_update_fused_total")
+    c0, f0 = compiles.value, fused.value
+    for s in range(4):
+        trainer.set_learning_rate(1e-3 * (s + 1))
+        _set_grads(params, s + 1)
+        trainer.step(8 + 4 * s)
+    assert fused.value - f0 == 4, "steps did not stay on the fused path"
+    assert compiles.value - c0 == 0, \
+        "lr/batch change recompiled the fused update"
+
+
+def test_single_dispatch_regardless_of_param_count(monkeypatch):
+    """Dispatch-count regression guard: a >=50-parameter model must
+    execute exactly ONE compiled update launch per Trainer.step; the
+    same model on the loop path shows one per parameter (proving the
+    counter measures real launches)."""
+    reg = get_registry()
+    dispatch = reg.counter("mxtpu_trainer_update_dispatch_total")
+
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "1")
+    params = _make_params(n=55, seed=1)
+    trainer = Trainer(params, "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    _set_grads(params, 0)
+    trainer.step(8)  # compile
+    d0 = dispatch.value
+    _set_grads(params, 1)
+    trainer.step(8)
+    assert dispatch.value - d0 == 1, \
+        f"fused step took {dispatch.value - d0} dispatches, not 1"
+
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "0")
+    d1 = dispatch.value
+    _set_grads(params, 2)
+    trainer.step(8)
+    assert dispatch.value - d1 == 55
+
+
+def test_donation_frees_old_buffers(monkeypatch):
+    """donate_argnums on the fused step must invalidate the pre-step
+    weight and slot buffers (in-place HBM update, no 2x residency)."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "1")
+    params = _make_params(n=6, seed=2)
+    trainer = Trainer(params, "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    _set_grads(params, 0)
+    trainer.step(4)  # creates slots, compiles
+    old_w = [p.data()._data for p in params]
+    old_s = [trainer._updaters[0].states[i]._data
+             for i in range(len(params))]
+    _set_grads(params, 1)
+    trainer.step(4)
+    assert all(b.is_deleted() for b in old_w), "weight buffers not donated"
+    assert all(b.is_deleted() for b in old_s), "slot buffers not donated"
+    # the live buffers are the new ones and stay readable
+    assert all(np.isfinite(p.data().asnumpy()).all() for p in params)
+
+
+def test_fallback_paths(monkeypatch):
+    """ignore_stale_grad, unfusable optimizers, and the env kill-switch
+    run the per-param loop — and produce the same numbers."""
+    reg = get_registry()
+    fallback = reg.counter("mxtpu_trainer_update_fallback_total",
+                           labelnames=("reason",))
+
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "1")
+    params = _make_params(n=3, seed=4)
+    trainer = Trainer(params, "sgd", {"learning_rate": 0.1})
+    _set_grads(params, 0)
+    before = fallback.labels(reason="ignore_stale_grad").value
+    trainer.step(4, ignore_stale_grad=True)
+    assert fallback.labels(reason="ignore_stale_grad").value == before + 1
+
+    # unfusable optimizer (host-state per call)
+    params2 = _make_params(n=3, seed=5)
+    trainer2 = Trainer(params2, "nadam", {"learning_rate": 1e-3})
+    before = fallback.labels(reason="optimizer").value
+    _set_grads(params2, 0)
+    trainer2.step(4)
+    assert fallback.labels(reason="optimizer").value == before + 1
+
+    # kill-switch
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "0")
+    params3 = _make_params(n=3, seed=6)
+    trainer3 = Trainer(params3, "sgd", {"learning_rate": 0.1})
+    before = fallback.labels(reason="env_disabled").value
+    _set_grads(params3, 0)
+    trainer3.step(4)
+    assert fallback.labels(reason="env_disabled").value == before + 1
+
+
+def test_fused_fallback_sparse_grad(monkeypatch):
+    """A row-sparse gradient anywhere in the set must route the whole
+    step through the loop (the lazy row update is eager-only)."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "1")
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    import jax.numpy as jnp
+    params = _make_params(n=2, seed=7)
+    trainer = Trainer(params, "sgd", {"learning_rate": 0.1})
+    _set_grads(params, 0)
+    w = params[0].data()
+    rows = jnp.asarray([0, 2], jnp.int32)
+    params[0].data()._grad = RowSparseNDArray(
+        jnp.ones((2,) + w.shape[1:], jnp.float32), rows, w.shape)
+    reg = get_registry()
+    fallback = reg.counter("mxtpu_trainer_update_fallback_total",
+                           labelnames=("reason",))
+    before = fallback.labels(reason="sparse_grad").value
+    trainer.step(1)
+    assert fallback.labels(reason="sparse_grad").value == before + 1
+
+
+def test_fold_allreduce_multictx(monkeypatch):
+    """kvstore=None with per-context replicas: reduce + update must run
+    as ONE dispatch, replicas end identical, and the math matches the
+    reduced-gradient momentum update."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "1")
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    params = _make_params(n=3, seed=8, ctx=ctxs)
+    vals = [p.data().asnumpy().copy() for p in params]
+    trainer = Trainer(params, "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9},
+                      kvstore=None)
+    g_by_ctx = []
+    for i, p in enumerate(params):
+        gs = [np.random.RandomState(40 + 10 * j + i)
+              .randn(*p.shape).astype(np.float32) for j in range(2)]
+        for g_nd, g in zip(p.list_grad(), gs):
+            g_nd[:] = mx.nd.NDArray(g)
+        g_by_ctx.append(gs)
+    reg = get_registry()
+    dispatch = reg.counter("mxtpu_trainer_update_dispatch_total")
+    trainer.step(1)  # compile step
+    d0 = dispatch.value
+    for i, p in enumerate(params):
+        for g_nd, g in zip(p.list_grad(), g_by_ctx[i]):
+            g_nd[:] = mx.nd.NDArray(g)
+    trainer.step(1)
+    assert dispatch.value - d0 == 1
+    for i, p in enumerate(params):
+        total = g_by_ctx[i][0] + g_by_ctx[i][1]
+        # both steps saw the same per-ctx grads: two momentum updates
+        # on the reduced gradient
+        mom1 = -0.1 * total
+        mom2 = 0.9 * mom1 - 0.1 * total
+        want = vals[i] + mom1 + mom2
+        replicas = [d.asnumpy() for d in p.list_data()]
+        assert (replicas[0] == replicas[1]).all()
+        np.testing.assert_allclose(replicas[0], want, rtol=2e-5,
+                                   atol=2e-6)
+
+
+def test_tree_allreduce_matches_sum(monkeypatch):
+    """_allreduce_grads with no kvstore: every replica must hold the
+    cross-context sum after the single tree-level reduce."""
+    ctxs = [mx.cpu(0), mx.cpu(1), mx.cpu(2)]
+    params = _make_params(n=4, seed=9, ctx=ctxs)
+    trainer = Trainer(params, "sgd", {"learning_rate": 0.1},
+                      kvstore=None, update_on_kvstore=False)
+    grads = []
+    for i, p in enumerate(params):
+        gs = [np.random.RandomState(60 + 10 * j + i)
+              .randn(*p.shape).astype(np.float32) for j in range(3)]
+        for g_nd, g in zip(p.list_grad(), gs):
+            g_nd[:] = mx.nd.NDArray(g)
+        grads.append(gs)
+    trainer.allreduce_grads()
+    for i, p in enumerate(params):
+        total = grads[i][0] + grads[i][1] + grads[i][2]
+        for g_nd in p.list_grad():
+            np.testing.assert_allclose(g_nd.asnumpy(), total, rtol=1e-6,
+                                       atol=1e-6)
+
+
+def test_fused_state_checkpoint_roundtrip(monkeypatch, tmp_path):
+    """Slots written by the fused path restore bit-exactly through the
+    resilience checkpoint, and training resumes on the fused path."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "1")
+    params = _make_params(n=4, seed=10)
+    trainer = Trainer(params, "adam", {"learning_rate": 1e-3})
+    for s in range(3):
+        _set_grads(params, s)
+        trainer.step(8)
+    trainer.save_state(str(tmp_path))
+    after3 = [p.data().asnumpy().copy() for p in params]
+    _set_grads(params, 3)
+    trainer.step(8)
+    after4 = [p.data().asnumpy().copy() for p in params]
+
+    params2 = _make_params(n=4, seed=11)
+    trainer2 = Trainer(params2, "adam", {"learning_rate": 1e-3})
+    trainer2.restore_state(str(tmp_path))
+    for wa, p in zip(after3, params2):
+        assert (wa == p.data().asnumpy()).all()
+    _set_grads(params2, 3)
+    trainer2.step(8)
+    for wa, p in zip(after4, params2):
+        assert (wa == p.data().asnumpy()).all(), \
+            "resumed step diverged from the uninterrupted run"
+
+
+def test_donation_does_not_break_param_copies(monkeypatch):
+    """Target-network pattern: a second parameter set_data'd from a
+    trained one must keep a private buffer — the donated update of the
+    source must not delete the copy's storage (DQN/EMA regression)."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_UPDATE", "1")
+    params = _make_params(n=3, seed=12)
+    targets = _make_params(n=3, seed=13)
+    for p, t in zip(params, targets):
+        t.set_data(p.data())
+    trainer = Trainer(params, "sgd", {"learning_rate": 0.1})
+    snap = [t.data().asnumpy().copy() for t in targets]
+    for s in range(2):
+        _set_grads(params, s)
+        trainer.step(4)
+    for t, before in zip(targets, snap):
+        assert (t.data().asnumpy() == before).all()  # alive AND unchanged
+
+
+def test_enable_compile_cache(tmp_path):
+    """enable_compile_cache points JAX's persistent cache at the dir and
+    fresh compiles land there as cache entries."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import runtime
+    prev = {f: getattr(jax.config, f)
+            for f in ("jax_compilation_cache_dir",
+                      "jax_persistent_cache_min_compile_time_secs",
+                      "jax_persistent_cache_min_entry_size_bytes")}
+    try:
+        resolved = runtime.enable_compile_cache(str(tmp_path))
+        assert resolved == str(tmp_path)
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+        # a shape this suite never uses elsewhere -> fresh compile
+        x = jnp.ones((13, 17, 3))
+        jax.jit(lambda a: (a * 2.5 + 1.0).sum(axis=1))(x).block_until_ready()
+        entries = [f for f in os.listdir(str(tmp_path)) if "cache" in f]
+        assert entries, "no persistent cache entries written"
+    finally:
+        for f, v in prev.items():
+            jax.config.update(f, v)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()  # drop the tmp_path-backed cache
