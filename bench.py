@@ -92,8 +92,91 @@ def _timed_reps(run_n, n, reps=3, step_timer=None, examples_per_rep=None):
     return sorted(times)[len(times) // 2]
 
 
+def bench_train_compiled(dtype, layout, batch, train_iters,
+                         stem_s2d=False, remat=""):
+    """Train-side benchmark through the PRODUCTION runtime path: a gluon
+    Trainer driving ``CompiledTrainStep`` — per-step Python dispatch of
+    ONE donated program, exactly what a user training loop pays. The
+    scan-based protocol (``bench_resnet``) amortizes dispatch over
+    ``train_iters`` steps inside one launch and is kept as the
+    A/B control (``BENCH_COMPILED_STEP=0``).
+
+    Honest timing: step i+1's program consumes step i's donated weights
+    (a real dependency chain), batches are pre-staged on device, and the
+    timed unit ends with a host fetch of the last step's loss, which
+    synchronises the whole chain."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import Trainer
+    from mxnet_tpu.gluon.model_zoo import vision
+    import mxnet_tpu.autograd as ag
+
+    dev = jax.devices()[0]
+    in_shape = (1, 3, 224, 224) if layout == "NCHW" else (1, 224, 224, 3)
+    mx.random.seed(0)
+    net = vision.resnet50_v1(layout=layout, stem_s2d=stem_s2d)
+    net.initialize(init=mx.initializer.Xavier())
+    with ag.pause(train_mode=False):
+        net(mx.nd.NDArray(np.ones(in_shape, np.float32)))
+    if dtype != "float32":
+        net.cast(dtype)
+
+    x_shape = (batch,) + in_shape[1:]
+    xs = [nd.NDArray(jax.device_put(
+              np.random.RandomState(100 + i).randn(*x_shape).astype(dtype),
+              dev)) for i in range(train_iters)]
+    ys = [nd.NDArray(jax.device_put(
+              np.random.RandomState(200 + i).randint(0, 1000, (batch,))
+              .astype(np.int32), dev)) for i in range(train_iters)]
+
+    def loss_fn(x, y):
+        logits = net(x)
+        logp = mx.nd.log_softmax(logits.astype("float32"), axis=-1)
+        # per-sample NLL; CompiledTrainStep's rescale_grad /batch makes
+        # the update the gradient of the MEAN loss (scan-path parity)
+        return -mx.nd.pick(logp, y, axis=1)
+
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 1e-3, "momentum": 0.9})
+    step = trainer.compile_step(loss_fn, remat=remat or None)
+
+    loss = None
+
+    def run_train(n):
+        nonlocal loss
+        for i in range(n):
+            loss = step(xs[i], ys[i])
+        float(loss.asnumpy()[0])   # host fetch == real synchronisation
+
+    run_train(train_iters)          # warmup: compiles the step program
+    if step.last_reason is not None:
+        raise RuntimeError(
+            f"compiled-step bench fell back to eager: {step.last_reason}")
+    try:
+        from mxnet_tpu.observability import StepTimer
+        timer = StepTimer(subsystem="bench_loop")
+    except Exception:
+        timer = None
+    train_dt = _timed_reps(run_train, train_iters, step_timer=timer,
+                           examples_per_rep=batch * train_iters)
+    final_loss = float(loss.asnumpy().mean())
+    assert np.isfinite(final_loss), "training diverged"
+    train_flops = _cost_flops(step)
+
+    prof_dir = os.environ.get("BENCH_PROFILE")
+    if prof_dir:
+        with jax.profiler.trace(prof_dir):
+            run_train(train_iters)
+
+    return {
+        "train_img_s": batch / train_dt, "train_flops": train_flops,
+        "train_dt": train_dt, "final_loss": final_loss, "dev": dev,
+    }
+
+
 def bench_resnet(dtype, layout, batch, train_iters, infer_iters,
-                 stem_s2d=False):
+                 stem_s2d=False, train=True):
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
@@ -160,6 +243,12 @@ def bench_resnet(dtype, layout, batch, train_iters, infer_iters,
     run_infer(infer_iters)  # warmup past the post-compile slow window
     infer_dt = _timed_reps(run_infer, infer_iters)
     infer_img_s = batch / infer_dt
+
+    if not train:
+        # inference-only invocation (the compiled-step mode benches
+        # training through the runtime path instead of the scan)
+        return {"infer_img_s": infer_img_s, "infer_flops": infer_flops,
+                "dev": dev}
 
     # ---- training step (fwd+bwd+SGD-momentum, donated buffers) ----------
     def loss_fn(params, x, y):
@@ -338,12 +427,49 @@ def _probe_backend(timeout_s):
         return None, "unparseable backend probe output"
 
 
+def _parse_flags():
+    """CLI flags for the MFU levers; each maps onto its env var (flags
+    win) so `perf_capture.py` configs and interactive runs share one
+    spelling: ``--batch 256 --bn-fused-bwd --remat dots``."""
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--batch", type=int, help="env BENCH_BATCH")
+    ap.add_argument("--dtype", help="env BENCH_DTYPE")
+    ap.add_argument("--layout", help="env BENCH_LAYOUT")
+    ap.add_argument("--remat", choices=["", "full", "dots"],
+                    help="backward rematerialisation (env BENCH_REMAT)")
+    ap.add_argument("--bn-fused-bwd", dest="bn_fused_bwd", nargs="?",
+                    const="1", choices=["0", "1"],
+                    help="fused BatchNorm backward: bare flag or 1 = on, "
+                         "0 = off (env MXNET_TPU_BN_FUSED_BWD)")
+    ap.add_argument("--compiled-step", dest="compiled_step",
+                    choices=["0", "1"],
+                    help="train via gluon CompiledTrainStep (1, default) "
+                         "or the jax-scan control loop (0) "
+                         "(env BENCH_COMPILED_STEP)")
+    ap.add_argument("--iters", type=int, help="env BENCH_ITERS")
+    ap.add_argument("--train-iters", type=int,
+                    help="env BENCH_TRAIN_ITERS")
+    args = ap.parse_args()
+    for flag, env in (("batch", "BENCH_BATCH"), ("dtype", "BENCH_DTYPE"),
+                      ("layout", "BENCH_LAYOUT"), ("remat", "BENCH_REMAT"),
+                      ("compiled_step", "BENCH_COMPILED_STEP"),
+                      ("bn_fused_bwd", "MXNET_TPU_BN_FUSED_BWD"),
+                      ("iters", "BENCH_ITERS"),
+                      ("train_iters", "BENCH_TRAIN_ITERS")):
+        v = getattr(args, flag)
+        if v is not None:
+            os.environ[env] = str(v)
+
+
 def main():
+    _parse_flags()
     batch = int(os.environ.get("BENCH_BATCH", 128))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
     infer_iters = int(os.environ.get("BENCH_ITERS", 50))
     train_iters = int(os.environ.get("BENCH_TRAIN_ITERS", 50))
+    compiled_mode = os.environ.get("BENCH_COMPILED_STEP", "1") != "0"
     # MLPerf-style space-to-depth stem (numerically identical to the plain
     # 7x7/s2 stem — tests/test_layout.py); BENCH_S2D=0 opts out.
     stem_s2d = os.environ.get("BENCH_S2D", "1") != "0" and layout == "NHWC"
@@ -384,8 +510,15 @@ def main():
         pass
 
     try:
-        r = bench_resnet(dtype, layout, batch, train_iters, infer_iters,
-                         stem_s2d=stem_s2d)
+        if compiled_mode:
+            r = bench_resnet(dtype, layout, batch, train_iters,
+                             infer_iters, stem_s2d=stem_s2d, train=False)
+            r.update(bench_train_compiled(
+                dtype, layout, batch, train_iters, stem_s2d=stem_s2d,
+                remat=os.environ.get("BENCH_REMAT", "")))
+        else:
+            r = bench_resnet(dtype, layout, batch, train_iters,
+                             infer_iters, stem_s2d=stem_s2d)
     except jax.errors.JaxRuntimeError as e:
         # Tunnel died mid-run (UNAVAILABLE/DEADLINE_EXCEEDED). Anything
         # else is a real benchmark bug and should still traceback.
@@ -448,9 +581,24 @@ def main():
         _reg = get_registry()
         extra["data_fraction"] = round(
             float(_reg.gauge("mxtpu_bench_loop_data_fraction").value), 6)
+        # compiled-vs-eager dispatch accounting, from the same
+        # mxtpu_train_step_* series production training reports on: the
+        # compiled-step protocol is 1 launch per optimizer step; the
+        # scan control amortizes to 1/train_iters. Any eager fallback
+        # steps are itemized by reason — a nonzero fallback count means
+        # the headline did NOT measure the compiled path.
+        fallback = _reg.counter("mxtpu_train_step_fallback_total",
+                                labelnames=("reason",))
+        fb = {c.labelvalues[0]: int(c.value)
+              for c in fallback.children() if c.value}
         extra["dispatch"] = {
-            "train_dispatches_per_step": round(1.0 / train_iters, 6),
-            "update_dispatches_per_step": 0,  # folded into the scan body
+            "protocol": "compiled_step" if compiled_mode else "jax_scan",
+            "train_dispatches_per_step":
+                1 if compiled_mode else round(1.0 / train_iters, 6),
+            "update_dispatches_per_step": 0,  # folded into the step
+            "train_step_compiled": int(_reg.counter(
+                "mxtpu_train_step_compiled_total").value),
+            "train_step_fallback": fb,
             "xla_compiles": int(
                 _reg.counter("mxtpu_xla_compile_total").value),
             "xla_cache_hits": int(

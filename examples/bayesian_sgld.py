@@ -90,13 +90,16 @@ def main():
             idx = perm[i:i + B]
             with ag.record():
                 # SGLD samples the posterior of the DATASET-sum loss:
-                # scale the minibatch mean by N so (after Trainer's
-                # 1/B rescale) the drift term is the standard N/B
-                # minibatch estimator of the full-data gradient
+                # scale the minibatch mean by N so the drift term is the
+                # standard (N/B)·Σ_minibatch ∇ℓ = N·∇mean unbiased
+                # estimator of the full-data gradient sum. step(1) keeps
+                # rescale_grad at 1 — a step(B) here would divide the
+                # likelihood term by B, sampling a 32x-hotter posterior
+                # whose ensemble mean wanders off the data
                 loss = loss_fn(net(nd.array(x[idx])),
                                nd.array(y[idx])).mean() * len(x)
             loss.backward()
-            trainer.step(B)
+            trainer.step(1)
             total += float(loss.asnumpy()) / len(x)
         if epoch >= args.burn_in:
             snapshots.append([p.data().asnumpy().copy()

@@ -64,7 +64,7 @@ _LAZY_MODULES = ("numpy", "numpy_extension", "symbol", "gluon", "module",
                  "recordio", "executor", "monitor", "model", "operator",
                  "contrib", "onnx", "native", "library", "visualization",
                  "error", "engine", "attribute", "name", "rtc", "deploy",
-                 "rnn", "resilience", "serving", "observability")
+                 "rnn", "resilience", "serving", "observability", "jit")
 
 
 

@@ -89,6 +89,26 @@ def host_rng():
     return _host_rng
 
 
+def base_key():
+    """The process PRNG root key (creating it from seed 0 if unseeded).
+    Compiled whole-step programs take this as an INPUT together with a
+    host-drawn counter position (:func:`reserve_draw`) and fold the two
+    inside the program — the eager ``next_key()`` fold_in would cost one
+    extra device dispatch per training step."""
+    global _base_key
+    if _base_key is None:
+        seed(0)
+    return _base_key
+
+
+def reserve_draw():
+    """Advance the global draw counter on host and return the reserved
+    position. Pure host arithmetic (no device work); the checkpointed
+    (seed, draws) pair covers these draws, so restored runs replay the
+    identical stream."""
+    return next(_counter)
+
+
 def next_key():
     global _base_key
     ts = getattr(_trace_tls, "state", None)

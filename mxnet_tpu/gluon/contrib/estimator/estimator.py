@@ -52,6 +52,9 @@ class Estimator:
         self.trainer = trainer if trainer is not None else Trainer(
             net.collect_params(), "sgd", {"learning_rate": 1e-3})
         self.stop_training = False
+        self._compiled_step = None
+        self._compiled_step_auto = None
+        self._step_applied = False
 
     @staticmethod
     def _to_list(m):
@@ -64,8 +67,25 @@ class Estimator:
     # ------------------------------------------------------------ batch --
     def fit_batch(self, batch):
         """One forward/backward; returns (data, label, pred, loss).
-        Override for custom batch semantics (reference: fit_batch)."""
+        Override for custom batch semantics (reference: fit_batch).
+
+        With ``fit(compiled_step=...)`` the whole step — forward, loss,
+        backward AND the optimizer update — runs as one compiled
+        dispatch here; ``GradientUpdateHandler`` then skips its
+        ``trainer.step`` for the batch (``_step_applied``)."""
         data, label = _as_nd(batch[0]), _as_nd(batch[1])
+        if self._compiled_step is not None:
+            out = self._compiled_step(data, label)
+            if isinstance(out, tuple):
+                # fit(compiled_step=True) convention: loss first, pred
+                # rides along as the second program output
+                loss, pred = out[0], out[1]
+            else:
+                # a user-built step whose loss_fn returns only the loss:
+                # metric handlers skip pred=None, loss metrics still run
+                loss, pred = out, None
+            self._step_applied = True
+            return data, label, pred, loss
         with autograd.record():
             pred = self.net(data)
             loss = self.loss(pred, label)
@@ -96,7 +116,8 @@ class Estimator:
 
     # -------------------------------------------------------------- fit --
     def fit(self, train_data, val_data=None, epochs=None,
-            event_handlers=None, batches=None, device_prefetch=None):
+            event_handlers=None, batches=None, device_prefetch=None,
+            compiled_step=None):
         """Train for ``epochs`` epochs or ``batches`` batches
         (reference: fit:326).
 
@@ -106,9 +127,33 @@ class Estimator:
         that already device-prefetches (e.g. a ``DataLoader`` with the
         same env default) keeps its own depth — the source wins, no
         second staging thread is stacked. The StepTimerHandler's
-        ``mxtpu_training_data_fraction`` gauge shows the effect."""
+        ``mxtpu_training_data_fraction`` gauge shows the effect.
+
+        ``compiled_step``: ``True`` compiles the whole training step
+        (forward + loss + backward + update) into one buffer-donating
+        XLA dispatch per batch via
+        ``trainer.compile_step`` (:class:`mxnet_tpu.jit.
+        CompiledTrainStep`); pass a pre-built ``CompiledTrainStep`` to
+        share programs across fits. Ineligible batches fall back to
+        the eager path automatically (see docs/PERFORMANCE.md)."""
         if epochs is None and batches is None:
             epochs = 1
+        if compiled_step is True:
+            # built once per estimator: net/loss/trainer are fixed at
+            # construction, so repeated fits reuse the same programs
+            # instead of re-paying the whole-step compile
+            if self._compiled_step_auto is None:
+                net, loss_obj = self.net, self.loss
+
+                def _loss_and_pred(x, y):
+                    pred = net(x)
+                    # pred rides along as a program output so the metric
+                    # handlers see it without a second forward
+                    return loss_obj(pred, y), pred
+                self._compiled_step_auto = \
+                    self.trainer.compile_step(_loss_and_pred)
+            compiled_step = self._compiled_step_auto
+        self._compiled_step = compiled_step or None
         handlers = self._prepare_handlers(val_data, epochs, batches,
                                           event_handlers)
         train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \

@@ -416,6 +416,11 @@ class GradientUpdateHandler(BatchEnd):
         self.priority = priority
 
     def batch_end(self, estimator, *args, **kwargs):
+        if getattr(estimator, "_step_applied", False):
+            # fit_batch ran a CompiledTrainStep: the optimizer update
+            # already happened inside the one-dispatch program
+            estimator._step_applied = False
+            return
         batch = kwargs.get("batch")
         n = len(batch[0]) if batch is not None else 1
         estimator.trainer.step(n)

@@ -250,6 +250,15 @@ class Parameter:
         """Return data on ``ctx`` (tracer-backed during hybridize trace)."""
         if self._trace_data is not None:
             return self._trace_data
+        if _TRACE_STACK:
+            # a concrete read under an active trace frame bakes this
+            # parameter's value into the compiled program as a constant;
+            # frames that track reads (jit.CompiledTrainStep) use the
+            # set to promote such parameters to program inputs /
+            # guard the cache entry (CachedOp frames are plain dicts)
+            reads = getattr(_TRACE_STACK[-1], "reads", None)
+            if reads is not None:
+                reads.add(self)
         if self._data is None and self._deferred_init:
             raise DeferredInitializationError(
                 f"Parameter '{self.name}' not initialized yet (deferred).")

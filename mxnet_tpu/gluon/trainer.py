@@ -220,6 +220,33 @@ class Trainer:
         return replicated and getattr(
             self._kvstore, "fused_reduce_compatible", False)
 
+    def compile_step(self, loss_fn, buckets=None, donate=True, remat=None):
+        """Compile the WHOLE training step — forward + loss + backward +
+        cross-context gradient reduce + optimizer update — into one
+        buffer-donating XLA program per input signature
+        (:class:`mxnet_tpu.jit.CompiledTrainStep`).
+
+        ``loss_fn(*batch)`` is ordinary eager Python calling the net
+        (the ops are trace-transparent); it returns the per-sample loss,
+        or a tuple ``(loss, *extras)`` whose extras (predictions, ...)
+        ride along as program outputs. The returned step object replaces
+        the ``record()/backward()/step()`` triple::
+
+            step = trainer.compile_step(lambda x, y: loss(net(x), y))
+            for x, y in loader:          # ideally a DevicePrefetchIter
+                l = step(x, y)           # ONE device dispatch
+
+        Steps that cannot compile (sparse grads, host-sync optimizers,
+        data-dependent Python control flow, ``grad_req='add'``) fall
+        back to the eager path per step, counted by reason on
+        ``mxtpu_train_step_fallback_total``. ``remat`` ('full'/'dots')
+        rematerializes the backward for memory headroom (bigger
+        batches). See docs/PERFORMANCE.md.
+        """
+        from ..jit import CompiledTrainStep
+        return CompiledTrainStep(self, loss_fn, buckets=buckets,
+                                 donate=donate, remat=remat)
+
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + optimizer update (reference: trainer.py:329).
 

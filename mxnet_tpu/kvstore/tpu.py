@@ -28,6 +28,28 @@ __all__ = ["KVStoreTPU", "init_process_group"]
 _INITIALIZED = False
 
 
+def _enable_cpu_collectives():
+    """Multi-process groups whose backend is the XLA *CPU* client need a
+    cross-process collectives transport: plain XLA:CPU rejects any
+    computation spanning processes with "Multiprocess computations
+    aren't implemented on the CPU backend". jax ships a gloo TCP
+    transport for exactly this; selecting it is only possible BEFORE the
+    CPU client exists, so it is flipped here (the process-group
+    bootstrap is the first thing a distributed worker runs). TPU/GPU
+    platforms are untouched — the flag only affects the CPU client, so
+    when the platform is UNSET (jax will autodetect, possibly landing on
+    cpu) the flag is set anyway rather than risk the crash."""
+    import os
+    platforms = (os.environ.get("JAX_PLATFORMS")
+                 or getattr(jax.config, "jax_platforms", None) or "")
+    if platforms and "cpu" not in str(platforms).split(","):
+        return  # explicitly pinned to an accelerator: nothing to do
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older/newer jax without the flag: keep the default
+
+
 def init_process_group(coordinator_address=None, num_processes=None,
                        process_id=None, max_attempts=None):
     """Bootstrap multi-host collectives (≙ KVStore::InitPSEnv,
@@ -65,6 +87,7 @@ def init_process_group(coordinator_address=None, num_processes=None,
     if max_attempts is None:
         max_attempts = int(os.environ.get("MXNET_TPU_INIT_RETRIES", 8))
     if num_processes is not None and num_processes > 1:
+        _enable_cpu_collectives()
         from ..resilience import call_with_retry, faults
 
         def _connect():

@@ -50,7 +50,9 @@ from ..ops import invoke as _invoke
 from ..ops.registry import get as get_op
 from . import optimizer as _opt
 
-__all__ = ["FusedUpdater", "fusable"]
+__all__ = ["FusedUpdater", "fusable", "prepare_states", "build_roles",
+           "record_program", "rollback_counts", "bind_entries",
+           "apply_entries"]
 
 # Optimizers whose dense update routes ALL device math through registered
 # mutates ops (apply_op) with no host sync / per-call Python state: the
@@ -117,6 +119,101 @@ class _Recorder:
                              tkeys, tuple(slots)))
         results = [inputs[m] for m in op.mutates]
         return results[0] if len(results) == 1 else tuple(results)
+
+
+# --------------------------------------------------------------------------
+# Record/replay primitives, shared by FusedUpdater (update-only program)
+# and jit.CompiledTrainStep (whole-step program: forward+backward+reduce+
+# update in one donated dispatch).
+
+def prepare_states(optimizer, updater, work):
+    """Materialize/sync optimizer slots for ``work`` ([(index, Parameter)])
+    BEFORE a roles map is built over them (the updater would otherwise
+    create them lazily mid-recording)."""
+    for i, param in work:
+        w = param.list_data()[0]
+        if i not in updater.states:
+            updater.states[i] = optimizer.create_state_multi_precision(i, w)
+            updater.states_synced[i] = True
+        elif not updater.states_synced[i]:
+            updater.states[i] = updater.sync_state_context(
+                updater.states[i], w.context)
+            updater.states_synced[i] = True
+
+
+def build_roles(updater, work):
+    """Map id(NDArray) -> buffer slot for every weight/grad/slot of
+    ``work``. Returns (roles, weight_nds, grad_nds, state_nds,
+    state_defs); raises ValueError("state_leaf") when an optimizer slot
+    holds a non-NDArray leaf the compiled program cannot carry."""
+    roles = {}
+    weight_nds, grad_nds, state_nds, state_defs = [], [], [], []
+    for k, (i, param) in enumerate(work):
+        w = param.list_data()[0]
+        g = param.list_grad()[0]
+        roles[id(w)] = ("w", k)
+        roles[id(g)] = ("g", k)
+        leaves, treedef = _tu.tree_flatten(updater.states[i])
+        for leaf in leaves:
+            if not isinstance(leaf, NDArray):
+                raise ValueError("state_leaf")
+            roles[id(leaf)] = ("s", len(state_nds))
+            state_nds.append(leaf)
+        state_defs.append(treedef)
+        weight_nds.append(w)
+        grad_nds.append(g)
+    return roles, weight_nds, grad_nds, state_nds, state_defs
+
+
+def record_program(updater, work, grad_nds, weight_nds, roles):
+    """Phase A: drive the per-param updater once on host with the
+    ops.invoke chokepoint in record mode. All host bookkeeping (update
+    counts, lr scheduling, Adam bias correction, lr/wd multipliers)
+    advances exactly as in the eager loop; device work is captured as a
+    replayable program instead of executed. Returns the _Recorder
+    (check ``.ok``; on not-ok the caller must ``rollback_counts``)."""
+    rec = _Recorder(roles)
+    _invoke._FUSED_RECORDER.rec = rec
+    try:
+        for k, (i, param) in enumerate(work):
+            updater(i, grad_nds[k], weight_nds[k])
+    finally:
+        _invoke._FUSED_RECORDER.rec = None
+    return rec
+
+
+def rollback_counts(optimizer, work):
+    """Undo phase A's count/num_update advance so a fallback (which
+    re-runs the updater) does not double-count the step."""
+    for i, _ in work:
+        if i in optimizer._index_update_count:
+            optimizer._index_update_count[i] -= 1
+    counts = [c for c in optimizer._index_update_count.values()
+              if isinstance(c, (int, float))]
+    optimizer.num_update = max([optimizer.begin_num_update] + counts)
+
+
+def bind_entries(program):
+    """Resolve a recorded program's op names to Operator objects once,
+    outside the traced function."""
+    return [(get_op(name), entry_roles, dict(static_kw), tkeys, slots)
+            for name, entry_roles, static_kw, tkeys, slots in program]
+
+
+def apply_entries(entries, bufs, scalars):
+    """Replay a recorded update program over the ``bufs`` buffer map
+    ({('w'|'g'|'s', k): jax value}) inside a trace, with per-step
+    hyperparameters fed from the ``scalars`` tuple (traced, so lr/wd/
+    momentum/rescale changes never recompile). Mutates ``bufs``."""
+    for op, entry_roles, static_kw, tkeys, slots in entries:
+        kw = dict(static_kw)
+        for kname, slot in zip(tkeys, slots):
+            kw[kname] = scalars[slot]
+        outs = op.impl(*(bufs[r] for r in entry_roles), **kw)
+        outs_t = (outs,) if not isinstance(outs, (tuple, list)) \
+            else tuple(outs)
+        for oi, m in enumerate(op.mutates):
+            bufs[entry_roles[m]] = outs_t[oi]
 
 
 class FusedUpdater:
@@ -191,48 +288,20 @@ class FusedUpdater:
             self.last_fallback_reason = "replicated"
             return False
 
-        # states must exist before the roles map is built (the updater
-        # would create them lazily mid-recording otherwise)
-        for i, param in work:
-            w = param.list_data()[0]
-            if i not in upd.states:
-                upd.states[i] = opt.create_state_multi_precision(i, w)
-                upd.states_synced[i] = True
-            elif not upd.states_synced[i]:
-                upd.states[i] = upd.sync_state_context(upd.states[i],
-                                                       w.context)
-                upd.states_synced[i] = True
-
-        # roles: id(NDArray) -> buffer slot in the compiled program
-        roles = {}
-        weight_nds, grad_nds, state_nds, state_defs = [], [], [], []
-        for k, (i, param) in enumerate(work):
-            w = param.list_data()[0]
-            g = param.list_grad()[0]
-            roles[id(w)] = ("w", k)
-            roles[id(g)] = ("g", k)
-            leaves, treedef = _tu.tree_flatten(upd.states[i])
-            for leaf in leaves:
-                if not isinstance(leaf, NDArray):
-                    self._disabled = "state_leaf"
-                    self.last_fallback_reason = "state_leaf"
-                    return False
-                roles[id(leaf)] = ("s", len(state_nds))
-                state_nds.append(leaf)
-            state_defs.append(treedef)
-            weight_nds.append(w)
-            grad_nds.append(g)
+        prepare_states(opt, upd, work)
+        try:
+            # roles: id(NDArray) -> buffer slot in the compiled program
+            roles, weight_nds, grad_nds, state_nds, state_defs = \
+                build_roles(upd, work)
+        except ValueError:
+            self._disabled = "state_leaf"
+            self.last_fallback_reason = "state_leaf"
+            return False
 
         # ---- phase A: drive the per-param updater once on host ----------
         # All counters/schedulers/bias corrections advance exactly as in
         # the loop; device work is captured instead of executed.
-        rec = _Recorder(roles)
-        _invoke._FUSED_RECORDER.rec = rec
-        try:
-            for k, (i, param) in enumerate(work):
-                upd(i, grad_nds[k], weight_nds[k])
-        finally:
-            _invoke._FUSED_RECORDER.rec = None
+        rec = record_program(upd, work, grad_nds, weight_nds, roles)
         if not rec.ok:
             self._disabled = "unrecordable"
             self.last_fallback_reason = "unrecordable"
@@ -300,20 +369,11 @@ class FusedUpdater:
         return True
 
     def _rollback_counts(self, work):
-        """Undo phase A's count/num_update advance so the fallback loop
-        (which re-runs the updater) does not double-count the step."""
-        opt = self._optimizer
-        for i, _ in work:
-            if i in opt._index_update_count:
-                opt._index_update_count[i] -= 1
-        counts = [c for c in opt._index_update_count.values()
-                  if isinstance(c, (int, float))]
-        opt.num_update = max([opt.begin_num_update] + counts)
+        rollback_counts(self._optimizer, work)
 
     # ------------------------------------------------------------ build --
     def _build(self, program, state_defs, n_params, n_state_leaves):
-        entries = [(get_op(name), entry_roles, dict(static_kw), tkeys, slots)
-                   for name, entry_roles, static_kw, tkeys, slots in program]
+        entries = bind_entries(program)
 
         def fused(weights, grads, state_leaves, scalars):
             bufs = {}
@@ -330,15 +390,7 @@ class FusedUpdater:
                 bufs[("g", k)] = g
             for j, s in enumerate(state_leaves):
                 bufs[("s", j)] = s
-            for op, entry_roles, static_kw, tkeys, slots in entries:
-                kw = dict(static_kw)
-                for kname, slot in zip(tkeys, slots):
-                    kw[kname] = scalars[slot]
-                outs = op.impl(*(bufs[r] for r in entry_roles), **kw)
-                outs_t = (outs,) if not isinstance(outs, (tuple, list)) \
-                    else tuple(outs)
-                for oi, m in enumerate(op.mutates):
-                    bufs[entry_roles[m]] = outs_t[oi]
+            apply_entries(entries, bufs, scalars)
             return ([bufs[("w", k)] for k in range(n_params)],
                     [bufs[("s", j)] for j in range(n_state_leaves)])
 

@@ -119,6 +119,14 @@ class ShardedTrainer:
         self._rngkey = jax.random.key(0)
         self._params = None
         self._restore_pending = None
+        # training-side batch-tail bucketing (shared policy with
+        # jit.CompiledTrainStep): ragged final batches pad to a
+        # power-of-two bucket instead of retracing the SPMD program; a
+        # mask from the traced real-row count keeps the loss mean exact
+        from ..jit import step_buckets_config
+        self._buckets = step_buckets_config()
+        self._max_batch = 0
+        self._loss_scalar = None   # discovered at first trace
 
     def _ensure_init(self, x):
         if self._params is not None:
@@ -165,14 +173,27 @@ class ShardedTrainer:
             self._optimizer
         trainable = self._trainable
 
-        def step(params, opt_states, rng, t, x, y):
+        trainer = self
+
+        def step(params, opt_states, rng, t, n_real, x, y):
             def objective(trn_params):
                 full = dict(params)
                 full.update(trn_params)
                 out, aux = functional_call(block, full, x, training=True,
                                            rng=rng)
                 loss = loss_fn(NDArray(out), NDArray(y))
-                return loss._data.mean(), aux
+                lv = loss._data
+                trainer._loss_scalar = (lv.ndim == 0)
+                if lv.ndim == 0:
+                    return lv, aux
+                # masked mean over the REAL rows: identical to .mean()
+                # at full buckets (×1.0 then the same sum; the divisor
+                # value is equal), pad-row-proof at ragged tails
+                mask = (jnp.arange(lv.shape[0]) < n_real).astype(
+                    lv.dtype).reshape((lv.shape[0],)
+                                      + (1,) * (lv.ndim - 1))
+                per_row = lv.size // lv.shape[0]
+                return (lv * mask).sum() / (n_real * per_row), aux
 
             (loss, aux), grads = jax.value_and_grad(
                 objective, has_aux=True)({n: params[n] for n in trainable})
@@ -219,7 +240,34 @@ class ShardedTrainer:
                     "Examples processed (sum of Trainer.step "
                     "batch sizes)."),
             }
+            # the SPMD step is a compiled whole-step program too: it
+            # reports on the same mxtpu_train_step_* series the
+            # jit.CompiledTrainStep path feeds
+            from ..jit import _metrics as _step_metrics
+            obs.update(_step_metrics())
         return obs
+
+    def _pick_bucket(self, n, can_pad):
+        """Bucket for this batch: powers of two up to the largest batch
+        seen (jit.CompiledTrainStep's policy), rounded up to the mesh's
+        dp extent so the batch axis stays evenly shardable. Padding is
+        held off until the first trace proved the loss is per-sample
+        (a pre-reduced scalar loss cannot be pad-corrected)."""
+        self._max_batch = max(self._max_batch, n)
+        if not can_pad or self._buckets is None \
+                or self._loss_scalar is not False:
+            return n
+        from ..jit import pick_train_bucket
+        b = pick_train_bucket(n, self._buckets, self._max_batch)
+        dp = dict(self._mesh.shape).get("dp", 1)
+        if b % dp:
+            b += dp - (b % dp)
+        return b
+
+    @staticmethod
+    def _pad_rows(v, bucket):
+        from ..jit import pad_rows
+        return pad_rows(v, bucket)
 
     def step(self, x, y):
         """One SPMD training step; returns the (replicated) scalar loss."""
@@ -229,21 +277,29 @@ class ShardedTrainer:
         self._ensure_init(x)
         if self._step_jit is None:
             self._step_jit = self._build_step()
-        xb = shard_batch(x, self._mesh)._data if not (
-            isinstance(x, NDArray) and _is_sharded(x._data)) else x._data
-        yb = shard_batch(y, self._mesh)._data if not (
-            isinstance(y, NDArray) and _is_sharded(y._data)) else y._data
+        presharded_x = isinstance(x, NDArray) and _is_sharded(x._data)
+        presharded_y = isinstance(y, NDArray) and _is_sharded(y._data)
+        n = int(x.shape[0])
+        can_pad = not (presharded_x or presharded_y) \
+            and not _spans_processes(self._mesh)
+        bucket = self._pick_bucket(n, can_pad)
+        if bucket != n:
+            x, y = self._pad_rows(x, bucket), self._pad_rows(y, bucket)
+            obs["padded_rows"].inc(bucket - n)
+        xb = shard_batch(x, self._mesh)._data if not presharded_x \
+            else x._data
+        yb = shard_batch(y, self._mesh)._data if not presharded_y \
+            else y._data
         self._rngkey, sub = jax.random.split(self._rngkey)
         t = jnp.asarray(self._step_count + 1, jnp.float32)
         self._params, self._opt_states, loss = self._step_jit(
-            self._params, self._opt_states, sub, t, xb, yb)
+            self._params, self._opt_states, sub, t, n, xb, yb)
         self._step_count += 1
         obs["secs"].observe(_time.monotonic() - t0)
         obs["steps"].inc()
-        try:
-            obs["examples"].inc(int(x.shape[0]))
-        except Exception:
-            pass
+        obs["dispatch"].inc()
+        obs["compiled"].inc()
+        obs["examples"].inc(n)  # real rows, not the padded bucket
         from ..resilience import faults
         faults.on_step(self._step_count)
         if _spans_processes(self._mesh):

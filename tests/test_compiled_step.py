@@ -1,0 +1,544 @@
+"""Whole-step compilation (jit.CompiledTrainStep): bit-exact parity with
+the eager record/backward path, single-dispatch steady state, zero
+recompiles across lr changes and bucketed batch tails, AMP overflow
+skip, checkpoint resume mid-run, and the guarded fallback reasons."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn, Trainer
+import mxnet_tpu.autograd as ag
+from mxnet_tpu.observability import get_registry, \
+    install_jax_monitoring_bridge
+
+LOSS = gluon.loss.SoftmaxCrossEntropyLoss()
+
+
+def _build(seed=0, ctx=None, hybrid=False, bn=False):
+    """Fresh MLP with deferred init RESOLVED (so two same-seed builds
+    draw identical host-rng streams regardless of later forward
+    order)."""
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        if bn:
+            net.add(nn.Dense(16), nn.BatchNorm(), nn.Activation("relu"),
+                    nn.Dense(4))
+        else:
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(init=mx.initializer.Xavier(), ctx=ctx)
+    with ag.pause(train_mode=False):
+        net(nd.array(np.zeros((1, 6), np.float32)))
+    if hybrid:
+        net.hybridize()
+    return net
+
+
+def _data(steps=5, n=32):
+    rng = np.random.RandomState(7)
+    X = rng.randn(steps, n, 6).astype(np.float32)
+    Y = (np.arange(steps * n).reshape(steps, n) % 4).astype(np.float32)
+    return X, Y
+
+
+def _eager_run(net, opt, opt_args, sizes, lrs=None, kvstore="device"):
+    tr = Trainer(net.collect_params(), opt, dict(opt_args),
+                 kvstore=kvstore)
+    X, Y = _data(len(sizes))
+    losses = []
+    for s, n in enumerate(sizes):
+        if lrs:
+            tr.set_learning_rate(lrs[s % len(lrs)])
+        with ag.record():
+            l = LOSS(net(nd.array(X[s][:n])), nd.array(Y[s][:n]))
+        l.backward()
+        tr.step(n)
+        losses.append(l.asnumpy())
+    return tr, losses
+
+
+def _compiled_run(net, opt, opt_args, sizes, lrs=None, kvstore="device",
+                  **step_kw):
+    tr = Trainer(net.collect_params(), opt, dict(opt_args),
+                 kvstore=kvstore)
+    step = tr.compile_step(lambda x, y: LOSS(net(x), y), **step_kw)
+    X, Y = _data(len(sizes))
+    losses = []
+    for s, n in enumerate(sizes):
+        if lrs:
+            tr.set_learning_rate(lrs[s % len(lrs)])
+        losses.append(step(nd.array(X[s][:n]), nd.array(Y[s][:n]))
+                      .asnumpy())
+    return tr, step, losses
+
+
+def _params_of(net):
+    return {k: p.data().asnumpy().copy()
+            for k, p in net.collect_params().items()}
+
+
+def _assert_params_bitexact(net_a, net_b):
+    for (ka, pa), (kb, pb) in zip(sorted(net_a.collect_params().items()),
+                                  sorted(net_b.collect_params().items())):
+        assert (pa.data().asnumpy() == pb.data().asnumpy()).all(), \
+            f"parameter {ka} differs (not bit-exact)"
+
+
+@pytest.mark.parametrize("opt,args", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 1e-3, "wd": 1e-3}),
+])
+def test_parity_bitexact(opt, args):
+    """Acceptance: ≥5 steps, losses AND weights AND optimizer slots
+    bit-exact with the eager record/backward path, across lr changes and
+    batch-size changes (Adam bias correction included: the same host
+    phase-A pass that makes the fused update exact drives this)."""
+    sizes = [32, 16, 32, 16, 32]          # pow2 sizes: full buckets
+    lrs = [0.05, 0.02, 0.05, 0.01]
+    net_e = _build()
+    tr_e, el = _eager_run(net_e, opt, args, sizes, lrs)
+    net_c = _build()
+    tr_c, step, cl = _compiled_run(net_c, opt, args, sizes, lrs)
+    assert step.last_reason is None, step.last_reason
+    for s in range(len(sizes)):
+        assert (el[s] == cl[s]).all(), f"step {s} loss not bit-exact"
+    _assert_params_bitexact(net_e, net_c)
+    assert tr_e._optimizer._index_update_count == \
+        tr_c._optimizer._index_update_count
+    assert tr_e._optimizer.num_update == tr_c._optimizer.num_update
+    import jax
+    sa, sb = tr_e._updaters[0].states, tr_c._updaters[0].states
+    assert sorted(sa) == sorted(sb)
+    for k in sa:
+        for la, lb in zip(jax.tree_util.tree_leaves(sa[k]),
+                          jax.tree_util.tree_leaves(sb[k])):
+            assert (la.asnumpy() == lb.asnumpy()).all(), \
+                f"optimizer slot {k} differs"
+
+
+def test_parity_hybridized():
+    """A hybridized block traces into the whole-step program through its
+    eager forward (the CachedOp is bypassed under the trace) and stays
+    bit-exact with hybridized eager training."""
+    sizes = [32, 32, 32, 32, 32]
+    net_e = _build(hybrid=True)
+    _, el = _eager_run(net_e, "sgd", {"learning_rate": 0.05}, sizes)
+    net_c = _build(hybrid=True)
+    _, step, cl = _compiled_run(net_c, "sgd", {"learning_rate": 0.05},
+                                sizes)
+    assert step.last_reason is None
+    for s in range(5):
+        assert (el[s] == cl[s]).all()
+    _assert_params_bitexact(net_e, net_c)
+
+
+def test_parity_multictx():
+    """Per-context replicated parameters: the compiled step runs the
+    batch on the primary context and broadcasts the updated weights —
+    every replica ends identical, bit-exact with the eager path (whose
+    tree-sum reduce over one real + N zero gradients is the identity)."""
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    sizes = [32, 32, 32, 32, 32]
+    net_e = _build(ctx=ctxs)
+    _, el = _eager_run(net_e, "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9}, sizes,
+                       kvstore=None)
+    net_c = _build(ctx=ctxs)
+    _, step, cl = _compiled_run(
+        net_c, "sgd", {"learning_rate": 0.05, "momentum": 0.9}, sizes,
+        kvstore=None)
+    assert step.last_reason is None
+    for s in range(5):
+        assert (el[s] == cl[s]).all()
+    for k, p in net_c.collect_params().items():
+        reps = [d.asnumpy() for d in p.list_data()]
+        assert (reps[0] == reps[1]).all(), f"{k} replicas diverged"
+    _assert_params_bitexact(net_e, net_c)
+
+
+def test_single_dispatch_steady_state():
+    """CI smoke (acceptance criterion): a 2-step train through the
+    compiled path — after warmup, ONE device dispatch and ZERO XLA
+    compiles per step, loss parity with eager."""
+    install_jax_monitoring_bridge()
+    reg = get_registry()
+    dispatch = reg.counter("mxtpu_train_step_dispatch_total")
+    compiles = reg.counter("mxtpu_xla_compile_total")
+
+    net_e = _build()
+    _, el = _eager_run(net_e, "sgd", {"learning_rate": 0.05}, [32, 32])
+    net_c = _build()
+    tr_c = Trainer(net_c.collect_params(), "sgd", {"learning_rate": 0.05})
+    step = tr_c.compile_step(lambda x, y: LOSS(net_c(x), y))
+    X, Y = _data(2)
+    l0 = step(nd.array(X[0]), nd.array(Y[0]))      # warmup: compiles
+    d0, c0 = dispatch.value, compiles.value
+    l1 = step(nd.array(X[1]), nd.array(Y[1]))
+    assert dispatch.value - d0 == 1, \
+        f"steady-state step took {dispatch.value - d0} dispatches, not 1"
+    assert compiles.value - c0 == 0, "steady-state step recompiled"
+    assert (l0.asnumpy() == el[0]).all()
+    assert (l1.asnumpy() == el[1]).all()
+
+
+def test_zero_recompile_lr_and_tails():
+    """After one warmup per bucket, lr/batch-size changes and ragged
+    tails mapped to warm buckets must be recompile-free (asserted via
+    the jax.monitoring backend_compile counter)."""
+    install_jax_monitoring_bridge()
+    reg = get_registry()
+    compiles = reg.counter("mxtpu_xla_compile_total")
+    net = _build()
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = tr.compile_step(lambda x, y: LOSS(net(x), y))
+    X, Y = _data(10)
+    step(nd.array(X[0]), nd.array(Y[0]))            # bucket 32
+    step(nd.array(X[1][:20]), nd.array(Y[1][:20]))  # tail->32 (pad ops)
+    step(nd.array(X[2][:7]), nd.array(Y[2][:7]))    # bucket 8
+    c0 = compiles.value
+    for s, n in enumerate([32, 20, 32, 7, 20, 32], start=3):
+        tr.set_learning_rate(1e-3 * (s + 1))
+        step(nd.array(X[s][:n]), nd.array(Y[s][:n]))
+    assert compiles.value - c0 == 0, \
+        "lr change or warmed batch tail recompiled the step"
+    assert step.cache_size() == 2       # one program per bucket
+
+    # an UNSEEN tail size pays only O(ms) pad/slice glue compiles —
+    # never a step-program rebuild (the expensive compile)
+    bucket_compiles = reg.counter("mxtpu_train_step_bucket_compiles_total",
+                                  labelnames=("bucket",))
+    b0 = sum(c.value for c in bucket_compiles.children())
+    step(nd.array(X[9][:19]), nd.array(Y[9][:19]))  # 19 -> warm bucket 32
+    assert step.cache_size() == 2
+    assert sum(c.value for c in bucket_compiles.children()) == b0, \
+        "an unseen tail size rebuilt a whole-step program"
+
+
+def test_bucket_tail_semantics():
+    """A padded tail's per-sample losses equal the unpadded eager step's
+    bitwise (pad rows cannot touch real rows' forward); the update
+    matches to reduction-reassociation tolerance (batch-summed grads
+    see the +0 pad rows)."""
+    net_e = _build()
+    _, el = _eager_run(net_e, "sgd", {"learning_rate": 0.05}, [32, 20])
+    net_c = _build()
+    _, step, cl = _compiled_run(net_c, "sgd", {"learning_rate": 0.05},
+                                [32, 20])
+    assert cl[1].shape == (20,)
+    assert (el[1] == cl[1]).all(), "tail losses not bit-exact"
+    for (ka, pa), (kb, pb) in zip(sorted(net_e.collect_params().items()),
+                                  sorted(net_c.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(),
+                                   rtol=1e-6, atol=1e-7, err_msg=ka)
+    reg = get_registry()
+    assert reg.counter("mxtpu_train_step_padded_rows_total").value >= 12
+
+
+def test_amp_scaled_parity_and_overflow_skip():
+    """LossScaler rescale rides as a traced scalar (scaled runs stay
+    bit-exact with eager AMP); a forced overflow skips the update
+    IN-PROGRAM: weights/slots unchanged, scale halves, no step tick —
+    exactly the eager amp_step contract."""
+    from mxnet_tpu import amp
+    sizes = [16, 16, 16, 16]
+    X, Y = _data(len(sizes), 16)
+
+    def amp_eager(net):
+        tr = Trainer(net.collect_params(), "sgd", {"learning_rate": .05})
+        amp.init_trainer(tr, loss_scaler=amp.LossScaler(
+            init_scale=64.0, target_dtype="float16"))
+        for s, n in enumerate(sizes):
+            with ag.record():
+                l = LOSS(net(nd.array(X[s][:n])), nd.array(Y[s][:n]))
+                with amp.scale_loss(l, tr) as scaled:
+                    pass
+            scaled.backward()
+            tr.step(n)
+        return tr
+
+    net_e = _build(3)
+    amp_eager(net_e)
+    net_c = _build(3)
+    tr_c = Trainer(net_c.collect_params(), "sgd", {"learning_rate": .05})
+    amp.init_trainer(tr_c, loss_scaler=amp.LossScaler(
+        init_scale=64.0, target_dtype="float16"))
+    step = tr_c.compile_step(lambda x, y: LOSS(net_c(x), y))
+    for s, n in enumerate(sizes):
+        step(nd.array(X[s][:n]), nd.array(Y[s][:n]))
+    assert step.last_reason is None
+    _assert_params_bitexact(net_e, net_c)
+    assert tr_c._amp_loss_scaler.loss_scale == 64.0
+
+    # overflow: a loss scale beyond float32 range makes every gradient
+    # non-finite; the in-program where() must keep the weights
+    net_o = _build(4)
+    tr_o = Trainer(net_o.collect_params(), "sgd", {"learning_rate": .05})
+    amp.init_trainer(tr_o, loss_scaler=amp.LossScaler(
+        init_scale=1e39, target_dtype="float16"))
+    stepo = tr_o.compile_step(lambda x, y: LOSS(net_o(x), y))
+    before = _params_of(net_o)
+    with pytest.warns(UserWarning, match="overflow"):
+        stepo(nd.array(X[0]), nd.array(Y[0]))
+    assert tr_o._amp_loss_scaler.loss_scale == 5e38
+    assert tr_o._step_count == 0
+    for k, v in before.items():
+        assert (net_o.collect_params()[k].data().asnumpy() == v).all(), \
+            f"{k} changed despite overflow skip"
+
+
+def test_bn_aux_states_update_in_program():
+    """BatchNorm running stats are captured as program outputs and
+    written back; values track eager training to fusion tolerance (XLA
+    reassociates the batch-stat reductions inside the whole program —
+    exact bitwise parity is a no-reduction-fusion property)."""
+    sizes = [32] * 4
+    net_e = _build(bn=True)
+    _, el = _eager_run(net_e, "sgd", {"learning_rate": 0.05}, sizes)
+    net_c = _build(bn=True)
+    _, step, cl = _compiled_run(net_c, "sgd", {"learning_rate": 0.05},
+                                sizes)
+    assert step.last_reason is None
+    for s in range(4):
+        np.testing.assert_allclose(el[s], cl[s], rtol=1e-5, atol=1e-6)
+    moved = False
+    for (ka, pa), (kb, pb) in zip(sorted(net_e.collect_params().items()),
+                                  sorted(net_c.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(),
+                                   rtol=1e-4, atol=1e-6, err_msg=ka)
+        if "running" in ka and pb.data().asnumpy().any():
+            moved = True
+    assert moved, "BN running stats never updated under the compiled step"
+
+
+def test_remat_stays_correct():
+    """remat='dots'/'full' (the memory-headroom lever) recomputes the
+    forward in the backward without changing the trained result."""
+    sizes = [32] * 3
+    net_e = _build(5)
+    _, el = _eager_run(net_e, "sgd", {"learning_rate": 0.05}, sizes)
+    for remat in ("dots", "full"):
+        net_c = _build(5)
+        _, step, cl = _compiled_run(net_c, "sgd", {"learning_rate": 0.05},
+                                    sizes, remat=remat)
+        assert step.last_reason is None
+        for s in range(3):
+            np.testing.assert_allclose(el[s], cl[s], rtol=1e-6,
+                                       atol=1e-7)
+        for (ka, pa), (kb, pb) in zip(
+                sorted(net_e.collect_params().items()),
+                sorted(net_c.collect_params().items())):
+            np.testing.assert_allclose(pa.data().asnumpy(),
+                                       pb.data().asnumpy(),
+                                       rtol=1e-6, atol=1e-7, err_msg=ka)
+
+
+def test_checkpoint_resume_midrun():
+    """save_state after 3 compiled steps + restore into a fresh process
+    image resumes bit-exactly (optimizer slots, Adam counters, and the
+    RNG draw position all ride the resilience checkpoint)."""
+    import tempfile
+    sizes = [32] * 5
+    X, Y = _data(5)
+    with tempfile.TemporaryDirectory() as run_dir:
+        net_a = _build(6)
+        tr_a = Trainer(net_a.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+        step_a = tr_a.compile_step(lambda x, y: LOSS(net_a(x), y))
+        for s in range(3):
+            step_a(nd.array(X[s]), nd.array(Y[s]))
+        tr_a.save_state(run_dir)
+        for s in range(3, 5):
+            step_a(nd.array(X[s]), nd.array(Y[s]))
+        final_a = _params_of(net_a)
+
+        net_b = _build(7)      # different init: restore must overwrite
+        tr_b = Trainer(net_b.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+        tr_b.restore_state(run_dir)
+        step_b = tr_b.compile_step(lambda x, y: LOSS(net_b(x), y))
+        for s in range(3, 5):
+            step_b(nd.array(X[s]), nd.array(Y[s]))
+        assert tr_b._step_count == 5
+        # name prefixes differ between builds; compare by position
+        pa = [p.data().asnumpy() for _, p in
+              sorted(net_a.collect_params().items())]
+        pb = [p.data().asnumpy() for _, p in
+              sorted(net_b.collect_params().items())]
+        for i, (a, b) in enumerate(zip(pa, pb)):
+            assert (a == b).all(), \
+                f"param #{i} diverged after mid-run resume"
+
+
+def test_fallback_reasons_and_parity():
+    """Ineligible configurations run the eager path (same numbers),
+    counted by reason; data-dependent Python control flow is detected at
+    trace time and sticks to eager."""
+    reg = get_registry()
+    fallback = reg.counter("mxtpu_train_step_fallback_total",
+                           labelnames=("reason",))
+    X, Y = _data(2)
+
+    # host-state optimizer -> 'optimizer'
+    net = _build(8)
+    tr = Trainer(net.collect_params(), "nadam", {"learning_rate": 1e-3})
+    step = tr.compile_step(lambda x, y: LOSS(net(x), y))
+    before = fallback.labels(reason="optimizer").value
+    step(nd.array(X[0]), nd.array(Y[0]))
+    assert fallback.labels(reason="optimizer").value == before + 1
+    assert step.last_reason == "optimizer"
+
+    # env kill-switch -> 'env_disabled', numbers identical to eager
+    os.environ["MXNET_TPU_COMPILED_STEP"] = "0"
+    try:
+        net_e = _build(9)
+        _, el = _eager_run(net_e, "sgd", {"learning_rate": .05}, [32, 32])
+        net_c = _build(9)
+        _, stepc, cl = _compiled_run(net_c, "sgd",
+                                     {"learning_rate": .05}, [32, 32])
+        assert stepc.last_reason == "env_disabled"
+        for s in range(2):
+            assert (el[s] == cl[s]).all()
+        _assert_params_bitexact(net_e, net_c)
+    finally:
+        del os.environ["MXNET_TPU_COMPILED_STEP"]
+
+    # data-dependent Python control flow -> trace_failed, sticky, but
+    # training continues (eager) and still learns
+    net_d = _build(10)
+    tr_d = Trainer(net_d.collect_params(), "sgd", {"learning_rate": .05})
+
+    def branchy_loss(x, y):
+        out = net_d(x)
+        if float(out.asnumpy().sum()) > 1e9:   # host sync on a tracer
+            out = out * 2
+        return LOSS(out, y)
+
+    step_d = tr_d.compile_step(branchy_loss)
+    w0 = _params_of(net_d)
+    with pytest.warns(UserWarning, match="trace failed"):
+        step_d(nd.array(X[0]), nd.array(Y[0]))
+    assert step_d.last_reason == "trace_failed"
+    step_d(nd.array(X[1]), nd.array(Y[1]))     # sticky: no retrace
+    assert step_d.last_reason == "trace_failed"
+    assert any((net_d.collect_params()[k].data().asnumpy() != v).any()
+               for k, v in w0.items()), "fallback did not train"
+
+
+def test_frozen_subset_trainer_promotes_untracked_params():
+    """Fine-tuning: only HALF the parameters are in the Trainer. The
+    frozen parameters the loss reads are promoted to program inputs (not
+    baked constants), so mutating one later is picked up without a stale
+    result; the trained half stays bit-exact with eager."""
+    X, Y = _data(3)
+    net_e = _build(11)
+    head = {k: p for k, p in net_e.collect_params().items()
+            if "dense1" in k}
+    tr_e = Trainer(head, "sgd", {"learning_rate": 0.05})
+    el = []
+    for s in range(3):
+        with ag.record():
+            l = LOSS(net_e(nd.array(X[s])), nd.array(Y[s]))
+        l.backward()
+        tr_e.step(32)
+        el.append(l.asnumpy())
+
+    net_c = _build(11)
+    head_c = {k: p for k, p in net_c.collect_params().items()
+              if "dense1" in k}
+    tr_c = Trainer(head_c, "sgd", {"learning_rate": 0.05})
+    step = tr_c.compile_step(lambda x, y: LOSS(net_c(x), y))
+    for s in range(3):
+        lc = step(nd.array(X[s]), nd.array(Y[s]))
+        assert (el[s] == lc.asnumpy()).all()
+    assert step.last_reason is None
+    _assert_params_bitexact(net_e, net_c)
+
+    # mutate a frozen param: the next compiled step must see it
+    for k, p in net_c.collect_params().items():
+        if "dense0_weight" in k:
+            p.set_data(p.data() * 0.0)
+    lc = step(nd.array(X[0]), nd.array(Y[0])).asnumpy()
+    for k, p in net_e.collect_params().items():
+        if "dense0_weight" in k:
+            p.set_data(p.data() * 0.0)
+    with ag.record():
+        le = LOSS(net_e(nd.array(X[0])), nd.array(Y[0]))
+    le.backward()
+    tr_e.step(32)
+    assert (le.asnumpy() == lc).all(), \
+        "compiled step served a stale frozen parameter"
+
+
+def test_estimator_compiled_step():
+    """Estimator.fit(compiled_step=True): the batch loop runs through
+    ONE dispatch per batch (GradientUpdateHandler skips its step), and
+    the trained parameters match a plain eager Estimator fit."""
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.metric import Accuracy
+    X, Y = _data(4)
+    batches = [(nd.array(X[s]), nd.array(Y[s])) for s in range(4)]
+
+    def fit(compiled):
+        net = _build(12)
+        est = Estimator(net, LOSS, train_metrics=[Accuracy()],
+                        trainer=Trainer(net.collect_params(), "sgd",
+                                        {"learning_rate": 0.05}))
+        est.fit(batches, epochs=1, compiled_step=compiled)
+        return net, est
+
+    reg = get_registry()
+    compiled_ctr = reg.counter("mxtpu_train_step_compiled_total")
+    net_e, _ = fit(False)
+    c0 = compiled_ctr.value
+    net_c, est_c = fit(True)
+    assert compiled_ctr.value - c0 == 4, \
+        "estimator batches did not run through the compiled step"
+    _assert_params_bitexact(net_e, net_c)
+    # the update happened exactly once per batch (a double step would
+    # change num_update)
+    assert est_c.trainer._optimizer.num_update == 4
+
+
+def test_device_prefetch_feeds_compiled_step():
+    """DevicePrefetchIter -> CompiledTrainStep: staged batches keep
+    order and the steady state stays one dispatch per step."""
+    from mxnet_tpu.gluon.data.prefetch import DevicePrefetchIter
+    reg = get_registry()
+    dispatch = reg.counter("mxtpu_train_step_dispatch_total")
+    X, Y = _data(4)
+    net = _build(13)
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    step = tr.compile_step(lambda x, y: LOSS(net(x), y))
+    src = [(nd.array(X[s]), nd.array(Y[s])) for s in range(4)]
+    it = iter(DevicePrefetchIter(src, depth=2))
+    step(*next(it))                     # warmup compile
+    d0 = dispatch.value
+    losses = [step(*b).asnumpy() for b in it]
+    assert dispatch.value - d0 == 3
+    assert all(np.isfinite(l).all() for l in losses)
+
+
+def test_sharded_trainer_tail_bucket_no_retrace():
+    """parallel.ShardedTrainer: a ragged tail pads to the warm bucket
+    instead of retracing the SPMD step program."""
+    install_jax_monitoring_bridge()
+    from mxnet_tpu import parallel
+    reg = get_registry()
+    compiles = reg.counter("mxtpu_xla_compile_total")
+    net = _build(14)
+    tr = parallel.ShardedTrainer(
+        net, LOSS, "sgd", {"learning_rate": 0.05})
+    rng = np.random.RandomState(3)
+    x32 = rng.randn(32, 6).astype(np.float32)
+    y32 = (np.arange(32) % 4).astype(np.float32)
+    tr.step(x32, y32)                   # trace @ bucket 32
+    tr.step(x32, y32)
+    c0 = compiles.value
+    l = tr.step(x32[:20], y32[:20])     # tail -> padded to 32
+    assert compiles.value - c0 == 0, "batch tail retraced the SPMD step"
+    assert np.isfinite(float(l.asscalar()))

@@ -186,6 +186,13 @@ def smoke():
             loss.backward()
             trainer.step(8)
 
+    # whole-step compilation: 2 one-dispatch steps (+ a bucketed tail)
+    # must land on the mxtpu_train_step_* series
+    step = trainer.compile_step(lambda a, b: loss_fn(net(a), b))
+    for _ in range(2):
+        step(x, y)
+    step(x[:5], y[:5])   # ragged tail -> padded bucket, not a retrace
+
     # resilience: one checkpoint commit + restore
     with tempfile.TemporaryDirectory() as run_dir:
         trainer.save_state(run_dir)
@@ -213,6 +220,19 @@ def smoke():
             return 1
     if samples[("mxtpu_training_steps_total", ())] < 2:
         print("SMOKE FAIL: step timer did not count 2 steps")
+        return 1
+    if samples.get(("mxtpu_train_step_dispatch_total", ())) != 3 or \
+            samples.get(("mxtpu_train_step_compiled_total", ())) != 3:
+        print("SMOKE FAIL: compiled train step did not report 3 "
+              "one-dispatch steps "
+              f"(dispatch={samples.get(('mxtpu_train_step_dispatch_total', ()))})")
+        return 1
+    if samples.get(("mxtpu_train_step_padded_rows_total", ())) != 3:
+        print("SMOKE FAIL: bucketed tail did not report its pad rows")
+        return 1
+    if not any(n == "mxtpu_train_step_bucket_compiles_total"
+               for n, _ in samples):
+        print("SMOKE FAIL: no per-bucket compile counter in exposition")
         return 1
 
     # JSONL round-trip through the env-gated writer

@@ -47,8 +47,14 @@ TRACE_ROOT = os.path.join(REPO, "perf_traces")
 # the round's headline protocol; later entries are the PERF.md lever
 # queue (bigger batch amortises overhead; fp32/NCHW is the reference
 # parity protocol). Each entry: (tag, env overrides).
+# Every config now runs training through CompiledTrainStep by default
+# (bench.py BENCH_COMPILED_STEP=1) — the runtime path users pay for —
+# with one jax-scan control config for the dispatch-overhead A/B. The
+# lever queue (bs=256, BN-fused-backward, remat) is expressed with the
+# same env vars bench.py's --batch/--bn-fused-bwd/--remat flags set.
 CONFIGS = [
     ("bs128_bf16_nhwc", {}),
+    ("bs128_bf16_nhwc_scanctl", {"BENCH_COMPILED_STEP": "0"}),
     ("bs128_bf16_nhwc_bnfuse", {"MXNET_TPU_BN_FUSED_BWD": "1"}),
     ("bs256_bf16_nhwc", {"BENCH_BATCH": "256"}),
     ("bs256_bf16_nhwc_bnfuse", {"BENCH_BATCH": "256",
@@ -169,6 +175,8 @@ def emit_bench_snapshot(rec):
     if img_s is None:
         img_s = extra.get("train_img_s")
     compiles = _metric_value(snap, "mxtpu_xla_compile_total")
+    step_dispatch = _metric_value(snap, "mxtpu_train_step_dispatch_total")
+    step_compiled = _metric_value(snap, "mxtpu_train_step_compiled_total")
     nn = _next_bench_round()
     path = os.path.join(REPO, f"BENCH_r{nn:02d}.json")
     with open(path, "w") as f:
@@ -184,6 +192,9 @@ def emit_bench_snapshot(rec):
             "step_time_s": step_s,
             "examples_per_sec": img_s,
             "xla_compiles": compiles,
+            "train_step_dispatches": step_dispatch,
+            "train_step_compiled": step_compiled,
+            "dispatch": extra.get("dispatch"),
             "device_kind": extra.get("device_kind"),
             "metrics_log": cap.get("metrics_log"),
         }, f, indent=1)
