@@ -177,24 +177,35 @@ class Estimator:
                 train_data = DevicePrefetchIter(train_data,
                                                 depth=device_prefetch)
 
+        from ....observability.tracing import get_tracer
+        tracer = get_tracer()
         self.stop_training = False
         for h in train_begin:
             h.train_begin(self)
+        epoch = 0
         while not self.stop_training:
-            for h in epoch_begin:
-                h.epoch_begin(self)
-            for batch in train_data:
-                for h in batch_begin:
-                    h.batch_begin(self, batch=batch)
-                data, label, pred, loss = self.fit_batch(batch)
-                for h in batch_end:
-                    h.batch_end(self, batch=batch, pred=pred,
-                                label=label, loss=loss)
-                self._sync_stop(handlers)
-                if self.stop_training:
-                    break
-            for h in epoch_end:
-                h.epoch_end(self)
+            # the epoch span parents everything the epoch causes — the
+            # per-batch train_step spans AND the DevicePrefetchIter
+            # staging spans on their worker thread (captured context).
+            # NOT step-category: the per-batch spans inside it own the
+            # device StepTraceAnnotation.
+            with tracer.span("mxtpu.estimator.epoch", "epoch", None,
+                             {"epoch": epoch}):
+                for h in epoch_begin:
+                    h.epoch_begin(self)
+                for batch in train_data:
+                    for h in batch_begin:
+                        h.batch_begin(self, batch=batch)
+                    data, label, pred, loss = self.fit_batch(batch)
+                    for h in batch_end:
+                        h.batch_end(self, batch=batch, pred=pred,
+                                    label=label, loss=loss)
+                    self._sync_stop(handlers)
+                    if self.stop_training:
+                        break
+                for h in epoch_end:
+                    h.epoch_end(self)
+            epoch += 1
             self._sync_stop(handlers)
         for h in train_end:
             h.train_end(self)

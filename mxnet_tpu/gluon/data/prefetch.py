@@ -136,12 +136,21 @@ class DevicePrefetchIter:
         stop = threading.Event()
         src = iter(self._source)
         device, do_stage, obs = self._device, self._stage, self._obs
+        from ...observability.tracing import get_tracer
+        tracer = get_tracer()
+        # the consumer's current span, captured at iteration start: the
+        # staging worker's spans parent under it so an exported trace
+        # shows H2D staging hanging off the training loop that asked
+        # for it (contextvars do not cross threads on their own)
+        parent = tracer.current()
 
         def producer():
             try:
                 for item in src:
                     if do_stage:
-                        item = stage_batch(item, device)
+                        with tracer.span("mxtpu.data_prefetch.stage",
+                                         "data", parent):
+                            item = stage_batch(item, device)
                         obs["batches"].inc()  # obs present when staging
                     while not stop.is_set():
                         try:

@@ -18,6 +18,11 @@ __all__ = ["Trainer"]
 _TREE_SUM = None
 
 
+def _tracer():
+    from ..observability.tracing import get_tracer
+    return get_tracer()
+
+
 def _tree_sum_jit():
     """One jitted program summing each parameter's per-context replicas
     (input: tuple over params of tuple over ctx of arrays, all staged on
@@ -261,19 +266,21 @@ class Trainer:
             self._init_kvstore()
         obs = self._obs_metrics()
         t0 = _time.monotonic()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        fused_reason = self._fused_updater().why_ineligible(
-            self._params, ignore_stale_grad)
-        fold = self._fold_reduce_ok(obs, fused_reason)
-        if not fold:
-            self._allreduce_grads()
-        if obs["want_grad_norm"]:
-            try:
-                self._observe_grad_norm(obs)
-            except Exception:
-                pass
-        self._update(ignore_stale_grad, _fold_reduce=fold,
-                     _fused_reason=fused_reason)
+        with _tracer().span("mxtpu.trainer.step", "step", None, None,
+                            self._step_count):
+            self._optimizer.rescale_grad = self._scale / batch_size
+            fused_reason = self._fused_updater().why_ineligible(
+                self._params, ignore_stale_grad)
+            fold = self._fold_reduce_ok(obs, fused_reason)
+            if not fold:
+                self._allreduce_grads()
+            if obs["want_grad_norm"]:
+                try:
+                    self._observe_grad_norm(obs)
+                except Exception:
+                    pass
+            self._update(ignore_stale_grad, _fold_reduce=fold,
+                         _fused_reason=fused_reason)
         obs["secs"].observe(_time.monotonic() - t0)
         obs["steps"].inc()
         obs["examples"].inc(batch_size)

@@ -134,6 +134,13 @@ def pad_rows(v, bucket):
         axis=0)
 
 
+def _tracer():
+    """The process tracer (lazy import keeps `import mxnet_tpu` light;
+    get_tracer itself is one lock-free global read after first use)."""
+    from .observability.tracing import get_tracer
+    return get_tracer()
+
+
 def _metrics():
     from .observability import get_registry
     reg = get_registry()
@@ -250,24 +257,29 @@ class CompiledTrainStep:
             tr._init_kvstore()
         obs = self._obs_metrics()
         t0 = _time.monotonic()
-        reason = self._why_ineligible()
-        if reason is not None:
-            return self._eager_step(args, reason)
-        try:
-            return self._compiled_step(args, obs, t0)
-        except _Fallback as e:
-            if e.reason == "scalar_loss_bucketed":
-                # a pre-reduced loss cannot be pad-corrected: drop the
-                # bucketing (exact shapes still compile whole-step) and
-                # retry once
-                self._buckets = None
-                try:
-                    return self._compiled_step(args, obs, t0)
-                except _Fallback as e2:
-                    e = e2
-            if e.reason in _STICKY_REASONS:
-                self._disabled = e.reason
-            return self._eager_step(args, e.reason)
+        # the step span (no kwargs, no attrs: the disabled path must
+        # allocate nothing per step); trace/compile/dispatch/fallback
+        # appear as children via contextvar nesting
+        with _tracer().span("mxtpu.train_step", "step", None, None,
+                            tr._step_count):
+            reason = self._why_ineligible()
+            if reason is not None:
+                return self._eager_step(args, reason)
+            try:
+                return self._compiled_step(args, obs, t0)
+            except _Fallback as e:
+                if e.reason == "scalar_loss_bucketed":
+                    # a pre-reduced loss cannot be pad-corrected: drop
+                    # the bucketing (exact shapes still compile whole-
+                    # step) and retry once
+                    self._buckets = None
+                    try:
+                        return self._compiled_step(args, obs, t0)
+                    except _Fallback as e2:
+                        e = e2
+                if e.reason in _STICKY_REASONS:
+                    self._disabled = e.reason
+                return self._eager_step(args, e.reason)
 
     # ---------------------------------------------------- the fast path --
     def _compiled_step(self, args, obs, t0):
@@ -349,15 +361,18 @@ class CompiledTrainStep:
             with self._lock:
                 entry = self._cache.get(key)
                 if entry is None:
-                    try:
-                        entry = self._build(
-                            rec.program, work, nts, in_fmt, flags, opaque,
-                            bucket, engaged,
-                            (weights, states, scalars, ls, n, rng_base,
-                             rng_draw, batch_vals))
-                    except _Fallback:
-                        _fused.rollback_counts(opt, work)
-                        raise
+                    with _tracer().span("mxtpu.train_step.compile",
+                                        "step") as _sp:
+                        _sp.set("bucket", bucket)
+                        try:
+                            entry = self._build(
+                                rec.program, work, nts, in_fmt, flags,
+                                opaque, bucket, engaged,
+                                (weights, states, scalars, ls, n,
+                                 rng_base, rng_draw, batch_vals))
+                        except _Fallback:
+                            _fused.rollback_counts(opt, work)
+                            raise
                     self._cache[key] = entry
                     obs["bucket_compiles"].labels(bucket=str(bucket)).inc()
         compiled, meta = entry
@@ -365,8 +380,9 @@ class CompiledTrainStep:
         nt_all = meta["nt_params"]
         nt_vals = [p._get_primary()._data for p in nt_all]
         try:
-            outs = compiled(weights, nt_vals, states, scalars, ls, n,
-                            rng_base, rng_draw, batch_vals)
+            with _tracer().span("mxtpu.train_step.dispatch", "step"):
+                outs = compiled(weights, nt_vals, states, scalars, ls, n,
+                                rng_base, rng_draw, batch_vals)
         except Exception:
             if any(w.is_deleted() for w in weights) or \
                     any(s.is_deleted() for s in states):
@@ -684,13 +700,16 @@ class CompiledTrainStep:
             if f and getattr(v, "ndim", 0):
                 n = int(v.shape[0])
                 break
-        with autograd.record():
-            out = self._loss_fn(*args)
-            loss = out[0] if isinstance(out, tuple) else out
-            head = loss * scaler.loss_scale \
-                if scaler is not None and scaler.loss_scale != 1.0 else loss
-        head.backward()
-        tr.step(n)
+        with _tracer().span("mxtpu.train_step.fallback", "step") as sp:
+            sp.set("reason", reason)
+            with autograd.record():
+                out = self._loss_fn(*args)
+                loss = out[0] if isinstance(out, tuple) else out
+                head = loss * scaler.loss_scale \
+                    if scaler is not None and scaler.loss_scale != 1.0 \
+                    else loss
+            head.backward()
+            tr.step(n)
         return out
 
     # ------------------------------------------------------- introspect --

@@ -31,13 +31,21 @@ Exporters (both zero-dependency):
   ``MXNET_TPU_METRICS_INTERVAL``); rendered by
   ``tools/metrics_dump.py``.
 
-See docs/OBSERVABILITY.md for the metric catalog.
+Causality lives next door: :mod:`.tracing` (:func:`get_tracer`) records
+nested host spans across the same subsystems — one step / one serving
+request readable end to end, exported as Chrome-trace/Perfetto JSON and
+bridged onto the XLA device timeline while a profiler capture runs —
+and :mod:`.rollup` attributes captured device traces to op families.
+
+See docs/OBSERVABILITY.md for the metric catalog and the tracing guide.
 """
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        DEFAULT_TIME_BUCKETS, get_registry)
 from .steptimer import StepTimer
 from .jaxmon import compile_count, install_jax_monitoring_bridge
+from .tracing import Span, Tracer, get_tracer, validate_chrome_trace
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_TIME_BUCKETS", "get_registry", "StepTimer",
-           "compile_count", "install_jax_monitoring_bridge"]
+           "compile_count", "install_jax_monitoring_bridge",
+           "Span", "Tracer", "get_tracer", "validate_chrome_trace"]

@@ -54,6 +54,10 @@ __all__ = ["FusedUpdater", "fusable", "prepare_states", "build_roles",
            "record_program", "rollback_counts", "bind_entries",
            "apply_entries"]
 
+def _tracer():
+    from ..observability.tracing import get_tracer
+    return get_tracer()
+
 # Optimizers whose dense update routes ALL device math through registered
 # mutates ops (apply_op) with no host sync / per-call Python state: the
 # recorded program is a complete, replayable description of the step.
@@ -329,7 +333,8 @@ class FusedUpdater:
         scalars = tuple(rec.slot_values)
 
         try:
-            new_w, new_s = fn(weights, grads, states, scalars)
+            with _tracer().span("mxtpu.fused_update.dispatch", "step"):
+                new_w, new_s = fn(weights, grads, states, scalars)
         except Exception:
             if any(w.is_deleted() for w in weights) or \
                     any(s.is_deleted() for s in states):

@@ -136,17 +136,20 @@ class scope:
 
 
 def host_scope(name):
-    """Host-timeline span: a ``jax.profiler.TraceAnnotation`` when a
-    trace is running, else a free no-op. ``scope`` annotates *device*
-    ops at trace (jit) time; already-compiled runtime phases — serving
-    batch assembly/dispatch, checkpoint IO — happen on the host after
-    tracing, so they need a host-side annotation instead. Usable on any
-    thread (the serving worker annotates each micro-batch with it)."""
-    import contextlib
-    if _state != "run":
-        return contextlib.nullcontext()
-    import jax
-    return jax.profiler.TraceAnnotation(name)
+    """Host-timeline span — one API, two sinks. ``scope`` annotates
+    *device* ops at trace (jit) time; already-compiled runtime phases —
+    serving batch assembly/dispatch, checkpoint IO — happen on the host
+    after tracing, so they need a host-side annotation instead. Usable
+    on any thread (the serving worker annotates each micro-batch).
+
+    Delegates to :func:`mxnet_tpu.observability.tracing.Tracer.span`,
+    which routes to whatever sinks are live: a tracer span when the
+    span tracer is enabled (existing host_scope call sites appear in
+    ``tracer.export()`` Chrome traces with no second instrumentation),
+    a ``jax.profiler.TraceAnnotation`` while a profiler capture runs
+    (either way), and a shared no-op singleton when both are off."""
+    from .observability.tracing import get_tracer
+    return get_tracer().span(name, "host")
 
 
 def _load_trace_events():

@@ -99,6 +99,11 @@ def _obs():
     }
 
 
+def _tracer():
+    from ..observability.tracing import get_tracer
+    return get_tracer()
+
+
 def _corrupt(msg):
     from ..error import CheckpointCorruptError
     return CheckpointCorruptError(msg)
@@ -136,40 +141,45 @@ def write_checkpoint(run_dir, arrays, step, epoch=None, extra=None,
         return None
     obs = _obs()
     t0 = time.monotonic()
-    os.makedirs(run_dir, exist_ok=True)
-    ckpt = os.path.join(run_dir, checkpoint_dirname(step))
-    os.makedirs(ckpt, exist_ok=True)
+    with _tracer().span("mxtpu.ckpt.write", "resilience") as span:
+        span.set("step", int(step))
+        os.makedirs(run_dir, exist_ok=True)
+        ckpt = os.path.join(run_dir, checkpoint_dirname(step))
+        os.makedirs(ckpt, exist_ok=True)
 
-    def _write_all():
-        faults.check("checkpoint.write")
-        from ..ndarray import save as nd_save
-        files = {}
-        data_path = os.path.join(ckpt, DATA_FILE)
-        meta = nd_save(data_path, dict(arrays))
-        files[DATA_FILE] = {"crc32": meta["crc32"],
-                            "nbytes": meta["nbytes"]}
-        for fname, payload in (blobs or {}).items():
-            with atomic_write(os.path.join(ckpt, fname)) as f:
-                f.write(payload)
-            files[fname] = {"crc32": f.crc32, "nbytes": f.nbytes}
-        manifest = {"format": FORMAT, "step": int(step),
-                    "epoch": None if epoch is None else int(epoch),
-                    "wall_time": time.time(), "files": files,
-                    "arrays": meta["arrays"], "extra": extra or {}}
-        # the manifest write is the commit: everything above is invisible
-        # to readers until this rename lands
-        with atomic_write(os.path.join(ckpt, MANIFEST_NAME)) as f:
-            f.write(json.dumps(manifest, indent=1).encode())
-        return manifest
+        def _write_all():
+            faults.check("checkpoint.write")
+            from ..ndarray import save as nd_save
+            files = {}
+            data_path = os.path.join(ckpt, DATA_FILE)
+            meta = nd_save(data_path, dict(arrays))
+            files[DATA_FILE] = {"crc32": meta["crc32"],
+                                "nbytes": meta["nbytes"]}
+            for fname, payload in (blobs or {}).items():
+                with atomic_write(os.path.join(ckpt, fname)) as f:
+                    f.write(payload)
+                files[fname] = {"crc32": f.crc32, "nbytes": f.nbytes}
+            manifest = {"format": FORMAT, "step": int(step),
+                        "epoch": None if epoch is None else int(epoch),
+                        "wall_time": time.time(), "files": files,
+                        "arrays": meta["arrays"], "extra": extra or {}}
+            # the manifest write is the commit: everything above is
+            # invisible to readers until this rename lands
+            with atomic_write(os.path.join(ckpt, MANIFEST_NAME)) as f:
+                f.write(json.dumps(manifest, indent=1).encode())
+            return manifest
 
-    manifest = call_with_retry(_write_all, op="checkpoint.write", **_RETRY)
-    with atomic_write(os.path.join(run_dir, LATEST_NAME)) as f:
-        f.write(os.path.basename(ckpt).encode())
-    obs["write_secs"].observe(time.monotonic() - t0)
-    obs["writes"].inc()
-    obs["write_bytes"].inc(sum(int(rec["nbytes"]) for rec in
-                               manifest.get("files", {}).values()))
-    obs["last_step"].set(int(step))
+        manifest = call_with_retry(_write_all, op="checkpoint.write",
+                                   **_RETRY)
+        with atomic_write(os.path.join(run_dir, LATEST_NAME)) as f:
+            f.write(os.path.basename(ckpt).encode())
+        nbytes = sum(int(rec["nbytes"]) for rec in
+                     manifest.get("files", {}).values())
+        span.set("bytes", nbytes)
+        obs["write_secs"].observe(time.monotonic() - t0)
+        obs["writes"].inc()
+        obs["write_bytes"].inc(nbytes)
+        obs["last_step"].set(int(step))
     if keep is not None:
         prune_checkpoints(run_dir, keep)
     return ckpt
@@ -270,15 +280,18 @@ def read_arrays(ckpt_dir, manifest=None, verify_arrays=False):
         manifest = validate_checkpoint(ckpt_dir)
     obs = _obs()
     t0 = time.monotonic()
-    from ..ndarray import load as nd_load
-    out = nd_load(os.path.join(ckpt_dir, DATA_FILE),
-                  manifest=manifest.get("arrays") if verify_arrays
-                  else None)
+    with _tracer().span("mxtpu.ckpt.restore", "resilience") as span:
+        span.set("step", manifest.get("step"))
+        from ..ndarray import load as nd_load
+        out = nd_load(os.path.join(ckpt_dir, DATA_FILE),
+                      manifest=manifest.get("arrays") if verify_arrays
+                      else None)
+        data_rec = manifest.get("files", {}).get(DATA_FILE)
+        if data_rec:
+            span.set("bytes", int(data_rec["nbytes"]))
+            obs["read_bytes"].inc(int(data_rec["nbytes"]))
     obs["restore_secs"].observe(time.monotonic() - t0)
     obs["restores"].inc()
-    data_rec = manifest.get("files", {}).get(DATA_FILE)
-    if data_rec:
-        obs["read_bytes"].inc(int(data_rec["nbytes"]))
     return out
 
 

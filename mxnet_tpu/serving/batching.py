@@ -16,11 +16,16 @@ callers block only on their own result, never on the batch.
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
 from concurrent.futures import Future
 
 __all__ = ["ServerClosed", "Request", "MicroBatchQueue"]
+
+# process-wide request ids (monotonic, never reused): the correlation
+# key a request's tracer span and event-log records carry end to end
+_request_ids = itertools.count(1)
 
 
 class ServerClosed(RuntimeError):
@@ -28,13 +33,17 @@ class ServerClosed(RuntimeError):
 
 
 class Request:
-    __slots__ = ("x", "future", "t_enqueue", "t_dequeue")
+    __slots__ = ("x", "future", "t_enqueue", "t_dequeue", "rid", "span")
 
     def __init__(self, x):
         self.x = x
         self.future = Future()
         self.t_enqueue = time.monotonic()
         self.t_dequeue = None
+        self.rid = next(_request_ids)
+        # a tracer hand-off span the server attaches at submit time and
+        # finishes (on the worker thread) when the future resolves
+        self.span = None
 
     @property
     def wait_s(self):
@@ -56,7 +65,17 @@ class MicroBatchQueue:
     # -------------------------------------------------------- producer --
     def submit(self, x):
         """Enqueue one request; returns its Future."""
+        return self.submit_request(x).future
+
+    def submit_request(self, x):
+        """Enqueue one request; returns the Request itself."""
         req = Request(x)
+        self.enqueue(req)
+        return req
+
+    def enqueue(self, req):
+        """Admit a pre-built Request (the server constructs it first so
+        its tracing span is attached BEFORE the worker can pop it)."""
         with self._lock:
             if self._closed:
                 raise ServerClosed(

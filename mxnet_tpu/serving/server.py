@@ -33,8 +33,33 @@ import numpy as np
 from .batching import MicroBatchQueue, ServerClosed
 from .bucketing import bucket_sizes, pick_bucket, pad_batch, waste_fraction
 from .telemetry import ServingStats, EventLog, compile_count
+from ..observability.tracing import get_tracer
 
 __all__ = ["ModelServer", "ServerClosed"]
+
+
+def _finish_request_spans(batch, bucket=None, pad_s=None, service_s=None,
+                          error=None):
+    """Close each request's hand-off span with the latency decomposition
+    (queue → pad → compute, in ms) and its request id, so one serving
+    request reads end to end in an exported trace. No-ops when tracing
+    is off (the spans are the _NULL singleton)."""
+    for req in batch:
+        sp = req.span
+        if sp is None:
+            continue
+        sp.set("req_id", req.rid)
+        sp.set("queue_ms", round(req.wait_s * 1e3, 3))
+        if bucket is not None:
+            sp.set("bucket", bucket)
+        if pad_s is not None:
+            sp.set("pad_ms", round(pad_s * 1e3, 3))
+        if service_s is not None:
+            sp.set("compute_ms", round(service_s * 1e3, 3))
+        if error is not None:
+            sp.set("error", error)
+        sp.finish()
+        req.span = None
 
 
 def _env_int(name, default):
@@ -216,7 +241,25 @@ class ModelServer:
                 "server owns the batch dimension)")
         if not self._started:
             raise RuntimeError("server not started; call start()")
-        fut = self._queue.submit(x)
+        tracer = get_tracer()
+        if tracer.enabled:
+            # hand-off span: opened here under the CALLER's current
+            # span (contextvar), finished by the worker at reply — the
+            # request id + queue/pad/compute decomposition ride on it.
+            # Attached before enqueue so the worker can never pop a
+            # request whose span is still missing.
+            from .batching import Request
+            req = Request(x)
+            req.span = tracer.begin("mxtpu.serving.request", "serving",
+                                    tracer.current())
+            try:
+                fut = self._queue.enqueue(req)
+            except ServerClosed:
+                req.span.set("error", "ServerClosed")
+                req.span.finish()
+                raise
+        else:
+            fut = self._queue.submit(x)
         self._stats.record_submit()
         self._stats.record_queue_depth(self._queue.depth())
         return fut
@@ -281,7 +324,7 @@ class ModelServer:
 
     # ------------------------------------------------------ worker loop --
     def _serve_loop(self):
-        from .. import profiler
+        tracer = get_tracer()
         while True:
             batch = self._queue.get_batch(self.max_batch_size,
                                           self.max_delay_s)
@@ -291,29 +334,49 @@ class ModelServer:
                 exc = ServerClosed("server shut down without drain")
                 for req in batch:
                     req.future.set_exception(exc)
+                _finish_request_spans(batch, error="aborted")
                 self._stats.record_failure(len(batch))
                 continue
             self._stats.record_queue_depth(self._queue.depth())
             n = len(batch)
             bucket = pick_bucket(n, self.buckets)
-            rows = np.stack([r.x for r in batch]).astype(
-                self._dtype, copy=False)
-            padded = pad_batch(rows, bucket)
-            t0 = time.monotonic()
-            try:
-                with profiler.host_scope(
-                        f"mxnet_tpu.serving/{self.name}/bucket{bucket}"):
-                    out = np.asarray(self._fn(padded))
-            except Exception as exc:    # resolve, never hang callers
-                for req in batch:
-                    req.future.set_exception(exc)
-                self._stats.record_failure(n)
-                self._events.emit("batch_error", n=n, bucket=bucket,
-                                  error=repr(exc))
-                continue
-            service_s = time.monotonic() - t0
-            for i, req in enumerate(batch):
-                req.future.set_result(out[i])
+            with tracer.span("mxtpu.serving.batch", "serving") as bsp:
+                bsp.set("server", self.name)
+                bsp.set("n", n)
+                bsp.set("bucket", bucket)
+                t_pad = time.monotonic()
+                with tracer.span("mxtpu.serving.pad", "serving"):
+                    rows = np.stack([r.x for r in batch]).astype(
+                        self._dtype, copy=False)
+                    padded = pad_batch(rows, bucket)
+                pad_s = time.monotonic() - t_pad
+                t0 = time.monotonic()
+                try:
+                    # one span, both sinks (tracer ring + jax profiler
+                    # annotation) — wrapping host_scope here too would
+                    # record the same region twice now that host_scope
+                    # delegates to the tracer
+                    with tracer.span("mxtpu.serving.dispatch",
+                                     "serving") as dsp:
+                        dsp.set("server", self.name)
+                        dsp.set("bucket", bucket)
+                        out = np.asarray(self._fn(padded))
+                except Exception as exc:    # resolve, never hang callers
+                    for req in batch:
+                        req.future.set_exception(exc)
+                    _finish_request_spans(batch, bucket=bucket,
+                                          pad_s=pad_s, error=repr(exc))
+                    self._stats.record_failure(n)
+                    self._events.emit("batch_error", n=n, bucket=bucket,
+                                      error=repr(exc))
+                    continue
+                service_s = time.monotonic() - t0
+                with tracer.span("mxtpu.serving.reply", "serving"):
+                    for i, req in enumerate(batch):
+                        req.future.set_result(out[i])
+                    _finish_request_spans(batch, bucket=bucket,
+                                          pad_s=pad_s,
+                                          service_s=service_s)
             self._stats.record_batch(
                 n, bucket, [r.wait_s for r in batch], service_s)
             self._events.emit(

@@ -20,9 +20,9 @@ Three pieces:
   throughput, same schema as before the registry migration.
 - :class:`EventLog` — JSON-lines event sink (one dict per line, ``ts``
   stamped) for offline analysis; the server emits per-batch records and
-  lifecycle events into it. Pairs with ``mx.profiler``: when a trace is
-  running the same batch spans appear on the host timeline via
-  ``profiler.host_scope``.
+  lifecycle events into it. Pairs with the observability tracer: the
+  same batches are traced as ``mxtpu.serving.*`` spans, which also land
+  on the ``mx.profiler`` host timeline while a capture runs.
 """
 from __future__ import annotations
 

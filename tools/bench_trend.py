@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Maintain the committed MFU / img/s trend table from BENCH_r*.json.
+
+The bench trajectory is only evidence if every artifact is classified
+honestly: BENCH_r01–r03 are rc=1 / suspect-timing artifacts and r05
+silently reused a stale in-session capture — none of them is a valid
+headline, and a trend table that lists them as numbers teaches the
+wrong lesson. This tool scans the repo's ``BENCH_r*.json`` (both the
+driver's ``{"n", "rc", "parsed"}`` wrapper shape and
+``tools/perf_capture.py``'s direct shape), classifies each round —
+
+- ``valid``    rc=0, value present, not suspect/skipped/stale
+- ``stale``    headline taken from an earlier in-session capture (shown
+               for context, never as evidence)
+- ``skipped``  backend unreachable, value null
+- ``invalid``  non-zero rc, unparseable output, or suspect timing
+
+— and splices the rendered table between the ``BENCH_TREND`` markers in
+``docs/PERFORMANCE.md`` (appending the section on first run):
+
+    python tools/bench_trend.py            # rewrite the committed table
+    python tools/bench_trend.py --dry-run  # print only
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "PERFORMANCE.md")
+BEGIN = "<!-- BENCH_TREND:BEGIN (tools/bench_trend.py — do not edit by hand) -->"
+END = "<!-- BENCH_TREND:END -->"
+
+
+def _round_of(path, rec):
+    if isinstance(rec.get("round"), int):
+        return rec["round"]
+    if isinstance(rec.get("n"), int):
+        return rec["n"]
+    m = re.search(r"BENCH_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else 0
+
+
+def _img_s(inner):
+    for probe in (inner.get("extra") or {}, inner):
+        for key in ("train_img_s", "examples_per_sec"):
+            v = probe.get(key)
+            if v is not None:
+                return float(v)
+    return None
+
+
+def classify(path, rec):
+    """One table row: {round, status, mfu, img_s, tag, note}."""
+    rnd = _round_of(path, rec)
+    row = {"round": rnd, "status": "valid", "mfu": None, "img_s": None,
+           "tag": rec.get("tag") or "", "note": ""}
+    inner = rec
+    if "rc" in rec:                      # driver wrapper shape
+        if rec.get("rc") != 0:
+            row.update(status="invalid",
+                       note=f"rc={rec['rc']}: bench run failed "
+                            "(tunnel down / backend init error)")
+            return row
+        inner = rec.get("parsed")
+        if not isinstance(inner, dict):
+            row.update(status="invalid", note="unparseable bench output")
+            return row
+    if inner.get("suspect"):
+        row.update(status="invalid",
+                   note="suspect timing — self-check failed "
+                        "(see suspect_reason in the artifact)")
+        return row
+    value = inner.get("value")
+    unit = inner.get("unit") or ""
+    stale = bool(inner.get("stale"))
+    if inner.get("skipped"):
+        if value is None:
+            row.update(status="skipped",
+                       note=f"skipped: {inner.get('skipped')}")
+            return row
+        # a skipped run that still carries a value = stale promotion
+        stale = True
+    src = inner.get("last_capture") if stale and \
+        isinstance(inner.get("last_capture"), dict) else inner
+    if "%" in unit:
+        row["mfu"] = value
+    row["img_s"] = _img_s(src)
+    if not row["tag"]:
+        row["tag"] = (src.get("_capture") or {}).get("tag") or \
+            inner.get("metric") or ""
+    if stale:
+        row.update(status="stale",
+                   note="value reused from an earlier in-session "
+                        "capture — context only, not fresh evidence")
+    return row
+
+
+def scan(repo=REPO):
+    rows = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            rows.append({"round": _round_of(path, {}), "status": "invalid",
+                         "mfu": None, "img_s": None, "tag": "",
+                         "note": f"unreadable: {e}"})
+            continue
+        rows.append(classify(path, rec))
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def render(rows):
+    def fmt(v, pat):
+        return pat % v if v is not None else "—"
+    lines = [
+        "| round | status | MFU (% bf16 peak) | train img/s | config | note |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| r{r['round']:02d} | {r['status']} "
+            f"| {fmt(r['mfu'], '%.2f')} | {fmt(r['img_s'], '%.0f')} "
+            f"| {r['tag']} | {r['note']} |")
+    valid = [r for r in rows if r["status"] == "valid" and
+             r["mfu"] is not None]
+    if valid:
+        best = max(valid, key=lambda r: r["mfu"])
+        lines.append(
+            f"\nBest verified MFU: **{best['mfu']:.2f}%** "
+            f"(r{best['round']:02d}, {best['tag']}).")
+    else:
+        lines.append(
+            "\nNo round has a fresh driver-verified headline yet; the "
+            "best *in-session* capture (stale rows above) is the working "
+            "reference until a bench lands in an up-tunnel window.")
+    return "\n".join(lines)
+
+
+def splice(doc_path, table):
+    block = f"{BEGIN}\n\n{table}\n\n{END}"
+    try:
+        with open(doc_path) as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    if BEGIN in text and END in text:
+        pre = text.split(BEGIN)[0]
+        post = text.split(END, 1)[1]
+        text = pre + block + post
+    else:
+        if text and not text.endswith("\n"):
+            text += "\n"
+        text += ("\n## Bench trend (MFU / throughput per round)\n\n"
+                 "Regenerate with `python tools/bench_trend.py` after "
+                 "every new `BENCH_rNN.json`; rows the table marks "
+                 "invalid/stale/skipped are artifacts, not evidence.\n\n"
+                 + block + "\n")
+    with open(doc_path, "w") as f:
+        f.write(text)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repo", default=REPO)
+    ap.add_argument("--doc", default=None,
+                    help="markdown file to splice (default "
+                         "docs/PERFORMANCE.md under --repo)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the table without touching the doc")
+    args = ap.parse_args()
+    rows = scan(args.repo)
+    if not rows:
+        print("no BENCH_r*.json found", file=sys.stderr)
+        return 1
+    table = render(rows)
+    print(table)
+    if not args.dry_run:
+        doc = args.doc or os.path.join(args.repo, "docs",
+                                       "PERFORMANCE.md")
+        splice(doc, table)
+        print(f"\nwrote {doc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,10 +15,12 @@ offline captures and live scrapes are interchangeable downstream.
 
 ``--smoke`` runs the full path in-process — instrument a 2-step
 training loop, a checkpoint write, a micro-batched serving burst and
-the XLA compile bridge, then snapshot → JSONL → reload → exposition →
-validate — and prints ``SMOKE PASS``. Wired into tier-1 CI
-(tests/test_examples_smoke.py) so the exposition path is exercised on
-every run.
+the XLA compile bridge with span tracing ON, then snapshot → JSONL →
+reload → exposition → validate, plus a tracer export whose Chrome/
+Perfetto JSON well-formedness and ``mxtpu_trace_*`` counters (spans
+started/dropped, export bytes) are checked — and prints ``SMOKE
+PASS``. Wired into tier-1 CI (tests/test_examples_smoke.py) so the
+exporter paths are exercised on every run.
 """
 import argparse
 import json
@@ -164,10 +166,12 @@ def smoke():
     from mxnet_tpu.gluon import nn, Trainer
     from mxnet_tpu.gluon.loss import L2Loss
     import mxnet_tpu.autograd as ag
-    from mxnet_tpu.observability import (get_registry, StepTimer,
+    from mxnet_tpu.observability import (get_registry, get_tracer,
+                                         StepTimer, validate_chrome_trace,
                                          install_jax_monitoring_bridge)
 
     install_jax_monitoring_bridge()
+    tracer = get_tracer().enable()
     mx.random.seed(0)
 
     # training: 2 timed Trainer steps
@@ -235,7 +239,45 @@ def smoke():
         print("SMOKE FAIL: no per-bucket compile counter in exposition")
         return 1
 
-    # JSONL round-trip through the env-gated writer
+    # tracer export: Perfetto-loadable Chrome trace JSON + the
+    # mxtpu_trace_* counters (spans started/dropped, export bytes)
+    started = samples.get(("mxtpu_trace_spans_started_total", ()), 0)
+    if started <= 0:
+        print("SMOKE FAIL: tracing was on but no spans were started")
+        return 1
+    if ("mxtpu_trace_spans_dropped_total", ()) not in samples:
+        print("SMOKE FAIL: no spans-dropped counter in exposition")
+        return 1
+    span_names = {s["name"] for s in tracer.snapshot()}
+    for needed in ("mxtpu.train_step", "mxtpu.train_step.dispatch",
+                   "mxtpu.serving.request", "mxtpu.ckpt.write"):
+        if needed not in span_names:
+            print(f"SMOKE FAIL: no {needed} span recorded")
+            return 1
+    if tracer.stats()["open"] != 0:
+        print(f"SMOKE FAIL: {tracer.stats()['open']} spans left open")
+        return 1
+    with tempfile.TemporaryDirectory() as d:
+        trace_path = os.path.join(d, "trace.json")
+        tracer.export(trace_path)
+        try:
+            n_events = validate_chrome_trace(trace_path)
+        except ValueError as e:
+            print(f"SMOKE FAIL: trace export not well-formed: {e}")
+            return 1
+        if n_events < started - tracer.stats()["dropped"]:
+            print(f"SMOKE FAIL: export carries {n_events} events for "
+                  f"{started} spans")
+            return 1
+    export_bytes = reg.counter("mxtpu_trace_export_bytes_total").value
+    if not (export_bytes > 0 and
+            reg.counter("mxtpu_trace_exports_total").value > 0):
+        print("SMOKE FAIL: export did not account its bytes")
+        return 1
+
+    # JSONL round-trip through the env-gated writer (re-scrape: the
+    # export above moved the mxtpu_trace_* counters)
+    samples = parse_exposition(reg.expose())
     with tempfile.TemporaryDirectory() as d:
         log = os.path.join(d, "metrics.jsonl")
         reg.write_snapshot(log)
@@ -248,7 +290,8 @@ def smoke():
             print("SMOKE FAIL: JSONL-rendered exposition != live scrape")
             return 1
     print(f"SMOKE PASS ({len(samples)} series, "
-          f"{len({n for n, _ in samples})} metrics)")
+          f"{len({n for n, _ in samples})} metrics, "
+          f"{int(started)} trace spans)")
     return 0
 
 

@@ -100,6 +100,11 @@ def run_bench(tag, env_overrides, timeout_s=1500):
     # XLA compile count) here; emit_bench_snapshot reads it back
     metrics_log = os.path.join(trace_dir, "metrics.jsonl")
     env["MXNET_TPU_METRICS_LOG"] = metrics_log
+    # host span tracing rides along: the bench process exports its
+    # Chrome trace next to the device capture, and the mxtpu_trace_*
+    # counters land in the same metrics snapshot (span_stats below)
+    env.setdefault("MXNET_TPU_TRACE", "1")
+    env.setdefault("MXNET_TPU_TRACE_DIR", trace_dir)
     # The daemon already proved the backend is up; keep bench's own
     # probe short so a tunnel that died between probe and launch fails
     # fast instead of eating the window.
@@ -159,17 +164,121 @@ def _next_bench_round():
     return top + 1
 
 
-def emit_bench_snapshot(rec):
-    """Write the next BENCH_rNN.json from a valid capture: the headline
-    value plus the registry-sourced step time / examples-per-sec / XLA
-    compile count, so the bench trajectory is populated from the same
-    metrics pipeline every subsystem reports through. Returns the path
-    (None for invalid captures)."""
-    if not _is_valid(rec):
+def _span_stats(snap):
+    """Host-tracing digest from the bench's metrics snapshot: the
+    mxtpu_trace_* counters (spans started/dropped, export bytes), so a
+    bench artifact records whether its host-span trace is complete."""
+    out = {
+        "spans_started": _metric_value(
+            snap, "mxtpu_trace_spans_started_total"),
+        "spans_dropped": _metric_value(
+            snap, "mxtpu_trace_spans_dropped_total"),
+        "trace_export_bytes": _metric_value(
+            snap, "mxtpu_trace_export_bytes_total"),
+    }
+    return out if any(v is not None for v in out.values()) else None
+
+
+def _rollup_summary(trace_dir, steps=50):
+    """Per-op-family device-time attribution of the capture's trace
+    (None when the capture has no readable TPU trace) — the profile
+    that turns a BENCH artifact from one MFU scalar into something a
+    kernel PR can act on.
+
+    rollup.py is loaded by file path, NOT via ``import mxnet_tpu``:
+    this daemon stays jax-free by design (anything touching the
+    backend runs in killable subprocesses), and the package import
+    would drag jax in."""
+    _ru = _rollup_mod()
+    try:
+        return _ru.summary(trace_dir, steps=steps)
+    except (_ru.RollupError, OSError, ValueError):
         return None
+
+
+_RU = None
+
+
+def _rollup_mod():
+    """mxnet_tpu/observability/rollup.py, loaded by file path once (it
+    is deliberately stdlib-only; see _rollup_summary)."""
+    global _RU
+    if _RU is None:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_mxtpu_rollup", os.path.join(REPO, "mxnet_tpu",
+                                          "observability", "rollup.py"))
+        _RU = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_RU)
+    return _RU
+
+
+def emit_bench_snapshot(rec, allow_stale=False):
+    """Write the next BENCH_rNN.json for a capture attempt and return
+    its path.
+
+    Valid captures get the headline value plus the registry-sourced
+    step time / examples-per-sec / XLA compile count, the per-op-family
+    rollup of the device trace, and the host-span stats — the bench
+    trajectory is populated from the same pipelines every subsystem
+    reports through, with attribution attached.
+
+    Skipped / suspect / stale records are REFUSED as headlines: the
+    artifact is still written (the trajectory must show the attempt),
+    but with a hard top-level ``"skipped"`` marker and ``"value":
+    null`` so no downstream reader can mistake a stale in-session
+    capture for a fresh measurement (the BENCH_r05 regression). Only
+    ``allow_stale=True`` (the ``--allow-stale`` flag) promotes a stale
+    last-capture value, and even then under an explicit ``"stale":
+    true`` marker."""
     cap = rec.get("_capture", {})
     snap = _last_metrics_snapshot(cap.get("metrics_log", ""))
     extra = rec.get("extra", {})
+    nn = _next_bench_round()
+    path = os.path.join(REPO, f"BENCH_r{nn:02d}.json")
+
+    if not _is_valid(rec):
+        reason = rec.get("skipped") or (
+            "suspect" if rec.get("suspect") else "invalid")
+        out = {
+            "round": nn,
+            "source": "tools/perf_capture.py (observability registry)",
+            "captured_at": cap.get("captured_at", _now()),
+            "tag": cap.get("tag"),
+            "metric": rec.get("metric"),
+            "skipped": reason,
+            "value": None,
+            "vs_baseline": None,
+            "unit": rec.get("unit"),
+            "detail": rec.get("detail"),
+        }
+        last = rec.get("last_capture")
+        if last and last.get("value") is not None:
+            if allow_stale and last.get("metric") == rec.get("metric"):
+                out["value"] = last.get("value")
+                out["vs_baseline"] = last.get("vs_baseline")
+                out["stale"] = True
+                out["stale_captured_at"] = (last.get("_capture") or {}) \
+                    .get("captured_at")
+                out["detail"] = ((out.get("detail") or "")
+                                 + "; value promoted from a STALE "
+                                 "in-session capture (--allow-stale)")
+            else:
+                out["stale_capture_available"] = {
+                    "metric": last.get("metric"),
+                    "value": last.get("value"),
+                    "captured_at": (last.get("_capture") or {})
+                    .get("captured_at"),
+                }
+                out["detail"] = ((out.get("detail") or "")
+                                 + "; a stale in-session capture exists "
+                                 "but was NOT promoted (pass "
+                                 "--allow-stale to surface it)")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+        return path
+
     step_s = _metric_value(snap, "mxtpu_bench_step_seconds")
     img_s = _metric_value(snap, "mxtpu_bench_examples_per_sec")
     if img_s is None:
@@ -177,8 +286,6 @@ def emit_bench_snapshot(rec):
     compiles = _metric_value(snap, "mxtpu_xla_compile_total")
     step_dispatch = _metric_value(snap, "mxtpu_train_step_dispatch_total")
     step_compiled = _metric_value(snap, "mxtpu_train_step_compiled_total")
-    nn = _next_bench_round()
-    path = os.path.join(REPO, f"BENCH_r{nn:02d}.json")
     with open(path, "w") as f:
         json.dump({
             "round": nn,
@@ -197,6 +304,8 @@ def emit_bench_snapshot(rec):
             "dispatch": extra.get("dispatch"),
             "device_kind": extra.get("device_kind"),
             "metrics_log": cap.get("metrics_log"),
+            "rollup": _rollup_summary(cap.get("trace_dir", "")),
+            "span_stats": _span_stats(snap),
         }, f, indent=1)
         f.write("\n")
     return path
@@ -253,7 +362,7 @@ def _tag_batch(tag):
     return int(m.group(1)) if m else 0
 
 
-def capture_window():
+def capture_window(allow_stale=False):
     """Tunnel is up: run the config queue until done or the tunnel dies.
     Already-captured configs are skipped; the big-batch configs get a
     longer budget (XLA compile of the bs=256 program is slower)."""
@@ -274,7 +383,8 @@ def capture_window():
                                 "skipped")}
             entry["new_best"] = _maybe_update_best(rec)
             try:
-                entry["bench_snapshot"] = emit_bench_snapshot(rec)
+                entry["bench_snapshot"] = emit_bench_snapshot(
+                    rec, allow_stale=allow_stale)
             except Exception as exc:  # noqa: BLE001 — never kill a window
                 entry["bench_snapshot_error"] = repr(exc)
             got_any = got_any or _is_valid(rec)
@@ -296,6 +406,15 @@ def main():
                     help="seconds between probes while tunnel is down")
     ap.add_argument("--max-hours", type=float, default=12)
     ap.add_argument("--probe-timeout", type=float, default=90)
+    ap.add_argument("--allow-stale", action="store_true",
+                    default=os.environ.get("BENCH_ALLOW_STALE") == "1",
+                    help="permit a BENCH_rNN.json headline value sourced "
+                         "from a stale in-session capture (it still "
+                         "carries a 'stale': true marker); without this "
+                         "flag (or BENCH_ALLOW_STALE=1, its env twin — "
+                         "the bench subprocess reads the same var) "
+                         "stale/skipped captures emit value=null with a "
+                         "top-level 'skipped' marker")
     args = ap.parse_args()
 
     deadline = time.time() + args.max_hours * 3600
@@ -315,7 +434,7 @@ def main():
                 _log({"event": "probe_down_end", "misses": down_streak})
                 down_streak = 0
             _log({"event": "tunnel_up", "kind": info.get("kind")})
-            capture_window()
+            capture_window(allow_stale=args.allow_stale)
             if args.once:
                 return
             time.sleep(max(args.interval, 600))

@@ -1,12 +1,13 @@
 #!/usr/bin/env python
 """Roll up a captured TPU device trace into per-op-family time shares.
 
-Produces the table in docs/PERF.md ("r5 trace breakdown"): reads the
-`vm.trace.json.gz` that `BENCH_PROFILE=<dir>` / tools/perf_capture.py
-writes (jax.profiler / XPlane -> trace-viewer JSON), sums XLA-op
-durations on the device's "XLA Ops" thread, groups by fusion-family
-prefix, and prints ms/step + share. Use it to quantify a lever's
-effect between two captures:
+Thin CLI over :mod:`mxnet_tpu.observability.rollup` (the library form
+every other tool shares — ``perf_capture.py`` embeds the same summary
+into ``BENCH_rNN.json``). Reads the ``*.trace.json.gz`` that
+``BENCH_PROFILE=<dir>`` / tools/perf_capture.py writes (jax.profiler /
+XPlane -> trace-viewer JSON), sums XLA-op durations on the device's
+"XLA Ops" thread, groups by fusion-family prefix, and prints ms/step +
+share. Use it to quantify a lever's effect between two captures:
 
     python tools/trace_rollup.py perf_traces/<ts>_<tag>  [--steps 50]
     python tools/trace_rollup.py A_dir B_dir             # side by side
@@ -15,55 +16,18 @@ The scan wrapper (`while.*`) is excluded: XLA counts the scan body
 once, so the inner ops already represent one step times `--steps`.
 """
 import argparse
-import collections
-import glob
-import gzip
-import json
+import importlib.util
 import os
-import re
 import sys
 
-
-def find_trace(path):
-    if os.path.isfile(path):
-        return path
-    hits = glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
-                     recursive=True)
-    if not hits:
-        raise FileNotFoundError(f"no *.trace.json.gz under {path}")
-    return sorted(hits)[-1]
-
-
-def rollup(path):
-    trace = find_trace(path)
-    with gzip.open(trace) as f:
-        data = json.load(f)
-    events = data.get("traceEvents", [])
-    device_pids = {e["pid"] for e in events
-                   if e.get("ph") == "M" and e.get("name") == "process_name"
-                   and "TPU" in (e.get("args") or {}).get("name", "")}
-    op_tids = {(e["pid"], e["tid"]) for e in events
-               if e.get("ph") == "M" and e.get("name") == "thread_name"
-               and e.get("pid") in device_pids
-               and (e.get("args") or {}).get("name") == "XLA Ops"}
-    if not op_tids:
-        raise SystemExit(
-            f"{trace}: no TPU 'XLA Ops' thread found — this is not a TPU "
-            "device capture (CPU/GPU traces lay out differently)")
-    fam = collections.Counter()
-    total = 0
-    for e in events:
-        if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in op_tids:
-            continue
-        name = e.get("name", "")
-        if name.startswith("while"):
-            continue  # scan wrapper double-counts its body
-        d = e.get("dur", 0)
-        fam[re.sub(r"[.\d]+$", "", name)] += d
-        total += d
-    if total == 0:
-        raise SystemExit(f"{trace}: TPU op thread present but empty")
-    return fam, total
+# load rollup.py by file path: `import mxnet_tpu` drags jax in, and
+# this CLI must keep working on trace files from machines without it
+_spec = importlib.util.spec_from_file_location(
+    "_mxtpu_rollup",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "mxnet_tpu", "observability", "rollup.py"))
+_ru = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_ru)
 
 
 def main():
@@ -77,24 +41,18 @@ def main():
 
     results = []
     for p in args.paths:
-        fam, total = rollup(p)
-        results.append((p, fam, total))
-        print(f"\n{p}: {total / 1e3:.1f} ms device time over "
-              f"{args.steps} steps -> {total / 1e3 / args.steps:.2f} "
-              "ms/step")
-        for name, d in fam.most_common(args.top):
-            print(f"  {d / 1e3 / args.steps:7.2f} ms/step "
-                  f"{100 * d / total:5.1f}%  {name}")
+        try:
+            fam, total = _ru.rollup(p)
+        except _ru.RollupError as e:
+            raise SystemExit(str(e))
+        results.append((fam, total))
+        print(f"\n{p}: "
+              + _ru.family_table(fam, total, steps=args.steps,
+                                 top=args.top))
 
     if len(results) == 2:
-        (pa, fa, ta), (pb, fb, tb) = results
-        print("\ndelta (B - A), ms/step:")
-        keys = sorted(set(fa) | set(fb),
-                      key=lambda k: -(abs(fb.get(k, 0) - fa.get(k, 0))))
-        for k in keys[:args.top]:
-            d = (fb.get(k, 0) - fa.get(k, 0)) / 1e3 / args.steps
-            if abs(d) > 0.005:
-                print(f"  {d:+7.2f}  {k}")
+        report = _ru.diff(results[0], results[1], steps=args.steps)
+        print("\n" + _ru.format_diff(report, top=args.top))
     return 0
 
 
