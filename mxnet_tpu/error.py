@@ -8,7 +8,8 @@ from .base import MXNetError
 
 __all__ = ["MXNetError", "InternalError", "ValueError", "TypeError",
            "IndexError", "NotImplementedForSymbol",
-           "CheckpointCorruptError", "register_error"]
+           "CheckpointCorruptError", "CheckpointWriteError",
+           "register_error"]
 
 
 class InternalError(MXNetError):
@@ -35,6 +36,13 @@ class CheckpointCorruptError(InternalError):
     """A serialized NDArray container / checkpoint failed validation
     (bad magic, truncation, CRC mismatch). Recovery paths catch this to
     fall back to the newest valid checkpoint."""
+
+
+class CheckpointWriteError(InternalError):
+    """A background (async) checkpoint save failed. Raised on the NEXT
+    save/wait/close — never swallowed silently — carrying the original
+    failure as ``__cause__``. The newest previously committed checkpoint
+    is unaffected (partial directories never validate)."""
 
 
 _ERROR_REGISTRY = {"MXNetError": MXNetError}

@@ -354,6 +354,13 @@ class CheckpointOnPreemption(TrainBegin, BatchEnd, TrainEnd):
         trainer = getattr(estimator, "trainer", None)
         if trainer is not None and hasattr(trainer, "save_state"):
             trainer.save_state(self.ckpt_dir)
+            # this is the LAST checkpoint of a preempted run — with
+            # MXNET_TPU_CKPT_ASYNC the save is in a background writer,
+            # and exiting on the atexit flush would reduce a failed
+            # write to a warning + exit 0. Join here so a failure
+            # raises before the process reports a clean stop.
+            if hasattr(trainer, "ckpt_wait"):
+                trainer.ckpt_wait()
         else:
             # fall back to params-only via the atomic nd.save path
             os.makedirs(self.ckpt_dir, exist_ok=True)

@@ -84,6 +84,10 @@ class Trainer:
         self._step_count = 0
         self._obs = None
         self._fused = None  # lazy optimizer.fused.FusedUpdater
+        import weakref
+        self._compiled_steps = weakref.WeakSet()
+        self._restored_step_state = None
+        self._ckpt_mgrs = {}   # realpath(run_dir) -> CheckpointManager
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -286,6 +290,8 @@ class Trainer:
         obs["examples"].inc(batch_size)
         self._step_count += 1
         from ..resilience import faults
+        from ..resilience import async_writer as _aw
+        _aw.note_step_overlap()
         faults.on_step(self._step_count)
 
     def allreduce_grads(self):
@@ -403,14 +409,23 @@ class Trainer:
         self._fused = None  # the optimizer object may have been replaced
 
     # -------------------------------------------------- full-state ckpt --
-    def save_state(self, run_dir, step=None, epoch=None, keep=5):
+    def save_state(self, run_dir, step=None, epoch=None, keep=5,
+                   num_shards=None):
         """Commit the FULL training state to a crash-safe checkpoint
         directory: parameter values, optimizer slots, AMP loss-scaler
         state, global RNG position, and the step counter. Unlike
         ``save_states`` (optimizer pickle only, reference parity), a
         checkpoint written here plus ``restore_state`` resumes a run
-        bit-exactly across a process restart. Returns the checkpoint
-        path (None on non-zero ranks)."""
+        bit-exactly across a process restart.
+
+        ``MXNET_TPU_CKPT_SHARDED`` (or ``num_shards=``) writes the
+        parallel per-shard v2 layout; ``MXNET_TPU_CKPT_ASYNC=1`` moves
+        serialization off the training thread — the state is snapshotted
+        here (step boundary = consistent) and an
+        :class:`~mxnet_tpu.resilience.AsyncSaveHandle` is returned
+        instead of a path (``ckpt_wait()`` joins; a failed background
+        write raises ``CheckpointWriteError`` on the next save/wait).
+        Returns None on non-zero ranks."""
         import pickle
         from .. import _rng
         from ..resilience import checkpoint as ckpt
@@ -440,11 +455,34 @@ class Trainer:
             "scaler": scaler.state_dict() if scaler is not None else None,
             "param_names": [p.name for p in self._params],
         }
-        return ckpt.write_checkpoint(
-            run_dir, arrays,
-            step=self._step_count if step is None else step,
-            epoch=epoch, extra=extra,
-            blobs={ckpt.TRAINER_FILE: blob}, keep=keep)
+        # compiled-step bucket warmth rides along so a resumed run pads
+        # ragged tails to the same buckets (identical numerics for
+        # batch-statistics nets, no cold-bucket recompiles on resume)
+        max_batch = max((s._max_batch for s in self._compiled_steps),
+                        default=0)
+        if max_batch:
+            extra["compiled_step"] = {"max_batch": int(max_batch)}
+        mgr = ckpt.manager_for(self._ckpt_mgrs, run_dir, keep=keep,
+                               num_shards=num_shards)
+        return mgr.save(arrays,
+                        step=self._step_count if step is None else step,
+                        epoch=epoch, extra=extra,
+                        blobs={ckpt.TRAINER_FILE: blob})
+
+    def ckpt_wait(self):
+        """Join every in-flight async checkpoint save this trainer
+        started; drains ALL run dirs before raising the FIRST failure
+        (one bad disk must not leave the others' saves unjoined). No-op
+        when async checkpointing is off."""
+        first = None
+        for mgr in self._ckpt_mgrs.values():
+            try:
+                mgr.wait()
+            except BaseException as exc:   # noqa: B036 — InjectedCrash
+                if first is None:
+                    first = exc
+        if first is not None:
+            raise first
 
     def restore_state(self, run_dir):
         """Restore from the newest VALID checkpoint under ``run_dir``
@@ -493,4 +531,12 @@ class Trainer:
         scaler = getattr(self, "_amp_loss_scaler", None)
         if scaler is not None and extra.get("scaler") is not None:
             scaler.load_state_dict(extra["scaler"])
+        # rebuild compiled-step bucket warmth: steps compiled after (or
+        # alive across) this restore pad tails to the saved run's
+        # buckets instead of rediscovering them cold
+        self._restored_step_state = extra.get("compiled_step") or None
+        if self._restored_step_state:
+            mb = int(self._restored_step_state.get("max_batch", 0) or 0)
+            for s in self._compiled_steps:
+                s.seed_bucket_state(mb)
         return manifest

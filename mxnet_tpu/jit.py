@@ -204,6 +204,16 @@ class CompiledTrainStep:
         self._remat = remat or None
         self._buckets = step_buckets_config(buckets)
         self._max_batch = 0
+        # mid-run resume: a trainer restored from a checkpoint carries
+        # the saved run's bucket warmth — seed it so resumed tails pad
+        # to the same buckets (identical numerics, no cold recompiles);
+        # registration lets a restore_state() AFTER compile_step reach
+        # live step objects the same way
+        registry = getattr(trainer, "_compiled_steps", None)
+        if registry is not None:
+            registry.add(self)
+        restored = getattr(trainer, "_restored_step_state", None) or {}
+        self.seed_bucket_state(restored.get("max_batch", 0))
         self._cache = {}      # signature key -> (compiled, meta)
         self._disabled = None
         self._exec_failures = 0
@@ -244,6 +254,11 @@ class CompiledTrainStep:
         return self._obs
 
     # -------------------------------------------------------- bucketing --
+    def seed_bucket_state(self, max_batch):
+        """Adopt bucket warmth from a restored checkpoint (monotonic —
+        never shrinks what this step already saw)."""
+        self._max_batch = max(self._max_batch, int(max_batch or 0))
+
     def _pick_bucket(self, n):
         if self._buckets == "auto":
             self._max_batch = max(self._max_batch, n)
@@ -444,6 +459,8 @@ class CompiledTrainStep:
             tobs["steps"].inc()
             tobs["examples"].inc(n)
             from .resilience import faults
+            from .resilience import async_writer as _aw
+            _aw.note_step_overlap()
             faults.on_step(tr._step_count)
         self.last_reason = None
         return self._package(meta, loss_out, extras, n, bucket)

@@ -14,7 +14,8 @@ import numpy as _np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ["make_mesh", "local_mesh", "data_parallel_spec"]
+__all__ = ["make_mesh", "local_mesh", "data_parallel_spec",
+           "mesh_shard_info"]
 
 
 def make_mesh(dp: Optional[int] = None, tp: int = 1, pp: int = 1,
@@ -50,3 +51,21 @@ def local_mesh(n: Optional[int] = None) -> Mesh:
 def data_parallel_spec(ndim: int) -> PartitionSpec:
     """PartitionSpec sharding axis0 (batch) on dp, rest replicated."""
     return PartitionSpec("dp", *([None] * (ndim - 1)))
+
+
+def mesh_shard_info(mesh: Mesh) -> dict:
+    """Checkpoint-facing shard layout metadata for a mesh: how many
+    parallel checkpoint shards the mesh naturally supports (one per
+    participating process), which shard this process owns, and the
+    logical axis extents — recorded in sharded-checkpoint manifests so
+    an elastic resume knows what world wrote the state it is reading
+    (``resilience.sharded`` plans its row layout from this count when
+    ``MXNET_TPU_CKPT_SHARDED=auto``)."""
+    procs = sorted({d.process_index for d in mesh.devices.flat})
+    me = jax.process_index()
+    return {
+        "num_shards": len(procs),
+        "shard_id": procs.index(me) if me in procs else 0,
+        "axes": {k: int(v) for k, v in dict(mesh.shape).items()},
+        "num_devices": int(mesh.devices.size),
+    }

@@ -127,6 +127,7 @@ class ShardedTrainer:
         self._buckets = step_buckets_config()
         self._max_batch = 0
         self._loss_scalar = None   # discovered at first trace
+        self._ckpt_mgrs = {}       # realpath(run_dir) -> CheckpointManager
 
     def _ensure_init(self, x):
         if self._params is not None:
@@ -301,6 +302,8 @@ class ShardedTrainer:
         obs["compiled"].inc()
         obs["examples"].inc(n)  # real rows, not the padded bucket
         from ..resilience import faults
+        from ..resilience import async_writer as _aw
+        _aw.note_step_overlap()
         faults.on_step(self._step_count)
         if _spans_processes(self._mesh):
             # the loss is replicated; hand back this process's copy so
@@ -321,15 +324,22 @@ class ShardedTrainer:
         load_params(self._block, self._params)
 
     # -------------------------------------------------- full-state ckpt --
-    def save_state(self, run_dir, epoch=None, keep=5):
+    def save_state(self, run_dir, epoch=None, keep=5, num_shards=None):
         """Commit the full sharded training state to a crash-safe
         checkpoint directory (resilience.checkpoint layout): parameters,
         every optimizer slot, the trainer's PRNG key, and the step
         counter. Arrays are written as full host values (sharding is a
         placement property, not a value property), so a checkpoint can
-        be restored under a different mesh/param_spec. Only process 0
-        writes. Returns the checkpoint path (None if uninitialized)."""
+        be restored under a different mesh/param_spec — and with the
+        sharded v2 layout (``MXNET_TPU_CKPT_SHARDED`` / ``num_shards=``)
+        they land as parallel per-shard row files whose manifest records
+        the global tree, so restore reshards to ANY mesh size. Async
+        mode (``MXNET_TPU_CKPT_ASYNC=1``) snapshots here and
+        serializes on a background writer (``ckpt_wait()`` joins). Only
+        process 0 writes. Returns the checkpoint path / async handle
+        (None if uninitialized)."""
         from ..resilience import checkpoint as ckpt
+        from .mesh import mesh_shard_info
         if self._params is None:
             return None
         # keyed by position in the sorted name list, not by raw name:
@@ -353,10 +363,28 @@ class ShardedTrainer:
                 jax.random.key_data(self._rngkey)).tolist(),
             "opt_leaf_counts": opt_structs,
             "param_names": list(self._names),
+            # the mesh that SAVED: elastic resume reads this for
+            # diagnostics/placement hints, never as a constraint
+            "mesh": mesh_shard_info(self._mesh),
+            "max_batch": int(self._max_batch),
         }
-        return ckpt.write_checkpoint(run_dir, arrays,
-                                     step=self._step_count, epoch=epoch,
-                                     extra=extra, keep=keep)
+        mgr = ckpt.manager_for(self._ckpt_mgrs, run_dir, keep=keep,
+                               num_shards=num_shards)
+        return mgr.save(arrays, step=self._step_count, epoch=epoch,
+                        extra=extra)
+
+    def ckpt_wait(self):
+        """Join in-flight async checkpoint saves; drains ALL run dirs
+        before raising the FIRST failure."""
+        first = None
+        for mgr in self._ckpt_mgrs.values():
+            try:
+                mgr.wait()
+            except BaseException as exc:   # noqa: B036 — InjectedCrash
+                if first is None:
+                    first = exc
+        if first is not None:
+            raise first
 
     def restore_state(self, run_dir):
         """Load the newest valid checkpoint under ``run_dir``. Before
@@ -417,6 +445,10 @@ class ShardedTrainer:
             self._opt_states[n] = jax.tree_util.tree_unflatten(
                 treedef, new_leaves)
         self._step_count = int(extra.get("step_count", 0))
+        # bucket warmth from the saved run: resumed ragged tails pad to
+        # the same buckets the uninterrupted run would have used
+        self._max_batch = max(self._max_batch,
+                              int(extra.get("max_batch", 0) or 0))
         if extra.get("rng_key") is not None:
             self._rngkey = jax.random.wrap_key_data(
                 jnp.asarray(_np.asarray(extra["rng_key"],

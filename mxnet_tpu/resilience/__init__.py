@@ -7,28 +7,40 @@ coordinator comes up late. This package makes those events survivable:
   every durable write in the repo (``nd.save``, checkpoints) uses it.
 - :mod:`.checkpoint` — manifest-validated checkpoint directories with
   per-array CRC32, a ``LATEST`` pointer, and newest-valid fallback scan.
+- :mod:`.sharded` — the ``mxtpu-ckpt-v2`` layout: N parallel-written
+  per-shard files + a layout manifest that makes restore *elastic*
+  (assemble at any other world size from whichever shards hold the
+  rows).
+- :mod:`.async_writer` — background checkpoint saves: snapshot at the
+  step boundary, serialize/fsync/prune off the critical path, at most
+  one in flight, failed writes surfaced typed on the next save/close.
 - :mod:`.retry` — bounded exponential backoff with deterministic jitter.
 - :mod:`.preemption` — :class:`PreemptionGuard`: SIGTERM/SIGINT → flag
   polled at step boundaries → final checkpoint + clean exit.
 - :mod:`.faults` — the fault-injection harness the tests use to prove
   each recovery path actually recovers (kill write at byte N, scripted
-  transient OSErrors, SIGTERM at step K).
+  transient OSErrors, crash at a named phase point, SIGTERM at step K,
+  park a writer thread on a gate).
 
 See docs/RESILIENCE.md for the checkpoint layout and resume recipes.
 """
-from . import atomic, faults, retry, preemption, checkpoint  # noqa: F401
+from . import (atomic, faults, retry, preemption, sharded,  # noqa: F401
+               checkpoint, async_writer)
 from .atomic import atomic_write, is_temp_path
 from .retry import RetryError, backoff_schedule, call_with_retry
 from .retry import retry as with_retry
 from .preemption import PreemptionGuard
 from .checkpoint import (CheckpointManager, write_checkpoint,
                          latest_checkpoint, validate_checkpoint,
-                         read_arrays, prune_checkpoints)
+                         read_arrays, prune_checkpoints, snapshot_arrays)
+from .async_writer import AsyncCheckpointWriter, AsyncSaveHandle
 from .faults import InjectedCrash
 
 __all__ = ["atomic", "faults", "retry", "preemption", "checkpoint",
+           "sharded", "async_writer",
            "atomic_write", "is_temp_path", "RetryError",
            "backoff_schedule", "call_with_retry", "with_retry",
            "PreemptionGuard", "CheckpointManager", "write_checkpoint",
            "latest_checkpoint", "validate_checkpoint", "read_arrays",
-           "prune_checkpoints", "InjectedCrash"]
+           "prune_checkpoints", "snapshot_arrays",
+           "AsyncCheckpointWriter", "AsyncSaveHandle", "InjectedCrash"]

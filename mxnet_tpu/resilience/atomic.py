@@ -108,6 +108,10 @@ def atomic_write(path, mode: str = "wb"):
             os.remove(tmp)
         raise
     f.close()
+    # the publish boundary: a crash here (fully-written temp, rename
+    # never issued) is the "mid-manifest rename" failure mode — the temp
+    # stays behind and readers still see the previous contents
+    faults.point(f"atomic.replace:{os.path.basename(path)}")
     os.replace(tmp, path)
     fsync_dir(dirname)
 

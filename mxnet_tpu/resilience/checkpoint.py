@@ -27,25 +27,40 @@ Manifest schema (``mxtpu-ckpt-v1``)::
                       "dtype": "float32"}, ...},
      "extra":  {...}}           # trainer-specific (rng, scaler, ...)
 
+Sharded checkpoints (``mxtpu-ckpt-v2``, :mod:`.sharded`) replace the
+single ``data.params`` with N parallel-written ``shard-K-of-N.params``
+files plus a ``layout`` manifest section recording each array's global
+shape and per-shard row ranges — the commit/validity rules are
+identical (a checkpoint exists iff its manifest commits and every
+listed file passes size/CRC), and restore is *elastic*: the layout lets
+a reader at any other world size assemble its own shards. Async saves
+(:mod:`.async_writer`, ``CheckpointManager(async_=...)`` or
+``MXNET_TPU_CKPT_ASYNC=1``) snapshot to host at the step boundary and
+run everything from serialization to pruning on a background writer.
+
 Checkpoint I/O is wrapped in bounded :mod:`.retry` so transient
 ``OSError`` (NFS blips, scripted test faults) are survived; an injected
 crash is a ``BaseException`` and is never retried — a kill stays a kill.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import threading
 import time
 
 from . import faults
+from . import sharded as _sharded
 from .atomic import atomic_write, crc32_file, is_temp_path
 from .retry import call_with_retry
 
 __all__ = ["MANIFEST_NAME", "DATA_FILE", "TRAINER_FILE", "LATEST_NAME",
-           "CKPT_PREFIX", "FORMAT", "checkpoint_dirname",
+           "CKPT_PREFIX", "FORMAT", "FORMAT_SHARDED", "checkpoint_dirname",
+           "sharded_mode", "async_mode", "snapshot_arrays",
            "write_checkpoint", "validate_checkpoint", "list_checkpoints",
            "latest_checkpoint", "read_arrays", "read_blob",
-           "prune_checkpoints", "CheckpointManager"]
+           "prune_checkpoints", "inflight_dirs", "CheckpointManager"]
 
 MANIFEST_NAME = "MANIFEST.json"
 DATA_FILE = "data.params"
@@ -53,6 +68,7 @@ TRAINER_FILE = "trainer.pkl"
 LATEST_NAME = "LATEST"
 CKPT_PREFIX = "ckpt-"
 FORMAT = "mxtpu-ckpt-v1"
+FORMAT_SHARDED = "mxtpu-ckpt-v2"
 
 _RETRY = dict(retry_on=(OSError,), max_attempts=4, base_delay=0.02,
               max_delay=0.5)
@@ -96,6 +112,17 @@ def _obs():
             "mxtpu_resilience_checkpoint_corrupt_total",
             "Checkpoint directories skipped as partial/corrupt during "
             "newest-valid scans."),
+        "pruned": reg.counter(
+            "mxtpu_ckpt_pruned_total",
+            "Checkpoint directories deleted by retention pruning, by "
+            "reason (retention = superseded valid checkpoint, invalid = "
+            "unreadable partial left by a crashed writer).", ("reason",)),
+        "prune_skipped": reg.counter(
+            "mxtpu_ckpt_prune_skipped_total",
+            "Checkpoint directories a prune pass deliberately left "
+            "alone, by reason (in_flight = an async save is still "
+            "writing it — deleting it would corrupt the save).",
+            ("reason",)),
     }
 
 
@@ -120,18 +147,118 @@ def _step_of(dirname: str):
         return None
 
 
+# ----------------------------------------------------------- env modes ----
+
+def sharded_mode(override=None):
+    """Resolve the shard count: ``None`` = legacy single-file v1 layout,
+    else the number of shard files to write (v2). ``override`` (the
+    ``num_shards=`` argument) wins over ``MXNET_TPU_CKPT_SHARDED``:
+    ``0``/``off`` = v1, ``auto``/``on`` = one shard per participating
+    process, an integer = exactly that many shards (``1`` still writes
+    the v2 layout — useful for format-forward runs)."""
+    if override is not None and not isinstance(override, str):
+        if override is False or override == 0:
+            return None
+        if override is True:
+            return _auto_shards()
+        return max(1, int(override))
+    if override is not None:
+        v = override.strip().lower()
+    else:
+        v = os.environ.get("MXNET_TPU_CKPT_SHARDED", "").strip().lower()
+    if v in ("", "0", "off", "false", "none"):
+        return None
+    if v in ("auto", "on", "true"):
+        return _auto_shards()
+    try:
+        return max(1, int(v))
+    except ValueError:
+        raise ValueError(
+            f"MXNET_TPU_CKPT_SHARDED/num_shards: expected an integer, "
+            f"'auto'/'on', or '0'/'off', got {v!r}") from None
+
+
+def _auto_shards():
+    try:
+        import jax
+        return max(1, jax.process_count())
+    except Exception:
+        return 1
+
+
+def async_mode(override=None) -> bool:
+    """``MXNET_TPU_CKPT_ASYNC`` truthy = background writer saves."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get("MXNET_TPU_CKPT_ASYNC", "").strip().lower() \
+        in ("1", "on", "true", "auto")
+
+
+def snapshot_arrays(arrays):
+    """Host copies of an array tree — the consistent step-boundary
+    snapshot an async save hands to the writer thread. Forces the
+    device→host fetch NOW (training may donate/overwrite the device
+    buffers on the very next step) and copies, so later in-place
+    mutation of the live parameters cannot leak into the write."""
+    import numpy as _np
+    out = {}
+    for name, a in arrays.items():
+        host = a.asnumpy() if hasattr(a, "asnumpy") else a
+        out[name] = _np.array(host, copy=True)
+    return out
+
+
+# ------------------------------------------------- in-flight protection ----
+
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT = {}   # realpath(run_dir) -> set of ckpt dir basenames
+
+
+@contextlib.contextmanager
+def _mark_inflight(run_dir, dirname):
+    """Register a checkpoint directory as being written so concurrent
+    prune passes (sync callers racing an async writer) neither delete
+    its half-written files as "invalid" nor count it toward retention
+    before its manifest commits."""
+    key = os.path.realpath(run_dir)
+    with _INFLIGHT_LOCK:
+        _INFLIGHT.setdefault(key, set()).add(dirname)
+    try:
+        yield
+    finally:
+        with _INFLIGHT_LOCK:
+            members = _INFLIGHT.get(key)
+            if members is not None:
+                members.discard(dirname)
+                if not members:
+                    _INFLIGHT.pop(key, None)
+
+
+def inflight_dirs(run_dir):
+    """Basenames of checkpoint dirs currently being written under
+    ``run_dir`` (this process)."""
+    with _INFLIGHT_LOCK:
+        return set(_INFLIGHT.get(os.path.realpath(run_dir), ()))
+
+
 # ---------------------------------------------------------------- write ----
 
 def write_checkpoint(run_dir, arrays, step, epoch=None, extra=None,
-                     blobs=None, keep=None):
+                     blobs=None, keep=None, num_shards=None):
     """Commit one checkpoint under ``run_dir``; returns its path.
 
-    arrays : dict name -> NDArray (saved into ``data.params``)
+    arrays : dict name -> NDArray or host numpy (saved into
+             ``data.params``, or ``shard-K-of-N.params`` files when
+             sharded)
     blobs  : optional dict filename -> bytes (opaque sidecar files,
              e.g. pickled optimizer state), each written atomically and
              CRC-recorded in the manifest
     extra  : JSON-serializable trainer metadata stored verbatim
     keep   : if set, prune to the newest ``keep`` valid checkpoints
+             (after the commit — never before)
+    num_shards : shard-count override for :func:`sharded_mode`; the
+             resolved count > 0 writes the ``mxtpu-ckpt-v2`` layout with
+             parallel per-shard files (:mod:`.sharded`)
 
     In multi-process runs only process 0 writes (checkpoints hold
     replicated/global state; N identical writers would race on the same
@@ -139,38 +266,60 @@ def write_checkpoint(run_dir, arrays, step, epoch=None, extra=None,
     """
     if _process_index() != 0:
         return None
+    shards = sharded_mode(num_shards)
     obs = _obs()
     t0 = time.monotonic()
-    with _tracer().span("mxtpu.ckpt.write", "resilience") as span:
+    os.makedirs(run_dir, exist_ok=True)
+    ckpt = os.path.join(run_dir, checkpoint_dirname(step))
+    with _tracer().span("mxtpu.ckpt.write", "resilience") as span, \
+            _mark_inflight(run_dir, os.path.basename(ckpt)):
         span.set("step", int(step))
-        os.makedirs(run_dir, exist_ok=True)
-        ckpt = os.path.join(run_dir, checkpoint_dirname(step))
+        if shards:
+            span.set("shards", int(shards))
         os.makedirs(ckpt, exist_ok=True)
 
         def _write_all():
             faults.check("checkpoint.write")
-            from ..ndarray import save as nd_save
             files = {}
-            data_path = os.path.join(ckpt, DATA_FILE)
-            meta = nd_save(data_path, dict(arrays))
-            files[DATA_FILE] = {"crc32": meta["crc32"],
-                                "nbytes": meta["nbytes"]}
+            if shards:
+                meta = _sharded.global_array_meta(arrays)
+                layout = _sharded.plan_layout(meta, shards)
+                per_shard = _sharded.partition_arrays(arrays, layout,
+                                                      shards)
+                files.update(_sharded.write_shard_files(ckpt, per_shard,
+                                                        shards))
+                arrays_meta = {
+                    name: {"shape": list(shape), "dtype": dtype}
+                    for name, (shape, dtype) in meta.items()}
+            else:
+                from ..ndarray import save as nd_save
+                meta = nd_save(os.path.join(ckpt, DATA_FILE),
+                               dict(arrays))
+                files[DATA_FILE] = {"crc32": meta["crc32"],
+                                    "nbytes": meta["nbytes"]}
+                arrays_meta = meta["arrays"]
             for fname, payload in (blobs or {}).items():
                 with atomic_write(os.path.join(ckpt, fname)) as f:
                     f.write(payload)
                 files[fname] = {"crc32": f.crc32, "nbytes": f.nbytes}
-            manifest = {"format": FORMAT, "step": int(step),
+            manifest = {"format": FORMAT_SHARDED if shards else FORMAT,
+                        "step": int(step),
                         "epoch": None if epoch is None else int(epoch),
                         "wall_time": time.time(), "files": files,
-                        "arrays": meta["arrays"], "extra": extra or {}}
+                        "arrays": arrays_meta, "extra": extra or {}}
+            if shards:
+                manifest["layout"] = {"num_shards": int(shards),
+                                      "arrays": layout}
             # the manifest write is the commit: everything above is
             # invisible to readers until this rename lands
+            faults.point("ckpt.manifest")
             with atomic_write(os.path.join(ckpt, MANIFEST_NAME)) as f:
                 f.write(json.dumps(manifest, indent=1).encode())
             return manifest
 
         manifest = call_with_retry(_write_all, op="checkpoint.write",
                                    **_RETRY)
+        faults.point("ckpt.latest")
         with atomic_write(os.path.join(run_dir, LATEST_NAME)) as f:
             f.write(os.path.basename(ckpt).encode())
         nbytes = sum(int(rec["nbytes"]) for rec in
@@ -180,6 +329,9 @@ def write_checkpoint(run_dir, arrays, step, epoch=None, extra=None,
         obs["writes"].inc()
         obs["write_bytes"].inc(nbytes)
         obs["last_step"].set(int(step))
+    # retention runs strictly AFTER the commit (and after this dir left
+    # the in-flight set), so a crash during prune can only ever remove
+    # superseded state — the just-committed checkpoint is already safe
     if keep is not None:
         prune_checkpoints(run_dir, keep)
     return ckpt
@@ -208,7 +360,7 @@ def validate_checkpoint(ckpt_dir):
             manifest = json.loads(f.read().decode())
     except (OSError, ValueError, UnicodeDecodeError) as exc:
         raise _corrupt(f"{mpath}: unreadable manifest: {exc!r}") from exc
-    if manifest.get("format") != FORMAT:
+    if manifest.get("format") not in (FORMAT, FORMAT_SHARDED):
         raise _corrupt(f"{mpath}: unknown format "
                        f"{manifest.get('format')!r}")
     for fname, want in manifest.get("files", {}).items():
@@ -248,8 +400,12 @@ def latest_checkpoint(run_dir):
     ``(None, None)`` if none. The newest-first scan is authoritative —
     the ``LATEST`` pointer can be one save stale (writer killed between
     the manifest commit and the pointer update) and is only consulted as
-    a last-resort fallback for non-``ckpt-*`` directory names."""
+    a last-resort fallback for non-``ckpt-*`` directory names. An async
+    save in flight for ``run_dir`` is joined first, so within one
+    process a reader never races its own background commit."""
     from ..error import CheckpointCorruptError
+    from .async_writer import join_run_dir
+    join_run_dir(run_dir)
     for _, path in list_checkpoints(run_dir):
         try:
             return path, validate_checkpoint(path)
@@ -282,14 +438,24 @@ def read_arrays(ckpt_dir, manifest=None, verify_arrays=False):
     t0 = time.monotonic()
     with _tracer().span("mxtpu.ckpt.restore", "resilience") as span:
         span.set("step", manifest.get("step"))
-        from ..ndarray import load as nd_load
-        out = nd_load(os.path.join(ckpt_dir, DATA_FILE),
-                      manifest=manifest.get("arrays") if verify_arrays
-                      else None)
-        data_rec = manifest.get("files", {}).get(DATA_FILE)
-        if data_rec:
-            span.set("bytes", int(data_rec["nbytes"]))
-            obs["read_bytes"].inc(int(data_rec["nbytes"]))
+        if manifest.get("format") == FORMAT_SHARDED:
+            out = _sharded.read_sharded_arrays(ckpt_dir, manifest,
+                                               verify=verify_arrays)
+            nbytes = sum(
+                int(rec["nbytes"])
+                for fname, rec in manifest.get("files", {}).items()
+                if _sharded.parse_shard_filename(fname))
+            span.set("bytes", nbytes)
+            obs["read_bytes"].inc(nbytes)
+        else:
+            from ..ndarray import load as nd_load
+            out = nd_load(os.path.join(ckpt_dir, DATA_FILE),
+                          manifest=manifest.get("arrays") if verify_arrays
+                          else None)
+            data_rec = manifest.get("files", {}).get(DATA_FILE)
+            if data_rec:
+                span.set("bytes", int(data_rec["nbytes"]))
+                obs["read_bytes"].inc(int(data_rec["nbytes"]))
     obs["restore_secs"].observe(time.monotonic() - t0)
     obs["restores"].inc()
     return out
@@ -312,38 +478,116 @@ def read_blob(ckpt_dir, fname, manifest=None):
 
 
 def prune_checkpoints(run_dir, keep: int):
-    """Delete all but the newest ``keep`` VALID checkpoints (invalid /
-    partial directories are always removed — they are unreadable noise a
-    crashed writer left behind)."""
+    """Delete all but the newest ``keep`` VALID checkpoints. Invalid /
+    partial directories are removed too (unreadable noise a crashed
+    writer left behind) — EXCEPT directories an in-flight save of this
+    process is still writing: those look partial right up to their
+    manifest commit, and deleting one would corrupt the save that is
+    about to supersede everything. Skips and deletions are counted on
+    ``mxtpu_ckpt_prune*`` metrics."""
     from ..error import CheckpointCorruptError
     import shutil
+    obs = _obs()
+    faults.point("ckpt.prune")
+    protected = inflight_dirs(run_dir)
     valid = []
     for step, path in list_checkpoints(run_dir):
+        if os.path.basename(path) in protected:
+            obs["prune_skipped"].labels(reason="in_flight").inc()
+            continue
         try:
             validate_checkpoint(path)
             valid.append(path)
         except CheckpointCorruptError:
             shutil.rmtree(path, ignore_errors=True)
+            obs["pruned"].labels(reason="invalid").inc()
     for path in valid[keep:]:
         shutil.rmtree(path, ignore_errors=True)
+        obs["pruned"].labels(reason="retention").inc()
+
+
+def manager_for(cache, run_dir, keep=5, num_shards=None):
+    """Per-run-dir :class:`CheckpointManager` out of a caller-owned
+    cache dict (the trainers keep one), refreshed with the caller's
+    current retention/shard settings."""
+    key = os.path.realpath(os.fspath(run_dir))
+    mgr = cache.get(key)
+    if mgr is None:
+        mgr = cache[key] = CheckpointManager(run_dir, keep=keep,
+                                             num_shards=num_shards)
+    mgr.keep = keep
+    mgr._num_shards = num_shards
+    return mgr
 
 
 class CheckpointManager:
-    """Convenience wrapper binding a run directory + retention policy.
+    """Convenience wrapper binding a run directory + retention policy,
+    with the sharded/async levers.
 
     >>> mgr = CheckpointManager(run_dir, keep=3)
     >>> mgr.save(arrays, step=10, extra={"rng": ...})
     >>> path, manifest = mgr.latest()
     >>> arrays = mgr.load_arrays(path, manifest)
+
+    ``async_``/``num_shards`` default to the ``MXNET_TPU_CKPT_ASYNC`` /
+    ``MXNET_TPU_CKPT_SHARDED`` environment (re-read per save, so tests
+    and long-lived trainers pick up changes). Async saves snapshot the
+    arrays to host immediately and return an
+    :class:`~.async_writer.AsyncSaveHandle` (truthy; ``result()`` joins);
+    sync saves return the committed path. ``wait``/``flush``/``close``
+    join the background writer and surface any parked write error as
+    :class:`~mxnet_tpu.error.CheckpointWriteError`.
     """
 
-    def __init__(self, run_dir, keep=5):
+    def __init__(self, run_dir, keep=5, async_=None, num_shards=None):
         self.run_dir = os.fspath(run_dir)
         self.keep = keep
+        self._async = async_
+        self._num_shards = num_shards
 
     def save(self, arrays, step, epoch=None, extra=None, blobs=None):
-        return write_checkpoint(self.run_dir, arrays, step, epoch=epoch,
-                                extra=extra, blobs=blobs, keep=self.keep)
+        if not async_mode(self._async):
+            return write_checkpoint(self.run_dir, arrays, step,
+                                    epoch=epoch, extra=extra, blobs=blobs,
+                                    keep=self.keep,
+                                    num_shards=self._num_shards)
+        if _process_index() != 0:
+            return None
+        from .async_writer import _obs as _aw_obs, writer_for
+        t0 = time.monotonic()
+        host = snapshot_arrays(arrays)
+        _aw_obs()["snapshot_secs"].observe(time.monotonic() - t0)
+        run_dir, keep, num_shards = self.run_dir, self.keep, \
+            self._num_shards
+        step_i = int(step)
+
+        def job():
+            return write_checkpoint(run_dir, host, step_i, epoch=epoch,
+                                    extra=extra, blobs=blobs, keep=keep,
+                                    num_shards=num_shards)
+
+        return writer_for(run_dir).submit(
+            job, path=os.path.join(run_dir, checkpoint_dirname(step_i)),
+            step=step_i)
+
+    # ------------------------------------------------------ writer sync --
+    @property
+    def in_flight(self) -> bool:
+        from .async_writer import peek_writer
+        w = peek_writer(self.run_dir)
+        return w is not None and w.in_flight
+
+    def wait(self, timeout=None):
+        """Join any in-flight async save; raises the typed error of a
+        failed one. No-op for sync-only managers."""
+        from .async_writer import peek_writer
+        w = peek_writer(self.run_dir)
+        return w.wait(timeout) if w is not None else None
+
+    flush = wait
+
+    def close(self):
+        self.wait()
 
     def latest(self):
         return latest_checkpoint(self.run_dir)

@@ -15,6 +15,15 @@ near-free no-ops until a test arms an injector, then:
   coordinator-connect failures, consumed one per call.
 - ``sigterm_at_step(k)`` delivers a real ``SIGTERM`` to this process the
   k-th time a training step completes — the preemption drill.
+- ``crash_at_point(match, nth)`` crashes (InjectedCrash) at the nth
+  named crash *point* whose name contains ``match`` — points mark
+  phase boundaries a byte count cannot reach (the rename publishing a
+  manifest, the k-th shard write of a sharded checkpoint, the prune
+  pass after a commit).
+- ``block_at(site)`` returns a :class:`Gate` that makes the next
+  matching ``check``/``point`` call park until the test releases it —
+  the deterministic way to hold a background checkpoint writer mid-save
+  while asserting the training thread keeps stepping (no sleeps).
 
 All schedules are explicit and deterministic: no randomness, no timers.
 """
@@ -24,9 +33,9 @@ import os
 import signal
 import threading
 
-__all__ = ["InjectedCrash", "FaultInjector", "active", "reset",
-           "kill_write_at", "script", "sigterm_at_step",
-           "check", "wrap_file", "on_step"]
+__all__ = ["InjectedCrash", "FaultInjector", "Gate", "active", "reset",
+           "kill_write_at", "script", "sigterm_at_step", "crash_at_point",
+           "block_at", "check", "wrap_file", "on_step", "point"]
 
 
 class InjectedCrash(BaseException):
@@ -65,6 +74,26 @@ class _CountingFile:
         return getattr(self._f, item)
 
 
+class Gate:
+    """A release-once barrier a fault site parks on (``block_at``).
+    ``reached`` is set when the hooked code arrives; the blocked thread
+    continues only after ``release()``. Released gates stay open."""
+
+    def __init__(self):
+        self.reached = threading.Event()
+        self._go = threading.Event()
+
+    def release(self):
+        self._go.set()
+
+    def wait_reached(self, timeout=10.0):
+        return self.reached.wait(timeout)
+
+    def _pass_through(self):
+        self.reached.set()
+        self._go.wait()
+
+
 class FaultInjector:
     """Holds the armed fault schedules. One global instance (``active``)
     is consulted by the resilience hooks; tests arm it and ``reset()``
@@ -78,9 +107,14 @@ class FaultInjector:
         with getattr(self, "_lock", threading.Lock()):
             self._write_kills = []        # [(substr, nbytes)]
             self._scripts = {}            # site -> list of Exception|None
+            self._points = []             # [[substr, countdown]]
+            gates = getattr(self, "_gates", [])
+            self._gates = []              # [(substr, Gate)]
             self._sigterm_step = None
             self._step = 0
             self.armed = False
+        for _, gate in gates:
+            gate.release()   # never leave a thread parked after teardown
 
     # ------------------------------------------------------------- arm --
     def kill_write_at(self, match: str, nbytes: int):
@@ -106,7 +140,39 @@ class FaultInjector:
             self._step = 0
             self.armed = True
 
+    def crash_at_point(self, match: str, nth: int = 1):
+        """Raise InjectedCrash at the ``nth`` call to ``point(name)``
+        whose name contains ``match`` (1-based, counted per arming)."""
+        with self._lock:
+            self._points.append([match, int(nth)])
+            self.armed = True
+
+    def block_at(self, match: str) -> Gate:
+        """Park any ``check``/``point`` call whose site name contains
+        ``match`` until the returned :class:`Gate` is released."""
+        gate = Gate()
+        with self._lock:
+            self._gates.append((match, gate))
+            self.armed = True
+        return gate
+
     # ----------------------------------------------------------- hooks --
+    def _gate_and_crash(self, name: str):
+        """Shared tail of check/point: park on matching gates, then fire
+        a countdown crash if one reaches zero here."""
+        with self._lock:
+            gates = [g for m, g in self._gates if m in name]
+            fire = False
+            for rec in self._points:
+                if rec[0] in name:
+                    rec[1] -= 1
+                    if rec[1] == 0:
+                        fire = True
+        for gate in gates:
+            gate._pass_through()
+        if fire:
+            raise InjectedCrash(f"injected crash at point {name!r}")
+
     def check(self, site: str):
         """Consume and raise the next scripted fault for ``site``."""
         if not self.armed:
@@ -116,6 +182,14 @@ class FaultInjector:
             exc = sched.pop(0) if sched else None
         if exc is not None:
             raise exc
+        self._gate_and_crash(site)
+
+    def point(self, name: str):
+        """Named crash point (phase boundary). Near-free no-op until a
+        test arms ``crash_at_point``/``block_at``."""
+        if not self.armed:
+            return
+        self._gate_and_crash(name)
 
     def wrap_file(self, f, path: str):
         """Return ``f`` or a crash-at-byte-N proxy if armed for ``path``."""
@@ -145,6 +219,9 @@ reset = active.reset
 kill_write_at = active.kill_write_at
 script = active.script
 sigterm_at_step = active.sigterm_at_step
+crash_at_point = active.crash_at_point
+block_at = active.block_at
 check = active.check
+point = active.point
 wrap_file = active.wrap_file
 on_step = active.on_step

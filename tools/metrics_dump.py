@@ -197,10 +197,27 @@ def smoke():
         step(x, y)
     step(x[:5], y[:5])   # ragged tail -> padded bucket, not a retrace
 
-    # resilience: one checkpoint commit + restore
+    # resilience: one checkpoint commit + restore, then a sharded+async
+    # save so the mxtpu_ckpt_async_* series land in the exposition
     with tempfile.TemporaryDirectory() as run_dir:
         trainer.save_state(run_dir)
         trainer.restore_state(run_dir)
+    with tempfile.TemporaryDirectory() as run_dir:
+        from mxnet_tpu.resilience import async_writer
+        handle = trainer.save_state(run_dir, num_shards=2)
+        # async on: route explicitly through the manager lever (env-free)
+        mgr = trainer._ckpt_mgrs[os.path.realpath(run_dir)]
+        mgr._async = True
+        handle = trainer.save_state(run_dir, step=trainer._step_count + 1,
+                                    num_shards=2)
+        trainer.step(8)          # a step while the save may be in flight
+        handle.result(timeout=60)
+        trainer.ckpt_wait()
+        manifest = trainer.restore_state(run_dir)
+        if manifest.get("format") != "mxtpu-ckpt-v2":
+            print("SMOKE FAIL: sharded save did not produce a v2 "
+                  "manifest")
+            return 1
 
     # serving: a padded micro-batch burst through a callable backend
     srv = serving.ModelServer(lambda b: b * 2.0, buckets=[1, 2, 4],
@@ -217,11 +234,27 @@ def smoke():
     samples = parse_exposition(text)          # must be valid exposition
     for subsystem in ("mxtpu_training_", "mxtpu_serving_",
                       "mxtpu_resilience_checkpoint_",
-                      "mxtpu_xla_compile_"):
+                      "mxtpu_xla_compile_", "mxtpu_ckpt_async_"):
         if not any(name.startswith(subsystem)
                    for name, _ in samples):
             print(f"SMOKE FAIL: no {subsystem}* metric in exposition")
             return 1
+    # async checkpointing: the background save must have committed and
+    # accounted itself (counters + queue-state gauge + write histogram)
+    if samples.get(("mxtpu_ckpt_async_submitted_total", ()), 0) < 1 or \
+            samples.get(("mxtpu_ckpt_async_committed_total", ()), 0) < 1:
+        print("SMOKE FAIL: async checkpoint save not counted "
+              f"(submitted={samples.get(('mxtpu_ckpt_async_submitted_total', ()))})")
+        return 1
+    if ("mxtpu_ckpt_async_in_flight", ()) not in samples:
+        print("SMOKE FAIL: no async in-flight gauge in exposition")
+        return 1
+    if not any(name == "mxtpu_ckpt_async_write_seconds_count"
+               or name.startswith("mxtpu_ckpt_async_write_seconds")
+               for name, _ in samples):
+        print("SMOKE FAIL: no async write-seconds histogram in "
+              "exposition")
+        return 1
     if samples[("mxtpu_training_steps_total", ())] < 2:
         print("SMOKE FAIL: step timer did not count 2 steps")
         return 1

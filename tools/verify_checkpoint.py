@@ -2,13 +2,25 @@
 """Validate a resilience checkpoint run directory.
 
 Walks every ``ckpt-*`` directory under the given run dir, validates its
-manifest (presence, parsability, per-file size + CRC32), and prints a
-per-checkpoint verdict plus the newest restorable step. Exit code 0 if
-at least one checkpoint is restorable, 1 otherwise — usable as a
-pre-resume health gate in launch scripts:
+manifest (presence, parsability, per-file size + CRC32 — shard files
+included for ``mxtpu-ckpt-v2``), and prints a per-checkpoint verdict
+plus the newest restorable step. Sharded checkpoints additionally get a
+layout check (row coverage, parts vs committed files, orphan ``shard-*``
+strays) and an optional ``--reshard-check N`` dry-run that proves the
+newest checkpoint is assemblable at a different mesh size N without
+reading any payload.
 
-    python tools/verify_checkpoint.py /ckpts/run1          # report
-    python tools/verify_checkpoint.py /ckpts/run1 --quiet  # gate only
+Exit codes (distinct per failure class, usable as a pre-resume gate):
+
+    0  at least one checkpoint restorable (and requested checks passed)
+    1  nothing restorable (no ckpt-* dirs, or all corrupt/partial)
+    2  newest restorable checkpoint has shard-layout inconsistencies
+       (coverage gap, part in an uncommitted file, orphan shard files)
+    3  --reshard-check N failed: not assemblable at mesh size N
+
+    python tools/verify_checkpoint.py /ckpts/run1            # report
+    python tools/verify_checkpoint.py /ckpts/run1 --quiet    # gate only
+    python tools/verify_checkpoint.py /ckpts/run1 --reshard-check 16
 
 See docs/RESILIENCE.md for the layout and manifest schema.
 """
@@ -18,6 +30,11 @@ import argparse
 import os
 import sys
 
+EXIT_OK = 0
+EXIT_NOTHING_RESTORABLE = 1
+EXIT_LAYOUT_INCONSISTENT = 2
+EXIT_RESHARD_FAILED = 3
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -25,17 +42,27 @@ def main(argv=None) -> int:
                                     "(contains ckpt-*/ subdirs)")
     ap.add_argument("--quiet", action="store_true",
                     help="no per-checkpoint report, just the exit code")
+    ap.add_argument("--reshard-check", type=int, metavar="N",
+                    default=None,
+                    help="dry-run: verify the newest restorable "
+                         "checkpoint is assemblable at mesh size N "
+                         "(exit 3 if not)")
     args = ap.parse_args(argv)
+    if args.reshard_check is not None and args.reshard_check < 1:
+        ap.error(f"--reshard-check N must be >= 1 "
+                 f"(got {args.reshard_check})")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     try:
         from mxnet_tpu.error import CheckpointCorruptError
         from mxnet_tpu.resilience import checkpoint as ckpt
+        from mxnet_tpu.resilience import sharded as sh
     except ModuleNotFoundError:   # running from outside the repo root
         sys.path.insert(0, os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         from mxnet_tpu.error import CheckpointCorruptError
         from mxnet_tpu.resilience import checkpoint as ckpt
+        from mxnet_tpu.resilience import sharded as sh
 
     def say(*a):
         if not args.quiet:
@@ -44,9 +71,10 @@ def main(argv=None) -> int:
     entries = ckpt.list_checkpoints(args.run_dir)
     if not entries:
         say(f"{args.run_dir}: no ckpt-* directories found")
-        return 1
+        return EXIT_NOTHING_RESTORABLE
 
     newest_ok = None
+    newest_path = None
     for step, path in entries:   # newest first
         try:
             manifest = ckpt.validate_checkpoint(path)
@@ -56,17 +84,50 @@ def main(argv=None) -> int:
         n_arrays = len(manifest.get("arrays", {}))
         n_bytes = sum(int(f["nbytes"])
                       for f in manifest.get("files", {}).values())
+        layout = manifest.get("layout") or {}
+        shard_note = ""
+        if manifest.get("format") == ckpt.FORMAT_SHARDED:
+            n_shards = int(layout.get("num_shards", 0))
+            n_present = sum(1 for f in manifest.get("files", {})
+                            if sh.parse_shard_filename(f))
+            shard_note = f"  shards={n_present}/{n_shards}"
         say(f"  OK       {os.path.basename(path)}  step={manifest['step']}"
             f"  epoch={manifest.get('epoch')}  arrays={n_arrays}"
-            f"  bytes={n_bytes}")
+            f"  bytes={n_bytes}{shard_note}")
         if newest_ok is None:
-            newest_ok = manifest
+            newest_ok, newest_path = manifest, path
 
     if newest_ok is None:
         say(f"{args.run_dir}: NO restorable checkpoint")
-        return 1
+        return EXIT_NOTHING_RESTORABLE
     say(f"newest restorable step: {newest_ok['step']}")
-    return 0
+
+    if newest_ok.get("format") == ckpt.FORMAT_SHARDED:
+        problems = sh.check_layout(newest_path, newest_ok)
+        for p in problems:
+            say(f"  LAYOUT   {p}")
+        if problems:
+            say(f"{os.path.basename(newest_path)}: shard layout "
+                f"INCONSISTENT ({len(problems)} problems)")
+            return EXIT_LAYOUT_INCONSISTENT
+
+    if args.reshard_check is not None:
+        target = int(args.reshard_check)
+        if newest_ok.get("format") == ckpt.FORMAT_SHARDED:
+            try:
+                plan = sh.reshard_check(newest_path, newest_ok, target)
+            except CheckpointCorruptError as exc:
+                say(f"reshard-check {target}: FAILED ({exc})")
+                return EXIT_RESHARD_FAILED
+            fan_in = max((len(v) for v in plan["reads"].values()),
+                         default=0)
+            say(f"reshard-check {target}: OK — assemblable "
+                f"(max {fan_in} source files per new shard)")
+        else:
+            # v1 single-file layout: any world size reads the one file
+            say(f"reshard-check {target}: OK — single-file checkpoint "
+                "is assemblable at any mesh size")
+    return EXIT_OK
 
 
 if __name__ == "__main__":
