@@ -25,9 +25,11 @@ import struct
 
 import numpy as _np
 
-__all__ = ["export_predictor", "load_predictor", "Predictor"]
+__all__ = ["export_predictor", "load_predictor", "Predictor",
+           "export_decoder", "load_decoder"]
 
 _MAGIC = b"MXTPUPRED1"
+_LLM_MAGIC = b"MXTPULLM01"
 
 
 def export_predictor(net, example_input, path=None, training=False,
@@ -135,3 +137,120 @@ class Predictor:
 
 def load_predictor(path_or_bytes, donate_input=False):
     return Predictor(path_or_bytes, donate_input=donate_input)
+
+
+# --------------------------------------------------- decoder artifacts --
+#
+# Autoregressive serving cannot ship a single fixed forward the way the
+# predictor artifact does: the LLM engine needs the model in DECODE
+# shape — the prefill forward plus the per-token paged decode_step
+# (serving/llm/model.py) — with the paged-KV geometry riding along. The
+# artifact therefore serializes the decoder CONFIG + parameter pytree
+# (npz, CRC-free: the loader rebuilds the jitted programs, which the
+# server warmup then pre-compiles per bucket); the loaded pair plugs
+# straight into serving.llm.LLMServer.
+
+
+def _flatten_params(tree, prefix=""):
+    out = {}
+    if isinstance(tree, (dict, list, tuple)) and not tree:
+        # an empty container flattens to nothing and would silently
+        # vanish from the round-tripped tree — fail at export instead
+        # of KeyError-ing at the loaded model's first forward
+        raise ValueError(
+            f"empty subtree at {prefix[:-1] or '<root>'!r} cannot "
+            "round-trip through a decoder artifact")
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            # the loader rebuilds the tree from dot-joined paths and
+            # treats all-digit segments as LIST indices — a dict key
+            # that is all digits or contains the separator would
+            # silently corrupt the round-tripped structure, so refuse
+            # it at export time with a clear error instead
+            k = str(k)
+            if "." in k or k.isdigit() or not k:
+                raise ValueError(
+                    f"unsupported param key {prefix + k!r}: decoder "
+                    "artifact keys must be non-empty, non-numeric and "
+                    "'.'-free (list positions serialize as digits)")
+            out.update(_flatten_params(v, f"{prefix}{k}."))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten_params(v, f"{prefix}{i}."))
+    else:
+        out[prefix[:-1]] = _np.asarray(tree)
+    return out
+
+
+def export_decoder(model, params, path=None):
+    """Serialize a paged-decode model (a ``serving.llm.TinyDecoder``-
+    shaped object: ``.config`` + param pytree) into a self-contained
+    decode-serving artifact. Returns the bytes; writes ``path`` if
+    given. Load with :func:`load_decoder`, serve with
+    ``serving.llm.LLMServer``."""
+    import io
+    flat = _flatten_params(params)
+    buf = io.BytesIO()
+    _np.savez(buf, **flat)
+    blob = buf.getvalue()
+    header = json.dumps({
+        "format": "mxtpu-llm-decoder/npz",
+        "config": model.config.to_dict(),
+        "arrays": sorted(flat),
+    }).encode()
+    artifact = _LLM_MAGIC + struct.pack("<I", len(header)) \
+        + header + blob
+    if path:
+        with open(path, "wb") as f:
+            f.write(artifact)
+    return artifact
+
+
+def load_decoder(path_or_bytes):
+    """Load an :func:`export_decoder` artifact. Returns
+    ``(model, params)`` ready for ``serving.llm.LLMServer(model,
+    params)`` / ``LLMEngine``."""
+    import io
+    from .serving.llm.model import DecoderConfig, TinyDecoder
+    artifact = path_or_bytes
+    if isinstance(artifact, str):
+        with open(artifact, "rb") as f:
+            artifact = f.read()
+    if not artifact.startswith(_LLM_MAGIC):
+        raise ValueError("not an mxnet_tpu decoder artifact")
+    off = len(_LLM_MAGIC)
+    (hlen,) = struct.unpack_from("<I", artifact, off)
+    off += 4
+    meta = json.loads(artifact[off:off + hlen].decode())
+    if meta.get("format") != "mxtpu-llm-decoder/npz":
+        raise ValueError(f"unknown decoder format {meta.get('format')!r}")
+    flat = dict(_np.load(io.BytesIO(artifact[off + hlen:])))
+    missing = set(meta.get("arrays", [])) - set(flat)
+    if missing:
+        raise ValueError(f"decoder artifact missing arrays: "
+                         f"{sorted(missing)[:4]}")
+    params = {}
+    for key, arr in flat.items():
+        parts = key.split(".")
+        node = params
+        for i, p in enumerate(parts[:-1]):
+            nxt_is_idx = parts[i + 1].isdigit()
+            if p.isdigit():
+                p = int(p)
+                while len(node) <= p:
+                    node.append({} if not nxt_is_idx else [])
+                node = node[p]
+            else:
+                if p not in node:
+                    node[p] = [] if nxt_is_idx else {}
+                node = node[p]
+        leaf = parts[-1]
+        if leaf.isdigit():
+            li = int(leaf)
+            while len(node) <= li:
+                node.append(None)
+            node[li] = arr
+        else:
+            node[leaf] = arr
+    model = TinyDecoder(DecoderConfig.from_dict(meta["config"]))
+    return model, params

@@ -21,6 +21,9 @@ subsystem         instrumented where
 ``serving``       request/batch counters, wait/service/latency
                   histograms, queue depth
                   (``mxnet_tpu.serving.telemetry``)
+``llm``           decode serving: tokens/sec, time-to-first-token,
+                  KV-block occupancy, preemptions/evictions
+                  (``mxnet_tpu.serving.llm.metrics``)
 ================  ====================================================
 
 Exporters (both zero-dependency):

@@ -11,6 +11,7 @@ from . import shape_ops     # noqa: F401
 from . import nn            # noqa: F401
 from . import rnn           # noqa: F401
 from . import flash_attention  # noqa: F401
+from . import ragged_attention  # noqa: F401
 from . import contrib_det   # noqa: F401
 from . import contrib_det2  # noqa: F401
 from . import extra         # noqa: F401

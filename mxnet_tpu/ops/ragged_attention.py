@@ -1,0 +1,198 @@
+"""Ragged paged attention for TPU LLM decoding.
+
+The decode half of "Ragged Paged Attention: A High-Performance and
+Flexible LLM Inference Kernel for TPU" (PAPERS.md): a batch of
+sequences with *different* lengths attends over a paged KV cache — a
+fixed pool of ``[num_blocks, block_size, heads, head_dim]`` blocks —
+indirected through per-sequence block tables, so no sequence ever owns
+contiguous KV storage and the batch shape never depends on the length
+mix. One query token per sequence (the continuous-batching decode
+shape: ``[max_seqs, 1]``), keys/values gathered block-by-block.
+
+Two paths, gated exactly like :mod:`.flash_attention`:
+
+- ``ragged_attention_reference`` — a gather-based plain-``jnp`` oracle:
+  gather every sequence's blocks, mask positions ``>= kv_len``, one
+  masked softmax. This is the path the decode engine runs off-TPU and
+  the oracle the Pallas kernel is pinned against
+  (tests/test_ragged_attention.py).
+- ``_ragged_decode_pallas`` — a Pallas kernel, grid
+  ``(num_seqs, blocks_per_seq)``: the block table and the ragged
+  lengths ride in as SCALAR-PREFETCH operands
+  (``pltpu.PrefetchScalarGridSpec``), so each grid step's KV page DMA
+  is index-mapped through ``block_tables[i, j]`` before the kernel body
+  runs — the gather never materializes in HBM. Online softmax
+  (running max / denominator in VMEM scratch, f32) across a sequence's
+  block steps; fully-masked blocks (``j*block_size >= kv_len``) skip
+  their compute. Off-TPU the same kernel runs in interpret mode.
+
+Lengths semantics: ``kv_lens[i]`` counts the VALID tokens of sequence
+``i`` (the current decode token's KV must already be written to its
+page). The masking guarantee runs one way: data beyond ``kv_lens[i]``
+— and anything in the null block — can never leak into row ``i``'s
+output (pinned by the garbage-invisibility test). Rows with
+``kv_lens[i] == 0`` are undefined; callers keep inactive rows clamped
+to 1 over the null block and DISCARD their outputs — the null block
+accumulates stale K/V from padded writes, so those rows are
+unspecified values, not zeros.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .registry import register
+from .flash_attention import _NEG_INF, _on_tpu
+
+
+def ragged_attention_reference(q, k_pages, v_pages, block_tables,
+                               kv_lens, scale=None):
+    """Gather-based oracle. q: (S, H, D); pages: (N, bs, H, D);
+    block_tables: (S, MB) int32; kv_lens: (S,) int32."""
+    S, H, D = q.shape
+    bs = k_pages.shape[1]
+    MB = block_tables.shape[1]
+    s = scale if scale is not None else float(1.0 / (D ** 0.5))
+    k = k_pages[block_tables].reshape(S, MB * bs, H, D)
+    v = v_pages[block_tables].reshape(S, MB * bs, H, D)
+    logits = jnp.einsum("shd,skhd->shk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    pos = jnp.arange(MB * bs, dtype=jnp.int32)
+    mask = pos[None, None, :] < kv_lens[:, None, None]
+    logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("shk,skhd->shd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ----------------------------------------------------------- pallas --
+
+
+def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, block_size,
+                   num_blocks):
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    kv_len = len_ref[i]
+    # a block whose first position is past the ragged length is fully
+    # masked: skip its compute (the page DMA still streams)
+    base = j * block_size
+
+    @pl.when(base < kv_len)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)            # (H, D)
+        k = k_ref[...].astype(jnp.float32)            # (bs, H, D)
+        v = v_ref[...].astype(jnp.float32)
+        # batch over heads: (H, D) x (bs, H, D) -> (H, bs)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)            # (1, bs)
+        s = jnp.where(pos < kv_len, s, _NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                        # masked -> 0.0
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1,
+                                                  keepdims=True)
+        # (H, bs) x (bs, H, D) batched over H -> (H, D)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(j == num_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def _ragged_decode_pallas(q, k_pages, v_pages, block_tables, kv_lens,
+                          scale, interpret):
+    S, H, D = q.shape
+    bs = k_pages.shape[1]
+    MB = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, MB),
+        in_specs=[
+            pl.BlockSpec((None, H, D), lambda i, j, bt, ln: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            # one KV page per grid step, index-mapped through the
+            # scalar-prefetched block table: the DMA for block j of
+            # sequence i fetches page block_tables[i, j]
+            pl.BlockSpec((None, bs, H, D),
+                         lambda i, j, bt, ln: (bt[i, j], 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bs, H, D),
+                         lambda i, j, bt, ln: (bt[i, j], 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((None, H, D),
+                               lambda i, j, bt, ln: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, scale=scale,
+                               block_size=bs, num_blocks=MB)
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
+                           scale=None, use_pallas=None, interpret=None):
+    """Paged decode attention entry point.
+
+    q: (S, H, D) — one query token per sequence; k_pages/v_pages:
+    (N, bs, H, D); block_tables: (S, MB) int32 page indices (pad unused
+    entries with the null block 0); kv_lens: (S,) int32 valid-token
+    counts (>= 1; keep inactive rows at 1 over the null block).
+
+    ``use_pallas`` defaults to the flash_attention gate: the Pallas
+    kernel on TPU, the gather reference elsewhere. Forcing
+    ``use_pallas=True`` off-TPU runs the kernel in interpret mode
+    (the parity-test configuration).
+    """
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if scale is None:
+        scale = float(1.0 / (q.shape[-1] ** 0.5))
+    if not use_pallas:
+        return ragged_attention_reference(q, k_pages, v_pages,
+                                          block_tables, kv_lens, scale)
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _ragged_decode_pallas(q, k_pages, v_pages,
+                                 jnp.asarray(block_tables),
+                                 jnp.asarray(kv_lens),
+                                 float(scale), bool(interpret))
+
+
+@register("ragged_paged_attention", differentiable=False)
+def _ragged_op(q, k_pages, v_pages, block_tables, kv_lens, *,
+               scale=None, use_pallas=None):
+    """Registered decode-attention op: Pallas kernel on TPU, gather
+    reference elsewhere."""
+    return ragged_paged_attention(q, k_pages, v_pages, block_tables,
+                                  kv_lens, scale=scale,
+                                  use_pallas=use_pallas)

@@ -17,17 +17,27 @@ production request path:
 - graceful drain on shutdown or preemption
   (``ModelServer.attach_preemption_guard`` +
   ``resilience.PreemptionGuard``): stop admitting, flush the queue,
-  resolve every in-flight Future, exit.
+  resolve every in-flight Future, exit;
+- :mod:`.llm` — the autoregressive counterpart: continuous-batching
+  greedy decoding over a paged KV cache with ragged attention
+  (:class:`~.llm.LLMServer`), token-level scheduling, drain-with-
+  deadline (``SequenceEvictedError`` carries partial generations).
 
 See docs/SERVING.md for architecture, bucketing math and env vars.
 """
 from .batching import MicroBatchQueue, Request, ServerClosed
-from .bucketing import (bucket_sizes, pick_bucket, pad_batch,
-                        waste_fraction)
+from .bucketing import (BucketSpec, bucket_sizes, pick_bucket,
+                        pad_batch, pad_to_bucket, waste_fraction)
 from .server import ModelServer
 from .telemetry import (CompileCounter, EventLog, ServingStats,
                         compile_count)
+from . import llm
+from .llm import (LLMServer, LLMEngine, SequenceEvictedError,
+                  GenerationResult)
 
 __all__ = ["ModelServer", "ServerClosed", "MicroBatchQueue", "Request",
-           "bucket_sizes", "pick_bucket", "pad_batch", "waste_fraction",
-           "CompileCounter", "EventLog", "ServingStats", "compile_count"]
+           "BucketSpec", "bucket_sizes", "pick_bucket", "pad_batch",
+           "pad_to_bucket", "waste_fraction",
+           "CompileCounter", "EventLog", "ServingStats", "compile_count",
+           "llm", "LLMServer", "LLMEngine", "SequenceEvictedError",
+           "GenerationResult"]

@@ -21,7 +21,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["bucket_sizes", "pick_bucket", "pad_batch", "waste_fraction"]
+__all__ = ["bucket_sizes", "pick_bucket", "pad_batch", "pad_to_bucket",
+           "waste_fraction", "BucketSpec"]
 
 
 def bucket_sizes(max_batch, min_bucket=1):
@@ -55,21 +56,110 @@ def pick_bucket(n, buckets):
         "batcher must cap micro-batches at max(buckets)")
 
 
-def pad_batch(rows, bucket):
-    """Zero-pad a stacked ``(n, *item)`` batch up to ``(bucket, *item)``.
+def pad_to_bucket(rows, bucket, axis=0):
+    """Zero-pad ``rows`` along ``axis`` up to ``bucket`` entries.
 
-    Returns the padded array (the input itself when ``n == bucket``, so
-    the full-bucket fast path copies nothing).
+    The one padding primitive behind both serving paths: the single-shot
+    server pads the BATCH axis of a stacked micro-batch, the LLM prefill
+    path pads the LENGTH axis of a prompt. Returns the input itself when
+    the axis is already bucket-sized, so the full-bucket fast path
+    copies nothing.
     """
-    n = rows.shape[0]
+    n = rows.shape[axis]
     if n == bucket:
         return rows
     if n > bucket:
         raise ValueError(f"batch of {n} does not fit bucket {bucket}")
-    pad = np.zeros((bucket - n,) + rows.shape[1:], dtype=rows.dtype)
-    return np.concatenate([rows, pad], axis=0)
+    widths = [(0, 0)] * rows.ndim
+    widths[axis] = (0, bucket - n)
+    return np.pad(rows, widths)
+
+
+def pad_batch(rows, bucket):
+    """Zero-pad a stacked ``(n, *item)`` batch up to ``(bucket, *item)``."""
+    return pad_to_bucket(rows, bucket, axis=0)
 
 
 def waste_fraction(n, bucket):
     """Fraction of the bucket's rows that are padding."""
     return (bucket - n) / float(bucket)
+
+
+class BucketSpec:
+    """One bucket set + its pick/pad/waste/warmup discipline.
+
+    Owns what used to be copy-pasted bucket math at each call site: the
+    sorted bucket list, smallest-fitting-bucket selection, zero-pad to
+    the bucket along a configurable axis, padded-waste accounting, and
+    the warmup iteration order (every bucket exactly once, ascending, so
+    the jit cache ends up holding every shape the caller can emit).
+    ``ModelServer`` uses it over the batch axis; the LLM prefill path
+    (:mod:`mxnet_tpu.serving.llm`) uses it over the prompt-length axis
+    with a ``multiple_of=block_size`` constraint so every bucket is
+    page-aligned.
+    """
+
+    def __init__(self, buckets, axis=0):
+        buckets = sorted(set(int(b) for b in buckets))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"buckets must be >= 1, got {buckets}")
+        self.buckets = buckets
+        self.axis = axis
+
+    @classmethod
+    def pow2(cls, max_size, min_bucket=1, axis=0, multiple_of=1):
+        """Powers of two up to ``max_size`` (the classic serving set),
+        each rounded UP to a multiple of ``multiple_of`` and de-duped —
+        the page-aligned variant the paged-KV prefill path needs.
+        ``max_size`` must itself be aligned, or the rounded top bucket
+        would exceed it (shapes past the caller's cap)."""
+        if multiple_of > 1 and max_size % multiple_of:
+            raise ValueError(
+                f"max_size {max_size} is not a multiple of "
+                f"{multiple_of}; the top bucket must cover max_size "
+                "without exceeding it")
+        sizes = bucket_sizes(max_size, min_bucket=min_bucket)
+        if multiple_of > 1:
+            sizes = [-(-b // multiple_of) * multiple_of for b in sizes]
+        return cls(sizes, axis=axis)
+
+    @property
+    def max_size(self):
+        return self.buckets[-1]
+
+    def pick(self, n):
+        """Smallest bucket >= n."""
+        return pick_bucket(n, self.buckets)
+
+    def pad(self, rows, bucket=None):
+        """Pad ``rows`` along the spec's axis to ``bucket`` (default:
+        the smallest fitting bucket). Returns (padded, bucket)."""
+        n = rows.shape[self.axis]
+        if bucket is None:
+            bucket = self.pick(n)
+        return pad_to_bucket(rows, bucket, axis=self.axis), bucket
+
+    def waste(self, n, bucket=None):
+        if bucket is None:
+            bucket = self.pick(n)
+        return waste_fraction(n, bucket)
+
+    def warmup_shapes(self, item_shape):
+        """(bucket, shape) per bucket, ascending: the shapes a warmup
+        loop must pre-compile so steady state can never recompile."""
+        item_shape = tuple(item_shape)
+        out = []
+        for b in self.buckets:
+            shape = (item_shape[:self.axis] + (b,)
+                     + item_shape[self.axis:])
+            out.append((b, shape))
+        return out
+
+    def __iter__(self):
+        return iter(self.buckets)
+
+    def __len__(self):
+        return len(self.buckets)
+
+    def __repr__(self):
+        return f"BucketSpec({self.buckets}, axis={self.axis})"

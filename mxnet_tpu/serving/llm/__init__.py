@@ -1,0 +1,47 @@
+"""mxnet_tpu.serving.llm — continuous-batching LLM decode serving.
+
+The autoregressive half of the serving story (ROADMAP open item 2;
+"Ragged Paged Attention", PAPERS.md). Where :class:`..ModelServer`
+schedules *requests* (one forward pass each), this subsystem schedules
+*tokens*:
+
+- :mod:`.kv_cache` — a paged KV cache: a fixed pool of
+  ``[num_blocks, block_size, heads, head_dim]`` blocks, a strict
+  free-list :class:`~.kv_cache.BlockAllocator`, per-sequence block
+  tables padded with the reserved null block;
+- :mod:`mxnet_tpu.ops.ragged_attention` — decode attention over the
+  block-table-indirected cache for a batch of different-length
+  sequences (gather-based jnp reference + a Pallas kernel with
+  scalar-prefetched block tables, gated like ``ops/flash_attention``);
+- :mod:`.scheduler` / :mod:`.engine` — continuous batching: admit,
+  step and retire sequences every iteration; prefill rides the shared
+  pow2 :class:`~..bucketing.BucketSpec` discipline (page-aligned
+  length buckets), decode runs ONE fixed ``[max_seqs]`` shape —
+  zero steady-state recompiles after :meth:`~.server.LLMServer.warmup`;
+  KV pressure preempts the newest sequence (recompute policy);
+- :mod:`.server` — :class:`~.server.LLMServer`: Futures in, greedy
+  generations out; drain-with-deadline on shutdown/preemption
+  (sequences that cannot finish resolve with a typed
+  :class:`~.server.SequenceEvictedError` carrying their partial
+  tokens); :mod:`.metrics` puts tokens/sec, TTFT, queue depth and
+  KV-block occupancy on the shared registry as ``mxtpu_llm_*``.
+
+See docs/SERVING.md ("LLM decoding") for the architecture and the
+block-table layout, docs/ENV_VARS.md for the ``MXNET_TPU_LLM_*`` knobs.
+"""
+from .kv_cache import (BlockAllocator, PagedKVCache, KVCacheError,
+                       NoFreeBlocksError, BlockAccountingError,
+                       NULL_BLOCK)
+from .scheduler import Sequence, Scheduler
+from .model import DecoderConfig, TinyDecoder, greedy_decode_reference
+from .engine import LLMEngine
+from .metrics import LLMStats
+from .server import LLMServer, SequenceEvictedError, GenerationResult
+
+__all__ = [
+    "BlockAllocator", "PagedKVCache", "KVCacheError",
+    "NoFreeBlocksError", "BlockAccountingError", "NULL_BLOCK",
+    "Sequence", "Scheduler", "DecoderConfig", "TinyDecoder",
+    "greedy_decode_reference", "LLMEngine", "LLMStats", "LLMServer",
+    "SequenceEvictedError", "GenerationResult",
+]

@@ -1,0 +1,398 @@
+"""Continuous-batching decode engine: admit, step, evict — every step.
+
+The execution core of ``mxnet_tpu.serving.llm``. One engine iteration
+(:meth:`LLMEngine.step`):
+
+1. **admit** — while a decode slot and enough KV blocks are free, pop
+   the oldest waiting sequence and PREFILL it: pad the prompt to a
+   power-of-two, page-aligned length bucket (the same
+   :class:`~..bucketing.BucketSpec` discipline the single-shot server
+   uses on the batch axis), run the dense causal forward once, write
+   the prompt's K/V into freshly allocated pages, and emit the first
+   generated token from the last real position's logits;
+2. **allocate** — any running sequence whose next token starts a new
+   page gets a block; under KV pressure the newest-admitted sequence
+   is preempted (blocks freed, generation folded into its prompt,
+   requeued — deterministic greedy decoding resumes the exact stream);
+3. **decode** — ONE fixed-shape jitted launch for the whole batch:
+   ``[max_seqs]`` tokens/positions/lengths + ``[max_seqs,
+   max_blocks_per_seq]`` block tables in, next tokens out, KV pages
+   donated through. Inactive slots ride along pointed at the null
+   block. The shape never depends on how many sequences are live or
+   how long they are — so after :meth:`warmup` (every prefill bucket
+   once + the decode program) steady state compiles NOTHING, no matter
+   how ragged the arrival/length/stop mix gets (asserted via the
+   ``backend_compile`` counter in tier-1).
+
+The engine is single-threaded by design (the serving worker
+discipline): :class:`~.server.LLMServer` owns the thread, the queue
+and the futures; the engine owns device state and determinism.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..bucketing import BucketSpec
+from ..envutil import env_int as _env_int
+from .kv_cache import PagedKVCache, KVCacheError, NULL_BLOCK
+from .scheduler import Scheduler, Sequence, RUNNING, FINISHED, EVICTED
+from ...observability.tracing import get_tracer
+
+__all__ = ["LLMEngine"]
+
+
+class LLMEngine:
+    """Token-level scheduler + fixed-shape jitted prefill/decode.
+
+    ``model`` provides ``num_layers/num_heads/head_dim/vocab_size/
+    max_context`` plus the pure functions ``forward(params, tokens)``
+    and ``decode_step(params, tokens, positions, k_pages, v_pages,
+    block_tables, kv_lens)`` (see :class:`~.model.TinyDecoder`, the
+    reference implementation). ``params`` is its pytree.
+
+    Config resolution: constructor arg > ``MXNET_TPU_LLM_*`` env var >
+    default. ``max_context`` must be a multiple of ``block_size`` (the
+    top prefill bucket is the full context); ``num_blocks`` must leave
+    room for at least one full-context sequence, which also guarantees
+    a lone sequence can never deadlock on allocation.
+    """
+
+    def __init__(self, model, params, max_seqs=None, block_size=None,
+                 num_blocks=None, max_context=None,
+                 prefill_buckets=None, stats=None, dtype="float32"):
+        import jax
+        import jax.numpy as jnp
+        self.model = model
+        if max_seqs is None:
+            max_seqs = _env_int("MXNET_TPU_LLM_MAX_SEQS", 8)
+        if block_size is None:
+            block_size = _env_int("MXNET_TPU_LLM_BLOCK_SIZE", 16)
+        if max_context is None:
+            max_context = _env_int("MXNET_TPU_LLM_MAX_CONTEXT",
+                                   model.max_context)
+        if max_context > model.max_context:
+            raise ValueError(
+                f"max_context {max_context} exceeds the model's "
+                f"{model.max_context}")
+        if max_context % block_size:
+            raise ValueError(
+                f"max_context {max_context} must be a multiple of "
+                f"block_size {block_size} (the top prefill bucket is "
+                "the full context)")
+        blocks_per_seq = max_context // block_size
+        if num_blocks is None:
+            num_blocks = _env_int(
+                "MXNET_TPU_LLM_NUM_BLOCKS",
+                max_seqs * blocks_per_seq + 1)
+        if num_blocks - 1 < blocks_per_seq:
+            raise ValueError(
+                f"num_blocks {num_blocks} cannot hold one full-context "
+                f"sequence ({blocks_per_seq} blocks + the null block)")
+        self.max_seqs = int(max_seqs)
+        self.max_context = int(max_context)
+        self.cache = PagedKVCache(
+            model.num_layers, model.num_heads, model.head_dim,
+            block_size, num_blocks, max_context, dtype=dtype)
+        self.scheduler = Scheduler(self.max_seqs)
+        if prefill_buckets is None:
+            env = os.environ.get("MXNET_TPU_LLM_PREFILL_BUCKETS")
+            if env:
+                prefill_buckets = [int(b) for b in env.split(",")
+                                   if b.strip()]
+        if prefill_buckets is not None:
+            spec = BucketSpec(prefill_buckets, axis=0)
+            bad = [b for b in spec.buckets
+                   if b % block_size or b > max_context]
+            if bad:
+                raise ValueError(
+                    f"prefill buckets {bad} must be multiples of "
+                    f"block_size {block_size} and <= max_context "
+                    f"{max_context}")
+            if spec.max_size < max_context:
+                raise ValueError(
+                    f"largest prefill bucket {spec.max_size} must "
+                    f"cover max_context {max_context} (preemption can "
+                    "requeue near-full prompts)")
+            self.prefill_spec = spec
+        else:
+            self.prefill_spec = BucketSpec.pow2(
+                max_context, axis=0, multiple_of=block_size)
+        self._stats = stats
+        self._params = jax.tree_util.tree_map(jnp.asarray, params)
+        # donation is a TPU/HBM lever; CPU backends ignore it with a
+        # warning per call site, so only request it where it works
+        from ...ops.flash_attention import _on_tpu
+        donate = (1, 2) if _on_tpu() else ()
+        self._decode_jit = jax.jit(self._decode_impl,
+                                   donate_argnums=donate)
+        self._prefill_jit = jax.jit(self._prefill_impl,
+                                    donate_argnums=donate)
+        self._warmed = False
+        # sequences finished but not yet handed to the caller — kept
+        # OUTSIDE step()'s local event list so a step that finishes A
+        # and then raises on B's prefill cannot lose A (the server
+        # drains this in its error path too)
+        self._finished_pending = []
+
+    # ---------------------------------------------- jitted programs --
+    def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
+                     block_tables, kv_lens):
+        import jax.numpy as jnp
+        logits, k_pages, v_pages = self.model.decode_step(
+            params, tokens, positions, k_pages, v_pages, block_tables,
+            kv_lens)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, k_pages, v_pages
+
+    def _prefill_impl(self, params, k_pages, v_pages, tokens,
+                      block_ids, t_real):
+        import jax.numpy as jnp
+        logits, k, v = self.model.forward(params, tokens[None, :])
+        L, _, Tp, H, D = k.shape
+        bs = k_pages.shape[2]
+        nb = block_ids.shape[0]
+        k = k[:, 0].reshape(L, nb, bs, H, D).astype(k_pages.dtype)
+        v = v[:, 0].reshape(L, nb, bs, H, D).astype(v_pages.dtype)
+        # padded tail blocks target the null page; real blocks land
+        # page-aligned because every prefill bucket is a block multiple
+        k_pages = k_pages.at[:, block_ids].set(k)
+        v_pages = v_pages.at[:, block_ids].set(v)
+        first = jnp.argmax(logits[0, t_real - 1]).astype(jnp.int32)
+        return first, k_pages, v_pages
+
+    # ------------------------------------------------------- warmup --
+    def warmup(self):
+        """Compile every program steady state can reach: one prefill
+        per length bucket + the one decode shape. Returns
+        {'prefill_<bucket>'|'decode': seconds}. After this, a mixed
+        prefill/decode workload cannot recompile."""
+        timings = {}
+        S, MB = self.max_seqs, self.cache.max_blocks_per_seq
+        bs = self.cache.block_size
+        for bucket in self.prefill_spec:
+            toks = np.zeros(bucket, np.int32)
+            blocks = np.full(bucket // bs, NULL_BLOCK, np.int32)
+            t0 = time.monotonic()
+            first, kp, vp = self._prefill_jit(
+                self._params, self.cache.k_pages, self.cache.v_pages,
+                toks, blocks, np.int32(1))
+            self.cache.swap(kp, vp)
+            np.asarray(first)
+            timings[f"prefill_{bucket}"] = time.monotonic() - t0
+        t0 = time.monotonic()
+        nxt, kp, vp = self._decode_jit(
+            self._params, self.cache.k_pages, self.cache.v_pages,
+            np.zeros(S, np.int32), np.zeros(S, np.int32),
+            np.full((S, MB), NULL_BLOCK, np.int32),
+            np.ones(S, np.int32))
+        self.cache.swap(kp, vp)
+        np.asarray(nxt)
+        timings["decode"] = time.monotonic() - t0
+        self._warmed = True
+        return timings
+
+    # ---------------------------------------------------- admission --
+    def add_validate(self, seq):
+        """Validate a sequence WITHOUT enqueueing it — the server runs
+        this on the caller's thread so shape/vocab errors raise at
+        submit time, not inside the engine loop."""
+        if not isinstance(seq, Sequence):
+            raise TypeError(f"add() wants a Sequence, got {type(seq)}")
+        if len(seq.prompt) > self.max_context - 1:
+            raise ValueError(
+                f"prompt of {len(seq.prompt)} tokens leaves no room to "
+                f"generate (max_context={self.max_context})")
+        vocab = self.model.vocab_size
+        bad = [t for t in seq.prompt if not (0 <= t < vocab)]
+        if bad:
+            raise ValueError(
+                f"prompt tokens {bad[:4]} out of vocab [0, {vocab})")
+        return seq
+
+    def add(self, seq):
+        """Enqueue a WAITING sequence."""
+        self.scheduler.add(self.add_validate(seq))
+
+    def has_work(self):
+        return self.scheduler.has_work()
+
+    def _record_block_gauges(self):
+        if self._stats:
+            self._stats.record_blocks(self.cache.allocator.num_used,
+                                      self.cache.allocator.num_usable)
+            self._stats.record_admission_state(
+                self.scheduler.num_waiting, self.scheduler.num_running)
+
+    def _prefill(self, seq, slot):
+        tracer = get_tracer()
+        T = len(seq.prompt)
+        nb = self.cache.blocks_for(T)
+        blocks = self.cache.allocator.alloc(nb)
+        bucket = self.prefill_spec.pick(T)
+        toks, _ = self.prefill_spec.pad(
+            np.asarray(seq.prompt, np.int32), bucket)
+        bs = self.cache.block_size
+        block_arr = np.full(bucket // bs, NULL_BLOCK, np.int32)
+        block_arr[:nb] = blocks
+        with tracer.span("mxtpu.llm.prefill", "llm") as sp:
+            sp.set("seq_id", seq.seq_id)
+            sp.set("prompt", T)
+            sp.set("bucket", bucket)
+            try:
+                first, kp, vp = self._prefill_jit(
+                    self._params, self.cache.k_pages,
+                    self.cache.v_pages, toks, block_arr, np.int32(T))
+                self.cache.swap(kp, vp)
+                first = int(np.asarray(first))
+            except Exception:
+                # the blocks are not yet on the sequence: return them
+                # or they leak past every later free path
+                self.cache.allocator.free(blocks)
+                raise
+        self.scheduler.place(seq, slot)
+        seq.block_ids = blocks
+        seq.seq_len = T
+        seq.generated.append(first)
+        seq.last_token = first
+        if self._stats:
+            self._stats.record_prefill(T)
+            self._stats.record_prefill_token()
+        if seq.t_first_token is None:
+            seq.t_first_token = time.monotonic()
+            if self._stats:
+                self._stats.record_first_token(
+                    seq.t_first_token - seq.t_submit)
+        return first
+
+    def _admit(self, events):
+        while self.scheduler.num_waiting:
+            slot = self.scheduler.free_slot()
+            if slot is None:
+                break
+            seq = self.scheduler.peek_waiting()
+            T = len(seq.prompt)
+            need = self.cache.blocks_for(T)
+            if T % self.cache.block_size == 0:
+                need += 1           # first decode opens a new page
+            if not self.cache.allocator.can_alloc(need):
+                break               # FIFO: no head-of-line skipping
+            self._prefill(seq, slot)
+            events.append(("admitted", seq))
+            if seq.done or seq.seq_len + 1 >= self.max_context:
+                self._finish(seq, events)
+
+    def _finish(self, seq, events):
+        self.cache.allocator.free(seq.block_ids)
+        seq.block_ids = []
+        reason = ("stop_token" if (seq.stop_token is not None
+                                   and seq.generated
+                                   and seq.generated[-1]
+                                   == seq.stop_token)
+                  else "length" if seq.num_generated
+                  < seq.max_new_tokens else "max_new_tokens")
+        self.scheduler.release(seq, FINISHED, reason)
+        self._finished_pending.append(seq)
+        events.append(("finished", seq))
+
+    def _preempt(self, seq):
+        self.cache.allocator.free(seq.block_ids)
+        seq.block_ids = []
+        self.scheduler.preempt(seq)
+        if self._stats:
+            self._stats.record_preemption()
+
+    # --------------------------------------------------------- step --
+    def step(self):
+        """One engine iteration. Returns events:
+        ``[("admitted"|"token"|"finished"|"preempted", Sequence)]``."""
+        tracer = get_tracer()
+        events = []
+        self._admit(events)
+        running = sorted(self.scheduler.running(),
+                         key=lambda s: s.admit_index)
+        if not running:
+            self._record_block_gauges()
+            return events
+        # a sequence whose next position starts a new page needs a
+        # block now; under pressure preempt newest-admitted first
+        for seq in running:
+            if seq.state != RUNNING:
+                continue            # preempted by an earlier victim
+            if seq.seq_len % self.cache.block_size == 0:
+                while not self.cache.allocator.can_alloc(1):
+                    victim = self.scheduler.pick_victim(exclude=(seq,))
+                    if victim is None:
+                        raise KVCacheError(
+                            "lone sequence cannot allocate — "
+                            "num_blocks too small for max_context")
+                    self._preempt(victim)
+                    events.append(("preempted", victim))
+                seq.block_ids.append(self.cache.allocator.alloc(1)[0])
+        running = [s for s in running if s.state == RUNNING]
+        if not running:
+            self._record_block_gauges()
+            return events
+        S, MB = self.max_seqs, self.cache.max_blocks_per_seq
+        toks = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        lens = np.ones(S, np.int32)
+        tables = np.full((S, MB), NULL_BLOCK, np.int32)
+        for seq in running:
+            i = seq.slot
+            toks[i] = seq.last_token
+            pos[i] = seq.seq_len
+            lens[i] = seq.seq_len + 1
+            tables[i] = self.cache.table_row(seq.block_ids)
+        t0 = time.monotonic()
+        with tracer.span("mxtpu.llm.decode_step", "llm") as sp:
+            sp.set("running", len(running))
+            nxt, kp, vp = self._decode_jit(
+                self._params, self.cache.k_pages, self.cache.v_pages,
+                toks, pos, tables, lens)
+            self.cache.swap(kp, vp)
+            nxt = np.asarray(nxt)
+        step_s = time.monotonic() - t0
+        for seq in running:
+            tok = int(nxt[seq.slot])
+            seq.generated.append(tok)
+            seq.seq_len += 1
+            seq.last_token = tok
+            events.append(("token", seq))
+            if seq.done or seq.seq_len + 1 >= self.max_context:
+                self._finish(seq, events)
+        if self._stats:
+            self._stats.record_decode_step(len(running), step_s)
+        self._record_block_gauges()
+        return events
+
+    def pop_finished(self):
+        """Drain the finished-but-unreported sequences. The server
+        resolves Futures from THIS (not from step()'s event list) so a
+        completion can survive an exception later in the same step."""
+        out, self._finished_pending = self._finished_pending, []
+        return out
+
+    # -------------------------------------------------------- drain --
+    def evict_all(self, reason="evicted"):
+        """Release every live sequence (running AND waiting) into the
+        EVICTED state, freeing its blocks. Returns the evicted
+        sequences — the server turns them into
+        ``SequenceEvictedError`` resolutions, never silent drops."""
+        out = []
+        for seq in self.scheduler.running():
+            self.cache.allocator.free(seq.block_ids)
+            seq.block_ids = []
+            self.scheduler.release(seq, EVICTED, reason)
+            out.append(seq)
+        while self.scheduler.waiting:
+            seq = self.scheduler.waiting.popleft()
+            if seq.block_ids:       # defensive: waiting seqs normally
+                self.cache.allocator.free(seq.block_ids)
+                seq.block_ids = []  # hold no blocks
+            self.scheduler.release(seq, EVICTED, reason)
+            out.append(seq)
+        self._record_block_gauges()
+        return out

@@ -1,0 +1,214 @@
+"""Paged KV cache: a fixed pool of KV blocks + a free-list allocator.
+
+The memory model behind continuous batching (vLLM's PagedAttention,
+and the TPU-side "Ragged Paged Attention" kernel shape): instead of one
+contiguous ``[max_seqs, max_context, ...]`` KV tensor — whose worst-case
+shape wastes almost all of it on short sequences — the cache is a pool
+of ``num_blocks`` fixed-size blocks, ``[block_size]`` token slots each,
+handed out on demand:
+
+- a sequence owns ``ceil(seq_len / block_size)`` blocks, listed in its
+  *block table* — an int32 row of page indices, padded with the
+  reserved NULL block 0;
+- the attention kernel indirects every KV read through the block
+  table (:mod:`mxnet_tpu.ops.ragged_attention`), so blocks never need
+  to be contiguous or ordered;
+- block 0 is never allocated: padded table entries, whole padded tail
+  BLOCKS of a bucketed prompt, and inactive batch rows all point at
+  it. Note the protection boundary precisely: pad positions that land
+  INSIDE a sequence's own last live block DO get written with garbage
+  K/V — what keeps every output correct is the ``kv_lens`` mask (no
+  read past the valid length, pinned by the garbage-invisibility
+  test) plus decode overwriting each slot before ``kv_lens`` ever
+  reaches it. Block 0's contents are scratch; inactive rows'
+  attention outputs are discarded, never interpreted.
+
+The allocator is strict by design: over-allocating raises
+:class:`NoFreeBlocksError` (the scheduler's signal to evict), freeing a
+block that is not currently allocated raises
+:class:`BlockAccountingError` — a leak or double-free is a bug worth
+crashing on, not a statistic (pinned by a 1k-schedule fuzz test in
+tests/test_ragged_attention.py).
+
+The block arrays themselves are jnp buffers ``[num_layers, num_blocks,
+block_size, heads, head_dim]``, updated FUNCTIONALLY by the engine's
+jitted programs (donated in, swapped back via :meth:`swap`), so the
+decode hot path stays a fixed-shape, zero-recompile XLA program.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+__all__ = ["KVCacheError", "NoFreeBlocksError", "BlockAccountingError",
+           "BlockAllocator", "PagedKVCache", "NULL_BLOCK"]
+
+# block 0 is reserved: the write/read sink for padding and inactive rows
+NULL_BLOCK = 0
+
+
+class KVCacheError(RuntimeError):
+    """Base class for paged-KV-cache failures."""
+
+
+class NoFreeBlocksError(KVCacheError):
+    """alloc() could not satisfy the request; evict and retry."""
+
+
+class BlockAccountingError(KVCacheError):
+    """free() of a block that is not allocated (double-free / corrupt
+    table) — always a caller bug."""
+
+
+class BlockAllocator:
+    """Free-list allocator over block ids ``1..num_blocks-1``.
+
+    All-or-nothing ``alloc(n)``; strict double-free detection; O(1)
+    occupancy accounting. Not thread-safe — the engine loop is the only
+    caller (one thread), matching the serving worker discipline.
+    """
+
+    def __init__(self, num_blocks):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (1 usable + the reserved null block "
+                f"{NULL_BLOCK}), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self._free = collections.deque(range(1, num_blocks))
+        self._used = set()
+
+    @property
+    def num_usable(self):
+        """Total allocatable blocks (the pool minus the null block)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_used(self):
+        return len(self._used)
+
+    def occupancy(self):
+        """Fraction of usable blocks currently allocated."""
+        return self.num_used / float(self.num_usable)
+
+    def can_alloc(self, n):
+        return n <= self.num_free
+
+    def alloc(self, n=1):
+        """Allocate ``n`` blocks; returns their ids. All-or-nothing:
+        raises NoFreeBlocksError without touching the pool when fewer
+        than ``n`` are free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise NoFreeBlocksError(
+                f"need {n} blocks, {len(self._free)} free "
+                f"({len(self._used)}/{self.num_usable} in use)")
+        out = [self._free.popleft() for _ in range(n)]
+        self._used.update(out)
+        return out
+
+    def free(self, blocks):
+        """Return blocks to the pool. Raises BlockAccountingError on
+        the null block, an out-of-range id, or a block that is not
+        currently allocated (double-free)."""
+        blocks = list(blocks)
+        for b in blocks:                      # validate before mutating
+            if b == NULL_BLOCK:
+                raise BlockAccountingError(
+                    f"block {NULL_BLOCK} is the reserved null block")
+            if not (0 < b < self.num_blocks):
+                raise BlockAccountingError(f"block {b} out of range")
+            if b not in self._used:
+                raise BlockAccountingError(
+                    f"block {b} is not allocated (double free?)")
+        if len(set(blocks)) != len(blocks):
+            raise BlockAccountingError(
+                f"duplicate blocks in free(): {blocks}")
+        for b in blocks:
+            self._used.discard(b)
+            self._free.append(b)
+
+    def check(self):
+        """Invariant: every block is exactly one of {null, free, used}.
+        Raises BlockAccountingError on violation; returns True."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise BlockAccountingError("duplicate ids in free list")
+        if free & self._used:
+            raise BlockAccountingError(
+                f"blocks both free and used: {sorted(free & self._used)}")
+        if len(free) + len(self._used) != self.num_usable:
+            raise BlockAccountingError(
+                f"leak: {self.num_usable - len(free) - len(self._used)} "
+                "blocks neither free nor used")
+        return True
+
+
+class PagedKVCache:
+    """The block pool's storage + allocator + block-table helpers.
+
+    K and V pages are jnp arrays of shape ``[num_layers, num_blocks,
+    block_size, num_heads, head_dim]``. The engine passes them into its
+    donated jitted programs and swaps the returned buffers back in via
+    :meth:`swap` — the cache object itself never mutates device memory.
+    """
+
+    def __init__(self, num_layers, num_heads, head_dim, block_size,
+                 num_blocks, max_context, dtype="float32"):
+        import jax.numpy as jnp
+        if max_context < 1:
+            raise ValueError(f"max_context must be >= 1, {max_context}")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_context = int(max_context)
+        self.dtype = np.dtype(dtype)
+        # every sequence's table has room for a full-context sequence
+        self.max_blocks_per_seq = -(-self.max_context // self.block_size)
+        self.allocator = BlockAllocator(self.num_blocks)
+        shape = (self.num_layers, self.num_blocks, self.block_size,
+                 self.num_heads, self.head_dim)
+        self.k_pages = jnp.zeros(shape, dtype=jnp.dtype(self.dtype))
+        self.v_pages = jnp.zeros(shape, dtype=jnp.dtype(self.dtype))
+
+    # ------------------------------------------------------- tables --
+    def blocks_for(self, num_tokens):
+        """Blocks needed to hold ``num_tokens`` KV entries."""
+        return -(-int(num_tokens) // self.block_size)
+
+    def table_row(self, block_ids):
+        """A sequence's padded block-table row: int32
+        ``[max_blocks_per_seq]``, unused entries = the null block."""
+        row = np.full(self.max_blocks_per_seq, NULL_BLOCK, np.int32)
+        if len(block_ids) > self.max_blocks_per_seq:
+            raise KVCacheError(
+                f"{len(block_ids)} blocks exceed the "
+                f"{self.max_blocks_per_seq}-block table "
+                f"(max_context={self.max_context})")
+        row[:len(block_ids)] = block_ids
+        return row
+
+    # ------------------------------------------------------ storage --
+    def swap(self, k_pages, v_pages):
+        """Install the updated page buffers a donated program returned."""
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+
+    # -------------------------------------------------------- stats --
+    def stats(self):
+        a = self.allocator
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "blocks_used": a.num_used,
+            "blocks_free": a.num_free,
+            "occupancy": a.occupancy(),
+            "max_blocks_per_seq": self.max_blocks_per_seq,
+        }

@@ -1,0 +1,201 @@
+"""LLM serving telemetry: the ``mxtpu_llm_*`` series.
+
+Same discipline as :mod:`mxnet_tpu.serving.telemetry`: every series
+lives on the process-wide observability registry, labeled
+``{server="<name>"}`` via the shared claim protocol (a restarted server
+re-claims its label; a live duplicate gets ``#N``), so LLM decode
+telemetry lands in the same Prometheus exposition as training,
+checkpoint and single-shot serving metrics.
+
+The serving-economics headline numbers ("Fine-Tuning and Serving Gemma
+4 31B on Google Cloud TPU", PAPERS.md) are first-class:
+
+- ``mxtpu_llm_tokens_per_sec`` — decode throughput gauge (smoothed
+  per-launch rate, EMA over decode steps — a lifetime average would
+  decay across idle gaps; use ``rate(`` on the token counter for
+  precise windows);
+- ``mxtpu_llm_ttft_seconds`` — time-to-first-token histogram (submit →
+  first generated token, i.e. queue wait + prefill);
+- ``mxtpu_llm_kv_blocks_in_use`` / ``_total`` — paged-cache occupancy.
+"""
+from __future__ import annotations
+
+import threading
+
+from ...observability import get_registry
+from ..telemetry import _claim_server_label, _LATENCY_BUCKETS
+
+__all__ = ["LLMStats"]
+
+
+class LLMStats:
+    """Thread-safe LLM serving counters on the shared registry."""
+
+    def __init__(self, server="llm", registry=None):
+        self._reg = registry if registry is not None else get_registry()
+        self._server = _claim_server_label(str(server), self)
+        r, lbl = self._reg, ("server",)
+        s = {"server": self._server}
+        self._submitted = r.counter(
+            "mxtpu_llm_requests_submitted_total",
+            "Decode requests accepted.", lbl).labels(**s)
+        self._completed = r.counter(
+            "mxtpu_llm_requests_completed_total",
+            "Decode requests finished with a full generation.",
+            lbl).labels(**s)
+        self._evicted = r.counter(
+            "mxtpu_llm_requests_evicted_total",
+            "Decode requests rejected mid-flight "
+            "(drain deadline, shutdown).", ("server", "reason"))
+        self._failed = r.counter(
+            "mxtpu_llm_requests_failed_total",
+            "Decode requests resolved with an error.", lbl).labels(**s)
+        self._tokens = r.counter(
+            "mxtpu_llm_tokens_generated_total",
+            "Tokens produced by decode steps and prefill.",
+            lbl).labels(**s)
+        self._prefill_tokens = r.counter(
+            "mxtpu_llm_prefill_tokens_total",
+            "Prompt tokens whose KV was written by prefill "
+            "(pad excluded).", lbl).labels(**s)
+        self._prefills = r.counter(
+            "mxtpu_llm_prefills_total",
+            "Prefill launches (admissions incl. preemption resumes).",
+            lbl).labels(**s)
+        self._decode_steps = r.counter(
+            "mxtpu_llm_decode_steps_total",
+            "Fixed-shape decode batch launches.", lbl).labels(**s)
+        self._preemptions = r.counter(
+            "mxtpu_llm_preemptions_total",
+            "Sequences evicted for KV pressure and requeued "
+            "(restart-based preemption).", lbl).labels(**s)
+        self._queue_depth = r.gauge(
+            "mxtpu_llm_queue_depth",
+            "Sequences waiting for admission.", lbl).labels(**s)
+        self._running = r.gauge(
+            "mxtpu_llm_running_seqs",
+            "Sequences in the decode batch.", lbl).labels(**s)
+        self._blocks_in_use = r.gauge(
+            "mxtpu_llm_kv_blocks_in_use",
+            "Allocated KV cache blocks.", lbl).labels(**s)
+        self._blocks_total = r.gauge(
+            "mxtpu_llm_kv_blocks_total",
+            "Usable KV cache blocks (pool minus the null block).",
+            lbl).labels(**s)
+        self._tps = r.gauge(
+            "mxtpu_llm_tokens_per_sec",
+            "Decode throughput: smoothed per-step rate (EMA over "
+            "decode launches). For precise windows use "
+            "rate(mxtpu_llm_tokens_generated_total).",
+            lbl).labels(**s)
+        self._ttft = r.histogram(
+            "mxtpu_llm_ttft_seconds",
+            "Time to first token: submit -> first generated token "
+            "(queue wait + prefill).", lbl,
+            buckets=_LATENCY_BUCKETS).labels(**s)
+        self._latency = r.histogram(
+            "mxtpu_llm_request_seconds",
+            "Per-request end-to-end latency (submit -> last token).",
+            lbl, buckets=_LATENCY_BUCKETS).labels(**s)
+        self._step_time = r.histogram(
+            "mxtpu_llm_decode_step_seconds",
+            "Wall time of one decode batch launch.", lbl,
+            buckets=_LATENCY_BUCKETS).labels(**s)
+        self._evict_children = {}
+        self._lock = threading.Lock()
+        self._gen_count = 0
+
+    @property
+    def server_label(self):
+        return self._server
+
+    # ---------------------------------------------------- recording --
+    def record_submit(self):
+        self._submitted.inc()
+
+    def record_admission_state(self, waiting, running):
+        self._queue_depth.set(waiting)
+        self._running.set(running)
+
+    def record_blocks(self, in_use, total):
+        self._blocks_in_use.set(in_use)
+        self._blocks_total.set(total)
+
+    def record_prefill(self, prompt_tokens):
+        self._prefills.inc()
+        self._prefill_tokens.inc(prompt_tokens)
+
+    def record_first_token(self, ttft_s):
+        self._ttft.observe(ttft_s)
+
+    # smoothing factor for the per-step throughput EMA: heavy enough
+    # to damp single-launch jitter, light enough that the gauge tracks
+    # a load change within a few steps. A lifetime average would decay
+    # toward zero across idle gaps and misreport healthy bursts.
+    _TPS_ALPHA = 0.2
+
+    def record_decode_step(self, new_tokens, step_s):
+        with self._lock:
+            self._decode_steps.inc()
+            self._step_time.observe(step_s)
+            self._tokens.inc(new_tokens)
+            self._gen_count += new_tokens
+            inst = new_tokens / max(step_s, 1e-9)
+            prev = self._tps.value
+            self._tps.set(inst if prev == 0
+                          else prev + self._TPS_ALPHA * (inst - prev))
+
+    def record_prefill_token(self):
+        """The first generated token comes out of prefill, not a
+        decode step — count it so the token counter sees every
+        token (the throughput EMA tracks decode launches only)."""
+        with self._lock:
+            self._tokens.inc()
+            self._gen_count += 1
+
+    def record_preemption(self):
+        self._preemptions.inc()
+
+    def record_completed(self, latency_s):
+        self._completed.inc()
+        self._latency.observe(latency_s)
+
+    def record_evicted(self, reason):
+        child = self._evict_children.get(reason)
+        if child is None:
+            child = self._evicted.labels(server=self._server,
+                                         reason=reason)
+            self._evict_children[reason] = child
+        child.inc()
+
+    def record_failure(self, n=1):
+        self._failed.inc(n)
+
+    # -------------------------------------------------------- stats --
+    def snapshot(self):
+        with self._lock:
+            return {
+                "requests_submitted": int(self._submitted.value),
+                "requests_completed": int(self._completed.value),
+                "requests_evicted": int(sum(
+                    c.value for c in self._evict_children.values())),
+                "requests_failed": int(self._failed.value),
+                "tokens_generated": int(self._tokens.value),
+                "prefill_tokens": int(self._prefill_tokens.value),
+                "prefills": int(self._prefills.value),
+                "decode_steps": int(self._decode_steps.value),
+                "preemptions": int(self._preemptions.value),
+                "queue_depth": int(self._queue_depth.value),
+                "running_seqs": int(self._running.value),
+                "kv_blocks_in_use": int(self._blocks_in_use.value),
+                "kv_blocks_total": int(self._blocks_total.value),
+                "tokens_per_sec": self._tps.value,
+                "ttft_ms": {
+                    "p50": self._ttft.percentile(50) * 1e3,
+                    "p99": self._ttft.percentile(99) * 1e3,
+                },
+                "request_ms": {
+                    "p50": self._latency.percentile(50) * 1e3,
+                    "p99": self._latency.percentile(99) * 1e3,
+                },
+            }

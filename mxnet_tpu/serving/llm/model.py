@@ -1,0 +1,240 @@
+"""A small decoder-only transformer in paged-decode form.
+
+Two entry points per model, mirroring the prefill/decode split every
+LLM serving stack runs:
+
+- :meth:`TinyDecoder.forward` — dense causal forward over a whole
+  token prefix ``[B, T]``, returning logits AND the per-layer K/V it
+  computed. The engine runs this once per admitted sequence (prefill)
+  and writes the K/V into the paged cache; it is also the eager oracle
+  (:func:`greedy_decode_reference`).
+- :meth:`TinyDecoder.decode_step` — one token per sequence ``[S]``
+  against the paged KV cache: each layer writes the new token's K/V
+  into its page slot (block table + position), then attends over the
+  block-table-indirected history via
+  :func:`mxnet_tpu.ops.ragged_attention.ragged_paged_attention`.
+
+Both are pure functions of ``(params, inputs)`` — the engine jits them
+with donated page buffers. The architecture is deliberately small
+(learned absolute positions, pre-LN blocks, GELU MLP) — the subsystem
+under test is the serving engine, not the model zoo — but the
+interface (``num_layers/num_heads/head_dim/vocab_size`` + the two
+methods above) is what any decoder backend must provide.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...ops.ragged_attention import ragged_paged_attention
+from ...ops.flash_attention import attention_reference
+
+__all__ = ["DecoderConfig", "TinyDecoder", "greedy_decode_reference"]
+
+
+class DecoderConfig:
+    """Shape of a :class:`TinyDecoder` (serializable for deploy)."""
+
+    FIELDS = ("vocab_size", "d_model", "num_layers", "num_heads",
+              "d_ff", "max_context")
+
+    def __init__(self, vocab_size=32, d_model=32, num_layers=2,
+                 num_heads=2, d_ff=64, max_context=128):
+        self.vocab_size = int(vocab_size)
+        self.d_model = int(d_model)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.d_ff = int(d_ff)
+        self.max_context = int(max_context)
+        for f in self.FIELDS:
+            if getattr(self, f) < 1:
+                raise ValueError(f"{f} must be >= 1, got {getattr(self, f)}")
+        if self.d_model % self.num_heads:
+            raise ValueError(
+                f"d_model {d_model} not divisible by heads {num_heads}")
+        self.head_dim = self.d_model // self.num_heads
+
+    def to_dict(self):
+        return {f: getattr(self, f) for f in self.FIELDS}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**{f: d[f] for f in cls.FIELDS})
+
+    def __repr__(self):
+        return ("DecoderConfig(" + ", ".join(
+            f"{f}={getattr(self, f)}" for f in self.FIELDS) + ")")
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    m = x.mean(axis=-1, keepdims=True)
+    v = ((x - m) ** 2).mean(axis=-1, keepdims=True)
+    import jax.numpy as jnp
+    return (x - m) / jnp.sqrt(v + eps) * g + b
+
+
+class TinyDecoder:
+    """Decoder-only transformer with paged-decode support."""
+
+    def __init__(self, config=None, **kw):
+        self.config = config if config is not None else DecoderConfig(**kw)
+
+    # engine-facing shape attributes
+    @property
+    def num_layers(self):
+        return self.config.num_layers
+
+    @property
+    def num_heads(self):
+        return self.config.num_heads
+
+    @property
+    def head_dim(self):
+        return self.config.head_dim
+
+    @property
+    def vocab_size(self):
+        return self.config.vocab_size
+
+    @property
+    def max_context(self):
+        return self.config.max_context
+
+    # ------------------------------------------------------- params --
+    def init_params(self, seed=0):
+        """Deterministic random params (host numpy, float32)."""
+        c = self.config
+        rs = np.random.RandomState(seed)
+
+        def w(*shape, scale=None):
+            scale = scale if scale is not None else 1.0 / np.sqrt(shape[0])
+            return (rs.randn(*shape) * scale).astype(np.float32)
+
+        layers = []
+        for _ in range(c.num_layers):
+            layers.append({
+                "ln1_g": np.ones(c.d_model, np.float32),
+                "ln1_b": np.zeros(c.d_model, np.float32),
+                "wq": w(c.d_model, c.d_model),
+                "wk": w(c.d_model, c.d_model),
+                "wv": w(c.d_model, c.d_model),
+                "wo": w(c.d_model, c.d_model),
+                "ln2_g": np.ones(c.d_model, np.float32),
+                "ln2_b": np.zeros(c.d_model, np.float32),
+                "w1": w(c.d_model, c.d_ff),
+                "b1": np.zeros(c.d_ff, np.float32),
+                "w2": w(c.d_ff, c.d_model),
+                "b2": np.zeros(c.d_model, np.float32),
+            })
+        return {
+            "embed": w(c.vocab_size, c.d_model, scale=0.5),
+            "pos": w(c.max_context, c.d_model, scale=0.1),
+            "lnf_g": np.ones(c.d_model, np.float32),
+            "lnf_b": np.zeros(c.d_model, np.float32),
+            "head": w(c.d_model, c.vocab_size),
+            "layers": layers,
+        }
+
+    # ------------------------------------------------------ prefill --
+    def forward(self, params, tokens):
+        """Dense causal forward. tokens: int32 [B, T] (T <=
+        max_context). Returns (logits [B, T, V], k, v) with k/v
+        [L, B, T, H, Dh] — the KV the prefill path writes into pages.
+        """
+        import jax
+        import jax.numpy as jnp
+        c = self.config
+        B, T = tokens.shape
+        h = params["embed"][tokens] + params["pos"][:T][None, :, :]
+        ks, vs = [], []
+        for lp in params["layers"]:
+            x = _layer_norm(h, lp["ln1_g"], lp["ln1_b"])
+            q = (x @ lp["wq"]).reshape(B, T, c.num_heads, c.head_dim)
+            k = (x @ lp["wk"]).reshape(B, T, c.num_heads, c.head_dim)
+            v = (x @ lp["wv"]).reshape(B, T, c.num_heads, c.head_dim)
+            ks.append(k)
+            vs.append(v)
+            att = attention_reference(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=True)
+            att = att.transpose(0, 2, 1, 3).reshape(B, T, c.d_model)
+            h = h + att @ lp["wo"]
+            x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
+            h = h + jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"] \
+                + lp["b2"]
+        logits = _layer_norm(h, params["lnf_g"],
+                             params["lnf_b"]) @ params["head"]
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+    # ------------------------------------------------------- decode --
+    def decode_step(self, params, tokens, positions, k_pages, v_pages,
+                    block_tables, kv_lens):
+        """One decode token per sequence against the paged cache.
+
+        tokens/positions: int32 [S]; pages: [L, N, bs, H, Dh];
+        block_tables: int32 [S, MB]; kv_lens: int32 [S] — the valid
+        length INCLUDING the token being decoded (positions + 1 for
+        active rows, 1 for inactive rows over the null block).
+
+        Each layer first writes the new token's K/V at
+        ``(block_tables[i, pos // bs], pos % bs)`` — padding/inactive
+        rows target the null block — then attends over the whole paged
+        history. Returns (logits [S, V], k_pages, v_pages).
+        """
+        import jax
+        import jax.numpy as jnp
+        c = self.config
+        S = tokens.shape[0]
+        bs = k_pages.shape[2]
+        rows = jnp.arange(S)
+        bidx = block_tables[rows, positions // bs]     # [S] page ids
+        slot = positions % bs
+        h = params["embed"][tokens] + params["pos"][positions]
+        for li, lp in enumerate(params["layers"]):
+            x = _layer_norm(h, lp["ln1_g"], lp["ln1_b"])
+            q = (x @ lp["wq"]).reshape(S, c.num_heads, c.head_dim)
+            k = (x @ lp["wk"]).reshape(S, c.num_heads, c.head_dim)
+            v = (x @ lp["wv"]).reshape(S, c.num_heads, c.head_dim)
+            k_pages = k_pages.at[li, bidx, slot].set(
+                k.astype(k_pages.dtype))
+            v_pages = v_pages.at[li, bidx, slot].set(
+                v.astype(v_pages.dtype))
+            att = ragged_paged_attention(q, k_pages[li], v_pages[li],
+                                         block_tables, kv_lens)
+            h = h + att.reshape(S, c.d_model) @ lp["wo"]
+            x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
+            h = h + jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"] \
+                + lp["b2"]
+        logits = _layer_norm(h, params["lnf_g"],
+                             params["lnf_b"]) @ params["head"]
+        return logits, k_pages, v_pages
+
+
+def greedy_decode_reference(model, params, prompt_tokens,
+                            max_new_tokens, stop_token=None):
+    """Per-sequence eager greedy decoding — the oracle continuous
+    batching must match token for token.
+
+    Recomputes the dense causal forward over the full prefix at every
+    step (no KV cache at all) and takes the prefix's last position's
+    argmax. The input is zero-padded to ``max_context`` so every step
+    runs the SAME shape: causal masking makes positions past the
+    prefix invisible to it, and one fixed shape keeps the oracle from
+    compiling one program per prefix length. Returns the generated
+    tokens (prompt excluded) as a list.
+    """
+    import jax.numpy as jnp
+    toks = [int(t) for t in prompt_tokens]
+    out = []
+    ctx = model.max_context
+    for _ in range(max_new_tokens):
+        padded = np.zeros(ctx, np.int32)
+        padded[:len(toks)] = toks
+        logits, _, _ = model.forward(params, jnp.asarray(padded[None]))
+        nxt = int(jnp.argmax(logits[0, len(toks) - 1]))
+        out.append(nxt)
+        toks.append(nxt)
+        if stop_token is not None and nxt == stop_token:
+            break
+        if len(toks) >= ctx:
+            break
+    return out

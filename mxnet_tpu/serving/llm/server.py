@@ -1,0 +1,323 @@
+"""LLMServer: the request-facing front end of the decode engine.
+
+Reuses the ``ModelServer`` plumbing contracts (PR 2) on top of
+:class:`~.engine.LLMEngine`: many threads submit prompts and get
+Futures; ONE worker thread drives the engine loop (admit → step →
+retire, every iteration); ``warmup()`` pre-compiles every reachable
+program so steady state never hits XLA; drain on shutdown or
+preemption resolves EVERY Future.
+
+What decode adds over single-shot serving is drain *semantics*: an
+in-flight sequence is minutes of state, not one forward pass. So drain
+runs the engine until every live sequence completes OR a deadline
+(``deadline_ms`` arg > ``MXNET_TPU_SERVE_DRAIN_DEADLINE_MS`` env >
+unbounded) expires — past it, live sequences are rejected with a typed
+:class:`SequenceEvictedError` CARRYING the tokens generated so far.
+A caller always gets either its full generation or a partial one
+under a typed error; nothing is silently dropped.
+
+Observability: per-request hand-off spans (``mxtpu.llm.request``
+opened under the caller's context, finished by the worker with
+ttft/token counts), engine prefill/decode spans, and the
+``mxtpu_llm_*`` registry series (:class:`~.metrics.LLMStats`) —
+tokens/sec, TTFT, queue depth, KV-block occupancy/eviction.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..batching import ServerClosed
+from ..envutil import env_float as _env_float
+from .engine import LLMEngine
+from .metrics import LLMStats
+from .scheduler import Sequence
+from ..telemetry import compile_count
+from ...observability.tracing import get_tracer
+
+__all__ = ["LLMServer", "SequenceEvictedError", "GenerationResult"]
+
+
+class SequenceEvictedError(RuntimeError):
+    """A decode sequence was evicted before completing (drain deadline,
+    no-drain shutdown). Carries everything generated so far — the
+    caller decides whether a partial generation is usable."""
+
+    def __init__(self, message, tokens=(), seq_id=None,
+                 reason="evicted"):
+        super().__init__(message)
+        self.tokens = [int(t) for t in tokens]
+        self.seq_id = seq_id
+        self.reason = reason
+
+
+class GenerationResult:
+    """A completed generation: ``tokens`` (ints, prompt excluded),
+    ``seq_id``, ``ttft_s``, ``finish_reason``."""
+
+    __slots__ = ("tokens", "seq_id", "ttft_s", "finish_reason")
+
+    def __init__(self, tokens, seq_id, ttft_s, finish_reason):
+        self.tokens = tokens
+        self.seq_id = seq_id
+        self.ttft_s = ttft_s
+        self.finish_reason = finish_reason
+
+    def __repr__(self):
+        return (f"GenerationResult(seq={self.seq_id}, "
+                f"tokens={len(self.tokens)}, "
+                f"reason={self.finish_reason!r})")
+
+
+class LLMServer:
+    """Serve autoregressive greedy decoding with continuous batching.
+
+    ``model``/``params``: a decoder in paged form (see
+    :class:`~.model.TinyDecoder`) and its parameter pytree. Engine
+    sizing kwargs (``max_seqs``, ``block_size``, ``num_blocks``,
+    ``max_context``, ``prefill_buckets``) pass through to
+    :class:`~.engine.LLMEngine`, each defaulting to its
+    ``MXNET_TPU_LLM_*`` env var.
+    """
+
+    def __init__(self, model, params, name="llm", **engine_kw):
+        self.name = name
+        self._stats = LLMStats(server=name)
+        self._engine = LLMEngine(model, params, stats=self._stats,
+                                 **engine_kw)
+        self._cv = threading.Condition()
+        self._pending = []
+        self._closed = False
+        self._drain = True
+        self._deadline = None
+        self._worker = None
+        self._started = False
+        self._guard_watcher = None
+        self._guard_stop = threading.Event()
+
+    # -------------------------------------------------------- sizing --
+    @property
+    def engine(self):
+        return self._engine
+
+    @property
+    def max_context(self):
+        return self._engine.max_context
+
+    # ----------------------------------------------------- lifecycle --
+    def start(self):
+        if self._started:
+            return self
+        self._started = True
+        self._worker = threading.Thread(
+            target=self._run_loop, name=f"mxtpu-{self.name}-engine",
+            daemon=True)
+        self._worker.start()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    @property
+    def running(self):
+        return self._started and not self._closed
+
+    def warmup(self):
+        """Pre-compile every prefill bucket + the decode program.
+        Must run BEFORE ``start()`` — enforced, because warmup and the
+        engine thread would otherwise race on the shared KV pages
+        (concurrent ``cache.swap`` loses updates; on TPU both would
+        donate the same buffer). Returns {program: seconds}."""
+        if self._started:
+            raise RuntimeError(
+                "warmup() must run before start(): the engine thread "
+                "owns the KV cache once serving begins")
+        return self._engine.warmup()
+
+    # -------------------------------------------------------- submit --
+    def submit(self, prompt_tokens, max_new_tokens, stop_token=None):
+        """Enqueue one prompt; returns a Future resolving to a
+        :class:`GenerationResult` (or raising
+        :class:`SequenceEvictedError` / :class:`ServerClosed`)."""
+        if not self._started:
+            raise RuntimeError("server not started; call start()")
+        prompt = [int(t) for t in np.asarray(prompt_tokens).ravel()]
+        seq = Sequence(prompt, max_new_tokens, stop_token=stop_token)
+        # validate shape/vocab NOW, on the caller's thread
+        self._engine.add_validate(seq)
+        from concurrent.futures import Future
+        seq.future = Future()
+        tracer = get_tracer()
+        if tracer.enabled:
+            seq.span = tracer.begin("mxtpu.llm.request", "llm",
+                                    tracer.current())
+            seq.span.set("seq_id", seq.seq_id)
+            seq.span.set("prompt", len(prompt))
+        with self._cv:
+            if self._closed:
+                if seq.span is not None:
+                    seq.span.set("error", "ServerClosed")
+                    seq.span.finish()
+                raise ServerClosed(
+                    "server is draining; no new sequences admitted")
+            self._pending.append(seq)
+            self._cv.notify_all()
+        self._stats.record_submit()
+        return seq.future
+
+    def generate(self, prompt_tokens, max_new_tokens, stop_token=None,
+                 timeout=None):
+        """Blocking single-prompt decode through the batcher."""
+        return self.submit(prompt_tokens, max_new_tokens,
+                           stop_token=stop_token).result(timeout=timeout)
+
+    # --------------------------------------------------------- stats --
+    def stats(self):
+        snap = self._stats.snapshot()
+        snap["compiles"] = compile_count()
+        snap["kv_cache"] = self._engine.cache.stats()
+        snap["prefill_buckets"] = list(self._engine.prefill_spec)
+        snap["max_seqs"] = self._engine.max_seqs
+        return snap
+
+    # --------------------------------------------------------- drain --
+    def shutdown(self, drain=True, deadline_ms=None):
+        """Stop admitting. With ``drain``, run every live sequence to
+        completion within the deadline (explicit ``deadline_ms`` arg >
+        ``MXNET_TPU_SERVE_DRAIN_DEADLINE_MS`` env > unbounded); past it
+        — or immediately with ``drain=False`` — live sequences resolve
+        with :class:`SequenceEvictedError` carrying their tokens so
+        far. An EXPLICIT ``deadline_ms=0`` means "evict now, typed"
+        (the ``ModelServer.shutdown(timeout=0)`` analogue); an unset/0
+        env var means unbounded. Idempotent; every Future resolves
+        either way."""
+        if not self._started:
+            return
+        if deadline_ms is None:
+            env_ms = _env_float("MXNET_TPU_SERVE_DRAIN_DEADLINE_MS", 0.0)
+            deadline_ms = env_ms if env_ms > 0 else None
+        with self._cv:
+            if not self._closed:
+                self._closed = True
+                self._drain = bool(drain)
+                if not drain:
+                    self._deadline = time.monotonic()
+                elif deadline_ms is None:
+                    self._deadline = None
+                else:
+                    self._deadline = (time.monotonic()
+                                      + deadline_ms / 1e3)
+            self._cv.notify_all()
+        if self._worker is not None:
+            self._worker.join()
+        self._guard_stop.set()
+
+    close = shutdown
+
+    def attach_preemption_guard(self, guard, poll_s=0.05,
+                                deadline_ms=None):
+        """Drain on preemption (``resilience.PreemptionGuard``): once
+        the guard trips, stop admitting and drain under the deadline —
+        sequences that cannot finish in time are evicted WITH their
+        partial tokens, never lost silently."""
+        if self._guard_watcher is not None:
+            return self
+
+        def _watch():
+            while not self._guard_stop.is_set():
+                if guard.wait(poll_s):
+                    self.shutdown(drain=True, deadline_ms=deadline_ms)
+                    return
+
+        self._guard_watcher = threading.Thread(
+            target=_watch, name=f"mxtpu-{self.name}-preempt-watch",
+            daemon=True)
+        self._guard_watcher.start()
+        return self
+
+    # --------------------------------------------------- worker loop --
+    def _resolve_finished(self, seq):
+        ttft = (seq.t_first_token - seq.t_submit
+                if seq.t_first_token else None)
+        res = GenerationResult(seq.output_tokens(), seq.seq_id, ttft,
+                               seq.finish_reason)
+        self._stats.record_completed(time.monotonic() - seq.t_submit)
+        if seq.span is not None:
+            seq.span.set("tokens", len(res.tokens))
+            if ttft is not None:
+                seq.span.set("ttft_ms", round(ttft * 1e3, 3))
+            seq.span.set("finish", seq.finish_reason)
+            seq.span.finish()
+            seq.span = None
+        seq.future.set_result(res)
+
+    def _resolve_evicted(self, seq, reason):
+        toks = seq.output_tokens()
+        err = SequenceEvictedError(
+            f"sequence {seq.seq_id} evicted ({reason}) after "
+            f"{len(toks)} tokens", tokens=toks, seq_id=seq.seq_id,
+            reason=reason)
+        self._stats.record_evicted(reason)
+        if seq.span is not None:
+            seq.span.set("error", reason)
+            seq.span.set("tokens", len(toks))
+            seq.span.finish()
+            seq.span = None
+        seq.future.set_exception(err)
+
+    def _run_loop(self):
+        engine = self._engine
+        while True:
+            with self._cv:
+                while (not self._pending and not engine.has_work()
+                       and not self._closed):
+                    self._cv.wait(timeout=0.05)
+                pending, self._pending = self._pending, []
+                closed, drain = self._closed, self._drain
+                deadline = self._deadline
+            for seq in pending:
+                engine.add(seq)
+            if closed:
+                expired = (deadline is not None
+                           and time.monotonic() >= deadline)
+                if not drain or expired:
+                    reason = ("shutdown" if not drain
+                              else "drain_deadline")
+                    for seq in engine.pop_finished():
+                        self._resolve_finished(seq)
+                    for seq in engine.evict_all(reason):
+                        self._resolve_evicted(seq, reason)
+                    return
+                if not engine.has_work():
+                    return
+            if not engine.has_work():
+                continue
+            try:
+                engine.step()
+            except Exception as exc:    # resolve, never hang callers
+                # the worker is about to die: close admission FIRST so
+                # no future submit can enqueue onto a dead loop, then
+                # deliver what DID finish inside the failing step and
+                # fail everything else live (engine + still-pending)
+                with self._cv:
+                    self._closed = True
+                    self._drain = False
+                    orphans, self._pending = self._pending, []
+                for seq in engine.pop_finished():
+                    self._resolve_finished(seq)
+                for seq in orphans + engine.evict_all("engine_error"):
+                    self._stats.record_failure()
+                    if seq.span is not None:
+                        seq.span.set("error", repr(exc))
+                        seq.span.finish()
+                        seq.span = None
+                    seq.future.set_exception(exc)
+                raise
+            for seq in engine.pop_finished():
+                self._resolve_finished(seq)

@@ -31,7 +31,7 @@ import time
 import numpy as np
 
 from .batching import MicroBatchQueue, ServerClosed
-from .bucketing import bucket_sizes, pick_bucket, pad_batch, waste_fraction
+from .bucketing import BucketSpec, bucket_sizes, waste_fraction
 from .telemetry import ServingStats, EventLog, compile_count
 from ..observability.tracing import get_tracer
 
@@ -62,14 +62,7 @@ def _finish_request_spans(batch, bucket=None, pad_s=None, service_s=None,
         req.span = None
 
 
-def _env_int(name, default):
-    v = os.environ.get(name)
-    return int(v) if v else default
-
-
-def _env_float(name, default):
-    v = os.environ.get(name)
-    return float(v) if v else default
+from .envutil import env_int as _env_int, env_float as _env_float
 
 
 def _env_buckets():
@@ -112,7 +105,8 @@ class ModelServer:
             max_delay_ms = _env_float("MXNET_TPU_SERVE_MAX_DELAY_MS", 2.0)
         if buckets is None:
             buckets = bucket_sizes(max_batch_size)
-        buckets = sorted(set(buckets))
+        self._bucket_spec = BucketSpec(buckets, axis=0)
+        buckets = self._bucket_spec.buckets
         if max_batch_size > max(buckets):
             raise ValueError(
                 f"max_batch_size {max_batch_size} exceeds the largest "
@@ -130,7 +124,7 @@ class ModelServer:
                         else EventLog.from_env())
         self._worker = None
         self._started = False
-        self._abort = False
+        self._abort = None      # set to an abort reason string
         self._drained = threading.Event()
         self._guard_watcher = None
         self._guard_stop = threading.Event()
@@ -216,8 +210,8 @@ class ModelServer:
                 "constructor (they are inferred automatically for "
                 "Predictor backends)")
         timings = {}
-        for b in self.buckets:
-            zeros = np.zeros((b,) + self._item_shape, dtype=self._dtype)
+        for b, shape in self._bucket_spec.warmup_shapes(self._item_shape):
+            zeros = np.zeros(shape, dtype=self._dtype)
             t0 = time.monotonic()
             out = self._fn(zeros)
             np.asarray(out)
@@ -280,17 +274,35 @@ class ModelServer:
     # ------------------------------------------------------------ drain --
     def shutdown(self, drain=True, timeout=None):
         """Stop admitting; with ``drain`` serve everything queued, else
-        fail queued requests with ServerClosed. Idempotent."""
+        fail queued requests with ServerClosed. Idempotent.
+
+        ``timeout`` bounds the drain (default: the
+        ``MXNET_TPU_SERVE_DRAIN_DEADLINE_MS`` env var, unbounded when
+        unset). Past the deadline the remaining queued requests are
+        REJECTED with ServerClosed instead of served — every Future
+        still resolves, nothing is silently dropped."""
         if not self._started:
             return
+        if timeout is None:
+            deadline_ms = _env_float("MXNET_TPU_SERVE_DRAIN_DEADLINE_MS",
+                                     0.0)
+            timeout = deadline_ms / 1e3 if deadline_ms > 0 else None
         if not drain:
             # fail queued work fast: the worker resolves the remaining
             # requests with ServerClosed instead of running the model
-            self._abort = True
+            self._abort = "no_drain"
         self._queue.close()
         self._events.emit("drain_begin", queued=self._queue.depth())
         if self._worker is not None:
             self._worker.join(timeout=timeout)
+            if self._worker.is_alive():
+                # deadline expired mid-drain: flip to abort so the
+                # worker fails the remaining queue instead of running
+                # the model for it, then wait for that (fast) flush
+                self._abort = "drain_deadline"
+                self._events.emit("drain_deadline",
+                                  queued=self._queue.depth())
+                self._worker.join()
         self._guard_stop.set()
         self._drained.set()
         self._events.emit("stop", **{k: v for k, v in self.stats().items()
@@ -331,15 +343,21 @@ class ModelServer:
             if not batch:
                 return  # closed and empty
             if self._abort:
-                exc = ServerClosed("server shut down without drain")
+                # tell the caller WHY its request was not served: a
+                # deadline-bounded drain that ran out of time is not
+                # the same as a no-drain shutdown
+                exc = ServerClosed(
+                    "server drain deadline expired; request not served"
+                    if self._abort == "drain_deadline"
+                    else "server shut down without drain")
                 for req in batch:
                     req.future.set_exception(exc)
-                _finish_request_spans(batch, error="aborted")
+                _finish_request_spans(batch, error=self._abort)
                 self._stats.record_failure(len(batch))
                 continue
             self._stats.record_queue_depth(self._queue.depth())
             n = len(batch)
-            bucket = pick_bucket(n, self.buckets)
+            bucket = self._bucket_spec.pick(n)
             with tracer.span("mxtpu.serving.batch", "serving") as bsp:
                 bsp.set("server", self.name)
                 bsp.set("n", n)
@@ -348,7 +366,7 @@ class ModelServer:
                 with tracer.span("mxtpu.serving.pad", "serving"):
                     rows = np.stack([r.x for r in batch]).astype(
                         self._dtype, copy=False)
-                    padded = pad_batch(rows, bucket)
+                    padded, _ = self._bucket_spec.pad(rows, bucket)
                 pad_s = time.monotonic() - t_pad
                 t0 = time.monotonic()
                 try:

@@ -55,6 +55,9 @@ CASES = [
       "--image-size", "32"]),
     ("serve_predictor.py", ["--threads", "4", "--requests", "8",
                             "--max-batch", "4", "--feature-dim", "16"]),
+    ("llm_serve_decode.py", ["--threads", "4", "--requests", "4",
+                             "--max-context", "32",
+                             "--max-new-tokens", "6"]),
     ("nce_lm.py", ["--epochs", "3", "--max-ppl", "120"]),
     ("rbm_digits.py", ["--epochs", "3", "--num-samples", "256",
                        "--max-recon-err", "0.12"]),
@@ -99,6 +102,24 @@ def test_serve_bench_smoke():
         capture_output=True, text=True, timeout=600, env=env)
     assert p.returncode == 0, \
         f"serve_bench --smoke failed:\n{p.stdout[-2000:]}\n" \
+        f"{p.stderr[-2000:]}"
+    assert "SMOKE PASS" in p.stdout
+
+
+def test_llm_bench_smoke():
+    """tools/llm_bench.py --smoke: the continuous-batching decode load
+    generator must complete losslessly with zero recompiles during
+    load AND emit a BENCH json carrying tokens/sec, TTFT p50/p99 and
+    KV occupancy (it exits 1 otherwise)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    tools = os.path.join(os.path.dirname(EXAMPLES), "tools")
+    p = subprocess.run(
+        [sys.executable, os.path.join(tools, "llm_bench.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert p.returncode == 0, \
+        f"llm_bench --smoke failed:\n{p.stdout[-2000:]}\n" \
         f"{p.stderr[-2000:]}"
     assert "SMOKE PASS" in p.stdout
 

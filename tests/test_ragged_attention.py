@@ -1,0 +1,260 @@
+"""Ragged/paged attention parity + KV block allocator accounting.
+
+The kernel contract (ISSUE 8): attention over block-table-indirected
+paged KV for a batch of different-length sequences must match the
+dense oracle ``ops/flash_attention.py:attention_reference`` on every
+ragged length mix — including block-boundary edges (len = block_size
+- 1, block_size, block_size + 1) and fragmented (non-contiguous,
+shuffled) block tables — on BOTH paths (gather-based jnp reference and
+the Pallas kernel in interpret mode). The block allocator must never
+leak or double-free across randomized admit/evict schedules.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import jax.numpy as jnp  # noqa: E402
+
+from mxnet_tpu.ops.flash_attention import attention_reference  # noqa: E402
+from mxnet_tpu.ops.ragged_attention import (  # noqa: E402
+    ragged_attention_reference, ragged_paged_attention)
+from mxnet_tpu.serving.llm.kv_cache import (  # noqa: E402
+    BlockAllocator, PagedKVCache, NoFreeBlocksError,
+    BlockAccountingError, NULL_BLOCK)
+from mxnet_tpu.serving.bucketing import (  # noqa: E402
+    BucketSpec, pad_to_bucket)
+
+BS = 8          # block size
+H, D = 2, 16    # heads, head dim
+
+
+def _paged_case(lens, num_blocks=64, seed=0, fragment=True):
+    """Build a paged cache holding one ragged batch: returns
+    (q, k_pages, v_pages, block_tables, kv_lens, per-seq dense k/v)."""
+    rng = np.random.RandomState(seed)
+    S = len(lens)
+    MB = max(-(-int(t) // BS) for t in lens)
+    k_pages = np.zeros((num_blocks, BS, H, D), np.float32)
+    v_pages = np.zeros((num_blocks, BS, H, D), np.float32)
+    tables = np.full((S, MB), NULL_BLOCK, np.int32)
+    # fragmented, non-contiguous allocation: shuffle the pool so no
+    # sequence's blocks are adjacent or ordered (dedicated RNG so the
+    # q/k/v draws below are identical for fragment=True/False)
+    pool = list(range(1, num_blocks))
+    if fragment:
+        np.random.RandomState(seed + 1000).shuffle(pool)
+    it = iter(pool)
+    dense = []
+    q = rng.randn(S, H, D).astype(np.float32)
+    for i, t in enumerate(lens):
+        t = int(t)
+        k_seq = rng.randn(t, H, D).astype(np.float32)
+        v_seq = rng.randn(t, H, D).astype(np.float32)
+        dense.append((k_seq, v_seq))
+        nb = -(-t // BS)
+        for j in range(nb):
+            b = next(it)
+            tables[i, j] = b
+            chunk = k_seq[j * BS:(j + 1) * BS]
+            k_pages[b, :len(chunk)] = chunk
+            chunk = v_seq[j * BS:(j + 1) * BS]
+            v_pages[b, :len(chunk)] = chunk
+    return (q, k_pages, v_pages, tables,
+            np.asarray(lens, np.int32), dense)
+
+
+def _oracle(q, dense):
+    """Per-sequence dense attention via the flash oracle."""
+    outs = []
+    for i, (k_seq, v_seq) in enumerate(dense):
+        o = attention_reference(
+            jnp.asarray(q[i][None, :, None, :]),          # (1, H, 1, D)
+            jnp.asarray(k_seq.transpose(1, 0, 2)[None]),  # (1, H, t, D)
+            jnp.asarray(v_seq.transpose(1, 0, 2)[None]))
+        outs.append(np.asarray(o)[0, :, 0, :])
+    return np.stack(outs)
+
+
+# block-boundary edges around BS plus interior/multi-block lengths
+EDGE_MIXES = [
+    [BS - 1, BS, BS + 1],
+    [1, BS - 1, 2 * BS, 2 * BS + 1, 3 * BS - 1],
+    [5, 11, 17, 24],
+]
+
+
+@pytest.mark.parametrize("lens", EDGE_MIXES, ids=["edges", "multi", "mix"])
+@pytest.mark.parametrize("path", ["reference", "pallas"])
+def test_parity_vs_dense_oracle(lens, path):
+    q, kp, vp, bt, kl, dense = _paged_case(lens)
+    want = _oracle(q, dense)
+    got = ragged_paged_attention(q, kp, vp, bt, kl,
+                                 use_pallas=(path == "pallas"),
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(got), want,
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_fragmented_table_equals_contiguous():
+    """A shuffled block table must read identically to a contiguous
+    one — the kernel sees only the table, never block adjacency."""
+    lens = [BS + 3, 2 * BS, 3]
+    q, kp, vp, bt, kl, dense = _paged_case(lens, fragment=True, seed=3)
+    q2, kp2, vp2, bt2, kl2, dense2 = _paged_case(lens, fragment=False,
+                                                 seed=3)
+    a = np.asarray(ragged_paged_attention(q, kp, vp, bt, kl))
+    b = np.asarray(ragged_paged_attention(q2, kp2, vp2, bt2, kl2))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_pallas_matches_reference_path_bitwise_inputs():
+    """Both paths over the SAME buffers: allclose at f32 ulp level."""
+    lens = [2, BS, 19]
+    q, kp, vp, bt, kl, _ = _paged_case(lens, seed=7)
+    ref = np.asarray(ragged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(kl)))
+    pal = np.asarray(ragged_paged_attention(
+        q, kp, vp, bt, kl, use_pallas=True, interpret=True))
+    np.testing.assert_allclose(ref, pal, rtol=1e-5, atol=1e-6)
+
+
+def test_garbage_in_unreferenced_blocks_is_invisible():
+    """Stale KV beyond kv_len and in never-referenced blocks must not
+    leak into any output — the masking contract preemption relies on."""
+    lens = [5, 9]
+    q, kp, vp, bt, kl, dense = _paged_case(lens, seed=11)
+    base = np.asarray(ragged_paged_attention(q, kp, vp, bt, kl))
+    kp2, vp2 = kp.copy(), vp.copy()
+    # poison the null block, every free block, and the tail slots of
+    # each sequence's last block
+    used = set(bt.ravel().tolist()) - {NULL_BLOCK}
+    for b in range(kp.shape[0]):
+        if b not in used:
+            kp2[b] = 1e6
+            vp2[b] = -1e6
+    for i, t in enumerate(lens):
+        last = bt[i, (t - 1) // BS]
+        kp2[last, t % BS or BS:] = 1e6
+        vp2[last, t % BS or BS:] = -1e6
+    got = np.asarray(ragged_paged_attention(q, kp2, vp2, bt, kl))
+    np.testing.assert_array_equal(base, got)
+
+
+# ------------------------------------------------------- allocator --
+
+
+def test_allocator_alloc_free_roundtrip():
+    a = BlockAllocator(9)           # 8 usable
+    assert a.num_usable == 8 and a.num_free == 8
+    blocks = a.alloc(3)
+    assert len(blocks) == 3 and NULL_BLOCK not in blocks
+    assert a.num_used == 3 and a.occupancy() == pytest.approx(3 / 8)
+    a.free(blocks)
+    assert a.num_used == 0 and a.num_free == 8
+    a.check()
+
+
+def test_allocator_oom_is_all_or_nothing():
+    a = BlockAllocator(5)           # 4 usable
+    a.alloc(3)
+    with pytest.raises(NoFreeBlocksError):
+        a.alloc(2)
+    assert a.num_free == 1          # failed alloc touched nothing
+    a.check()
+
+
+def test_allocator_double_free_and_null_are_errors():
+    a = BlockAllocator(5)
+    b = a.alloc(2)
+    a.free(b)
+    with pytest.raises(BlockAccountingError):
+        a.free(b)                   # double free
+    with pytest.raises(BlockAccountingError):
+        a.free([NULL_BLOCK])        # the reserved block
+    with pytest.raises(BlockAccountingError):
+        a.free([99])                # out of range
+    c = a.alloc(1)
+    with pytest.raises(BlockAccountingError):
+        a.free(c + c)               # duplicates within one call
+    a.check()
+
+
+def test_allocator_fuzz_1k_schedules_never_leaks():
+    """Property test: across 1k random admit/evict schedules the
+    allocator's {free} ∪ {used} partition stays exact — no leaked, no
+    double-counted, no vanished blocks."""
+    rng = np.random.RandomState(0)
+    a = BlockAllocator(33)          # 32 usable
+    live = []                       # list of allocated block-id lists
+    for step in range(1000):
+        if live and (rng.rand() < 0.45 or a.num_free == 0):
+            seq_blocks = live.pop(rng.randint(len(live)))
+            a.free(seq_blocks)
+        else:
+            want = int(rng.randint(1, 6))
+            if a.can_alloc(want):
+                live.append(a.alloc(want))
+            else:
+                with pytest.raises(NoFreeBlocksError):
+                    a.alloc(want)
+        a.check()
+        held = sum(len(b) for b in live)
+        assert a.num_used == held
+        assert a.num_free == a.num_usable - held
+    for seq_blocks in live:
+        a.free(seq_blocks)
+    a.check()
+    assert a.num_free == a.num_usable
+
+
+def test_paged_cache_table_row_and_sizing():
+    c = PagedKVCache(num_layers=2, num_heads=2, head_dim=4,
+                     block_size=8, num_blocks=9, max_context=32)
+    assert c.max_blocks_per_seq == 4
+    assert c.blocks_for(1) == 1 and c.blocks_for(8) == 1
+    assert c.blocks_for(9) == 2
+    row = c.table_row([5, 3])
+    assert row.tolist() == [5, 3, NULL_BLOCK, NULL_BLOCK]
+    assert c.k_pages.shape == (2, 9, 8, 2, 4)
+    st = c.stats()
+    assert st["blocks_free"] == 8 and st["occupancy"] == 0.0
+
+
+# ------------------------------------------- shared bucketing spec --
+
+
+def test_bucket_spec_shared_pow2_discipline():
+    """The refactored BucketSpec is the one bucket implementation both
+    serving paths use: pow2 sizes, smallest-fit pick, zero-pad."""
+    spec = BucketSpec.pow2(8)
+    assert spec.buckets == [1, 2, 4, 8]
+    assert spec.pick(3) == 4
+    rows = np.ones((3, 5), np.float32)
+    padded, bucket = spec.pad(rows)
+    assert bucket == 4 and padded.shape == (4, 5)
+    np.testing.assert_array_equal(padded[3:], 0)
+    assert spec.waste(3) == pytest.approx(0.25)
+    assert [b for b, _ in spec.warmup_shapes((5,))] == [1, 2, 4, 8]
+
+
+def test_bucket_spec_page_aligned_length_axis():
+    """The LLM prefill variant: pow2 buckets rounded up to block
+    multiples, padding along the LENGTH axis."""
+    spec = BucketSpec.pow2(64, multiple_of=16)
+    assert spec.buckets == [16, 32, 64]
+    toks = np.arange(21, dtype=np.int32)
+    padded, bucket = spec.pad(toks)
+    assert bucket == 32 and padded.shape == (32,)
+    np.testing.assert_array_equal(padded[:21], toks)
+    np.testing.assert_array_equal(padded[21:], 0)
+    # axis-general padding (prefill pads axis 0 of a 1-D prompt; a
+    # batched caller pads axis 1)
+    x = np.ones((2, 3), np.float32)
+    assert pad_to_bucket(x, 4, axis=1).shape == (2, 4)
+    with pytest.raises(ValueError):
+        pad_to_bucket(x, 2, axis=1)

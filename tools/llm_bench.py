@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for mxnet_tpu.serving.llm.LLMServer.
+
+The decode-serving counterpart of tools/serve_bench.py: each of
+``--concurrency`` client threads keeps exactly one GENERATION in
+flight — submit a ragged-length prompt, wait for the full greedy
+generation, repeat. Reported (and emitted into a BENCH json via
+``tools/perf_capture.emit_llm_snapshot``, which refuses to headline a
+run that recompiled or lost requests): decode throughput in
+tokens/sec, time-to-first-token p50/p99, end-to-end request latency,
+KV-block occupancy, preemptions, and the XLA compile count observed
+DURING the measured window (0 is the healthy steady state — warmup
+pre-compiles every prefill bucket plus the one decode shape).
+
+Serve an exported decoder artifact::
+
+    python tools/llm_bench.py --model decoder.mxtpu --concurrency 8
+
+or, with no --model, a small built-in decoder (self-contained CI)::
+
+    python tools/llm_bench.py --smoke
+
+``--smoke`` runs a tiny configuration and exit(1)s unless the run was
+recompile-free and lossless AND the emitted BENCH json carries the
+tokens/sec + TTFT + KV-occupancy fields — wired into tier-1 via
+tests/test_examples_smoke.py.
+"""
+import argparse
+import datetime
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import serving  # noqa: E402
+from mxnet_tpu.serving.llm import (TinyDecoder, DecoderConfig,  # noqa: E402
+                                   LLMServer)
+
+
+def _builtin_decoder(vocab=32, d_model=32, layers=2, heads=2,
+                     max_context=128):
+    model = TinyDecoder(DecoderConfig(
+        vocab_size=vocab, d_model=d_model, num_layers=layers,
+        num_heads=heads, d_ff=2 * d_model, max_context=max_context))
+    return model, model.init_params(0)
+
+
+def run(args):
+    if args.model:
+        model, params = mx.deploy.load_decoder(args.model)
+    else:
+        model, params = _builtin_decoder(max_context=args.max_context)
+    srv = LLMServer(model, params, name="llm_bench",
+                    max_seqs=args.max_seqs,
+                    block_size=args.block_size,
+                    max_context=min(args.max_context,
+                                    model.max_context))
+    warm = srv.warmup()
+    srv.start()
+
+    rng = np.random.RandomState(0)
+    max_prompt = max(2, min(srv.max_context // 2, 48))
+    prompts = [rng.randint(0, model.vocab_size,
+                           size=rng.randint(1, max_prompt)).tolist()
+               for _ in range(min(64, args.requests))]
+    # spread the remainder so exactly --requests generations run (a
+    # silent floor-division cap would misreport the measured load)
+    base, rem = divmod(args.requests, args.concurrency)
+    quota = [base + (1 if t < rem else 0)
+             for t in range(args.concurrency)]
+    errors = []
+    ttfts = []
+    ttft_lock = threading.Lock()
+
+    def client(tid):
+        try:
+            for i in range(quota[tid]):
+                prompt = prompts[(tid + i) % len(prompts)]
+                n = 1 + (tid + i) % args.max_new_tokens
+                res = srv.generate(prompt, n, timeout=600)
+                # a generation may legally end early at the context
+                # cap (finish_reason "length"), not only at n
+                want = min(n, srv.max_context - len(prompt))
+                assert len(res.tokens) == want, \
+                    (len(res.tokens), want, res.finish_reason)
+                with ttft_lock:
+                    ttfts.append(res.ttft_s)
+        except Exception as exc:
+            errors.append(repr(exc))
+
+    tokens_before = srv.stats()["tokens_generated"]
+    t_load = time.monotonic()
+    with serving.CompileCounter() as cc:
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    load_s = max(time.monotonic() - t_load, 1e-9)
+    stats = srv.stats()      # before shutdown: gauges still live
+    srv.shutdown()
+    # headline = DELIVERED throughput over the measured wall window
+    # (prefill, scheduling and host time included) — the per-launch
+    # EMA gauge times only decode launches and would overstate it
+    delivered = (stats["tokens_generated"] - tokens_before) / load_s
+
+    ttfts.sort()
+
+    def pct(p):
+        if not ttfts:
+            return None
+        return ttfts[min(len(ttfts) - 1,
+                         int(round(p / 100.0 * (len(ttfts) - 1))))]
+
+    report = {
+        "requests": sum(quota),
+        "concurrency": args.concurrency,
+        "max_seqs": stats["max_seqs"],
+        "prefill_buckets": stats["prefill_buckets"],
+        "warmup_s": {k: round(v, 4) for k, v in warm.items()},
+        "tokens_per_sec": round(delivered, 2),
+        "decode_tokens_per_sec_ema": round(stats["tokens_per_sec"], 2),
+        "tokens_generated": stats["tokens_generated"],
+        "ttft_ms": {"p50": round((pct(50) or 0) * 1e3, 3),
+                    "p99": round((pct(99) or 0) * 1e3, 3)},
+        "request_ms": {k: round(v, 3)
+                       for k, v in stats["request_ms"].items()},
+        "kv_occupancy": round(stats["kv_cache"]["occupancy"], 4),
+        "kv_blocks_total": stats["kv_blocks_total"],
+        "preemptions": stats["preemptions"],
+        "decode_steps": stats["decode_steps"],
+        "compiles_during_load": cc.count,
+        "completed": stats["requests_completed"],
+        "failed": stats["requests_failed"] + stats["requests_evicted"],
+        "errors": errors[:5],
+    }
+    print(json.dumps(report, indent=1))
+    return report
+
+
+def emit_bench(report, out_dir):
+    """Mirror the run into a BENCH_llm_rNN.json through perf_capture
+    (registry snapshot + skip-refusal semantics)."""
+    from mxnet_tpu.observability import get_registry
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import perf_capture
+    finally:
+        sys.path.pop(0)
+    os.makedirs(out_dir, exist_ok=True)
+    metrics_log = os.path.join(out_dir, "llm_bench_metrics.jsonl")
+    get_registry().write_snapshot(metrics_log)
+    rec = {
+        "metric": "llm_tokens_per_sec",
+        "value": report["tokens_per_sec"],
+        "unit": "tokens/s",
+        "extra": {
+            "ttft_ms": report["ttft_ms"],
+            "kv_occupancy": report["kv_occupancy"],
+            "requests": report["requests"],
+            "preemptions": report["preemptions"],
+            "compiles_during_load": report["compiles_during_load"],
+        },
+        "_capture": {
+            "tag": "llm_bench",
+            "metrics_log": metrics_log,
+            "captured_at": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(),
+        },
+    }
+    reasons = []
+    if report["compiles_during_load"]:
+        reasons.append(f"{report['compiles_during_load']} XLA "
+                       "recompiles during the measured window")
+    if report["failed"] or report["errors"]:
+        reasons.append(f"{report['failed']} lost requests: "
+                       f"{report['errors'][:2]}")
+    if reasons:
+        rec["skipped"] = "; ".join(reasons)
+    return perf_capture.emit_llm_snapshot(rec, out_dir=out_dir)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    ap.add_argument("--model", default=None,
+                    help="decoder artifact from mx.deploy.export_decoder"
+                         " (default: built-in tiny decoder)")
+    ap.add_argument("--requests", type=int, default=64,
+                    help="total generations across all clients")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop client threads")
+    ap.add_argument("--max-seqs", type=int, default=8,
+                    help="decode batch slots")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="KV cache block size (tokens)")
+    ap.add_argument("--max-context", type=int, default=128)
+    ap.add_argument("--max-new-tokens", type=int, default=16,
+                    help="per-request generation lengths cycle 1..N")
+    ap.add_argument("--out", default=None,
+                    help="directory for the BENCH_llm_rNN.json "
+                         "(default: a temp dir, printed)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CI run; fail on recompiles, lost "
+                         "requests, or a malformed BENCH json")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.requests = min(args.requests, 24)
+        args.concurrency = min(args.concurrency, 4)
+        args.max_seqs = min(args.max_seqs, 4)
+        args.max_context = min(args.max_context, 64)
+        args.max_new_tokens = min(args.max_new_tokens, 8)
+
+    report = run(args)
+    out_dir = args.out or tempfile.mkdtemp(prefix="llm_bench_")
+    bench_path = emit_bench(report, out_dir)
+    print(f"BENCH json -> {bench_path}")
+
+    if args.smoke:
+        with open(bench_path) as f:
+            bench = json.load(f)
+        ok = (report["compiles_during_load"] == 0
+              and report["failed"] == 0
+              and not report["errors"]
+              and report["completed"] == report["requests"]
+              and report["tokens_per_sec"] > 0
+              and not bench.get("skipped")
+              and bench.get("value") == report["tokens_per_sec"]
+              and bench.get("tokens_per_sec") is not None
+              and bench.get("ttft_ms", {}).get("p50") is not None
+              and bench.get("ttft_ms", {}).get("p99") is not None
+              and bench.get("kv_blocks_in_use") is not None)
+        print("SMOKE", "PASS" if ok else "FAIL")
+        sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

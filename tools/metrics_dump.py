@@ -229,12 +229,29 @@ def smoke():
         f.result(timeout=60)
     srv.shutdown()
 
+    # LLM decode serving: a tiny continuous-batched greedy burst so the
+    # mxtpu_llm_* series (tokens/sec, TTFT, KV occupancy) land in the
+    # same exposition
+    from mxnet_tpu.serving.llm import TinyDecoder, DecoderConfig
+    dec = TinyDecoder(DecoderConfig(vocab_size=16, d_model=16,
+                                    num_layers=1, num_heads=2,
+                                    d_ff=32, max_context=32))
+    lsrv = serving.LLMServer(dec, dec.init_params(0), name="smoke_llm",
+                             max_seqs=2, block_size=8, max_context=32)
+    lsrv.warmup()
+    lsrv.start()
+    lfuts = [lsrv.submit([1 + i, 2, 3], 3) for i in range(4)]
+    for f in lfuts:
+        f.result(timeout=60)
+    lsrv.shutdown()
+
     reg = get_registry()
     text = reg.expose()
     samples = parse_exposition(text)          # must be valid exposition
     for subsystem in ("mxtpu_training_", "mxtpu_serving_",
                       "mxtpu_resilience_checkpoint_",
-                      "mxtpu_xla_compile_", "mxtpu_ckpt_async_"):
+                      "mxtpu_xla_compile_", "mxtpu_ckpt_async_",
+                      "mxtpu_llm_"):
         if not any(name.startswith(subsystem)
                    for name, _ in samples):
             print(f"SMOKE FAIL: no {subsystem}* metric in exposition")
@@ -254,6 +271,26 @@ def smoke():
                for name, _ in samples):
         print("SMOKE FAIL: no async write-seconds histogram in "
               "exposition")
+        return 1
+    # llm decode: the serving-economics headline series must carry the
+    # burst (4 requests x 3 tokens) under the server's label
+    lbl = (("server", "smoke_llm"),)
+    if samples.get(("mxtpu_llm_requests_completed_total", lbl)) != 4:
+        print("SMOKE FAIL: llm burst not counted "
+              f"({samples.get(('mxtpu_llm_requests_completed_total', lbl))})")
+        return 1
+    if samples.get(("mxtpu_llm_tokens_generated_total", lbl)) != 12:
+        print("SMOKE FAIL: llm token count off "
+              f"({samples.get(('mxtpu_llm_tokens_generated_total', lbl))})")
+        return 1
+    if samples.get(("mxtpu_llm_tokens_per_sec", lbl), 0) <= 0:
+        print("SMOKE FAIL: llm tokens/sec gauge not set")
+        return 1
+    if ("mxtpu_llm_kv_blocks_in_use", lbl) not in samples:
+        print("SMOKE FAIL: no KV-block occupancy gauge in exposition")
+        return 1
+    if not any(n.startswith("mxtpu_llm_ttft_seconds") for n, _ in samples):
+        print("SMOKE FAIL: no TTFT histogram in exposition")
         return 1
     if samples[("mxtpu_training_steps_total", ())] < 2:
         print("SMOKE FAIL: step timer did not count 2 steps")
@@ -283,7 +320,9 @@ def smoke():
         return 1
     span_names = {s["name"] for s in tracer.snapshot()}
     for needed in ("mxtpu.train_step", "mxtpu.train_step.dispatch",
-                   "mxtpu.serving.request", "mxtpu.ckpt.write"):
+                   "mxtpu.serving.request", "mxtpu.ckpt.write",
+                   "mxtpu.llm.request", "mxtpu.llm.prefill",
+                   "mxtpu.llm.decode_step"):
         if needed not in span_names:
             print(f"SMOKE FAIL: no {needed} span recorded")
             return 1

@@ -155,13 +155,20 @@ def _metric_value(snap, name):
     return None
 
 
-def _next_bench_round():
+def _next_round(prefix, out_dir):
+    """Next round number for a BENCH artifact series (one numbering
+    helper for both the training ``BENCH_rNN`` and the llm
+    ``BENCH_llm_rNN`` trajectories)."""
     top = 0
-    for fname in os.listdir(REPO):
-        m = re.match(r"BENCH_r(\d+)\.json$", fname)
+    for fname in os.listdir(out_dir):
+        m = re.match(r"%s(\d+)\.json$" % re.escape(prefix), fname)
         if m:
             top = max(top, int(m.group(1)))
     return top + 1
+
+
+def _next_bench_round():
+    return _next_round("BENCH_r", REPO)
 
 
 def _span_stats(snap):
@@ -314,6 +321,67 @@ def emit_bench_snapshot(rec, allow_stale=False):
 def _is_valid(rec):
     return (rec is not None and rec.get("value") is not None
             and not rec.get("suspect") and not rec.get("skipped"))
+
+
+def emit_llm_snapshot(rec, out_dir=None):
+    """Write a BENCH_llm_rNN.json for an llm_bench capture; returns
+    its path.
+
+    Same skip-refusal contract as :func:`emit_bench_snapshot`: a
+    skipped / suspect / valueless record still produces an artifact
+    (the trajectory must show the attempt) but with ``"skipped"`` set
+    and ``"value": null`` — a load window that recompiled or lost
+    requests can never masquerade as a healthy tokens/sec headline.
+    (No stale-promotion branch here: llm_bench measures in-process, so
+    there is never a "stale last capture" to promote.) The
+    serving-economics numbers (tokens/sec, TTFT p50/p99, KV-block
+    occupancy) come from the run's own registry snapshot + the
+    ``extra`` dict llm_bench computed from live server stats.
+    """
+    out_dir = out_dir or REPO
+    cap = rec.get("_capture", {})
+    snap = _last_metrics_snapshot(cap.get("metrics_log", ""))
+    extra = rec.get("extra", {})
+    nn = _next_round("BENCH_llm_r", out_dir)
+    path = os.path.join(out_dir, f"BENCH_llm_r{nn:02d}.json")
+    out = {
+        "round": nn,
+        "source": "tools/llm_bench.py (observability registry)",
+        "captured_at": cap.get("captured_at", _now()),
+        "tag": cap.get("tag"),
+        "metric": rec.get("metric"),
+        "unit": rec.get("unit"),
+    }
+    if not _is_valid(rec):
+        out.update({
+            "skipped": rec.get("skipped") or (
+                "suspect" if rec.get("suspect") else "invalid"),
+            "value": None,
+            "detail": rec.get("detail"),
+        })
+    else:
+        out.update({
+            "value": rec.get("value"),
+            "tokens_per_sec": _metric_value(
+                snap, "mxtpu_llm_tokens_per_sec"),
+            "ttft_ms": extra.get("ttft_ms"),
+            "kv_blocks_in_use": _metric_value(
+                snap, "mxtpu_llm_kv_blocks_in_use"),
+            "kv_blocks_total": _metric_value(
+                snap, "mxtpu_llm_kv_blocks_total"),
+            "kv_occupancy": extra.get("kv_occupancy"),
+            "requests": extra.get("requests"),
+            "preemptions": extra.get("preemptions"),
+            "device_kind": extra.get("device_kind"),
+            "xla_compiles": _metric_value(snap, "mxtpu_xla_compile_total"),
+            "compiles_during_load": extra.get("compiles_during_load"),
+            "metrics_log": cap.get("metrics_log"),
+            "span_stats": _span_stats(snap),
+        })
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    return path
 
 
 def _captured_tags():
