@@ -24,18 +24,29 @@ near-free no-ops until a test arms an injector, then:
   matching ``check``/``point`` call park until the test releases it —
   the deterministic way to hold a background checkpoint writer mid-save
   while asserting the training thread keeps stepping (no sleeps).
+- ``delay_at(site, seconds, times=N)`` sleeps at a matching
+  ``check``/``point`` call — injected slow compute for the serving
+  chaos harness (a dispatch that suddenly takes 50ms makes queued
+  deadlines expire without faking any clock).
 
-All schedules are explicit and deterministic: no randomness, no timers.
+The serving hot paths are instrumented with these same hooks
+(``serving.dispatch`` / ``serving.worker`` on the micro-batch server,
+``llm.prefill`` / ``llm.decode`` / ``llm.worker`` on the decode
+engine), so one switchboard drives both the training AND the serving
+chaos matrices. All schedules are explicit and deterministic: no
+randomness, no timers.
 """
 from __future__ import annotations
 
 import os
 import signal
 import threading
+import time
 
 __all__ = ["InjectedCrash", "FaultInjector", "Gate", "active", "reset",
            "kill_write_at", "script", "sigterm_at_step", "crash_at_point",
-           "block_at", "check", "wrap_file", "on_step", "point"]
+           "block_at", "delay_at", "check", "wrap_file", "on_step",
+           "point"]
 
 
 class InjectedCrash(BaseException):
@@ -108,6 +119,7 @@ class FaultInjector:
             self._write_kills = []        # [(substr, nbytes)]
             self._scripts = {}            # site -> list of Exception|None
             self._points = []             # [[substr, countdown]]
+            self._delays = []             # [[substr, seconds, remaining]]
             gates = getattr(self, "_gates", [])
             self._gates = []              # [(substr, Gate)]
             self._sigterm_step = None
@@ -147,6 +159,15 @@ class FaultInjector:
             self._points.append([match, int(nth)])
             self.armed = True
 
+    def delay_at(self, match: str, seconds: float, times: int = None):
+        """Sleep ``seconds`` at every ``check``/``point`` call whose
+        site name contains ``match`` (injected slow compute). ``times``
+        bounds how many calls are slowed (None = every one)."""
+        with self._lock:
+            self._delays.append([match, float(seconds),
+                                 None if times is None else int(times)])
+            self.armed = True
+
     def block_at(self, match: str) -> Gate:
         """Park any ``check``/``point`` call whose site name contains
         ``match`` until the returned :class:`Gate` is released."""
@@ -162,12 +183,20 @@ class FaultInjector:
         a countdown crash if one reaches zero here."""
         with self._lock:
             gates = [g for m, g in self._gates if m in name]
+            sleep_s = 0.0
+            for rec in self._delays:
+                if rec[0] in name and (rec[2] is None or rec[2] > 0):
+                    sleep_s += rec[1]
+                    if rec[2] is not None:
+                        rec[2] -= 1
             fire = False
             for rec in self._points:
                 if rec[0] in name:
                     rec[1] -= 1
                     if rec[1] == 0:
                         fire = True
+        if sleep_s > 0:
+            time.sleep(sleep_s)
         for gate in gates:
             gate._pass_through()
         if fire:
@@ -221,6 +250,7 @@ script = active.script
 sigterm_at_step = active.sigterm_at_step
 crash_at_point = active.crash_at_point
 block_at = active.block_at
+delay_at = active.delay_at
 check = active.check
 point = active.point
 wrap_file = active.wrap_file

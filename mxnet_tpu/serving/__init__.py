@@ -21,23 +21,38 @@ production request path:
 - :mod:`.llm` — the autoregressive counterpart: continuous-batching
   greedy decoding over a paged KV cache with ragged attention
   (:class:`~.llm.LLMServer`), token-level scheduling, drain-with-
-  deadline (``SequenceEvictedError`` carries partial generations).
+  deadline (``SequenceEvictedError`` carries partial generations);
+- :mod:`.errors` — ONE typed exception hierarchy under
+  :class:`ServingError` for every way the serving layer can resolve a
+  request without a result: ``ServerClosed`` (drain/shutdown/worker
+  death), ``Overloaded`` / ``CircuitOpenError`` (admission-control
+  shed, fail-fast at submit), ``DeadlineExceededError`` (end-to-end
+  deadline expired — partial tokens carried on the LLM path) and
+  ``SequenceEvictedError`` (decode drain/eviction, partial tokens);
+- :mod:`.overload` — the :class:`CircuitBreaker` behind
+  "degrade to rejection instead of crash-looping".
 
-See docs/SERVING.md for architecture, bucketing math and env vars.
+See docs/SERVING.md for architecture, bucketing math, the
+overload/failure state machine and env vars.
 """
-from .batching import MicroBatchQueue, Request, ServerClosed
+from .errors import (ServingError, ServerClosed, Overloaded,
+                     CircuitOpenError, DeadlineExceededError,
+                     SequenceEvictedError)
+from .overload import CircuitBreaker
+from .batching import MicroBatchQueue, Request
 from .bucketing import (BucketSpec, bucket_sizes, pick_bucket,
                         pad_batch, pad_to_bucket, waste_fraction)
 from .server import ModelServer
 from .telemetry import (CompileCounter, EventLog, ServingStats,
                         compile_count)
 from . import llm
-from .llm import (LLMServer, LLMEngine, SequenceEvictedError,
-                  GenerationResult)
+from .llm import LLMServer, LLMEngine, GenerationResult
 
-__all__ = ["ModelServer", "ServerClosed", "MicroBatchQueue", "Request",
+__all__ = ["ModelServer", "MicroBatchQueue", "Request",
+           "ServingError", "ServerClosed", "Overloaded",
+           "CircuitOpenError", "DeadlineExceededError",
+           "SequenceEvictedError", "CircuitBreaker",
            "BucketSpec", "bucket_sizes", "pick_bucket", "pad_batch",
            "pad_to_bucket", "waste_fraction",
            "CompileCounter", "EventLog", "ServingStats", "compile_count",
-           "llm", "LLMServer", "LLMEngine", "SequenceEvictedError",
-           "GenerationResult"]
+           "llm", "LLMServer", "LLMEngine", "GenerationResult"]

@@ -6,8 +6,10 @@ pops *micro-batches*: it blocks until at least one request is waiting,
 then keeps collecting until either ``max_batch`` items are in hand or
 ``max_delay`` has elapsed since the oldest waiting request was enqueued
 (the TensorFlow-Serving batching discipline: batch_timeout_micros +
-max_batch_size). Under load the delay never binds — batches fill
-instantly; at low rate a lone request waits at most ``max_delay``.
+max_batch_size — which pairs batching with BOUNDED queues and
+rejection: see ``max_depth``). Under load the delay never binds —
+batches fill instantly; at low rate a lone request waits at most
+``max_delay``.
 
 Each request carries a :class:`concurrent.futures.Future`; the worker
 resolves it with the request's output rows (or an exception), so
@@ -21,21 +23,20 @@ import threading
 import time
 from concurrent.futures import Future
 
-__all__ = ["ServerClosed", "Request", "MicroBatchQueue"]
+from .errors import Overloaded, ServerClosed
+
+__all__ = ["ServerClosed", "Overloaded", "Request", "MicroBatchQueue"]
 
 # process-wide request ids (monotonic, never reused): the correlation
 # key a request's tracer span and event-log records carry end to end
 _request_ids = itertools.count(1)
 
 
-class ServerClosed(RuntimeError):
-    """Raised by submit() once admission is closed (drain/shutdown)."""
-
-
 class Request:
-    __slots__ = ("x", "future", "t_enqueue", "t_dequeue", "rid", "span")
+    __slots__ = ("x", "future", "t_enqueue", "t_dequeue", "rid", "span",
+                 "deadline")
 
-    def __init__(self, x):
+    def __init__(self, x, deadline=None):
         self.x = x
         self.future = Future()
         self.t_enqueue = time.monotonic()
@@ -44,6 +45,14 @@ class Request:
         # a tracer hand-off span the server attaches at submit time and
         # finishes (on the worker thread) when the future resolves
         self.span = None
+        # absolute monotonic end-to-end deadline (None = unbounded);
+        # the worker fails an expired request BEFORE dispatching it
+        self.deadline = deadline
+
+    def expired(self, now=None):
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
 
     @property
     def wait_s(self):
@@ -54,13 +63,21 @@ class Request:
 
 
 class MicroBatchQueue:
-    """Thread-safe FIFO with micro-batch pop semantics."""
+    """Thread-safe FIFO with micro-batch pop semantics.
 
-    def __init__(self):
+    ``max_depth`` bounds the queue (admission control): past it,
+    ``enqueue`` fails fast with :class:`Overloaded` instead of growing
+    the backlog — under sustained overload a bounded queue sheds load
+    at submit time rather than queueing every request into a deadline
+    it can no longer meet. ``None``/0 = unbounded (the historical
+    behavior)."""
+
+    def __init__(self, max_depth=None):
         self._q = collections.deque()
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._closed = False
+        self.max_depth = int(max_depth) if max_depth else None
 
     # -------------------------------------------------------- producer --
     def submit(self, x):
@@ -80,6 +97,12 @@ class MicroBatchQueue:
             if self._closed:
                 raise ServerClosed(
                     "server is draining; no new requests admitted")
+            if (self.max_depth is not None
+                    and len(self._q) >= self.max_depth):
+                raise Overloaded(
+                    f"queue full ({len(self._q)} >= max_depth "
+                    f"{self.max_depth}); request shed",
+                    reason="queue_full", depth=len(self._q))
             self._q.append(req)
             self._nonempty.notify_all()
         return req.future
@@ -129,3 +152,11 @@ class MicroBatchQueue:
 
     def depth(self):
         return len(self._q)
+
+    def drain(self):
+        """Pop and return every queued request (worker-death cleanup:
+        the server fails them typed so no Future is silently lost)."""
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+            return out

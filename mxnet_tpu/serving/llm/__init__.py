@@ -29,6 +29,8 @@ schedules *requests* (one forward pass each), this subsystem schedules
 See docs/SERVING.md ("LLM decoding") for the architecture and the
 block-table layout, docs/ENV_VARS.md for the ``MXNET_TPU_LLM_*`` knobs.
 """
+from ..errors import (DeadlineExceededError, Overloaded,
+                      SequenceEvictedError)
 from .kv_cache import (BlockAllocator, PagedKVCache, KVCacheError,
                        NoFreeBlocksError, BlockAccountingError,
                        NULL_BLOCK)
@@ -36,12 +38,13 @@ from .scheduler import Sequence, Scheduler
 from .model import DecoderConfig, TinyDecoder, greedy_decode_reference
 from .engine import LLMEngine
 from .metrics import LLMStats
-from .server import LLMServer, SequenceEvictedError, GenerationResult
+from .server import LLMServer, GenerationResult
 
 __all__ = [
     "BlockAllocator", "PagedKVCache", "KVCacheError",
     "NoFreeBlocksError", "BlockAccountingError", "NULL_BLOCK",
     "Sequence", "Scheduler", "DecoderConfig", "TinyDecoder",
     "greedy_decode_reference", "LLMEngine", "LLMStats", "LLMServer",
-    "SequenceEvictedError", "GenerationResult",
+    "SequenceEvictedError", "DeadlineExceededError", "Overloaded",
+    "GenerationResult",
 ]

@@ -30,6 +30,7 @@ and the futures; the engine owns device state and determinism.
 """
 from __future__ import annotations
 
+import collections
 import os
 import time
 
@@ -40,6 +41,7 @@ from ..envutil import env_int as _env_int
 from .kv_cache import PagedKVCache, KVCacheError, NULL_BLOCK
 from .scheduler import Scheduler, Sequence, RUNNING, FINISHED, EVICTED
 from ...observability.tracing import get_tracer
+from ...resilience import faults
 
 __all__ = ["LLMEngine"]
 
@@ -62,7 +64,8 @@ class LLMEngine:
 
     def __init__(self, model, params, max_seqs=None, block_size=None,
                  num_blocks=None, max_context=None,
-                 prefill_buckets=None, stats=None, dtype="float32"):
+                 prefill_buckets=None, stats=None, dtype="float32",
+                 breaker=None):
         import jax
         import jax.numpy as jnp
         self.model = model
@@ -131,11 +134,21 @@ class LLMEngine:
         self._prefill_jit = jax.jit(self._prefill_impl,
                                     donate_argnums=donate)
         self._warmed = False
+        # circuit breaker (shared with the server): successful
+        # prefill/decode dispatches close it, failing ones trip it —
+        # the server's submit path rejects while it is open
+        self._breaker = breaker
         # sequences finished but not yet handed to the caller — kept
         # OUTSIDE step()'s local event list so a step that finishes A
         # and then raises on B's prefill cannot lose A (the server
         # drains this in its error path too)
         self._finished_pending = []
+        # (seq, reason) whose deadline expired / cancel was requested —
+        # the server resolves them with DeadlineExceededError
+        self._dead_pending = []
+        # (seq, exc) isolated out of a failing prefill/decode dispatch —
+        # the server resolves them with the ORIGINAL exception
+        self._poison_pending = []
 
     # ---------------------------------------------- jitted programs --
     def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
@@ -242,14 +255,18 @@ class LLMEngine:
             sp.set("prompt", T)
             sp.set("bucket", bucket)
             try:
+                # chaos-harness site: scripted raises / injected
+                # latency for "prefill fails on this prompt"
+                faults.check("llm.prefill")
                 first, kp, vp = self._prefill_jit(
                     self._params, self.cache.k_pages,
                     self.cache.v_pages, toks, block_arr, np.int32(T))
                 self.cache.swap(kp, vp)
                 first = int(np.asarray(first))
-            except Exception:
+            except BaseException:
                 # the blocks are not yet on the sequence: return them
-                # or they leak past every later free path
+                # or they leak past every later free path (BaseException:
+                # an InjectedCrash "worker death" must not leak either)
                 self.cache.allocator.free(blocks)
                 raise
         self.scheduler.place(seq, slot)
@@ -279,7 +296,27 @@ class LLMEngine:
                 need += 1           # first decode opens a new page
             if not self.cache.allocator.can_alloc(need):
                 break               # FIFO: no head-of-line skipping
-            self._prefill(seq, slot)
+            try:
+                self._prefill(seq, slot)
+            except Exception as exc:
+                if self._pages_deleted():
+                    raise       # KV pool gone: isolation impossible
+                # poison prompt: isolate it — fail ONLY this sequence
+                # (the server resolves its Future with this original
+                # exception) and keep admitting the rest
+                if (self.scheduler.waiting
+                        and self.scheduler.waiting[0] is seq):
+                    self.scheduler.waiting.popleft()
+                self.scheduler.release(seq, EVICTED, "poison")
+                self._poison_pending.append((seq, exc))
+                if self._stats:
+                    self._stats.record_poison()
+                if self._breaker is not None:
+                    self._breaker.record_failure(site="prefill")
+                events.append(("poisoned", seq))
+                continue
+            if self._breaker is not None:
+                self._breaker.record_success(site="prefill")
             events.append(("admitted", seq))
             if seq.done or seq.seq_len + 1 >= self.max_context:
                 self._finish(seq, events)
@@ -304,12 +341,142 @@ class LLMEngine:
         if self._stats:
             self._stats.record_preemption()
 
+    def _expire(self, events):
+        """Lifecycle scan: release sequences whose end-to-end deadline
+        expired or whose caller cancelled them (generate timeout).
+        Waiting ones die before costing a prefill; running ones free
+        their KV blocks and decode slot immediately. The server turns
+        the ``(seq, reason)`` records into typed
+        ``DeadlineExceededError`` resolutions carrying partial tokens."""
+        now = time.monotonic()
+        if self.scheduler.waiting:
+            keep = collections.deque()
+            while self.scheduler.waiting:
+                seq = self.scheduler.waiting.popleft()
+                reason = ("timeout" if seq.cancelled
+                          else "deadline" if seq.expired(now) else None)
+                if reason is None:
+                    keep.append(seq)
+                    continue
+                if seq.block_ids:       # defensive: waiting seqs
+                    self.cache.allocator.free(seq.block_ids)
+                    seq.block_ids = []  # normally hold no blocks
+                self.scheduler.release(seq, EVICTED, reason)
+                self._dead_pending.append((seq, reason))
+                events.append(("expired", seq))
+            self.scheduler.waiting = keep
+        for seq in self.scheduler.running():
+            reason = ("timeout" if seq.cancelled
+                      else "deadline" if seq.expired(now) else None)
+            if reason is None:
+                continue
+            self.cache.allocator.free(seq.block_ids)
+            seq.block_ids = []
+            self.scheduler.release(seq, EVICTED, reason)
+            self._dead_pending.append((seq, reason))
+            events.append(("expired", seq))
+
     # --------------------------------------------------------- step --
+    def _pages_deleted(self):
+        """True when the KV page buffers were consumed by a FAILED
+        donated dispatch (TPU: ``donate_argnums`` hands them to the
+        runtime even when the launch errors). Retrying against deleted
+        buffers would cascade every live sequence into a false poison
+        verdict — so the isolation paths treat this as fatal engine
+        state and re-raise instead, letting the server's worker-death
+        cleanup resolve every Future typed."""
+        is_del = getattr(self.cache.k_pages, "is_deleted", None)
+        try:
+            return bool(is_del and is_del())
+        except Exception:       # non-jax array backends
+            return False
+
+    def _decode_batch(self, seqs):
+        """ONE fixed-shape decode launch for ``seqs`` (slots not in
+        ``seqs`` ride along inactive on the null block — the shape, and
+        therefore the compiled program, never changes). Returns the
+        next-token array indexed by slot; dispatch failures propagate
+        to the isolation logic in :meth:`step`."""
+        S, MB = self.max_seqs, self.cache.max_blocks_per_seq
+        toks = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        lens = np.ones(S, np.int32)
+        tables = np.full((S, MB), NULL_BLOCK, np.int32)
+        for seq in seqs:
+            i = seq.slot
+            toks[i] = seq.last_token
+            pos[i] = seq.seq_len
+            lens[i] = seq.seq_len + 1
+            tables[i] = self.cache.table_row(seq.block_ids)
+        # chaos-harness site: scripted raises / injected latency
+        faults.check("llm.decode")
+        nxt, kp, vp = self._decode_jit(
+            self._params, self.cache.k_pages, self.cache.v_pages,
+            toks, pos, tables, lens)
+        self.cache.swap(kp, vp)
+        return np.asarray(nxt)
+
+    def _apply_tokens(self, seqs, nxt, events):
+        for seq in seqs:
+            tok = int(nxt[seq.slot])
+            seq.generated.append(tok)
+            seq.seq_len += 1
+            seq.last_token = tok
+            events.append(("token", seq))
+            if seq.done or seq.seq_len + 1 >= self.max_context:
+                self._finish(seq, events)
+
+    def _decode_isolate(self, seqs, events):
+        """Bisect-retry a failing decode dispatch to isolate the
+        poison row(s): halves re-dispatch through the SAME fixed-shape
+        program (no recompiles); a failing singleton is evicted with
+        its dispatch exception, everything else keeps its token.
+        Returns the sequences that made progress."""
+        if len(seqs) == 1:
+            try:
+                nxt = self._decode_batch(seqs)
+            except Exception as exc:
+                if self._pages_deleted():
+                    raise       # KV pool gone mid-bisect: fatal
+                seq = seqs[0]
+                self.cache.allocator.free(seq.block_ids)
+                seq.block_ids = []
+                self.scheduler.release(seq, EVICTED, "poison")
+                self._poison_pending.append((seq, exc))
+                if self._stats:
+                    self._stats.record_poison()
+                events.append(("poisoned", seq))
+                return []
+            # a successful sub-dispatch proves the backend is healthy:
+            # recurring poison rows isolate forever without ever
+            # accumulating into a breaker trip
+            if self._breaker is not None:
+                self._breaker.record_success(site="decode")
+            self._apply_tokens(seqs, nxt, events)
+            return list(seqs)
+        applied = []
+        mid = len(seqs) // 2
+        for half in (seqs[:mid], seqs[mid:]):
+            try:
+                nxt = self._decode_batch(half)
+            except Exception:
+                if self._pages_deleted():
+                    raise       # KV pool gone mid-bisect: fatal
+                applied += self._decode_isolate(half, events)
+            else:
+                if self._breaker is not None:
+                    self._breaker.record_success(site="decode")
+                self._apply_tokens(half, nxt, events)
+                applied += half
+        return applied
+
     def step(self):
         """One engine iteration. Returns events:
-        ``[("admitted"|"token"|"finished"|"preempted", Sequence)]``."""
+        ``[("admitted"|"token"|"finished"|"preempted"|"expired"|
+        "poisoned", Sequence)]``."""
         tracer = get_tracer()
         events = []
+        self._expire(events)
         self._admit(events)
         running = sorted(self.scheduler.running(),
                          key=lambda s: s.admit_index)
@@ -335,36 +502,28 @@ class LLMEngine:
         if not running:
             self._record_block_gauges()
             return events
-        S, MB = self.max_seqs, self.cache.max_blocks_per_seq
-        toks = np.zeros(S, np.int32)
-        pos = np.zeros(S, np.int32)
-        lens = np.ones(S, np.int32)
-        tables = np.full((S, MB), NULL_BLOCK, np.int32)
-        for seq in running:
-            i = seq.slot
-            toks[i] = seq.last_token
-            pos[i] = seq.seq_len
-            lens[i] = seq.seq_len + 1
-            tables[i] = self.cache.table_row(seq.block_ids)
         t0 = time.monotonic()
         with tracer.span("mxtpu.llm.decode_step", "llm") as sp:
             sp.set("running", len(running))
-            nxt, kp, vp = self._decode_jit(
-                self._params, self.cache.k_pages, self.cache.v_pages,
-                toks, pos, tables, lens)
-            self.cache.swap(kp, vp)
-            nxt = np.asarray(nxt)
+            try:
+                nxt = self._decode_batch(running)
+            except Exception as exc:
+                if self._pages_deleted():
+                    raise       # KV pool gone: isolation impossible
+                sp.set("error", repr(exc))
+                if self._breaker is not None:
+                    self._breaker.record_failure(site="decode")
+                with tracer.span("mxtpu.llm.isolate", "llm") as isp:
+                    isp.set("n", len(running))
+                    advanced = self._decode_isolate(running, events)
+            else:
+                if self._breaker is not None:
+                    self._breaker.record_success(site="decode")
+                self._apply_tokens(running, nxt, events)
+                advanced = running
         step_s = time.monotonic() - t0
-        for seq in running:
-            tok = int(nxt[seq.slot])
-            seq.generated.append(tok)
-            seq.seq_len += 1
-            seq.last_token = tok
-            events.append(("token", seq))
-            if seq.done or seq.seq_len + 1 >= self.max_context:
-                self._finish(seq, events)
         if self._stats:
-            self._stats.record_decode_step(len(running), step_s)
+            self._stats.record_decode_step(len(advanced), step_s)
         self._record_block_gauges()
         return events
 
@@ -373,6 +532,19 @@ class LLMEngine:
         resolves Futures from THIS (not from step()'s event list) so a
         completion can survive an exception later in the same step."""
         out, self._finished_pending = self._finished_pending, []
+        return out
+
+    def pop_dead(self):
+        """Drain the deadline-expired / cancelled ``(seq, reason)``
+        records (the server resolves them with
+        ``DeadlineExceededError`` carrying partial tokens)."""
+        out, self._dead_pending = self._dead_pending, []
+        return out
+
+    def pop_poison(self):
+        """Drain the poison-isolated ``(seq, exc)`` records (the
+        server resolves them with the original dispatch exception)."""
+        out, self._poison_pending = self._poison_pending, []
         return out
 
     # -------------------------------------------------------- drain --

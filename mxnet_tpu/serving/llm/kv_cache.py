@@ -201,6 +201,30 @@ class PagedKVCache:
         self.k_pages = k_pages
         self.v_pages = v_pages
 
+    # ---------------------------------------------------- invariants --
+    def check(self, live_block_ids=None):
+        """Pool-level invariant (the chaos-matrix gate): the allocator
+        accounting is consistent, and — when ``live_block_ids`` (an
+        iterable of per-sequence block-id lists) is given — the
+        allocated set is EXACTLY the union of blocks owned by live
+        sequences: no leaked blocks, no two sequences sharing one.
+        Raises :class:`BlockAccountingError`; returns True."""
+        self.allocator.check()
+        if live_block_ids is not None:
+            owned = []
+            for ids in live_block_ids:
+                owned.extend(ids)
+            if len(set(owned)) != len(owned):
+                raise BlockAccountingError(
+                    "a KV block is owned by two live sequences")
+            if set(owned) != self.allocator._used:
+                leaked = sorted(self.allocator._used - set(owned))
+                phantom = sorted(set(owned) - self.allocator._used)
+                raise BlockAccountingError(
+                    f"block accounting drift: leaked={leaked} "
+                    f"unallocated-but-owned={phantom}")
+        return True
+
     # -------------------------------------------------------- stats --
     def stats(self):
         a = self.allocator

@@ -23,7 +23,8 @@ from __future__ import annotations
 import threading
 
 from ...observability import get_registry
-from ..telemetry import _claim_server_label, _LATENCY_BUCKETS
+from ..telemetry import (OverloadStats, _claim_server_label,
+                         _LATENCY_BUCKETS)
 
 __all__ = ["LLMStats"]
 
@@ -101,6 +102,9 @@ class LLMStats:
             "mxtpu_llm_decode_step_seconds",
             "Wall time of one decode batch launch.", lbl,
             buckets=_LATENCY_BUCKETS).labels(**s)
+        # the overload/failure series share the single-shot server's
+        # mxtpu_serving_* catalog (one dashboard for both front ends)
+        self._overload = OverloadStats(r, self._server)
         self._evict_children = {}
         self._lock = threading.Lock()
         self._gen_count = 0
@@ -171,10 +175,23 @@ class LLMStats:
     def record_failure(self, n=1):
         self._failed.inc(n)
 
+    # ------------------------------------------------ overload series --
+    def record_shed(self, reason):
+        self._overload.record_shed(reason)
+
+    def record_deadline_expired(self, n=1):
+        self._overload.record_deadline_expired(n)
+
+    def record_poison(self, n=1):
+        self._overload.record_poison(n)
+
+    def record_breaker_state(self, state):
+        self._overload.record_breaker_state(state)
+
     # -------------------------------------------------------- stats --
     def snapshot(self):
         with self._lock:
-            return {
+            return self._overload.snapshot_into({
                 "requests_submitted": int(self._submitted.value),
                 "requests_completed": int(self._completed.value),
                 "requests_evicted": int(sum(
@@ -198,4 +215,4 @@ class LLMStats:
                     "p50": self._latency.percentile(50) * 1e3,
                     "p99": self._latency.percentile(99) * 1e3,
                 },
-            }
+            })

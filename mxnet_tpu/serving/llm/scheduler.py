@@ -46,9 +46,11 @@ class Sequence:
                  "max_new_tokens", "stop_token", "state", "slot",
                  "block_ids", "seq_len", "last_token", "t_submit",
                  "t_first_token", "admit_index", "preemptions",
-                 "future", "span", "finish_reason")
+                 "future", "span", "finish_reason", "deadline",
+                 "cancelled")
 
-    def __init__(self, prompt_tokens, max_new_tokens, stop_token=None):
+    def __init__(self, prompt_tokens, max_new_tokens, stop_token=None,
+                 deadline=None):
         self.seq_id = next(_seq_ids)
         self.prompt = [int(t) for t in prompt_tokens]
         if not self.prompt:
@@ -72,6 +74,20 @@ class Sequence:
         self.future = None        # attached by LLMServer
         self.span = None          # tracer hand-off span (LLMServer)
         self.finish_reason = None
+        # absolute monotonic end-to-end deadline (None = unbounded):
+        # expired-while-waiting sequences are failed before any
+        # prefill; expired-while-running ones are evicted with their
+        # partial tokens (typed DeadlineExceededError either way)
+        self.deadline = deadline
+        # set by LLMServer.cancel() (generate-timeout path); the
+        # engine releases the sequence's KV blocks and slot at the
+        # next lifecycle scan
+        self.cancelled = False
+
+    def expired(self, now=None):
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
 
     @property
     def num_generated(self):

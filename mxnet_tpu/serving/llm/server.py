@@ -16,11 +16,34 @@ unbounded) expires — past it, live sequences are rejected with a typed
 A caller always gets either its full generation or a partial one
 under a typed error; nothing is silently dropped.
 
+Overload & failure semantics (docs/SERVING.md):
+
+- ``submit(..., deadline_ms=)`` (env ``MXNET_TPU_SERVE_DEADLINE_MS``)
+  puts an END-TO-END deadline on the generation: expired while
+  waiting → failed before any prefill; expired mid-decode → evicted
+  with partial tokens; both resolve with a typed
+  :class:`~..errors.DeadlineExceededError`;
+- admission is bounded (``MXNET_TPU_SERVE_MAX_QUEUE`` counts
+  pending + waiting sequences — the backlog that holds no KV blocks
+  yet); past the bound ``submit`` sheds with a typed
+  :class:`~..errors.Overloaded` instead of growing the queue;
+- ``generate(..., timeout=)`` CANCELS the underlying sequence on
+  timeout: its KV blocks and decode slot are released and the Future
+  resolves typed — an abandoned caller cannot leak pool blocks;
+- poison prompts (prefill raises) and poison decode rows (bisect
+  isolation in the engine) fail ONLY their own Future, with the
+  original exception; persistent dispatch failures trip the shared
+  :class:`~..overload.CircuitBreaker` and submits fail fast with
+  :class:`~..errors.CircuitOpenError` until a half-open probe heals;
+- a dying worker (chaos point ``llm.worker``) resolves every live
+  Future and frees every KV block before the thread exits.
+
 Observability: per-request hand-off spans (``mxtpu.llm.request``
 opened under the caller's context, finished by the worker with
-ttft/token counts), engine prefill/decode spans, and the
-``mxtpu_llm_*`` registry series (:class:`~.metrics.LLMStats`) —
-tokens/sec, TTFT, queue depth, KV-block occupancy/eviction.
+ttft/token counts), engine prefill/decode/isolate spans, the
+``mxtpu_llm_*`` registry series (:class:`~.metrics.LLMStats`) and the
+shared ``mxtpu_serving_{shed,deadline_expired,poison_isolated,
+breaker_state}`` overload series.
 """
 from __future__ import annotations
 
@@ -29,28 +52,19 @@ import time
 
 import numpy as np
 
-from ..batching import ServerClosed
+from ..errors import (DeadlineExceededError, Overloaded,
+                      SequenceEvictedError, ServerClosed)
 from ..envutil import env_float as _env_float
+from ..overload import (CircuitBreaker, resolve_deadline,
+                        resolve_overload_knobs, shed_if_breaker_open)
 from .engine import LLMEngine
 from .metrics import LLMStats
 from .scheduler import Sequence
 from ..telemetry import compile_count
 from ...observability.tracing import get_tracer
+from ...resilience import faults
 
 __all__ = ["LLMServer", "SequenceEvictedError", "GenerationResult"]
-
-
-class SequenceEvictedError(RuntimeError):
-    """A decode sequence was evicted before completing (drain deadline,
-    no-drain shutdown). Carries everything generated so far — the
-    caller decides whether a partial generation is usable."""
-
-    def __init__(self, message, tokens=(), seq_id=None,
-                 reason="evicted"):
-        super().__init__(message)
-        self.tokens = [int(t) for t in tokens]
-        self.seq_id = seq_id
-        self.reason = reason
 
 
 class GenerationResult:
@@ -79,14 +93,25 @@ class LLMServer:
     sizing kwargs (``max_seqs``, ``block_size``, ``num_blocks``,
     ``max_context``, ``prefill_buckets``) pass through to
     :class:`~.engine.LLMEngine`, each defaulting to its
-    ``MXNET_TPU_LLM_*`` env var.
+    ``MXNET_TPU_LLM_*`` env var. Overload knobs: ``max_queue``
+    (``MXNET_TPU_SERVE_MAX_QUEUE``), ``deadline_ms``
+    (``MXNET_TPU_SERVE_DEADLINE_MS``), ``breaker_threshold`` /
+    ``breaker_cooldown_ms`` (``MXNET_TPU_SERVE_BREAKER_*``).
     """
 
-    def __init__(self, model, params, name="llm", **engine_kw):
+    def __init__(self, model, params, name="llm", max_queue=None,
+                 deadline_ms=None, breaker_threshold=None,
+                 breaker_cooldown_ms=None, **engine_kw):
         self.name = name
         self._stats = LLMStats(server=name)
+        self._breaker = CircuitBreaker(
+            threshold=breaker_threshold,
+            cooldown_ms=breaker_cooldown_ms,
+            on_state=self._stats.record_breaker_state)
         self._engine = LLMEngine(model, params, stats=self._stats,
-                                 **engine_kw)
+                                 breaker=self._breaker, **engine_kw)
+        self.max_queue, self.default_deadline_ms = \
+            resolve_overload_knobs(max_queue, deadline_ms)
         self._cv = threading.Condition()
         self._pending = []
         self._closed = False
@@ -141,18 +166,32 @@ class LLMServer:
         return self._engine.warmup()
 
     # -------------------------------------------------------- submit --
-    def submit(self, prompt_tokens, max_new_tokens, stop_token=None):
+    def _queue_depth(self):
+        """Admission backlog: sequences holding NO KV blocks yet."""
+        return len(self._pending) + self._engine.scheduler.num_waiting
+
+    def submit(self, prompt_tokens, max_new_tokens, stop_token=None,
+               deadline_ms=None):
         """Enqueue one prompt; returns a Future resolving to a
-        :class:`GenerationResult` (or raising
-        :class:`SequenceEvictedError` / :class:`ServerClosed`)."""
+        :class:`GenerationResult` (or raising a typed
+        :class:`~..errors.ServingError` subclass:
+        :class:`SequenceEvictedError`, :class:`DeadlineExceededError`,
+        :class:`ServerClosed`; at submit time: :class:`Overloaded` /
+        :class:`CircuitOpenError`)."""
         if not self._started:
             raise RuntimeError("server not started; call start()")
+        shed_if_breaker_open(self._breaker, self._stats)
+        deadline = resolve_deadline(deadline_ms,
+                                    self.default_deadline_ms,
+                                    self._stats)
         prompt = [int(t) for t in np.asarray(prompt_tokens).ravel()]
-        seq = Sequence(prompt, max_new_tokens, stop_token=stop_token)
+        seq = Sequence(prompt, max_new_tokens, stop_token=stop_token,
+                       deadline=deadline)
         # validate shape/vocab NOW, on the caller's thread
         self._engine.add_validate(seq)
         from concurrent.futures import Future
         seq.future = Future()
+        seq.future._mxtpu_seq = seq        # generate-timeout cancel hook
         tracer = get_tracer()
         if tracer.enabled:
             seq.span = tracer.begin("mxtpu.llm.request", "llm",
@@ -166,16 +205,72 @@ class LLMServer:
                     seq.span.finish()
                 raise ServerClosed(
                     "server is draining; no new sequences admitted")
+            if (self.max_queue is not None
+                    and self._queue_depth() >= self.max_queue):
+                depth = self._queue_depth()
+                self._stats.record_shed("queue_full")
+                if seq.span is not None:
+                    seq.span.set("error", "Overloaded")
+                    seq.span.finish()
+                raise Overloaded(
+                    f"admission queue full ({depth} >= max_queue "
+                    f"{self.max_queue}); request shed",
+                    reason="queue_full", depth=depth)
             self._pending.append(seq)
             self._cv.notify_all()
         self._stats.record_submit()
         return seq.future
 
+    def cancel(self, future):
+        """Cancel the sequence behind a Future returned by
+        :meth:`submit`: the engine releases its KV blocks and decode
+        slot at the next iteration and the Future resolves with a
+        typed :class:`DeadlineExceededError` (``reason="timeout"``)
+        carrying the tokens generated so far. No-op if the Future is
+        already resolved."""
+        seq = getattr(future, "_mxtpu_seq", None)
+        if seq is None or future.done():
+            return False
+        with self._cv:
+            seq.cancelled = True
+            self._cv.notify_all()
+        return True
+
     def generate(self, prompt_tokens, max_new_tokens, stop_token=None,
-                 timeout=None):
-        """Blocking single-prompt decode through the batcher."""
-        return self.submit(prompt_tokens, max_new_tokens,
-                           stop_token=stop_token).result(timeout=timeout)
+                 timeout=None, deadline_ms=None, reap_timeout=5.0):
+        """Blocking single-prompt decode through the batcher.
+
+        On ``timeout`` the underlying sequence is CANCELLED — its KV
+        blocks and decode slot are released, so an abandoned request
+        cannot leak pool capacity — and the typed
+        :class:`DeadlineExceededError` (with partial tokens) is raised
+        instead of a bare ``TimeoutError``. ``reap_timeout`` bounds
+        how long the cancel waits for the engine's next iteration to
+        resolve it (normally one loop tick; a wedged dispatch raises
+        the typed error after this window instead)."""
+        fut = self.submit(prompt_tokens, max_new_tokens,
+                          stop_token=stop_token, deadline_ms=deadline_ms)
+        from concurrent.futures import TimeoutError as FuturesTimeout
+        try:
+            return fut.result(timeout=timeout)
+        except FuturesTimeout:
+            self.cancel(fut)
+            try:
+                # the engine resolves the cancelled sequence on its
+                # next iteration (a dead worker resolves everything in
+                # its own cleanup)
+                return fut.result(timeout=reap_timeout)
+            except FuturesTimeout:
+                # engine wedged past the reap window (e.g. a dispatch
+                # stuck on-device): keep the typed-error contract —
+                # callers catching ServingError must see this too
+                seq = getattr(fut, "_mxtpu_seq", None)
+                raise DeadlineExceededError(
+                    "generation cancelled on timeout but not yet "
+                    "reaped by the engine",
+                    tokens=seq.output_tokens() if seq else (),
+                    seq_id=seq.seq_id if seq else None,
+                    reason="timeout") from None
 
     # --------------------------------------------------------- stats --
     def stats(self):
@@ -242,6 +337,13 @@ class LLMServer:
         return self
 
     # --------------------------------------------------- worker loop --
+    def _close_span(self, seq, **attrs):
+        if seq.span is not None:
+            for k, v in attrs.items():
+                seq.span.set(k, v)
+            seq.span.finish()
+            seq.span = None
+
     def _resolve_finished(self, seq):
         ttft = (seq.t_first_token - seq.t_submit
                 if seq.t_first_token else None)
@@ -264,14 +366,77 @@ class LLMServer:
             f"{len(toks)} tokens", tokens=toks, seq_id=seq.seq_id,
             reason=reason)
         self._stats.record_evicted(reason)
-        if seq.span is not None:
-            seq.span.set("error", reason)
-            seq.span.set("tokens", len(toks))
-            seq.span.finish()
-            seq.span = None
+        self._close_span(seq, error=reason, tokens=len(toks))
         seq.future.set_exception(err)
 
+    def _resolve_dead(self, seq, reason):
+        """A deadline-expired ("deadline") or cancelled ("timeout")
+        sequence: typed DeadlineExceededError with partial tokens."""
+        toks = seq.output_tokens()
+        err = DeadlineExceededError(
+            f"sequence {seq.seq_id} {reason} after {len(toks)} tokens",
+            tokens=toks, seq_id=seq.seq_id, reason=reason)
+        # exactly one counter per outcome: the dedicated deadline
+        # series for queue/decode expiry, the eviction series (by
+        # reason) for caller-cancelled timeouts
+        if reason == "deadline":
+            self._stats.record_deadline_expired()
+        else:
+            self._stats.record_evicted(reason)
+        self._close_span(seq, error=reason, tokens=len(toks))
+        seq.future.set_exception(err)
+
+    def _resolve_poison(self, seq, exc):
+        """A poison-isolated sequence fails with the ORIGINAL dispatch
+        exception (the serving layer isolates, it does not mask)."""
+        self._stats.record_failure()
+        self._close_span(seq, error=repr(exc))
+        seq.future.set_exception(exc)
+
+    def _flush_engine(self):
+        """Resolve everything the engine retired since the last call:
+        completions, deadline/cancel expiries, poison isolations."""
+        for seq in self._engine.pop_finished():
+            self._resolve_finished(seq)
+        for seq, reason in self._engine.pop_dead():
+            self._resolve_dead(seq, reason)
+        for seq, exc in self._engine.pop_poison():
+            self._resolve_poison(seq, exc)
+
+    def _fail_everything(self, exc):
+        """Worker-death cleanup: resolve EVERY live Future (engine +
+        still-pending) and free every KV block, so no caller hangs on
+        a dead engine thread and the pool stays leak-free. Futures
+        resolve with a TYPED ServerClosed chaining the original death
+        (same contract as ModelServer's worker-death path — a caller
+        catching ServingError sees every outcome, even an
+        InjectedCrash BaseException)."""
+        with self._cv:
+            self._closed = True
+            self._drain = False
+            orphans, self._pending = self._pending, []
+        self._flush_engine()
+        err = ServerClosed(f"llm engine worker died: {exc!r}")
+        err.__cause__ = exc
+        for seq in orphans + self._engine.evict_all("engine_error"):
+            if seq.future.done():       # defensive: never double-set
+                continue
+            self._stats.record_failure()
+            self._close_span(seq, error=repr(exc))
+            seq.future.set_exception(err)
+
     def _run_loop(self):
+        try:
+            self._run_loop_inner()
+        except BaseException as exc:
+            # InjectedCrash (chaos harness) or an engine bug the
+            # isolation layer could not contain: close admission FIRST
+            # so no future submit can enqueue onto a dead loop, then
+            # resolve every live Future
+            self._fail_everything(exc)
+            raise
+
+    def _run_loop_inner(self):
         engine = self._engine
         while True:
             with self._cv:
@@ -283,41 +448,24 @@ class LLMServer:
                 deadline = self._deadline
             for seq in pending:
                 engine.add(seq)
+            # chaos-harness point: crash_at_point("llm.worker")
+            # simulates the engine thread dying mid-loop
+            faults.point("llm.worker")
             if closed:
                 expired = (deadline is not None
                            and time.monotonic() >= deadline)
                 if not drain or expired:
                     reason = ("shutdown" if not drain
                               else "drain_deadline")
-                    for seq in engine.pop_finished():
-                        self._resolve_finished(seq)
+                    self._flush_engine()
                     for seq in engine.evict_all(reason):
                         self._resolve_evicted(seq, reason)
                     return
                 if not engine.has_work():
+                    self._flush_engine()
                     return
             if not engine.has_work():
+                self._flush_engine()
                 continue
-            try:
-                engine.step()
-            except Exception as exc:    # resolve, never hang callers
-                # the worker is about to die: close admission FIRST so
-                # no future submit can enqueue onto a dead loop, then
-                # deliver what DID finish inside the failing step and
-                # fail everything else live (engine + still-pending)
-                with self._cv:
-                    self._closed = True
-                    self._drain = False
-                    orphans, self._pending = self._pending, []
-                for seq in engine.pop_finished():
-                    self._resolve_finished(seq)
-                for seq in orphans + engine.evict_all("engine_error"):
-                    self._stats.record_failure()
-                    if seq.span is not None:
-                        seq.span.set("error", repr(exc))
-                        seq.span.finish()
-                        seq.span = None
-                    seq.future.set_exception(exc)
-                raise
-            for seq in engine.pop_finished():
-                self._resolve_finished(seq)
+            engine.step()
+            self._flush_engine()

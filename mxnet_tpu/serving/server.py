@@ -16,10 +16,40 @@ and "heavy concurrent traffic":
   :meth:`attach_preemption_guard`) drains gracefully: close admission,
   flush the queue, resolve every in-flight Future, then exit.
 
+Overload & failure semantics (docs/SERVING.md has the state machine):
+
+- **end-to-end deadlines** — ``submit(x, deadline_ms=...)`` (env
+  default ``MXNET_TPU_SERVE_DEADLINE_MS``) rides on the request; one
+  that expires while queued is failed with a typed
+  :class:`~.errors.DeadlineExceededError` BEFORE wasting a dispatch,
+  and batch assembly skips already-dead entries;
+- **admission control** — a bounded queue
+  (``MXNET_TPU_SERVE_MAX_QUEUE``) plus an estimated-wait check against
+  the request's deadline budget (driven by the
+  ``mxtpu_serving_service_seconds`` histogram); past either bound
+  ``submit`` fails fast with a typed :class:`~.errors.Overloaded`
+  (shed, counted by reason) instead of growing the queue;
+- **poison isolation** — a failing batched dispatch is bisect-retried
+  to isolate the poison row(s); only those Futures fail (with the
+  ORIGINAL exception), the rest are served;
+- **circuit breaker** — persistent dispatch failures trip a
+  :class:`~.overload.CircuitBreaker`; while open, submits and queued
+  batches are rejected typed (:class:`~.errors.CircuitOpenError`)
+  instead of crash-looping, with backoff-scheduled half-open probes;
+- **no stranded Futures** — if the worker thread dies (chaos harness:
+  ``resilience.faults`` point ``serving.worker``), every queued and
+  in-flight request is failed with a typed ``ServerClosed`` before the
+  thread exits. The invariant under every injected fault: every
+  submitted Future resolves, with a result or a typed error.
+
 Config resolution order: constructor arg > ``MXNET_TPU_SERVE_*`` env
 var > default. Env vars: ``MXNET_TPU_SERVE_MAX_BATCH`` (8),
 ``MXNET_TPU_SERVE_MAX_DELAY_MS`` (2.0), ``MXNET_TPU_SERVE_BUCKETS``
 (comma-separated, default powers of two up to max batch),
+``MXNET_TPU_SERVE_MAX_QUEUE`` (0 = unbounded),
+``MXNET_TPU_SERVE_DEADLINE_MS`` (0 = none),
+``MXNET_TPU_SERVE_BREAKER_THRESHOLD`` (5),
+``MXNET_TPU_SERVE_BREAKER_COOLDOWN_MS`` (1000),
 ``MXNET_TPU_SERVE_EVENT_LOG`` (JSONL path, off by default).
 """
 from __future__ import annotations
@@ -30,10 +60,15 @@ import time
 
 import numpy as np
 
-from .batching import MicroBatchQueue, ServerClosed
+from .batching import MicroBatchQueue, Request
 from .bucketing import BucketSpec, bucket_sizes, waste_fraction
+from .errors import (CircuitOpenError, DeadlineExceededError, Overloaded,
+                     ServerClosed)
+from .overload import (CircuitBreaker, resolve_deadline,
+                       resolve_overload_knobs, shed_if_breaker_open)
 from .telemetry import ServingStats, EventLog, compile_count
 from ..observability.tracing import get_tracer
+from ..resilience import faults
 
 __all__ = ["ModelServer", "ServerClosed"]
 
@@ -95,7 +130,9 @@ class ModelServer:
 
     def __init__(self, model, max_batch_size=None, max_delay_ms=None,
                  buckets=None, item_shape=None, dtype=None,
-                 event_log=None, name="serve"):
+                 event_log=None, name="serve", max_queue=None,
+                 deadline_ms=None, breaker_threshold=None,
+                 breaker_cooldown_ms=None):
         if buckets is None:
             buckets = _env_buckets()
         if max_batch_size is None:
@@ -115,16 +152,23 @@ class ModelServer:
         self.max_batch_size = max_batch_size
         self.max_delay_s = max_delay_ms / 1e3
         self.buckets = buckets
+        self.max_queue, self.default_deadline_ms = \
+            resolve_overload_knobs(max_queue, deadline_ms)
         self._item_shape = tuple(item_shape) if item_shape else None
         self._dtype = np.dtype(dtype) if dtype else None
         self._fn = self._build_fn(model)
-        self._queue = MicroBatchQueue()
+        self._queue = MicroBatchQueue(max_depth=self.max_queue)
         self._stats = ServingStats(server=name)
+        self._breaker = CircuitBreaker(
+            threshold=breaker_threshold,
+            cooldown_ms=breaker_cooldown_ms,
+            on_state=self._stats.record_breaker_state)
         self._events = (EventLog(event_log) if event_log is not None
                         else EventLog.from_env())
         self._worker = None
         self._started = False
         self._abort = None      # set to an abort reason string
+        self._inflight = []     # popped batch the worker owns right now
         self._drained = threading.Event()
         self._guard_watcher = None
         self._guard_stop = threading.Event()
@@ -184,7 +228,8 @@ class ModelServer:
         self._worker.start()
         self._events.emit("start", name=self.name, buckets=self.buckets,
                           max_batch=self.max_batch_size,
-                          max_delay_ms=self.max_delay_s * 1e3)
+                          max_delay_ms=self.max_delay_s * 1e3,
+                          max_queue=self.max_queue)
         return self
 
     def __enter__(self):
@@ -220,9 +265,32 @@ class ModelServer:
         return timings
 
     # ----------------------------------------------------------- submit --
-    def submit(self, x):
+    def _estimate_wait_s(self):
+        """Expected queue wait of a request admitted NOW: batches ahead
+        of it times the median observed service time. Zero until the
+        service histogram has data — the estimator never rejects before
+        it has evidence."""
+        p50 = self._stats.service_p50_s()
+        if p50 <= 0.0:
+            return 0.0
+        return (self._queue.depth() / float(self.max_batch_size)) * p50
+
+    def submit(self, x, deadline_ms=None):
         """Enqueue one sample (shape ``item_shape``); returns a Future
-        resolving to this sample's output row."""
+        resolving to this sample's output row.
+
+        ``deadline_ms`` is the request's END-TO-END budget (default:
+        ``MXNET_TPU_SERVE_DEADLINE_MS``, where an unset/0 env var
+        means unbounded; an EXPLICIT ``deadline_ms=0`` argument means
+        "already expired — fail fast typed", mirroring
+        ``shutdown(timeout=0)``): if it expires while the request is
+        queued, the Future fails with
+        :class:`DeadlineExceededError` without wasting a dispatch; if
+        the estimated queue wait already exceeds it, ``submit`` sheds
+        the request immediately (:class:`Overloaded`,
+        ``reason="deadline_unmeetable"``). A full bounded queue sheds
+        with ``reason="queue_full"``; an open circuit breaker with
+        :class:`CircuitOpenError`."""
         x = np.asarray(x)
         if self._item_shape is None:
             self._item_shape = x.shape
@@ -235,6 +303,23 @@ class ModelServer:
                 "server owns the batch dimension)")
         if not self._started:
             raise RuntimeError("server not started; call start()")
+        shed_if_breaker_open(self._breaker, self._stats, self._events)
+        deadline = resolve_deadline(deadline_ms,
+                                    self.default_deadline_ms,
+                                    self._stats, self._events)
+        if deadline is not None:
+            budget_s = deadline - time.monotonic()
+            est = self._estimate_wait_s()
+            if est > budget_s:
+                self._stats.record_shed("deadline_unmeetable")
+                self._events.emit("shed", reason="deadline_unmeetable",
+                                  est_wait_ms=round(est * 1e3, 3))
+                raise Overloaded(
+                    f"estimated queue wait {est * 1e3:.1f}ms exceeds "
+                    f"the request's {budget_s * 1e3:.1f}ms deadline "
+                    "budget; shed", reason="deadline_unmeetable",
+                    depth=self._queue.depth())
+        req = Request(x, deadline=deadline)
         tracer = get_tracer()
         if tracer.enabled:
             # hand-off span: opened here under the CALLER's current
@@ -242,25 +327,33 @@ class ModelServer:
             # request id + queue/pad/compute decomposition ride on it.
             # Attached before enqueue so the worker can never pop a
             # request whose span is still missing.
-            from .batching import Request
-            req = Request(x)
             req.span = tracer.begin("mxtpu.serving.request", "serving",
                                     tracer.current())
-            try:
-                fut = self._queue.enqueue(req)
-            except ServerClosed:
+        try:
+            fut = self._queue.enqueue(req)
+        except ServerClosed:
+            if req.span is not None:
                 req.span.set("error", "ServerClosed")
                 req.span.finish()
-                raise
-        else:
-            fut = self._queue.submit(x)
+                req.span = None
+            raise
+        except Overloaded as exc:
+            self._stats.record_shed("queue_full")
+            self._events.emit("shed", reason="queue_full",
+                              depth=exc.depth)
+            if req.span is not None:
+                req.span.set("error", "Overloaded")
+                req.span.finish()
+                req.span = None
+            raise
         self._stats.record_submit()
         self._stats.record_queue_depth(self._queue.depth())
         return fut
 
-    def predict(self, x, timeout=None):
+    def predict(self, x, timeout=None, deadline_ms=None):
         """Blocking single-sample inference through the batcher."""
-        return self.submit(x).result(timeout=timeout)
+        return self.submit(x, deadline_ms=deadline_ms).result(
+            timeout=timeout)
 
     # ------------------------------------------------------------ stats --
     def stats(self):
@@ -335,13 +428,127 @@ class ModelServer:
         return self
 
     # ------------------------------------------------------ worker loop --
+    def _dispatch(self, padded):
+        """One model execution. ``faults.check`` is the chaos-harness
+        hook: tests script dispatch raises / injected latency here
+        (site ``serving.dispatch``) without touching the model."""
+        faults.check("serving.dispatch")
+        return np.asarray(self._fn(padded))
+
+    def _run(self, batch, tracer):
+        """Pad ``batch`` to its bucket and dispatch once. Returns
+        ``(out, bucket, pad_s, service_s)``; dispatch exceptions
+        propagate to the caller (isolation / breaker logic)."""
+        n = len(batch)
+        bucket = self._bucket_spec.pick(n)
+        t_pad = time.monotonic()
+        with tracer.span("mxtpu.serving.pad", "serving"):
+            rows = np.stack([r.x for r in batch]).astype(
+                self._dtype, copy=False)
+            padded, _ = self._bucket_spec.pad(rows, bucket)
+        pad_s = time.monotonic() - t_pad
+        t0 = time.monotonic()
+        # one span, both sinks (tracer ring + jax profiler annotation)
+        with tracer.span("mxtpu.serving.dispatch", "serving") as dsp:
+            dsp.set("server", self.name)
+            dsp.set("bucket", bucket)
+            out = self._dispatch(padded)
+        return out, bucket, pad_s, time.monotonic() - t0
+
+    def _reply(self, batch, out, bucket, pad_s, service_s, tracer):
+        """Resolve every Future in ``batch`` with its row + account."""
+        with tracer.span("mxtpu.serving.reply", "serving"):
+            for i, req in enumerate(batch):
+                req.future.set_result(out[i])
+            _finish_request_spans(batch, bucket=bucket, pad_s=pad_s,
+                                  service_s=service_s)
+        n = len(batch)
+        self._stats.record_batch(
+            n, bucket, [r.wait_s for r in batch], service_s)
+        self._events.emit(
+            "batch", n=n, bucket=bucket,
+            waste=waste_fraction(n, bucket),
+            service_ms=service_s * 1e3,
+            max_wait_ms=max(r.wait_s for r in batch) * 1e3,
+            queue_depth=self._queue.depth())
+
+    def _isolate(self, batch, tracer):
+        """Bisect-retry a failing micro-batch to isolate the poison
+        row(s): halves re-dispatch independently (every sub-size pads
+        to an already-warmed bucket — no recompiles); a failing
+        singleton is the poison row and fails with ITS dispatch
+        exception; everything else is served normally."""
+        if len(batch) == 1:
+            req = batch[0]
+            try:
+                out, bucket, pad_s, service_s = self._run(batch, tracer)
+            except Exception as exc:
+                req.future.set_exception(exc)
+                _finish_request_spans(batch, error=repr(exc))
+                self._stats.record_poison()
+                self._stats.record_failure(1)
+                self._events.emit("poison", rid=req.rid,
+                                  error=repr(exc))
+                return
+            # a successful sub-dispatch proves the BACKEND is healthy:
+            # recurring poison rows must isolate forever without ever
+            # accumulating into a breaker trip
+            self._breaker.record_success()
+            self._reply(batch, out, bucket, pad_s, service_s, tracer)
+            return
+        mid = len(batch) // 2
+        for half in (batch[:mid], batch[mid:]):
+            try:
+                out, bucket, pad_s, service_s = self._run(half, tracer)
+            except Exception:
+                self._isolate(half, tracer)
+            else:
+                self._breaker.record_success()
+                self._reply(half, out, bucket, pad_s, service_s, tracer)
+
+    def _fail_remaining(self, exc):
+        """Worker-death cleanup: the loop is about to die with ``exc``
+        (e.g. an injected crash). Close admission and resolve EVERY
+        still-pending Future — the popped in-flight batch and the whole
+        queued backlog — with a typed error, so no caller ever hangs on
+        a dead worker."""
+        self._abort = self._abort or "worker_died"
+        self._queue.close()
+        stranded = [r for r in self._inflight if not r.future.done()]
+        self._inflight = []
+        stranded += self._queue.drain()
+        if not stranded:
+            return
+        err = ServerClosed(f"serving worker died: {exc!r}")
+        err.__cause__ = exc
+        for req in stranded:
+            req.future.set_exception(err)
+        _finish_request_spans(stranded, error="worker_died")
+        self._stats.record_failure(len(stranded))
+        self._events.emit("worker_died", n=len(stranded),
+                          error=repr(exc))
+
     def _serve_loop(self):
+        try:
+            self._serve_loop_inner()
+        except BaseException as exc:
+            # InjectedCrash (chaos harness) or any unexpected loop bug:
+            # never strand a Future behind a dead worker
+            self._fail_remaining(exc)
+            raise
+
+    def _serve_loop_inner(self):
         tracer = get_tracer()
         while True:
             batch = self._queue.get_batch(self.max_batch_size,
                                           self.max_delay_s)
             if not batch:
                 return  # closed and empty
+            self._inflight = batch
+            # chaos-harness point: crash_at_point("serving.worker")
+            # simulates the worker dying mid-batch (InjectedCrash is a
+            # BaseException — only _fail_remaining may see it)
+            faults.point("serving.worker")
             if self._abort:
                 # tell the caller WHY its request was not served: a
                 # deadline-bounded drain that ran out of time is not
@@ -354,52 +561,63 @@ class ModelServer:
                     req.future.set_exception(exc)
                 _finish_request_spans(batch, error=self._abort)
                 self._stats.record_failure(len(batch))
+                self._inflight = []
                 continue
             self._stats.record_queue_depth(self._queue.depth())
-            n = len(batch)
-            bucket = self._bucket_spec.pick(n)
+            # deadline gate: fail requests that died in the queue
+            # BEFORE spending any dispatch on them
+            now = time.monotonic()
+            dead = [r for r in batch if r.expired(now)]
+            if dead:
+                for req in dead:
+                    req.future.set_exception(DeadlineExceededError(
+                        f"request {req.rid} deadline expired after "
+                        f"{(now - req.t_enqueue) * 1e3:.1f}ms in queue",
+                        seq_id=req.rid))
+                _finish_request_spans(dead, error="deadline_expired")
+                self._stats.record_deadline_expired(len(dead))
+                self._stats.record_failure(len(dead))
+                self._events.emit("deadline_expired", n=len(dead),
+                                  at="queue")
+                batch = [r for r in batch if not r.expired(now)]
+                if not batch:
+                    self._inflight = []
+                    continue
+                self._inflight = batch
+            # breaker gate: while open, reject queued work typed
+            # instead of burning dispatches that will fail anyway
+            if not self._breaker.allow_dispatch():
+                err = CircuitOpenError(
+                    "circuit breaker open; request rejected without "
+                    "dispatch", retry_after_s=self._breaker.retry_after_s())
+                for req in batch:
+                    req.future.set_exception(err)
+                _finish_request_spans(batch, error="breaker_open")
+                self._stats.record_failure(len(batch))
+                self._events.emit("breaker_reject", n=len(batch))
+                self._inflight = []
+                continue
             with tracer.span("mxtpu.serving.batch", "serving") as bsp:
                 bsp.set("server", self.name)
-                bsp.set("n", n)
-                bsp.set("bucket", bucket)
-                t_pad = time.monotonic()
-                with tracer.span("mxtpu.serving.pad", "serving"):
-                    rows = np.stack([r.x for r in batch]).astype(
-                        self._dtype, copy=False)
-                    padded, _ = self._bucket_spec.pad(rows, bucket)
-                pad_s = time.monotonic() - t_pad
-                t0 = time.monotonic()
+                bsp.set("n", len(batch))
                 try:
-                    # one span, both sinks (tracer ring + jax profiler
-                    # annotation) — wrapping host_scope here too would
-                    # record the same region twice now that host_scope
-                    # delegates to the tracer
-                    with tracer.span("mxtpu.serving.dispatch",
-                                     "serving") as dsp:
-                        dsp.set("server", self.name)
-                        dsp.set("bucket", bucket)
-                        out = np.asarray(self._fn(padded))
+                    out, bucket, pad_s, service_s = self._run(batch,
+                                                              tracer)
                 except Exception as exc:    # resolve, never hang callers
-                    for req in batch:
-                        req.future.set_exception(exc)
-                    _finish_request_spans(batch, bucket=bucket,
-                                          pad_s=pad_s, error=repr(exc))
-                    self._stats.record_failure(n)
-                    self._events.emit("batch_error", n=n, bucket=bucket,
+                    if self._breaker.record_failure():
+                        self._events.emit(
+                            "breaker_open",
+                            retry_after_s=round(
+                                self._breaker.retry_after_s(), 4))
+                    self._events.emit("batch_error", n=len(batch),
                                       error=repr(exc))
-                    continue
-                service_s = time.monotonic() - t0
-                with tracer.span("mxtpu.serving.reply", "serving"):
-                    for i, req in enumerate(batch):
-                        req.future.set_result(out[i])
-                    _finish_request_spans(batch, bucket=bucket,
-                                          pad_s=pad_s,
-                                          service_s=service_s)
-            self._stats.record_batch(
-                n, bucket, [r.wait_s for r in batch], service_s)
-            self._events.emit(
-                "batch", n=n, bucket=bucket,
-                waste=waste_fraction(n, bucket),
-                service_ms=service_s * 1e3,
-                max_wait_ms=max(r.wait_s for r in batch) * 1e3,
-                queue_depth=self._queue.depth())
+                    with tracer.span("mxtpu.serving.isolate",
+                                     "serving") as isp:
+                        isp.set("n", len(batch))
+                        self._isolate(batch, tracer)
+                else:
+                    self._breaker.record_success()
+                    bsp.set("bucket", bucket)
+                    self._reply(batch, out, bucket, pad_s, service_s,
+                                tracer)
+            self._inflight = []

@@ -35,7 +35,8 @@ from ..observability import get_registry
 from ..observability.jaxmon import compile_count
 from ..observability.registry import DEFAULT_TIME_BUCKETS
 
-__all__ = ["compile_count", "CompileCounter", "ServingStats", "EventLog"]
+__all__ = ["compile_count", "CompileCounter", "ServingStats", "EventLog",
+           "OverloadStats"]
 
 
 class CompileCounter:
@@ -89,6 +90,83 @@ def _claim_server_label(name, holder):
                 return label
             n += 1
             label = f"{name}#{n}"
+
+
+class OverloadStats:
+    """The overload/failure series BOTH serving front ends expose
+    under one catalog (``mxtpu_serving_*`` labeled by server name):
+    requests shed at admission (by reason), requests failed on an
+    expired end-to-end deadline, poison rows isolated out of batches,
+    and the circuit-breaker state gauge (0 closed / 1 open / 2
+    half-open). ``ServingStats`` and ``LLMStats`` both embed one, so a
+    dashboard reads overload behavior identically for single-shot and
+    decode serving."""
+
+    def __init__(self, registry, server_label):
+        r, lbl = registry, ("server",)
+        s = {"server": server_label}
+        self._server = server_label
+        self._shed_metric = r.counter(
+            "mxtpu_serving_shed_total",
+            "Requests shed at admission instead of queued, by reason "
+            "(queue_full, deadline_unmeetable, breaker_open).",
+            ("server", "reason"))
+        self._deadline = r.counter(
+            "mxtpu_serving_deadline_expired_total",
+            "Requests failed because their end-to-end deadline expired "
+            "before a result existed (never dispatched past expiry).",
+            lbl).labels(**s)
+        self._poison = r.counter(
+            "mxtpu_serving_poison_isolated_total",
+            "Requests isolated out of a failing batch by bisect-retry "
+            "and failed with the original dispatch exception.",
+            lbl).labels(**s)
+        self._breaker = r.gauge(
+            "mxtpu_serving_breaker_state",
+            "Dispatch circuit breaker: 0 closed, 1 open (rejecting), "
+            "2 half-open (probing).", lbl).labels(**s)
+        self._shed_children = {}
+        self._shed_lock = threading.Lock()
+
+    def record_shed(self, reason):
+        with self._shed_lock:
+            child = self._shed_children.get(reason)
+            if child is None:
+                child = self._shed_metric.labels(server=self._server,
+                                                 reason=reason)
+                self._shed_children[reason] = child
+        child.inc()
+
+    def record_deadline_expired(self, n=1):
+        self._deadline.inc(n)
+
+    def record_poison(self, n=1):
+        self._poison.inc(n)
+
+    def record_breaker_state(self, state):
+        self._breaker.set(state)
+
+    def reset(self):
+        with self._shed_lock:
+            self._deadline.reset()
+            self._poison.reset()
+            self._breaker.reset()
+            for child in self._shed_metric.children():
+                if child.labels_dict.get("server") == self._server:
+                    child.reset()
+            self._shed_children = {}
+
+    def snapshot_into(self, snap):
+        """Merge the overload counters into a stats snapshot dict."""
+        with self._shed_lock:
+            snap["shed"] = {r: int(c.value)
+                            for r, c in self._shed_children.items()
+                            if c.value}
+        snap["requests_shed"] = sum(snap["shed"].values())
+        snap["deadline_expired"] = int(self._deadline.value)
+        snap["poison_isolated"] = int(self._poison.value)
+        snap["breaker_state"] = int(self._breaker.value)
+        return snap
 
 
 class ServingStats:
@@ -150,6 +228,7 @@ class ServingStats:
             "mxtpu_serving_bucket_hits_total",
             "Micro-batches dispatched per shape bucket.",
             ("server", "bucket"))
+        self._overload = OverloadStats(r, self._server)
         self._lock = threading.Lock()
         self._bucket_hits = {}
         self.reset()
@@ -168,6 +247,7 @@ class ServingStats:
                 if child.labels_dict.get("server") == self._server:
                     child.reset()
             self._bucket_hits = {}
+        self._overload.reset()
 
     def _hit_child(self, bucket):
         child = self._bucket_hits.get(bucket)
@@ -200,6 +280,24 @@ class ServingStats:
     def record_failure(self, n):
         self._failed.inc(n)
 
+    # ------------------------------------------------ overload series --
+    def record_shed(self, reason):
+        self._overload.record_shed(reason)
+
+    def record_deadline_expired(self, n=1):
+        self._overload.record_deadline_expired(n)
+
+    def record_poison(self, n=1):
+        self._overload.record_poison(n)
+
+    def record_breaker_state(self, state):
+        self._overload.record_breaker_state(state)
+
+    def service_p50_s(self):
+        """Median per-batch service time (seconds; 0 until observed) —
+        the admission controller's estimated-wait input."""
+        return self._service.percentile(50)
+
     # -------------------------------------------------------- snapshot --
     def snapshot(self):
         with self._lock:
@@ -209,7 +307,7 @@ class ServingStats:
             batches = self._batches.value
             completed = self._completed.value
             total_slots = rows + padded
-            return {
+            return self._overload.snapshot_into({
                 "requests_submitted": int(self._submitted.value),
                 "requests_completed": int(completed),
                 "requests_failed": int(self._failed.value),
@@ -225,7 +323,7 @@ class ServingStats:
                 "wait_ms": self._pcts(self._wait),
                 "latency_ms": self._pcts(self._latency),
                 "service_ms": self._pcts(self._service),
-            }
+            })
 
     @staticmethod
     def _pcts(hist):

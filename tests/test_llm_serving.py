@@ -426,6 +426,31 @@ def test_submit_validation(model, params):
     srv.shutdown()
 
 
+def test_submit_deadline_and_queue_knobs(model, params, monkeypatch):
+    """Overload knobs on the decode path: env-var resolution plus the
+    fail-fast submit behaviors (expired budget, bounded queue)."""
+    monkeypatch.setenv("MXNET_TPU_SERVE_MAX_QUEUE", "7")
+    monkeypatch.setenv("MXNET_TPU_SERVE_DEADLINE_MS", "500")
+    srv = LLMServer(model, params, name="knobs_t", max_seqs=2,
+                    block_size=BS, max_context=CTX)
+    assert srv.max_queue == 7
+    assert srv.default_deadline_ms == 500.0
+    srv.warmup()
+    srv.start()
+    with pytest.raises(serving.DeadlineExceededError):
+        srv.submit([1, 2], 4, deadline_ms=0)    # budget already gone
+    # a deadline generous enough never to bind: serves normally
+    res = srv.submit([1, 2], 3, deadline_ms=60000).result(timeout=30)
+    assert len(res.tokens) == 3
+    srv.shutdown()
+    assert srv.stats()["deadline_expired"] == 1
+    # typed-hierarchy satellite: eviction/deadline errors share the
+    # exported base
+    assert issubclass(SequenceEvictedError, serving.ServingError)
+    assert issubclass(serving.DeadlineExceededError,
+                      serving.ServingError)
+
+
 def test_engine_sizing_guards(model, params):
     with pytest.raises(ValueError):
         LLMEngine(model, params, max_seqs=2, block_size=BS,

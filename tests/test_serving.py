@@ -339,3 +339,31 @@ def test_env_var_config(predictor, monkeypatch):
     srv2 = serving.ModelServer(predictor)
     assert srv2.buckets == [2, 8]
     assert srv2.max_batch_size == 8
+
+
+def test_overload_env_var_config(predictor, monkeypatch):
+    """The overload knobs resolve constructor arg > env var > default
+    like every other serving knob."""
+    srv = serving.ModelServer(predictor, buckets=[1])
+    assert srv.max_queue is None            # default: unbounded
+    assert srv.default_deadline_ms is None  # default: no deadline
+    monkeypatch.setenv("MXNET_TPU_SERVE_MAX_QUEUE", "32")
+    monkeypatch.setenv("MXNET_TPU_SERVE_DEADLINE_MS", "250")
+    srv2 = serving.ModelServer(predictor, buckets=[1])
+    assert srv2.max_queue == 32
+    assert srv2._queue.max_depth == 32
+    assert srv2.default_deadline_ms == 250.0
+    srv3 = serving.ModelServer(predictor, buckets=[1], max_queue=4,
+                               deadline_ms=50)
+    assert srv3.max_queue == 4 and srv3.default_deadline_ms == 50.0
+
+
+def test_typed_errors_exported_under_one_base(predictor):
+    """Satellite: serving-side errors share the exported ServingError
+    base (and stay RuntimeError-compatible for old callers)."""
+    srv = serving.ModelServer(predictor, buckets=[1]).start()
+    srv.shutdown()
+    with pytest.raises(serving.ServingError):
+        srv.submit(np.zeros(ITEM, np.float32))
+    with pytest.raises(RuntimeError):       # legacy contract
+        srv.submit(np.zeros(ITEM, np.float32))

@@ -1,0 +1,692 @@
+"""Serving chaos matrix: overload-proof request semantics (ISSUE 10).
+
+The invariant pinned here, under EVERY injected fault: **every
+submitted Future resolves** — with a result or a typed error — no
+hangs, no silent drops, no KV-block leaks, and the zero-steady-state-
+recompile contract intact. The fault switchboard is the same
+``resilience.faults`` harness the training side uses, extended into
+the serving hot paths (``serving.dispatch`` / ``serving.worker`` /
+``llm.prefill`` / ``llm.decode`` / ``llm.worker``):
+
+- dispatch raise (transient → bisect-retry recovers; persistent →
+  poison row isolated, ONLY its Future fails, with the original
+  exception);
+- slow compute (injected latency / Gate-parked dispatch → queued
+  deadlines expire typed BEFORE wasting a dispatch);
+- worker death mid-batch (InjectedCrash → every queued + in-flight
+  Future resolves typed; later submits raise ServerClosed);
+- preemption mid-drain and drain-under-load (shed vs evict vs served
+  deterministic, each counted once);
+- queue overflow (bounded queue sheds with typed Overloaded at
+  submit);
+- circuit breaker (persistent failures → CircuitOpenError fail-fast,
+  half-open probe heals).
+
+Also pinned: the unified typed exception hierarchy
+(`serving.ServingError` satellite) and `PagedKVCache.check()` block
+accounting after every LLM scenario.
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mxnet_tpu import serving  # noqa: E402
+from mxnet_tpu.serving import (  # noqa: E402
+    CircuitOpenError, DeadlineExceededError, Overloaded,
+    SequenceEvictedError, ServerClosed, ServingError)
+from mxnet_tpu.serving.llm import (  # noqa: E402
+    TinyDecoder, DecoderConfig, LLMServer, greedy_decode_reference)
+from mxnet_tpu.resilience import faults  # noqa: E402
+
+ITEM = (2,)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _echo_server(name, fn=None, **kw):
+    kw.setdefault("buckets", [1, 2, 4])
+    kw.setdefault("max_delay_ms", 20.0)
+    return serving.ModelServer(fn or (lambda b: b * 2.0),
+                               item_shape=ITEM, dtype="float32",
+                               name=name, **kw).start()
+
+
+def _resolve_all(futs, timeout=30):
+    """The chaos invariant: every Future resolves (result or typed
+    error) — no hangs. Returns (results, errors)."""
+    results, errors = [], []
+    for f in futs:
+        try:
+            results.append(f.result(timeout=timeout))
+        except BaseException as exc:
+            errors.append(exc)
+    return results, errors
+
+
+# ---------------------------------------------------- error hierarchy --
+def test_typed_error_hierarchy_unified():
+    """Satellite: one exported base class covers every serving-side
+    typed error, and the legacy RuntimeError contract still holds."""
+    for exc_type in (ServerClosed, Overloaded, CircuitOpenError,
+                     DeadlineExceededError, SequenceEvictedError):
+        assert issubclass(exc_type, ServingError)
+        assert issubclass(exc_type, RuntimeError)
+    assert issubclass(CircuitOpenError, Overloaded)
+    # the hierarchy is importable from the package root AND the llm
+    # subpackage re-exports the decode-side members
+    from mxnet_tpu.serving import llm as llm_mod
+    assert llm_mod.SequenceEvictedError is SequenceEvictedError
+    assert llm_mod.DeadlineExceededError is DeadlineExceededError
+    # submit-after-close raises through the hierarchy
+    q = serving.MicroBatchQueue()
+    q.close()
+    with pytest.raises(ServingError):
+        q.submit(1)
+    err = DeadlineExceededError("x", tokens=[1, 2], seq_id=7)
+    assert err.tokens == [1, 2] and err.seq_id == 7
+
+
+# ------------------------------------------- ModelServer chaos matrix --
+def test_transient_dispatch_raise_recovers_all_rows():
+    """One injected dispatch raise: the bisect retry re-runs the rows
+    and every request is still served — zero failed Futures."""
+    srv = _echo_server("chaos_transient")
+    faults.script("serving.dispatch", [RuntimeError("transient blip")])
+    futs = [srv.submit(np.full(ITEM, i, np.float32)) for i in range(4)]
+    results, errors = _resolve_all(futs)
+    srv.shutdown()
+    assert not errors
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(r, np.full(ITEM, 2.0 * i))
+    st = srv.stats()
+    assert st["requests_failed"] == 0
+    assert st["requests_completed"] == 4
+    assert st["breaker_state"] == 0     # one blip does not trip
+
+
+def test_poison_row_isolated_rest_served():
+    """A row the model cannot process fails ONLY its own Future, with
+    the ORIGINAL exception; every other row in its micro-batch is
+    served, and each request is counted exactly once."""
+    def fn(batch):
+        if (batch == 99.0).any():
+            raise ValueError("poison row")
+        return batch * 2.0
+
+    srv = _echo_server("chaos_poison", fn=fn, buckets=[1, 2, 4, 8],
+                       max_delay_ms=50.0)
+    vals = [1, 2, 99, 4, 5, 6, 7, 8]
+    futs = [srv.submit(np.full(ITEM, v, np.float32)) for v in vals]
+    results, errors = _resolve_all(futs)
+    srv.shutdown()
+    assert len(results) == 7 and len(errors) == 1
+    assert isinstance(errors[0], ValueError)       # original, unmasked
+    assert "poison row" in str(errors[0])
+    st = srv.stats()
+    assert st["poison_isolated"] == 1
+    assert st["requests_completed"] == 7
+    assert st["requests_failed"] == 1
+    assert st["requests_completed"] + st["requests_failed"] \
+        == st["requests_submitted"]
+
+
+def test_slow_compute_expires_queued_deadlines():
+    """Gate-parked dispatch (injected slow compute): a request whose
+    deadline expires while queued fails typed BEFORE any dispatch is
+    spent on it; requests without deadlines are unaffected."""
+    gate = faults.block_at("serving.dispatch")
+    srv = _echo_server("chaos_slow", buckets=[1], max_delay_ms=0.1)
+    f_slow = srv.submit(np.zeros(ITEM, np.float32))     # parks in gate
+    assert gate.wait_reached(10)
+    f_dead = srv.submit(np.zeros(ITEM, np.float32), deadline_ms=5)
+    f_live = srv.submit(np.zeros(ITEM, np.float32))     # no deadline
+    time.sleep(0.03)                                    # deadline passes
+    gate.release()
+    np.testing.assert_array_equal(f_slow.result(timeout=30), 0.0)
+    np.testing.assert_array_equal(f_live.result(timeout=30), 0.0)
+    with pytest.raises(DeadlineExceededError):
+        f_dead.result(timeout=30)
+    srv.shutdown()
+    st = srv.stats()
+    assert st["deadline_expired"] == 1
+    assert st["requests_failed"] == 1
+
+
+def test_deadline_already_expired_fails_at_submit():
+    srv = _echo_server("chaos_dl0")
+    with pytest.raises(DeadlineExceededError):
+        srv.submit(np.zeros(ITEM, np.float32), deadline_ms=0)
+    srv.shutdown()
+    assert srv.stats()["deadline_expired"] == 1
+
+
+def test_estimated_wait_sheds_unmeetable_deadline():
+    """Once the service histogram knows dispatches are slow, a request
+    whose deadline cannot possibly be met is shed AT SUBMIT."""
+    faults.delay_at("serving.dispatch", 0.06)
+    srv = _echo_server("chaos_est", buckets=[1], max_delay_ms=0.1)
+    # teach the histogram: a few slow dispatches
+    for _ in range(3):
+        srv.predict(np.zeros(ITEM, np.float32), timeout=30)
+    gate = faults.block_at("serving.dispatch")
+    f_busy = srv.submit(np.zeros(ITEM, np.float32))
+    assert gate.wait_reached(10)
+    # queue up work so the estimator sees a backlog
+    f_q = srv.submit(np.zeros(ITEM, np.float32))
+    with pytest.raises(Overloaded) as ei:
+        srv.submit(np.zeros(ITEM, np.float32), deadline_ms=1.0)
+    assert ei.value.reason == "deadline_unmeetable"
+    gate.release()
+    _resolve_all([f_busy, f_q])
+    srv.shutdown()
+    assert srv.stats()["shed"].get("deadline_unmeetable") == 1
+
+
+def test_queue_overflow_sheds_typed():
+    """Bounded queue: past MXNET_TPU_SERVE_MAX_QUEUE, submit fails
+    fast with Overloaded(queue_full) instead of growing the queue;
+    every ADMITTED request still resolves."""
+    gate = faults.block_at("serving.dispatch")
+    srv = _echo_server("chaos_full", buckets=[1], max_delay_ms=0.1,
+                       max_queue=2)
+    f_busy = srv.submit(np.zeros(ITEM, np.float32))
+    assert gate.wait_reached(10)
+    admitted = [srv.submit(np.zeros(ITEM, np.float32))
+                for _ in range(2)]
+    shed = 0
+    for _ in range(3):
+        with pytest.raises(Overloaded) as ei:
+            srv.submit(np.zeros(ITEM, np.float32))
+        assert ei.value.reason == "queue_full"
+        shed += 1
+    gate.release()
+    results, errors = _resolve_all([f_busy] + admitted)
+    srv.shutdown()
+    assert len(results) == 3 and not errors
+    st = srv.stats()
+    assert st["shed"]["queue_full"] == shed == 3
+    assert st["requests_submitted"] == 3        # shed never admitted
+
+
+def test_worker_death_mid_batch_resolves_everything():
+    """InjectedCrash at the serving.worker point: the worker thread
+    dies mid-batch, yet every queued and in-flight Future resolves
+    typed, and later submits raise ServerClosed."""
+    faults.crash_at_point("serving.worker", nth=1)
+    srv = _echo_server("chaos_death", buckets=[1, 2, 4],
+                       max_delay_ms=100.0)
+    futs = [srv.submit(np.zeros(ITEM, np.float32)) for _ in range(5)]
+    results, errors = _resolve_all(futs, timeout=30)
+    assert len(results) + len(errors) == 5      # nothing hangs
+    assert all(isinstance(e, ServerClosed) for e in errors)
+    assert errors, "the crash must have failed at least the batch"
+    faults.reset()
+    with pytest.raises(ServerClosed):
+        srv.submit(np.zeros(ITEM, np.float32))
+    srv.shutdown()                               # must not hang
+
+
+def test_breaker_trips_then_half_open_probe_heals():
+    """Persistent dispatch failures trip the breaker (typed fail-fast
+    at submit AND for queued work); after the cooldown a half-open
+    probe succeeds and the breaker closes."""
+    state = {"broken": True}
+
+    def fn(batch):
+        if state["broken"]:
+            raise RuntimeError("backend down")
+        return batch + 1.0
+
+    srv = _echo_server("chaos_breaker", fn=fn, buckets=[1],
+                       max_delay_ms=0.1, breaker_threshold=2,
+                       breaker_cooldown_ms=50)
+    # two consecutive failing batch dispatches trip it
+    for _ in range(2):
+        f = srv.submit(np.zeros(ITEM, np.float32))
+        with pytest.raises(RuntimeError):
+            f.result(timeout=30)
+    deadline = time.monotonic() + 10
+    while (srv.stats()["breaker_state"] != 1
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    assert srv.stats()["breaker_state"] == 1     # OPEN
+    with pytest.raises(CircuitOpenError) as ei:
+        srv.submit(np.zeros(ITEM, np.float32))
+    assert ei.value.reason == "breaker_open"
+    assert srv.stats()["shed"]["breaker_open"] == 1
+    # heal the backend; past the cooldown the probe closes the breaker
+    state["broken"] = False
+    time.sleep(0.12)
+    out = srv.predict(np.zeros(ITEM, np.float32), timeout=30)
+    np.testing.assert_array_equal(out, 1.0)
+    srv.shutdown()
+    assert srv.stats()["breaker_state"] == 0     # CLOSED again
+
+
+def test_recurring_poison_rows_do_not_trip_breaker():
+    """Regression: isolation sub-dispatches that SUCCEED prove the
+    backend is healthy — a misbehaving client interleaving poison rows
+    into traffic must not accumulate consecutive breaker failures into
+    a self-inflicted outage."""
+    def fn(batch):
+        if (batch == 99.0).any():
+            raise ValueError("poison row")
+        return batch
+
+    srv = _echo_server("chaos_poisbrk", fn=fn, buckets=[1, 2],
+                       max_delay_ms=30.0, breaker_threshold=2)
+    for _ in range(4):      # 4 poison-containing rounds > threshold
+        f_bad = srv.submit(np.full(ITEM, 99.0, np.float32))
+        f_ok = srv.submit(np.full(ITEM, 1.0, np.float32))
+        with pytest.raises(ValueError):
+            f_bad.result(timeout=30)
+        np.testing.assert_array_equal(f_ok.result(timeout=30), 1.0)
+    assert srv.stats()["breaker_state"] == 0     # never tripped
+    srv.shutdown()
+    assert srv.stats()["poison_isolated"] == 4
+    assert srv.stats()["requests_completed"] == 4
+
+
+def test_drain_under_load_shed_evict_served_counted_once():
+    """Satellite: shutdown drain deadline x per-request deadlines x a
+    full bounded queue — the outcome of every request is deterministic
+    (served / shed / deadline-expired / drain-rejected) and each is
+    counted exactly once in the metrics."""
+    gate = faults.block_at("serving.dispatch")
+    srv = _echo_server("chaos_drain", buckets=[1], max_delay_ms=0.1,
+                       max_queue=3)
+    f_busy = srv.submit(np.zeros(ITEM, np.float32))   # served (parked)
+    assert gate.wait_reached(10)
+    f_ok = srv.submit(np.zeros(ITEM, np.float32))     # served on drain
+    f_dead = srv.submit(np.zeros(ITEM, np.float32),
+                        deadline_ms=5)                # expires queued
+    f_q = srv.submit(np.zeros(ITEM, np.float32))      # queue now full
+    with pytest.raises(Overloaded):                   # shed
+        srv.submit(np.zeros(ITEM, np.float32))
+    time.sleep(0.03)                                  # f_dead expires
+
+    done = threading.Event()
+
+    def _shutdown():
+        srv.shutdown(drain=True)                      # unbounded drain
+        done.set()
+
+    t = threading.Thread(target=_shutdown, daemon=True)
+    t.start()
+    gate.release()
+    assert done.wait(30)
+    served, errors = _resolve_all([f_busy, f_ok, f_dead, f_q])
+    assert len(served) == 3                           # busy, ok, q
+    assert len(errors) == 1
+    assert isinstance(errors[0], DeadlineExceededError)
+    st = srv.stats()
+    assert st["requests_submitted"] == 4
+    assert st["requests_completed"] == 3
+    assert st["requests_failed"] == 1
+    assert st["deadline_expired"] == 1
+    assert st["shed"] == {"queue_full": 1}
+    # exactly-once: admitted outcomes partition the submitted set
+    assert (st["requests_completed"] + st["requests_failed"]
+            == st["requests_submitted"])
+
+
+# -------------------------------------------------- LLM chaos matrix --
+VOCAB, BS, CTX = 17, 8, 32
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TinyDecoder(DecoderConfig(
+        vocab_size=VOCAB, d_model=16, num_layers=1, num_heads=2,
+        d_ff=32, max_context=CTX))
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init_params(seed=0)
+
+
+def _llm(model, params, name, **kw):
+    srv = LLMServer(model, params, name=name, max_seqs=2,
+                    block_size=BS, max_context=CTX, **kw)
+    srv.warmup()
+    srv.start()
+    return srv
+
+
+def _assert_kv_clean(srv):
+    eng = srv.engine
+    assert eng.cache.allocator.num_used == 0
+    assert eng.cache.check(live_block_ids=[])
+
+
+def test_llm_prefill_poison_isolated(model, params):
+    """A poison prompt (prefill raises) fails only ITS Future with the
+    original exception; other sequences decode normally; no KV leak."""
+    srv = _llm(model, params, "llmc_pois")
+    faults.script("llm.prefill", [ValueError("poison prompt")])
+    f_bad = srv.submit([1, 2, 3], 4)
+    f_ok = srv.submit([2, 3], 4)
+    with pytest.raises(ValueError, match="poison prompt"):
+        f_bad.result(timeout=30)
+    ref = greedy_decode_reference(model, params, [2, 3], 4)
+    assert f_ok.result(timeout=30).tokens == ref
+    srv.shutdown()
+    assert srv.stats()["poison_isolated"] == 1
+    _assert_kv_clean(srv)
+
+
+def test_llm_decode_transient_bitexact_zero_recompiles(model, params):
+    """One injected decode raise: the bisect retry re-dispatches the
+    SAME fixed shape — token streams stay bit-exact vs the eager
+    reference and the compile counter does not move."""
+    srv = _llm(model, params, "llmc_trans")
+    prompts = [[1, 2], [3, 4, 5]]
+    with serving.CompileCounter() as cc:
+        faults.script("llm.decode", [RuntimeError("transient")])
+        futs = [srv.submit(p, 6) for p in prompts]
+        res = [f.result(timeout=60) for f in futs]
+    srv.shutdown()
+    assert cc.count == 0, f"{cc.count} recompiles during chaos"
+    for p, r in zip(prompts, res):
+        assert r.tokens == greedy_decode_reference(model, params, p, 6)
+    _assert_kv_clean(srv)
+
+
+def test_llm_decode_poison_isolated(model, params):
+    """Persistent per-row decode failure: top-level dispatch, the
+    half, and the leaf retry all raise (3 scripted faults) — the
+    poisoned sequence fails with the original exception, the other
+    sequence keeps decoding to completion."""
+    srv = _llm(model, params, "llmc_dpois")
+    # deterministic: park the first decode launch on a Gate so BOTH
+    # sequences are in the batch, arm the script while parked, then
+    # release — the very next decode consumes the fault schedule
+    gate = faults.block_at("llm.decode")
+    f1 = srv.submit([1, 2, 3], 12)
+    f2 = srv.submit([4, 5], 12)
+    assert gate.wait_reached(30)
+    faults.script("llm.decode", [RuntimeError("poison-decode")] * 3)
+    gate.release()
+    r1 = r2 = None
+    try:
+        r1 = f1.result(timeout=60)
+    except RuntimeError as e:
+        r1 = e
+    try:
+        r2 = f2.result(timeout=60)
+    except RuntimeError as e:
+        r2 = e
+    srv.shutdown()
+    outcomes = [r1, r2]
+    poisoned = [r for r in outcomes if isinstance(r, RuntimeError)]
+    finished = [r for r in outcomes if not isinstance(r, RuntimeError)]
+    assert len(poisoned) == 1 and len(finished) == 1
+    assert "poison-decode" in str(poisoned[0])
+    assert len(finished[0].tokens) == 12
+    assert srv.stats()["poison_isolated"] == 1
+    _assert_kv_clean(srv)
+
+
+def test_llm_worker_death_resolves_everything(model, params):
+    """InjectedCrash in the engine loop: every Future resolves, the
+    pool has zero leaked blocks, later submits raise ServerClosed."""
+    srv = _llm(model, params, "llmc_death")
+    faults.crash_at_point("llm.worker", nth=2)
+    futs = [srv.submit([1 + i, 2], 10) for i in range(3)]
+    resolved = 0
+    for f in futs:
+        try:
+            f.result(timeout=30)
+        except BaseException:
+            pass
+        resolved += 1
+    assert resolved == 3
+    faults.reset()
+    deadline = time.monotonic() + 10
+    while srv.running and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(ServerClosed):
+        srv.submit([1], 1)
+    _assert_kv_clean(srv)
+
+
+def test_llm_queue_overflow_and_drain_under_load(model, params):
+    """Satellite (LLM side): bounded admission + drain deadline under
+    load — shed vs evicted vs served is deterministic and each request
+    is counted once; KV accounting stays clean."""
+    srv = LLMServer(model, params, name="llmc_full", max_seqs=1,
+                    block_size=BS, max_context=CTX, max_queue=2)
+    srv.warmup()
+    srv.start()
+    gate = faults.block_at("llm.decode")
+    f_run = srv.submit([1, 2, 3], 20)       # running, parked at gate
+    assert gate.wait_reached(30)
+    w1 = srv.submit([2, 3], 5)              # waiting
+    w2 = srv.submit([3, 4], 5)              # waiting (queue now full)
+    with pytest.raises(Overloaded) as ei:
+        srv.submit([4, 5], 5)
+    assert ei.value.reason == "queue_full"
+    with pytest.raises(DeadlineExceededError):
+        srv.submit([4, 5], 5, deadline_ms=0)
+
+    done = threading.Event()
+
+    def _shutdown():
+        srv.shutdown(drain=True, deadline_ms=0.0)   # evict now, typed
+        done.set()
+
+    t = threading.Thread(target=_shutdown, daemon=True)
+    t.start()
+    gate.release()
+    assert done.wait(60)
+    outcomes = {"evicted": 0, "served": 0}
+    for f in (f_run, w1, w2):
+        try:
+            f.result(timeout=10)
+            outcomes["served"] += 1
+        except SequenceEvictedError as e:
+            assert e.reason == "drain_deadline"
+            outcomes["evicted"] += 1
+    assert outcomes["evicted"] + outcomes["served"] == 3
+    assert outcomes["evicted"] >= 2          # deadline_ms=0 binds
+    st = srv.stats()
+    assert st["shed"] == {"queue_full": 1}
+    assert st["deadline_expired"] == 1       # the deadline_ms=0 submit
+    assert (st["requests_completed"] + st["requests_evicted"]
+            + st["requests_failed"] == st["requests_submitted"])
+    _assert_kv_clean(srv)
+
+
+def test_llm_deadline_expires_waiting_and_running(model, params):
+    """End-to-end deadlines on the decode path: a WAITING sequence
+    whose deadline expires dies before costing a prefill; a RUNNING
+    one is evicted typed WITH its partial tokens."""
+    srv = LLMServer(model, params, name="llmc_dl", max_seqs=1,
+                    block_size=BS, max_context=CTX)
+    srv.warmup()
+    srv.start()
+    gate = faults.block_at("llm.decode")
+    f_run = srv.submit([1, 2, 3], 20, deadline_ms=150.0)
+    assert gate.wait_reached(30)            # running, >=1 token out
+    f_wait = srv.submit([2, 3], 5, deadline_ms=50.0)   # never admitted
+    time.sleep(0.2)                         # both deadlines pass
+    gate.release()
+    faults.reset()
+    with pytest.raises(DeadlineExceededError) as e_run:
+        f_run.result(timeout=60)
+    with pytest.raises(DeadlineExceededError) as e_wait:
+        f_wait.result(timeout=60)
+    assert len(e_run.value.tokens) >= 1     # partial tokens carried
+    assert e_wait.value.tokens == []
+    srv.shutdown()
+    assert srv.stats()["deadline_expired"] == 2
+    _assert_kv_clean(srv)
+
+
+def test_llm_generate_timeout_cancels_sequence(model, params):
+    """Satellite: generate(timeout=) cancels the underlying sequence —
+    KV blocks and the decode slot are released, the Future resolves
+    typed with partial tokens, and the server keeps serving."""
+    srv = _llm(model, params, "llmc_cancel")
+    # injected slow decode: ~40ms/step makes a 20-token generation far
+    # outlive the 0.1s timeout while the engine keeps iterating (so it
+    # can observe the cancel), with no wall-clock race on the outcome
+    faults.delay_at("llm.decode", 0.04)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceededError) as ei:
+        srv.generate([1, 2, 3], 20, timeout=0.1)
+    assert time.monotonic() - t0 >= 0.1
+    assert ei.value.reason == "timeout"
+    assert len(ei.value.tokens) < 20        # partial generation carried
+    faults.reset()
+    # blocks released, slot free: the server still serves new work
+    ref = greedy_decode_reference(model, params, [4, 5], 4)
+    assert srv.generate([4, 5], 4, timeout=60).tokens == ref
+    srv.shutdown()
+    assert srv.stats()["requests_evicted"] >= 1
+    _assert_kv_clean(srv)
+
+
+def test_llm_breaker_trips_on_persistent_prefill_failure(model, params):
+    """A hard-down backend (every prefill raises) trips the breaker:
+    submits fail fast with CircuitOpenError; after the cooldown a
+    healthy probe closes it and serving resumes."""
+    srv = _llm(model, params, "llmc_brk", breaker_threshold=2,
+               breaker_cooldown_ms=50)
+    faults.script("llm.prefill", [RuntimeError("backend down")] * 2)
+    for i in range(2):
+        with pytest.raises(RuntimeError, match="backend down"):
+            srv.submit([1 + i, 2], 4).result(timeout=30)
+    deadline = time.monotonic() + 10
+    while (srv.stats()["breaker_state"] != 1
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    assert srv.stats()["breaker_state"] == 1
+    with pytest.raises(CircuitOpenError):
+        srv.submit([1], 2)
+    assert srv.stats()["shed"]["breaker_open"] == 1
+    faults.reset()
+    time.sleep(0.12)                        # cooldown passes
+    ref = greedy_decode_reference(model, params, [3, 4], 3)
+    assert srv.generate([3, 4], 3, timeout=60).tokens == ref
+    srv.shutdown()
+    assert srv.stats()["breaker_state"] == 0
+    _assert_kv_clean(srv)
+
+
+def test_llm_breaker_stays_open_while_decode_succeeds(model, params):
+    """An OPEN breaker must not be closed by decode launches of
+    already-admitted sequences: only a post-cooldown probe may heal
+    it. (Regression: a prefill-down backend with live decodes used to
+    flap the breaker shut on every decode success.)"""
+    srv = _llm(model, params, "llmc_brk2", breaker_threshold=2,
+               breaker_cooldown_ms=60000)      # cooldown >> test
+    # a long-running healthy sequence keeps the decode path busy
+    gate = faults.block_at("llm.decode")
+    f_live = srv.submit([1, 2, 3], CTX - 8)
+    assert gate.wait_reached(30)
+    gate.release()                              # decode now free-runs
+    faults.script("llm.prefill", [RuntimeError("backend down")] * 2)
+    for i in range(2):
+        with pytest.raises(RuntimeError, match="backend down"):
+            srv.submit([2 + i, 3], 4).result(timeout=30)
+    deadline = time.monotonic() + 10
+    while (srv.stats()["breaker_state"] != 1
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    assert srv.stats()["breaker_state"] == 1
+    # decode keeps succeeding for f_live, yet admission STAYS rejected
+    tok0 = srv.stats()["tokens_generated"]
+    deadline = time.monotonic() + 10
+    while (srv.stats()["tokens_generated"] < tok0 + 3
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    assert srv.stats()["tokens_generated"] >= tok0 + 3
+    with pytest.raises(CircuitOpenError):
+        srv.submit([5, 6], 2)
+    assert srv.stats()["breaker_state"] == 1    # still open
+    srv.shutdown(drain=True, deadline_ms=0.0)
+    try:
+        f_live.result(timeout=10)   # finished before the shutdown, or
+    except ServingError:
+        pass                        # evicted typed by it — both fine
+    _assert_kv_clean(srv)
+
+
+def test_llm_preemption_mid_drain_under_injected_latency(model, params):
+    """Preemption (guard-style drain) while dispatches are slow: the
+    deadline-bounded drain evicts what cannot finish — typed, partial
+    tokens carried — and block accounting survives the churn."""
+    import signal
+    from mxnet_tpu.resilience import PreemptionGuard
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,)).install()
+    try:
+        srv = _llm(model, params, "llmc_preempt")
+        srv.attach_preemption_guard(guard, poll_s=0.01,
+                                    deadline_ms=0.0)   # evict now
+        faults.delay_at("llm.decode", 0.02)
+        futs = [srv.submit([1 + i, 2], CTX - 8) for i in range(4)]
+        deadline = time.monotonic() + 30
+        while (srv.stats()["tokens_generated"] < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        results, errors = _resolve_all(futs, timeout=60)
+        assert len(results) + len(errors) == 4
+        assert all(isinstance(e, SequenceEvictedError) for e in errors)
+        assert errors                        # deadline 0 must evict
+        assert any(e.tokens for e in errors)  # partials carried
+        deadline = time.monotonic() + 10
+        while srv.running and time.monotonic() < deadline:
+            time.sleep(0.01)
+        _assert_kv_clean(srv)
+    finally:
+        guard.uninstall()
+
+
+def test_chaos_metrics_land_in_one_exposition(model, params):
+    """The degradation is observable: the new overload series are
+    present (and parseable) in one Prometheus exposition alongside the
+    pre-existing serving series."""
+    from mxnet_tpu.observability import get_registry
+    # self-contained: exercise one instance of each outcome so the
+    # series exist even when this test runs alone
+    srv = _echo_server("chaos_expo", buckets=[1], max_queue=1,
+                       max_delay_ms=0.1)
+    gate = faults.block_at("serving.dispatch")
+    f1 = srv.submit(np.zeros(ITEM, np.float32))
+    assert gate.wait_reached(10)
+    f2 = srv.submit(np.zeros(ITEM, np.float32))
+    with pytest.raises(Overloaded):
+        srv.submit(np.zeros(ITEM, np.float32))          # shed
+    with pytest.raises(DeadlineExceededError):
+        srv.submit(np.zeros(ITEM, np.float32),
+                   deadline_ms=0)                       # deadline
+    gate.release()
+    _resolve_all([f1, f2])
+    srv.shutdown()
+    text = get_registry().expose()
+    for needed in ("mxtpu_serving_shed_total",
+                   "mxtpu_serving_deadline_expired_total",
+                   "mxtpu_serving_poison_isolated_total",
+                   "mxtpu_serving_breaker_state"):
+        assert needed in text, f"{needed} missing from exposition"
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    try:
+        from metrics_dump import parse_exposition
+    finally:
+        sys.path.pop(0)
+    parse_exposition(text)      # raises on malformed exposition

@@ -229,6 +229,50 @@ def smoke():
         f.result(timeout=60)
     srv.shutdown()
 
+    # serving overload/failure path: bounded-queue shed, submit-time
+    # deadline expiry and a poison row, so the
+    # mxtpu_serving_{shed,deadline_expired,poison_isolated,
+    # breaker_state} series land in the same exposition
+    import threading
+    import time as _time
+    release = threading.Event()
+
+    def _overload_fn(batch):
+        release.wait(10)
+        if (batch == 99.0).any():
+            raise ValueError("poison row")
+        return batch
+
+    osrv = serving.ModelServer(_overload_fn, buckets=[1],
+                               max_delay_ms=0.1, item_shape=(3,),
+                               dtype="float32", max_queue=1,
+                               name="smoke_overload").start()
+    of1 = osrv.submit(np.zeros(3, np.float32))
+    deadline = _time.monotonic() + 10
+    while osrv._queue.depth() > 0 and _time.monotonic() < deadline:
+        _time.sleep(0.002)          # wait until of1 is in dispatch
+    of2 = osrv.submit(np.full(3, 99.0, np.float32))   # queued poison
+    shed_ok = dl_ok = poison_ok = False
+    try:
+        osrv.submit(np.zeros(3, np.float32))
+    except serving.Overloaded:
+        shed_ok = True
+    try:
+        osrv.submit(np.zeros(3, np.float32), deadline_ms=0)
+    except serving.DeadlineExceededError:
+        dl_ok = True
+    release.set()
+    of1.result(timeout=60)
+    try:
+        of2.result(timeout=60)
+    except ValueError:
+        poison_ok = True
+    osrv.shutdown()
+    if not (shed_ok and dl_ok and poison_ok):
+        print(f"SMOKE FAIL: overload path not exercised (shed={shed_ok}"
+              f" deadline={dl_ok} poison={poison_ok})")
+        return 1
+
     # LLM decode serving: a tiny continuous-batched greedy burst so the
     # mxtpu_llm_* series (tokens/sec, TTFT, KV occupancy) land in the
     # same exposition
@@ -272,6 +316,24 @@ def smoke():
         print("SMOKE FAIL: no async write-seconds histogram in "
               "exposition")
         return 1
+    # overload/failure series: the shed (by reason), deadline and
+    # breaker-state series must appear in the same exposition, each
+    # counting its one exercised instance exactly once
+    olbl = (("server", "smoke_overload"),)
+    if samples.get(("mxtpu_serving_shed_total",
+                    (("reason", "queue_full"),) + olbl)) != 1:
+        print("SMOKE FAIL: queue-full shed not counted once")
+        return 1
+    if samples.get(("mxtpu_serving_deadline_expired_total", olbl)) != 1:
+        print("SMOKE FAIL: deadline expiry not counted once")
+        return 1
+    if samples.get(("mxtpu_serving_poison_isolated_total", olbl)) != 1:
+        print("SMOKE FAIL: poison isolation not counted once")
+        return 1
+    if ("mxtpu_serving_breaker_state", olbl) not in samples:
+        print("SMOKE FAIL: no breaker-state gauge in exposition")
+        return 1
+
     # llm decode: the serving-economics headline series must carry the
     # burst (4 requests x 3 tokens) under the server's label
     lbl = (("server", "smoke_llm"),)

@@ -378,6 +378,11 @@ def emit_llm_snapshot(rec, out_dir=None):
             "metrics_log": cap.get("metrics_log"),
             "span_stats": _span_stats(snap),
         })
+        # saturation runs (llm_bench --overload) carry their shed-rate
+        # + served-TTFT block so the BENCH trajectory records behavior
+        # AT overload, not just underload
+        if extra.get("overload") is not None:
+            out["overload"] = extra["overload"]
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
         f.write("\n")
