@@ -34,6 +34,15 @@ Exporters (both zero-dependency):
   ``MXNET_TPU_METRICS_INTERVAL``); rendered by
   ``tools/metrics_dump.py``.
 
+Derived layers (all reading the same snapshots, never their own
+sampling paths): :mod:`.timeseries` (:class:`TimeSeriesRing`) adds the
+time axis — a bounded ring of periodic snapshots with in-process
+``rate()``/windowed-percentile queries; :mod:`.slo` evaluates
+declarative latency/availability/TTFT objectives with multi-window
+burn-rate status off that ring (``mxtpu_slo_*``); :mod:`.capacity`
+turns a replay window into the committed chips-per-M-users report
+(``tools/load_replay.py`` drives all three).
+
 Causality lives next door: :mod:`.tracing` (:func:`get_tracer`) records
 nested host spans across the same subsystems — one step / one serving
 request readable end to end, exported as Chrome-trace/Perfetto JSON and
@@ -47,8 +56,13 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
 from .steptimer import StepTimer
 from .jaxmon import compile_count, install_jax_monitoring_bridge
 from .tracing import Span, Tracer, get_tracer, validate_chrome_trace
+from .timeseries import TimeSeriesRing
+from .slo import (SLO, SLOEngine, STATUS_OK, STATUS_WARN, STATUS_PAGE,
+                  STATUS_BREACH)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_TIME_BUCKETS", "get_registry", "StepTimer",
            "compile_count", "install_jax_monitoring_bridge",
-           "Span", "Tracer", "get_tracer", "validate_chrome_trace"]
+           "Span", "Tracer", "get_tracer", "validate_chrome_trace",
+           "TimeSeriesRing", "SLO", "SLOEngine", "STATUS_OK",
+           "STATUS_WARN", "STATUS_PAGE", "STATUS_BREACH"]

@@ -34,9 +34,9 @@ _request_ids = itertools.count(1)
 
 class Request:
     __slots__ = ("x", "future", "t_enqueue", "t_dequeue", "rid", "span",
-                 "deadline")
+                 "deadline", "tenant")
 
-    def __init__(self, x, deadline=None):
+    def __init__(self, x, deadline=None, tenant=None):
         self.x = x
         self.future = Future()
         self.t_enqueue = time.monotonic()
@@ -48,6 +48,10 @@ class Request:
         # absolute monotonic end-to-end deadline (None = unbounded);
         # the worker fails an expired request BEFORE dispatching it
         self.deadline = deadline
+        # optional tenant attribution label (None = untagged); rides
+        # to the outcome paths so per-tenant served/shed/expired land
+        # on mxtpu_serving_tenant_requests_total
+        self.tenant = tenant
 
     def expired(self, now=None):
         if self.deadline is None:

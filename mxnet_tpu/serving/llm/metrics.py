@@ -23,8 +23,8 @@ from __future__ import annotations
 import threading
 
 from ...observability import get_registry
-from ..telemetry import (OverloadStats, _claim_server_label,
-                         _LATENCY_BUCKETS)
+from ..telemetry import (OverloadStats, TenantStats,
+                         _claim_server_label, _LATENCY_BUCKETS)
 
 __all__ = ["LLMStats"]
 
@@ -105,6 +105,9 @@ class LLMStats:
         # the overload/failure series share the single-shot server's
         # mxtpu_serving_* catalog (one dashboard for both front ends)
         self._overload = OverloadStats(r, self._server)
+        self._tenants = TenantStats(
+            r, "mxtpu_llm_tenant_requests_total", self._server,
+            tokens_metric="mxtpu_llm_tenant_tokens_total")
         self._evict_children = {}
         self._lock = threading.Lock()
         self._gen_count = 0
@@ -175,6 +178,14 @@ class LLMStats:
     def record_failure(self, n=1):
         self._failed.inc(n)
 
+    # ------------------------------------------------- tenant series --
+    def record_tenant(self, tenant, outcome, n=1):
+        """Per-tenant outcome attribution (no-op for tenant None)."""
+        self._tenants.record(tenant, outcome, n)
+
+    def record_tenant_tokens(self, tenant, n):
+        self._tenants.record_tokens(tenant, n)
+
     # ------------------------------------------------ overload series --
     def record_shed(self, reason):
         self._overload.record_shed(reason)
@@ -215,4 +226,5 @@ class LLMStats:
                     "p50": self._latency.percentile(50) * 1e3,
                     "p99": self._latency.percentile(99) * 1e3,
                 },
+                "tenants": self._tenants.snapshot(),
             })

@@ -47,10 +47,10 @@ class Sequence:
                  "block_ids", "seq_len", "last_token", "t_submit",
                  "t_first_token", "admit_index", "preemptions",
                  "future", "span", "finish_reason", "deadline",
-                 "cancelled")
+                 "cancelled", "tenant")
 
     def __init__(self, prompt_tokens, max_new_tokens, stop_token=None,
-                 deadline=None):
+                 deadline=None, tenant=None):
         self.seq_id = next(_seq_ids)
         self.prompt = [int(t) for t in prompt_tokens]
         if not self.prompt:
@@ -83,6 +83,9 @@ class Sequence:
         # engine releases the sequence's KV blocks and slot at the
         # next lifecycle scan
         self.cancelled = False
+        # optional tenant attribution label (None = untagged); the
+        # server's outcome paths record it on mxtpu_llm_tenant_*
+        self.tenant = tenant
 
     def expired(self, now=None):
         if self.deadline is None:

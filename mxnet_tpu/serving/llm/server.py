@@ -171,22 +171,35 @@ class LLMServer:
         return len(self._pending) + self._engine.scheduler.num_waiting
 
     def submit(self, prompt_tokens, max_new_tokens, stop_token=None,
-               deadline_ms=None):
+               deadline_ms=None, tenant=None):
         """Enqueue one prompt; returns a Future resolving to a
         :class:`GenerationResult` (or raising a typed
         :class:`~..errors.ServingError` subclass:
         :class:`SequenceEvictedError`, :class:`DeadlineExceededError`,
         :class:`ServerClosed`; at submit time: :class:`Overloaded` /
-        :class:`CircuitOpenError`)."""
+        :class:`CircuitOpenError`).
+
+        ``tenant`` (optional) attributes this generation's outcome —
+        and its generated tokens — on the per-tenant series
+        ``mxtpu_llm_tenant_requests_total`` /
+        ``mxtpu_llm_tenant_tokens_total``; untagged requests create
+        no tenant series."""
         if not self._started:
             raise RuntimeError("server not started; call start()")
-        shed_if_breaker_open(self._breaker, self._stats)
-        deadline = resolve_deadline(deadline_ms,
-                                    self.default_deadline_ms,
-                                    self._stats)
+        try:
+            shed_if_breaker_open(self._breaker, self._stats)
+            deadline = resolve_deadline(deadline_ms,
+                                        self.default_deadline_ms,
+                                        self._stats)
+        except Overloaded:              # breaker_open shed
+            self._stats.record_tenant(tenant, "shed")
+            raise
+        except DeadlineExceededError:   # budget spent at submit
+            self._stats.record_tenant(tenant, "expired")
+            raise
         prompt = [int(t) for t in np.asarray(prompt_tokens).ravel()]
         seq = Sequence(prompt, max_new_tokens, stop_token=stop_token,
-                       deadline=deadline)
+                       deadline=deadline, tenant=tenant)
         # validate shape/vocab NOW, on the caller's thread
         self._engine.add_validate(seq)
         from concurrent.futures import Future
@@ -209,6 +222,7 @@ class LLMServer:
                     and self._queue_depth() >= self.max_queue):
                 depth = self._queue_depth()
                 self._stats.record_shed("queue_full")
+                self._stats.record_tenant(tenant, "shed")
                 if seq.span is not None:
                     seq.span.set("error", "Overloaded")
                     seq.span.finish()
@@ -219,6 +233,7 @@ class LLMServer:
             self._pending.append(seq)
             self._cv.notify_all()
         self._stats.record_submit()
+        self._stats.record_tenant(tenant, "submitted")
         return seq.future
 
     def cancel(self, future):
@@ -237,7 +252,8 @@ class LLMServer:
         return True
 
     def generate(self, prompt_tokens, max_new_tokens, stop_token=None,
-                 timeout=None, deadline_ms=None, reap_timeout=5.0):
+                 timeout=None, deadline_ms=None, reap_timeout=5.0,
+                 tenant=None):
         """Blocking single-prompt decode through the batcher.
 
         On ``timeout`` the underlying sequence is CANCELLED — its KV
@@ -249,7 +265,8 @@ class LLMServer:
         resolve it (normally one loop tick; a wedged dispatch raises
         the typed error after this window instead)."""
         fut = self.submit(prompt_tokens, max_new_tokens,
-                          stop_token=stop_token, deadline_ms=deadline_ms)
+                          stop_token=stop_token, deadline_ms=deadline_ms,
+                          tenant=tenant)
         from concurrent.futures import TimeoutError as FuturesTimeout
         try:
             return fut.result(timeout=timeout)
@@ -350,6 +367,8 @@ class LLMServer:
         res = GenerationResult(seq.output_tokens(), seq.seq_id, ttft,
                                seq.finish_reason)
         self._stats.record_completed(time.monotonic() - seq.t_submit)
+        self._stats.record_tenant(seq.tenant, "served")
+        self._stats.record_tenant_tokens(seq.tenant, len(res.tokens))
         if seq.span is not None:
             seq.span.set("tokens", len(res.tokens))
             if ttft is not None:
@@ -366,6 +385,8 @@ class LLMServer:
             f"{len(toks)} tokens", tokens=toks, seq_id=seq.seq_id,
             reason=reason)
         self._stats.record_evicted(reason)
+        self._stats.record_tenant(seq.tenant, "evicted")
+        self._stats.record_tenant_tokens(seq.tenant, len(toks))
         self._close_span(seq, error=reason, tokens=len(toks))
         seq.future.set_exception(err)
 
@@ -383,6 +404,8 @@ class LLMServer:
             self._stats.record_deadline_expired()
         else:
             self._stats.record_evicted(reason)
+        self._stats.record_tenant(seq.tenant, "expired")
+        self._stats.record_tenant_tokens(seq.tenant, len(toks))
         self._close_span(seq, error=reason, tokens=len(toks))
         seq.future.set_exception(err)
 
@@ -390,6 +413,7 @@ class LLMServer:
         """A poison-isolated sequence fails with the ORIGINAL dispatch
         exception (the serving layer isolates, it does not mask)."""
         self._stats.record_failure()
+        self._stats.record_tenant(seq.tenant, "failed")
         self._close_span(seq, error=repr(exc))
         seq.future.set_exception(exc)
 
@@ -422,6 +446,7 @@ class LLMServer:
             if seq.future.done():       # defensive: never double-set
                 continue
             self._stats.record_failure()
+            self._stats.record_tenant(seq.tenant, "failed")
             self._close_span(seq, error=repr(exc))
             seq.future.set_exception(err)
 

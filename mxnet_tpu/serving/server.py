@@ -275,7 +275,7 @@ class ModelServer:
             return 0.0
         return (self._queue.depth() / float(self.max_batch_size)) * p50
 
-    def submit(self, x, deadline_ms=None):
+    def submit(self, x, deadline_ms=None, tenant=None):
         """Enqueue one sample (shape ``item_shape``); returns a Future
         resolving to this sample's output row.
 
@@ -290,7 +290,12 @@ class ModelServer:
         the request immediately (:class:`Overloaded`,
         ``reason="deadline_unmeetable"``). A full bounded queue sheds
         with ``reason="queue_full"``; an open circuit breaker with
-        :class:`CircuitOpenError`."""
+        :class:`CircuitOpenError`.
+
+        ``tenant`` (optional, any string-able key) attributes this
+        request's outcome on the per-tenant series
+        ``mxtpu_serving_tenant_requests_total{server,tenant,outcome}``
+        — untagged requests create no tenant series."""
         x = np.asarray(x)
         if self._item_shape is None:
             self._item_shape = x.shape
@@ -303,15 +308,24 @@ class ModelServer:
                 "server owns the batch dimension)")
         if not self._started:
             raise RuntimeError("server not started; call start()")
-        shed_if_breaker_open(self._breaker, self._stats, self._events)
-        deadline = resolve_deadline(deadline_ms,
-                                    self.default_deadline_ms,
-                                    self._stats, self._events)
+        try:
+            shed_if_breaker_open(self._breaker, self._stats,
+                                 self._events)
+            deadline = resolve_deadline(deadline_ms,
+                                        self.default_deadline_ms,
+                                        self._stats, self._events)
+        except Overloaded:              # breaker_open shed
+            self._stats.record_tenant(tenant, "shed")
+            raise
+        except DeadlineExceededError:   # budget spent at submit
+            self._stats.record_tenant(tenant, "expired")
+            raise
         if deadline is not None:
             budget_s = deadline - time.monotonic()
             est = self._estimate_wait_s()
             if est > budget_s:
                 self._stats.record_shed("deadline_unmeetable")
+                self._stats.record_tenant(tenant, "shed")
                 self._events.emit("shed", reason="deadline_unmeetable",
                                   est_wait_ms=round(est * 1e3, 3))
                 raise Overloaded(
@@ -319,7 +333,7 @@ class ModelServer:
                     f"the request's {budget_s * 1e3:.1f}ms deadline "
                     "budget; shed", reason="deadline_unmeetable",
                     depth=self._queue.depth())
-        req = Request(x, deadline=deadline)
+        req = Request(x, deadline=deadline, tenant=tenant)
         tracer = get_tracer()
         if tracer.enabled:
             # hand-off span: opened here under the CALLER's current
@@ -339,6 +353,7 @@ class ModelServer:
             raise
         except Overloaded as exc:
             self._stats.record_shed("queue_full")
+            self._stats.record_tenant(tenant, "shed")
             self._events.emit("shed", reason="queue_full",
                               depth=exc.depth)
             if req.span is not None:
@@ -347,13 +362,14 @@ class ModelServer:
                 req.span = None
             raise
         self._stats.record_submit()
+        self._stats.record_tenant(tenant, "submitted")
         self._stats.record_queue_depth(self._queue.depth())
         return fut
 
-    def predict(self, x, timeout=None, deadline_ms=None):
+    def predict(self, x, timeout=None, deadline_ms=None, tenant=None):
         """Blocking single-sample inference through the batcher."""
-        return self.submit(x, deadline_ms=deadline_ms).result(
-            timeout=timeout)
+        return self.submit(x, deadline_ms=deadline_ms,
+                           tenant=tenant).result(timeout=timeout)
 
     # ------------------------------------------------------------ stats --
     def stats(self):
@@ -460,6 +476,7 @@ class ModelServer:
         with tracer.span("mxtpu.serving.reply", "serving"):
             for i, req in enumerate(batch):
                 req.future.set_result(out[i])
+                self._stats.record_tenant(req.tenant, "served")
             _finish_request_spans(batch, bucket=bucket, pad_s=pad_s,
                                   service_s=service_s)
         n = len(batch)
@@ -487,6 +504,7 @@ class ModelServer:
                 _finish_request_spans(batch, error=repr(exc))
                 self._stats.record_poison()
                 self._stats.record_failure(1)
+                self._stats.record_tenant(req.tenant, "failed")
                 self._events.emit("poison", rid=req.rid,
                                   error=repr(exc))
                 return
@@ -523,6 +541,7 @@ class ModelServer:
         err.__cause__ = exc
         for req in stranded:
             req.future.set_exception(err)
+            self._stats.record_tenant(req.tenant, "failed")
         _finish_request_spans(stranded, error="worker_died")
         self._stats.record_failure(len(stranded))
         self._events.emit("worker_died", n=len(stranded),
@@ -559,6 +578,7 @@ class ModelServer:
                     else "server shut down without drain")
                 for req in batch:
                     req.future.set_exception(exc)
+                    self._stats.record_tenant(req.tenant, "failed")
                 _finish_request_spans(batch, error=self._abort)
                 self._stats.record_failure(len(batch))
                 self._inflight = []
@@ -574,6 +594,7 @@ class ModelServer:
                         f"request {req.rid} deadline expired after "
                         f"{(now - req.t_enqueue) * 1e3:.1f}ms in queue",
                         seq_id=req.rid))
+                    self._stats.record_tenant(req.tenant, "expired")
                 _finish_request_spans(dead, error="deadline_expired")
                 self._stats.record_deadline_expired(len(dead))
                 self._stats.record_failure(len(dead))
@@ -592,6 +613,7 @@ class ModelServer:
                     "dispatch", retry_after_s=self._breaker.retry_after_s())
                 for req in batch:
                     req.future.set_exception(err)
+                    self._stats.record_tenant(req.tenant, "failed")
                 _finish_request_spans(batch, error="breaker_open")
                 self._stats.record_failure(len(batch))
                 self._events.emit("breaker_reject", n=len(batch))

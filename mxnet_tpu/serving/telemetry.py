@@ -36,7 +36,7 @@ from ..observability.jaxmon import compile_count
 from ..observability.registry import DEFAULT_TIME_BUCKETS
 
 __all__ = ["compile_count", "CompileCounter", "ServingStats", "EventLog",
-           "OverloadStats"]
+           "OverloadStats", "TenantStats"]
 
 
 class CompileCounter:
@@ -169,6 +169,75 @@ class OverloadStats:
         return snap
 
 
+class TenantStats:
+    """Per-tenant outcome attribution, shared by both front ends.
+
+    One counter ``<metric>{server,tenant,outcome}`` (outcomes:
+    submitted / served / shed / expired / evicted / failed) plus an
+    optional per-tenant token counter for decode serving. Tenancy is
+    OPT-IN per request (``submit(..., tenant=)``): an untagged request
+    (tenant None) creates no series, so single-tenant deployments pay
+    zero extra cardinality. This is the dimension
+    ``tools/load_replay.py``'s skewed traffic and the capacity model's
+    per-tenant shares are attributed on."""
+
+    OUTCOMES = ("submitted", "served", "shed", "expired", "evicted",
+                "failed")
+
+    def __init__(self, registry, metric_name, server_label,
+                 tokens_metric=None):
+        self._server = server_label
+        self._requests = registry.counter(
+            metric_name,
+            "Per-tenant request outcomes (submitted/served/shed/"
+            "expired/evicted/failed); tagged requests only.",
+            ("server", "tenant", "outcome"))
+        self._tokens = registry.counter(
+            tokens_metric,
+            "Tokens generated for tagged tenants' requests.",
+            ("server", "tenant")) if tokens_metric else None
+        self._children = {}
+        self._lock = threading.Lock()
+
+    def record(self, tenant, outcome, n=1):
+        if tenant is None:
+            return
+        key = (str(tenant), outcome)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._requests.labels(
+                    server=self._server, tenant=key[0], outcome=outcome)
+                self._children[key] = child
+        child.inc(n)
+
+    def record_tokens(self, tenant, n):
+        if tenant is None or self._tokens is None:
+            return
+        self._tokens.labels(server=self._server,
+                            tenant=str(tenant)).inc(n)
+
+    def reset(self):
+        with self._lock:
+            for metric in (self._requests, self._tokens):
+                if metric is None:
+                    continue
+                for child in metric.children():
+                    if child.labels_dict.get("server") == self._server:
+                        child.reset()
+            self._children = {}
+
+    def snapshot(self):
+        """{tenant: {outcome: n}} for this server's tagged tenants."""
+        out = {}
+        with self._lock:
+            for (tenant, outcome), child in self._children.items():
+                if child.value:
+                    out.setdefault(tenant, {})[outcome] = \
+                        int(child.value)
+        return out
+
+
 class ServingStats:
     """Aggregated serving counters; every method is thread-safe.
 
@@ -229,9 +298,17 @@ class ServingStats:
             "Micro-batches dispatched per shape bucket.",
             ("server", "bucket"))
         self._overload = OverloadStats(r, self._server)
+        self._tenants = TenantStats(
+            r, "mxtpu_serving_tenant_requests_total", self._server)
         self._lock = threading.Lock()
         self._bucket_hits = {}
         self.reset()
+
+    @property
+    def server_label(self):
+        """The registry label this instance's series carry (the claim
+        protocol may have suffixed the requested name)."""
+        return self._server
 
     def reset(self):
         with self._lock:
@@ -248,6 +325,7 @@ class ServingStats:
                     child.reset()
             self._bucket_hits = {}
         self._overload.reset()
+        self._tenants.reset()
 
     def _hit_child(self, bucket):
         child = self._bucket_hits.get(bucket)
@@ -279,6 +357,11 @@ class ServingStats:
 
     def record_failure(self, n):
         self._failed.inc(n)
+
+    # ------------------------------------------------- tenant series --
+    def record_tenant(self, tenant, outcome, n=1):
+        """Per-tenant outcome attribution (no-op for tenant None)."""
+        self._tenants.record(tenant, outcome, n)
 
     # ------------------------------------------------ overload series --
     def record_shed(self, reason):
@@ -323,6 +406,7 @@ class ServingStats:
                 "wait_ms": self._pcts(self._wait),
                 "latency_ms": self._pcts(self._latency),
                 "service_ms": self._pcts(self._service),
+                "tenants": self._tenants.snapshot(),
             })
 
     @staticmethod

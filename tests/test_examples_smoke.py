@@ -124,6 +124,26 @@ def test_llm_bench_smoke():
     assert "SMOKE PASS" in p.stdout
 
 
+def test_load_replay_smoke():
+    """tools/load_replay.py --smoke: a tiny seeded trace replayed
+    against BOTH serving front ends must be deterministic (bit-
+    identical schedule), recompile-free, exactly accounted (typed
+    served/shed/expired partition sums to submitted), and must emit a
+    well-formed CAPACITY json plus a clean exposition carrying the
+    mxtpu_slo_*/mxtpu_ts_*/tenant series (it exits 1 otherwise)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    tools = os.path.join(os.path.dirname(EXAMPLES), "tools")
+    p = subprocess.run(
+        [sys.executable, os.path.join(tools, "load_replay.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert p.returncode == 0, \
+        f"load_replay --smoke failed:\n{p.stdout[-2000:]}\n" \
+        f"{p.stderr[-2000:]}"
+    assert "SMOKE PASS" in p.stdout
+
+
 def test_metrics_dump_smoke():
     """tools/metrics_dump.py --smoke: the observability exposition path
     (registry -> 4-subsystem instrumentation -> Prometheus text ->

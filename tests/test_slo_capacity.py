@@ -1,0 +1,481 @@
+"""SLO engine + time-series ring + trace replay + capacity model.
+
+Tier-1 coverage of the observability-derived capacity layer (ISSUE 11):
+
+- the seeded arrival process produces a BIT-IDENTICAL request schedule
+  for a fixed seed (the determinism contract the committed
+  ``CAPACITY_rNN.json`` artifacts rest on);
+- burn-rate window math is exact: a synthetic ring with hand-placed
+  timestamps yields the analytically-known burn rates, and multi-window
+  status requires BOTH the long and the short window to burn hot;
+- the time-series ring is bounded (eviction counted), reset-aware, and
+  its windowed percentile sees ONLY the window's observations;
+- a gate-deterministic SLO-violation path: typed deadline expiries
+  against a real ModelServer drive availability below target ->
+  BREACH status, published on the ``mxtpu_slo_status`` gauge;
+- tenant attribution reaches the per-tenant series from both submit
+  and outcome paths;
+- the capacity model's chips-per-M-users algebra is exact on synthetic
+  rates, and ``perf_capture.emit_capacity_snapshot`` honors the
+  stale/skip refusal contract (an unhealthy replay commits an artifact
+  with ``value: null`` + a ``skipped`` marker, never a headline).
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu.observability.registry import MetricsRegistry  # noqa: E402
+from mxnet_tpu.observability.timeseries import (  # noqa: E402
+    TimeSeriesRing, diff_cum_counts, percentile_from_counts)
+from mxnet_tpu.observability.slo import (  # noqa: E402
+    SLO, SLOEngine, STATUS_OK, STATUS_WARN, STATUS_PAGE, STATUS_BREACH)
+from mxnet_tpu.observability import capacity as cap_mod  # noqa: E402
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------- seeded schedule --
+
+def test_trace_bit_identical_for_fixed_seed():
+    lr = _load_tool("load_replay")
+    spec_kw = dict(seed=11, duration_s=6.0, base_rps=25.0,
+                   burst_rate=0.3, burst_mult=4.0, tenants=5,
+                   tenant_skew=1.4)
+    t1 = lr.generate_trace(lr.TraceSpec(**spec_kw))
+    t2 = lr.generate_trace(lr.TraceSpec(**spec_kw))
+    assert t1 == t2                       # bit-identical, field by field
+    assert lr.schedule_digest(t1) == lr.schedule_digest(t2)
+    t3 = lr.generate_trace(lr.TraceSpec(**dict(spec_kw, seed=12)))
+    assert lr.schedule_digest(t1) != lr.schedule_digest(t3)
+    assert len(t1) > 50                   # ~150 expected at 25rps x 6s
+
+
+def test_trace_shape_and_skew():
+    lr = _load_tool("load_replay")
+    trace = lr.generate_trace(lr.TraceSpec(
+        seed=2, duration_s=8.0, base_rps=40.0, tenants=4,
+        tenant_skew=1.5, prompt_min=2, prompt_max=64, out_min=1,
+        out_max=32))
+    ats = [r["at_us"] for r in trace]
+    assert ats == sorted(ats)             # arrivals are a time series
+    assert all(0 <= a < 8_000_000 for a in ats)
+    assert all(2 <= r["prompt_len"] <= 64 for r in trace)
+    assert all(1 <= r["new_tokens"] <= 32 for r in trace)
+    by_tenant = {}
+    for r in trace:
+        by_tenant[r["tenant"]] = by_tenant.get(r["tenant"], 0) + 1
+    # Zipf skew: the head tenant dominates every other tenant
+    head = by_tenant.get("t00", 0)
+    assert head == max(by_tenant.values())
+    assert head > len(trace) / 4          # > uniform share (1/4)
+    # heavy tail: medians sit well below the max (most requests short)
+    lens = sorted(r["prompt_len"] for r in trace)
+    assert lens[len(lens) // 2] <= 16
+
+
+def test_prompt_tokens_deterministic_and_in_vocab():
+    lr = _load_tool("load_replay")
+    spec = lr.TraceSpec(seed=5, duration_s=2.0, base_rps=20.0)
+    trace = lr.generate_trace(spec)
+    req = trace[0]
+    a = lr.prompt_tokens(spec, req, vocab=32)
+    b = lr.prompt_tokens(spec, req, vocab=32)
+    assert a == b and len(a) == req["prompt_len"]
+    assert all(0 <= t < 32 for t in a)
+
+
+# --------------------------------------------------- ring bounds ----
+
+def _mini_registry():
+    reg = MetricsRegistry()
+    served = reg.counter("mxtpu_serving_requests_completed_total", "",
+                         ("server",)).labels(server="u")
+    shed = reg.counter("mxtpu_serving_shed_total", "",
+                       ("server", "reason")).labels(server="u",
+                                                    reason="queue_full")
+    reg.counter("mxtpu_serving_deadline_expired_total", "",
+                ("server",)).labels(server="u")
+    hist = reg.histogram("mxtpu_serving_latency_seconds", "",
+                         ("server",)).labels(server="u")
+    return reg, served, shed, hist
+
+
+def test_ring_bounded_and_eviction_counted():
+    reg, served, _, _ = _mini_registry()
+    ring = TimeSeriesRing(reg, capacity=8)
+    for i in range(20):
+        served.inc()
+        ring.record(now=float(i))
+    assert len(ring) == 8
+    recs = ring.records()
+    assert recs[0]["ts"] == 12.0 and recs[-1]["ts"] == 19.0
+    assert reg.get("mxtpu_ts_snapshots_total").value == 20
+    assert reg.get("mxtpu_ts_snapshots_dropped_total").value == 12
+    assert reg.get("mxtpu_ts_ring_size").value == 8
+
+
+def test_ring_rate_window_and_reset():
+    reg, served, _, _ = _mini_registry()
+    ring = TimeSeriesRing(reg, capacity=32)
+    lbl = {"server": "u"}
+    name = "mxtpu_serving_requests_completed_total"
+    for i in range(10):
+        served.inc(5)                      # 5/s at 1s cadence
+        ring.record(now=100.0 + i)
+    assert ring.rate(name, lbl) == pytest.approx(5.0)
+    assert ring.rate(name, lbl, window_s=3.0) == pytest.approx(5.0)
+    assert ring.delta(name, lbl, window_s=3.0) == pytest.approx(15.0)
+    # reset-awareness: a restarted process restarts the counter
+    served.reset()
+    served.inc(2)
+    ring.record(now=111.0)
+    assert ring.delta(name, lbl, window_s=2.0) == pytest.approx(2.0)
+    # too-narrow window (single snapshot) -> no answer, not garbage
+    assert ring.rate(name, lbl, window_s=0.1) is None
+
+
+def test_ring_windowed_percentile_sees_only_window():
+    reg, _, _, hist = _mini_registry()
+    ring = TimeSeriesRing(reg, capacity=16)
+    name = "mxtpu_serving_latency_seconds"
+    lbl = {"server": "u"}
+    for _ in range(1000):
+        hist.observe(0.001)                # ancient fast history
+    ring.record(now=0.0)
+    for _ in range(10):
+        hist.observe(0.5)                  # fresh regression
+    ring.record(now=10.0)
+    # cumulative view drowns the regression; the window sees it
+    assert hist.percentile(50) < 0.01
+    win_p50 = ring.percentile_over(name, 50, lbl, window_s=60.0)
+    assert win_p50 > 0.25
+    # empty window -> None
+    hist_only = ring.percentile_over(name, 50, lbl, window_s=0.0)
+    assert hist_only is None
+
+
+def test_counts_helpers_exact():
+    assert diff_cum_counts([1, 3, 5], [2, 6, 9]) == [1, 3, 4]
+    # reset: now < then -> take now wholesale
+    assert diff_cum_counts([5, 9, 12], [1, 2, 3]) == [1, 2, 3]
+    edges = (0.1, 0.2, 0.4)
+    # 10 obs in (0.1, 0.2]: p50 interpolates to the bucket midpoint
+    assert percentile_from_counts(edges, [0, 10, 10, 10], 50) == \
+        pytest.approx(0.15)
+    assert percentile_from_counts(edges, [0, 0, 0, 0], 50) is None
+    # overflow bucket clamps to the top edge
+    assert percentile_from_counts(edges, [0, 0, 0, 10], 99) == \
+        pytest.approx(0.4)
+
+
+# ----------------------------------------------- burn-rate math ----
+
+def _burn_fixture(target=0.99):
+    """10 snapshots at 1s cadence: 9 clean seconds of 10 good/s, then
+    one second with 10 good + 10 shed -> last-1s error rate 0.5."""
+    reg, served, shed, _ = _mini_registry()
+    ring = TimeSeriesRing(reg, capacity=32)
+    t = 0.0
+    ring.record(now=t)
+    for i in range(9):
+        t += 1.0
+        served.inc(10)
+        ring.record(now=t)
+    t += 1.0
+    served.inc(10)
+    shed.inc(10)
+    ring.record(now=t)
+    slo = SLO.serving_availability("avail_u", "u", target=target)
+    return reg, ring, slo
+
+
+def test_burn_rate_window_math_exact():
+    reg, ring, slo = _burn_fixture(target=0.99)
+    # last 1s: 10 good, 10 bad -> err 0.5 -> burn 0.5/0.01 = 50
+    assert slo.burn(ring, 1.0) == pytest.approx(50.0)
+    # last 5s: 50 good, 10 bad -> err 1/6 -> burn 100/6
+    assert slo.burn(ring, 5.0) == pytest.approx((10 / 60) / 0.01)
+    # full span: 100 good, 10 bad -> err 1/11
+    assert slo.burn(ring, 10.0) == pytest.approx((10 / 110) / 0.01)
+    # an idle window burns nothing (None, not zero-division garbage)
+    reg2, served2, _, _ = _mini_registry()
+    ring2 = TimeSeriesRing(reg2, capacity=8)
+    ring2.record(now=0.0)
+    ring2.record(now=1.0)
+    slo2 = SLO.serving_availability("avail_idle", "u")
+    assert slo2.burn(ring2, 1.0) is None
+
+
+def test_multiwindow_status_requires_both_windows():
+    # long window hot + short window hot -> PAGE
+    reg, ring, slo = _burn_fixture(target=0.99)
+    eng = SLOEngine([slo], ring, registry=reg,
+                    windows=[(5.0, 1.0, 14.4, STATUS_PAGE)])
+    rep = eng.evaluate()["avail_u"]
+    # attainment 100/110 = 0.909 < 0.99: BREACH outranks PAGE
+    assert rep["status"] == STATUS_BREACH
+    # same burn shape but a lenient target that is still attained:
+    # burn windows decide alone
+    reg2, served2, shed2, _ = _mini_registry()
+    ring2 = TimeSeriesRing(reg2, capacity=32)
+    t = 0.0
+    ring2.record(now=t)
+    for i in range(9):
+        t += 1.0
+        served2.inc(100)
+        ring2.record(now=t)
+    t += 1.0
+    served2.inc(100)
+    shed2.inc(10)                       # lifetime err 10/1010 < 0.05
+    ring2.record(now=t)
+    slo2 = SLO.serving_availability("avail_w", "u", target=0.95)
+    # short window err 10/110 -> burn ~1.8; long 5s err 10/510 -> ~0.39
+    eng2 = SLOEngine([slo2], ring2, registry=reg2,
+                     windows=[(5.0, 1.0, 1.0, STATUS_PAGE)])
+    rep2 = eng2.evaluate()["avail_w"]
+    # long window under threshold -> NOT paging even though the short
+    # window burns hot (the multi-window AND)
+    assert rep2["status"] == STATUS_OK
+    eng3 = SLOEngine([slo2], ring2, registry=reg2,
+                     windows=[(1.5, 1.0, 1.0, STATUS_PAGE)])
+    rep3 = eng3.evaluate()["avail_w"]
+    assert rep3["status"] == STATUS_PAGE
+    assert rep3["burn_rates"]["1s"] == pytest.approx(
+        (10 / 110) / 0.05)
+
+
+def test_latency_slo_threshold_above_top_edge_counts_overflow_good():
+    """A bound at/above the histogram's top finite edge includes the
+    +Inf overflow bucket — slow-but-within-bound requests must not
+    read as violations (spurious breach)."""
+    reg, _, _, hist = _mini_registry()
+    ring = TimeSeriesRing(reg, capacity=8)
+    for _ in range(5):
+        hist.observe(40.0)        # beyond the 30s top _LATENCY edge
+    ring.record(now=0.0)
+    slo = SLO.latency("lat_top", threshold_ms=60_000.0, target=0.9,
+                      labels={"server": "u"})
+    good, total = slo.good_total(ring.latest()["metrics"])
+    assert (good, total) == (5.0, 5.0)
+    eng = SLOEngine([slo], ring, registry=reg, windows=[])
+    assert eng.evaluate()["lat_top"]["status"] == STATUS_OK
+
+
+def test_burn_gauge_clears_when_window_goes_idle():
+    """A hot burn gauge must return to 0 once the window empties —
+    otherwise dashboards read a live page condition forever."""
+    reg, ring, slo = _burn_fixture(target=0.99)
+    eng = SLOEngine([slo], ring, registry=reg,
+                    windows=[(5.0, 1.0, 14.4, STATUS_PAGE)])
+    eng.evaluate()
+    gauge = reg.get("mxtpu_slo_burn_rate")
+    assert gauge.labels(slo="avail_u", window="1s").value > 10
+    # traffic stops: two idle snapshots beyond every window
+    ring.record(now=100.0)
+    ring.record(now=101.0)
+    rep = eng.evaluate()["avail_u"]
+    assert rep["burn_rates"]["1s"] is None          # honest None
+    assert gauge.labels(slo="avail_u", window="1s").value == 0.0
+
+
+def test_metrics_dump_delta_survives_bucket_relayout():
+    md = _load_tool("metrics_dump")
+    ra = MetricsRegistry()
+    ra.histogram("mxtpu_serving_latency_seconds", "", ("server",),
+                 buckets=(0.1, 0.2)).labels(server="u").observe(0.15)
+    snap_a = {"ts": 0.0, "metrics": ra.snapshot()}
+    rb = MetricsRegistry()
+    rb.histogram("mxtpu_serving_latency_seconds", "", ("server",),
+                 buckets=(0.1, 0.2, 0.4)).labels(server="u").observe(0.3)
+    snap_b = {"ts": 1.0, "metrics": rb.snapshot()}
+    out = md.render_delta(snap_a, snap_b)   # must not raise
+    assert "bucket layout changed" in out
+
+
+def test_latency_slo_good_total_and_threshold_snap():
+    reg, _, _, hist = _mini_registry()
+    ring = TimeSeriesRing(reg, capacity=8)
+    for _ in range(90):
+        hist.observe(0.004)
+    for _ in range(10):
+        hist.observe(0.2)
+    ring.record(now=0.0)
+    slo = SLO.latency("lat_u", threshold_ms=5.0, target=0.95,
+                      labels={"server": "u"})
+    good, total = slo.good_total(ring.latest()["metrics"])
+    assert (good, total) == (90.0, 100.0)
+    # 5ms is a real edge of DEFAULT_TIME_BUCKETS -> snaps to itself
+    assert slo.effective_threshold_s == pytest.approx(0.005)
+    eng = SLOEngine([slo], ring, registry=reg, windows=[])
+    rep = eng.evaluate()["lat_u"]
+    assert rep["attainment"] == pytest.approx(0.9)
+    assert rep["status"] == STATUS_BREACH
+
+
+# ------------------------------- deterministic breach, end to end ----
+
+def test_slo_breach_path_from_typed_deadline_sheds():
+    """Gate-deterministic: expired-at-submit deadlines (deadline_ms=0
+    fails fast, no timing race) drive availability below target; the
+    engine reports BREACH and publishes it on mxtpu_slo_status."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.observability import get_registry
+    from mxnet_tpu.serving import DeadlineExceededError
+
+    srv = serving.ModelServer(lambda b: b * 2.0, buckets=[1, 2],
+                              max_delay_ms=0.5, item_shape=(3,),
+                              dtype="float32",
+                              name="slo_breach_t").start()
+    srv.warmup()
+    served = [srv.submit(np.zeros(3, np.float32)) for _ in range(2)]
+    for f in served:
+        f.result(timeout=60)
+    expired = 0
+    for _ in range(8):
+        with pytest.raises(DeadlineExceededError):
+            srv.submit(np.zeros(3, np.float32), deadline_ms=0,
+                       tenant="bad_tenant")
+        expired += 1
+    srv.shutdown()
+
+    label = srv._stats.server_label
+    reg = get_registry()
+    ring = TimeSeriesRing(reg, capacity=8)
+    ring.record(now=0.0)
+    slo = SLO.serving_availability("breach_avail", label, target=0.99)
+    eng = SLOEngine([slo], ring, registry=reg, windows=[])
+    rep = eng.evaluate()["breach_avail"]
+    assert rep["good"] == 2 and rep["total"] == 2 + expired
+    assert rep["attainment"] == pytest.approx(2 / (2 + expired))
+    assert rep["status"] == STATUS_BREACH
+    assert rep["status_name"] == "breach"
+    gauge = reg.get("mxtpu_slo_status")
+    assert gauge.labels(slo="breach_avail").value == STATUS_BREACH
+    # the typed sheds are tenant-attributed too (expired at submit)
+    tcounter = reg.get("mxtpu_serving_tenant_requests_total")
+    assert tcounter.labels(server=label, tenant="bad_tenant",
+                           outcome="expired").value == expired
+
+
+def test_tenant_attribution_served_path():
+    from mxnet_tpu import serving
+    from mxnet_tpu.observability import get_registry
+    srv = serving.ModelServer(lambda b: b + 1.0, buckets=[1, 2, 4],
+                              max_delay_ms=0.5, item_shape=(2,),
+                              dtype="float32",
+                              name="tenant_t").start()
+    srv.warmup()
+    futs = [srv.submit(np.zeros(2, np.float32),
+                       tenant=f"t{i % 2}") for i in range(6)]
+    for f in futs:
+        f.result(timeout=60)
+    snap = srv._stats.snapshot()
+    srv.shutdown()
+    assert snap["tenants"]["t0"] == {"submitted": 3, "served": 3}
+    assert snap["tenants"]["t1"] == {"submitted": 3, "served": 3}
+    # untagged submits create no series: exactly the two tenants above
+    label = srv._stats.server_label
+    reg = get_registry()
+    tcounter = reg.get("mxtpu_serving_tenant_requests_total")
+    tenants = {c.labels_dict["tenant"] for c in tcounter.children()
+               if c.labels_dict.get("server") == label}
+    assert tenants == {"t0", "t1"}
+
+
+# --------------------------------------------------- capacity model --
+
+def _capacity_fixture():
+    reg, served, shed, hist = _mini_registry()
+    ring = TimeSeriesRing(reg, capacity=16)
+    ring.record(now=0.0)
+    served.inc(200)                        # 20 qps over 10s
+    for _ in range(200):
+        hist.observe(0.004)
+    ring.record(now=10.0)
+    slo = SLO.latency("cap_lat", threshold_ms=25.0, target=0.99,
+                      labels={"server": "u"})
+    avail = SLO.serving_availability("cap_avail", "u", target=0.99)
+    eng = SLOEngine([avail, slo], ring, registry=reg, windows=[])
+    return reg, ring, slo, eng.evaluate()
+
+
+def test_capacity_algebra_exact():
+    reg, ring, slo, reports = _capacity_fixture()
+    rec = cap_mod.build_report(
+        ring, reports, [("serving", "u", slo)], chips=2,
+        user_model={"requests_per_user_per_s": 0.01})
+    assert rec["slo_attained"] is True
+    blk = rec["frontends"][0]
+    assert blk["served_qps"] == pytest.approx(20.0)
+    assert blk["good_qps"] == pytest.approx(20.0)
+    assert blk["qps_per_chip"] == pytest.approx(10.0)
+    # 1e6 users x 0.01 rps / 10 qps-per-chip = 1000 chips
+    assert blk["chips_per_m_users"] == pytest.approx(1000.0)
+    assert rec["value"] == pytest.approx(1000.0)
+    assert "skipped" not in rec
+
+
+def test_capacity_empty_window_refuses_headline():
+    reg, *_ = _mini_registry()
+    ring = TimeSeriesRing(reg, capacity=8)    # no snapshots at all
+    rec = cap_mod.build_report(ring, {}, [("serving", "u", None)])
+    assert rec["value"] is None
+    assert "skipped" in rec
+
+
+def test_emit_capacity_snapshot_refusal_contract(tmp_path):
+    pc = _load_tool("perf_capture")
+    good = {
+        "metric": "chips_per_m_users", "unit": "chips / 1M users",
+        "value": 12.5, "slo_attained": True, "slo": {}, "chips": 1,
+        "frontends": [], "user_model": {}, "window_s": 10.0,
+        "snapshots": 4, "compiles_during_replay": 0,
+        "_capture": {"tag": "t", "metrics_log": "",
+                     "captured_at": "now"},
+    }
+    p1 = pc.emit_capacity_snapshot(good, out_dir=str(tmp_path))
+    assert os.path.basename(p1) == "CAPACITY_r01.json"
+    with open(p1) as f:
+        rec1 = json.load(f)
+    assert rec1["value"] == 12.5 and "skipped" not in rec1
+    assert rec1["metric"] == "chips_per_m_users"
+    # an unhealthy run commits the attempt but never a headline
+    bad = dict(good, skipped="3 XLA recompiles during the measured "
+                             "window")
+    p2 = pc.emit_capacity_snapshot(bad, out_dir=str(tmp_path))
+    assert os.path.basename(p2) == "CAPACITY_r02.json"   # numbering
+    with open(p2) as f:
+        rec2 = json.load(f)
+    assert rec2["value"] is None
+    assert "recompiles" in rec2["skipped"]
+
+
+# ----------------------------------------------- delta render tool --
+
+def test_metrics_dump_delta_math():
+    md = _load_tool("metrics_dump")
+    reg, served, _, hist = _mini_registry()
+    snap_a = {"ts": 0.0, "metrics": reg.snapshot()}
+    served.inc(30)
+    for _ in range(10):
+        hist.observe(0.08)
+    snap_b = {"ts": 10.0, "metrics": reg.snapshot()}
+    out = md.render_delta(snap_a, snap_b)
+    assert "mxtpu_serving_requests_completed_total{server=u}" in out
+    assert "(+30)" in out and "(+3/s)" in out
+    assert "n+10" in out and "(1/s)" in out
+    # unchanged series are omitted from a delta view
+    assert "mxtpu_serving_deadline_expired_total" not in out

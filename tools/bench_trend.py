@@ -1,5 +1,14 @@
 #!/usr/bin/env python
-"""Maintain the committed MFU / img/s trend table from BENCH_r*.json.
+"""Maintain the committed bench trend tables from BENCH artifacts.
+
+Two trajectories, one classification discipline:
+
+- ``BENCH_r*.json`` (training) -> the MFU / img/s table between the
+  ``BENCH_TREND`` markers in docs/PERFORMANCE.md;
+- ``BENCH_llm_r*.json`` (decode serving) -> the tokens/sec + TTFT
+  table between the ``LLM_BENCH_TREND`` markers (appended on first
+  run), so the serving-economics headline has the same committed,
+  honestly-classified history as training MFU.
 
 The bench trajectory is only evidence if every artifact is classified
 honestly: BENCH_r01–r03 are rc=1 / suspect-timing artifacts and r05
@@ -32,6 +41,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOC = os.path.join(REPO, "docs", "PERFORMANCE.md")
 BEGIN = "<!-- BENCH_TREND:BEGIN (tools/bench_trend.py — do not edit by hand) -->"
 END = "<!-- BENCH_TREND:END -->"
+LLM_BEGIN = ("<!-- LLM_BENCH_TREND:BEGIN "
+             "(tools/bench_trend.py — do not edit by hand) -->")
+LLM_END = "<!-- LLM_BENCH_TREND:END -->"
+HEADING = ("\n## Bench trend (MFU / throughput per round)\n\n"
+           "Regenerate with `python tools/bench_trend.py` after "
+           "every new `BENCH_rNN.json`; rows the table marks "
+           "invalid/stale/skipped are artifacts, not evidence.\n\n")
+LLM_HEADING = ("\n## LLM decode bench trend (tokens/sec + TTFT per "
+               "round)\n\n"
+               "Regenerate with `python tools/bench_trend.py` after "
+               "every new `BENCH_llm_rNN.json` (tools/llm_bench.py); "
+               "skipped rows recompiled or lost requests and are not "
+               "evidence.\n\n")
 
 
 def _round_of(path, rec):
@@ -141,25 +163,90 @@ def render(rows):
     return "\n".join(lines)
 
 
-def splice(doc_path, table):
-    block = f"{BEGIN}\n\n{table}\n\n{END}"
+def scan_llm(repo=REPO):
+    """Classified rows for the ``BENCH_llm_r*.json`` trajectory:
+    {round, status, tokens_s, ttft_p50, ttft_p99, tag, note}. The
+    emitter (perf_capture.emit_llm_snapshot) already refused unhealthy
+    headlines, so classification is value/skipped-driven."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "BENCH_llm_r*.json"))):
+        m = re.search(r"BENCH_llm_r(\d+)\.json$", path)
+        rnd = int(m.group(1)) if m else 0
+        row = {"round": rnd, "status": "valid", "tokens_s": None,
+               "ttft_p50": None, "ttft_p99": None, "tag": "",
+               "note": ""}
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            row.update(status="invalid", note=f"unreadable: {e}")
+            rows.append(row)
+            continue
+        if isinstance(rec.get("round"), int):
+            row["round"] = rec["round"]
+        row["tag"] = rec.get("tag") or ""
+        if rec.get("skipped") or rec.get("value") is None:
+            row.update(status="skipped",
+                       note=f"skipped: {rec.get('skipped')}")
+            rows.append(row)
+            continue
+        row["tokens_s"] = float(rec["value"])
+        ttft = rec.get("ttft_ms") or {}
+        row["ttft_p50"] = ttft.get("p50")
+        row["ttft_p99"] = ttft.get("p99")
+        if rec.get("overload"):
+            ov = rec["overload"]
+            row["note"] = (f"overload run: shed_rate="
+                           f"{ov.get('shed_rate')}, served TTFT only")
+        rows.append(row)
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def render_llm(rows):
+    def fmt(v, pat):
+        return pat % v if v is not None else "—"
+    lines = [
+        "| round | status | tokens/s | TTFT p50 (ms) | TTFT p99 (ms) "
+        "| config | note |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| r{r['round']:02d} | {r['status']} "
+            f"| {fmt(r['tokens_s'], '%.1f')} "
+            f"| {fmt(r['ttft_p50'], '%.2f')} "
+            f"| {fmt(r['ttft_p99'], '%.2f')} "
+            f"| {r['tag']} | {r['note']} |")
+    valid = [r for r in rows if r["status"] == "valid"
+             and r["tokens_s"] is not None]
+    if valid:
+        best = max(valid, key=lambda r: r["tokens_s"])
+        lines.append(
+            f"\nBest verified decode throughput: "
+            f"**{best['tokens_s']:.1f} tokens/s** "
+            f"(r{best['round']:02d}, {best['tag']}).")
+    else:
+        lines.append("\nNo valid LLM bench round yet.")
+    return "\n".join(lines)
+
+
+def splice(doc_path, table, begin=BEGIN, end=END, heading=HEADING):
+    block = f"{begin}\n\n{table}\n\n{end}"
     try:
         with open(doc_path) as f:
             text = f.read()
     except OSError:
         text = ""
-    if BEGIN in text and END in text:
-        pre = text.split(BEGIN)[0]
-        post = text.split(END, 1)[1]
+    if begin in text and end in text:
+        pre = text.split(begin)[0]
+        post = text.split(end, 1)[1]
         text = pre + block + post
     else:
         if text and not text.endswith("\n"):
             text += "\n"
-        text += ("\n## Bench trend (MFU / throughput per round)\n\n"
-                 "Regenerate with `python tools/bench_trend.py` after "
-                 "every new `BENCH_rNN.json`; rows the table marks "
-                 "invalid/stale/skipped are artifacts, not evidence.\n\n"
-                 + block + "\n")
+        text += heading + block + "\n"
     with open(doc_path, "w") as f:
         f.write(text)
 
@@ -174,15 +261,25 @@ def main():
                     help="print the table without touching the doc")
     args = ap.parse_args()
     rows = scan(args.repo)
-    if not rows:
-        print("no BENCH_r*.json found", file=sys.stderr)
+    llm_rows = scan_llm(args.repo)
+    if not rows and not llm_rows:
+        print("no BENCH_r*.json or BENCH_llm_r*.json found",
+              file=sys.stderr)
         return 1
-    table = render(rows)
-    print(table)
+    doc = args.doc or os.path.join(args.repo, "docs",
+                                   "PERFORMANCE.md")
+    if rows:
+        table = render(rows)
+        print(table)
+        if not args.dry_run:
+            splice(doc, table)
+    if llm_rows:
+        llm_table = render_llm(llm_rows)
+        print("\n" + llm_table)
+        if not args.dry_run:
+            splice(doc, llm_table, begin=LLM_BEGIN, end=LLM_END,
+                   heading=LLM_HEADING)
     if not args.dry_run:
-        doc = args.doc or os.path.join(args.repo, "docs",
-                                       "PERFORMANCE.md")
-        splice(doc, table)
         print(f"\nwrote {doc}")
     return 0
 

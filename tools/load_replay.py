@@ -1,0 +1,708 @@
+#!/usr/bin/env python
+"""Deterministic trace-driven load replay -> SLO status -> capacity.
+
+The million-user proof harness (ROADMAP item 6, per-server half):
+generate a SEEDED synthetic-but-realistic request trace — diurnal +
+bursty arrivals (non-homogeneous Poisson by thinning), heavy-tail
+bounded-Pareto prompt/output length mix, Zipf-skewed tenants — and
+replay it open-loop (arrivals land at their scheduled wall times, the
+server keeps up or sheds — the mode that measures capacity) or
+closed-loop (N clients, next request only after the last answer — the
+mode that measures latency under a fixed concurrency) against BOTH
+serving front ends:
+
+- ``ModelServer`` (single-shot, jitted matmul backend or any
+  ``--model`` predictor artifact);
+- ``LLMServer`` (continuous-batching decode, built-in TinyDecoder).
+
+While traffic runs, a :class:`~mxnet_tpu.observability.timeseries.
+TimeSeriesRing` records periodic registry snapshots; afterwards the
+:class:`~mxnet_tpu.observability.slo.SLOEngine` evaluates declared
+SLOs (availability = served/(served+shed+expired), latency-percentile
+bound, TTFT bound for decode) with multi-window burn-rate status, and
+:mod:`mxnet_tpu.observability.capacity` derives sustainable QPS/chip,
+tokens/sec/chip and chips-per-M-users — every number read back out of
+registry snapshots, never hand-entered — emitted as a committed
+``CAPACITY_rNN.json`` via ``tools/perf_capture.emit_capacity_snapshot``
+(same stale/skip refusal contract as the BENCH trajectory).
+
+Determinism contract: a fixed ``--seed`` produces a BIT-IDENTICAL
+request schedule (asserted by ``tests/test_slo_capacity.py`` and
+re-checked in ``--smoke``); replay against warmed servers performs
+ZERO steady-state XLA compiles (backend_compile-counter pinned), and
+every replayed request resolves TYPED — the
+served/shed/expired/evicted/failed partition sums exactly to the
+number submitted, or the capacity report refuses itself.
+
+    python tools/load_replay.py --smoke              # tiny CI gate
+    python tools/load_replay.py --duration 30 --base-rps 50 \
+        --frontend both --out .                      # committed run
+"""
+import argparse
+import datetime
+import hashlib
+import json
+import math
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# Trace generation is deliberately import-light (numpy + stdlib): the
+# schedule must be computable — and testable bit-identical — without
+# touching jax or the serving stack.
+class TraceSpec:
+    """Parameters of one synthetic workload trace. Everything that
+    influences the schedule lives here, so (spec, seed) -> schedule is
+    a pure function and the spec block in the capacity report fully
+    reproduces the run."""
+
+    FIELDS = ("seed", "duration_s", "base_rps", "diurnal_period_s",
+              "diurnal_amp", "burst_rate", "burst_mean_s", "burst_mult",
+              "tenants", "tenant_skew", "prompt_min", "prompt_max",
+              "prompt_alpha", "out_min", "out_max", "out_alpha",
+              "deadline_ms")
+
+    def __init__(self, seed=0, duration_s=10.0, base_rps=20.0,
+                 diurnal_period_s=None, diurnal_amp=0.5,
+                 burst_rate=0.2, burst_mean_s=0.5, burst_mult=3.0,
+                 tenants=4, tenant_skew=1.2, prompt_min=2,
+                 prompt_max=48, prompt_alpha=1.5, out_min=1,
+                 out_max=16, out_alpha=1.3, deadline_ms=None):
+        self.seed = int(seed)
+        self.duration_s = float(duration_s)
+        self.base_rps = float(base_rps)
+        # one "day" defaults to the trace length: the replay sweeps a
+        # full peak/trough cycle however short the run is
+        self.diurnal_period_s = float(diurnal_period_s
+                                      if diurnal_period_s
+                                      else duration_s)
+        self.diurnal_amp = float(diurnal_amp)
+        if not (0.0 <= self.diurnal_amp < 1.0):
+            raise ValueError("diurnal_amp must be in [0, 1)")
+        self.burst_rate = float(burst_rate)      # burst starts / sec
+        self.burst_mean_s = float(burst_mean_s)  # mean burst length
+        self.burst_mult = float(burst_mult)      # rate multiplier
+        self.tenants = int(tenants)
+        self.tenant_skew = float(tenant_skew)    # zipf exponent
+        self.prompt_min = int(prompt_min)
+        self.prompt_max = int(prompt_max)
+        self.prompt_alpha = float(prompt_alpha)  # bounded-pareto tail
+        self.out_min = int(out_min)
+        self.out_max = int(out_max)
+        self.out_alpha = float(out_alpha)
+        self.deadline_ms = deadline_ms
+        if self.base_rps <= 0 or self.duration_s <= 0:
+            raise ValueError("base_rps and duration_s must be > 0")
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self.FIELDS}
+
+
+def _bounded_pareto(u, lo, hi, alpha):
+    """Inverse-CDF sample of a bounded Pareto(lo, hi, alpha) from one
+    uniform draw — the heavy-tail length distribution (most requests
+    short, a fat tail of long ones) real prompt/output mixes show."""
+    lo, hi = float(lo), float(hi)
+    if hi <= lo:
+        return int(lo)
+    ratio = (lo / hi) ** alpha
+    x = lo / (1.0 - u * (1.0 - ratio)) ** (1.0 / alpha)
+    return int(min(max(x, lo), hi))
+
+
+def _tenant_weights(spec):
+    """Zipf-ish share per tenant: w_k ~ 1/(k+1)^skew, normalized —
+    tenant t00 dominates, the tail splits the rest."""
+    w = np.array([1.0 / (k + 1) ** spec.tenant_skew
+                  for k in range(spec.tenants)])
+    return w / w.sum()
+
+
+def generate_trace(spec):
+    """The deterministic schedule: a list of request dicts
+    ``{i, at_us, tenant, prompt_len, new_tokens}`` sorted by arrival.
+
+    Arrivals are a non-homogeneous Poisson process sampled by
+    thinning: rate(t) = base * (1 + amp*sin(2pi t/period)) *
+    (burst_mult inside a burst window). Burst windows are drawn first
+    (their own exponential process), then arrivals, then per-request
+    attributes — all from ONE ``np.random.RandomState(seed)``, so the
+    draw order is fixed and the schedule is bit-identical for a fixed
+    spec (arrival times are quantized to integer microseconds to keep
+    the artifact platform-stable)."""
+    rng = np.random.RandomState(spec.seed)
+    bursts = []
+    if spec.burst_rate > 0 and spec.burst_mult > 1.0:
+        t = 0.0
+        while True:
+            t += rng.exponential(1.0 / spec.burst_rate)
+            if t >= spec.duration_s:
+                break
+            end = t + rng.exponential(spec.burst_mean_s)
+            bursts.append((t, min(end, spec.duration_s)))
+            t = end
+
+    def in_burst(t):
+        return any(a <= t < b for a, b in bursts)
+
+    def rate_at(t):
+        r = spec.base_rps * (1.0 + spec.diurnal_amp * math.sin(
+            2.0 * math.pi * t / spec.diurnal_period_s))
+        if in_burst(t):
+            r *= spec.burst_mult
+        return max(r, 0.0)
+
+    rate_max = spec.base_rps * (1.0 + spec.diurnal_amp) \
+        * max(spec.burst_mult, 1.0)
+    arrivals = []
+    t = 0.0
+    while True:
+        t += rng.exponential(1.0 / rate_max)
+        if t >= spec.duration_s:
+            break
+        if rng.uniform() * rate_max <= rate_at(t):
+            arrivals.append(t)
+
+    weights = _tenant_weights(spec)
+    trace = []
+    for i, at in enumerate(arrivals):
+        tenant = int(rng.choice(spec.tenants, p=weights))
+        p_len = _bounded_pareto(rng.uniform(), spec.prompt_min,
+                                spec.prompt_max, spec.prompt_alpha)
+        n_out = _bounded_pareto(rng.uniform(), spec.out_min,
+                                spec.out_max, spec.out_alpha)
+        trace.append({
+            "i": i,
+            "at_us": int(round(at * 1e6)),
+            "tenant": f"t{tenant:02d}",
+            "prompt_len": p_len,
+            "new_tokens": n_out,
+        })
+    return trace
+
+
+def schedule_digest(trace):
+    """SHA-256 over the canonical JSON schedule — the bit-identity
+    witness the tests and the capacity report's audit block carry."""
+    blob = json.dumps(trace, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def request_rng(spec, req):
+    """The per-request RNG: (seed, i) -> RandomState, the one
+    derivation every replayed input (prompt tokens, feature vectors)
+    draws from, so replay payloads are reproducible alongside the
+    schedule."""
+    return np.random.RandomState((spec.seed * 1000003 + req["i"])
+                                 % (2 ** 31 - 1))
+
+
+def prompt_tokens(spec, req, vocab):
+    """The request's actual prompt tokens, derived deterministically
+    from (seed, i) so the trace stays lengths-only but the replayed
+    tokens are reproducible too."""
+    return request_rng(spec, req).randint(
+        0, vocab, size=req["prompt_len"]).tolist()
+
+
+# ------------------------------------------------------------ replay --
+
+OUTCOMES = ("served", "shed", "expired", "evicted", "failed")
+
+
+def _classify(exc):
+    from mxnet_tpu.serving import (DeadlineExceededError, Overloaded,
+                                   SequenceEvictedError)
+    if isinstance(exc, DeadlineExceededError):
+        return "expired"
+    if isinstance(exc, Overloaded):          # incl. CircuitOpenError
+        return "shed"
+    if isinstance(exc, SequenceEvictedError):
+        return "evicted"
+    return "failed"
+
+
+def _drain_futures(futs, outcomes, timeout=600):
+    ttfts = []
+    for fut in futs:
+        try:
+            res = fut.result(timeout=timeout)
+            outcomes["served"] += 1
+            ttft = getattr(res, "ttft_s", None)
+            if ttft is not None:
+                ttfts.append(ttft)
+        except Exception as exc:
+            outcomes[_classify(exc)] += 1
+    return ttfts
+
+
+def replay(server, trace, spec, submit_fn, *, open_loop=True,
+           closed_workers=4, speed=1.0, result_timeout=600):
+    """Drive one front end through the schedule.
+
+    ``submit_fn(req) -> Future`` adapts the request dict to the
+    server (typed submit-time sheds are classified here). Open loop:
+    arrivals land at ``at_us/speed`` past replay start regardless of
+    completions. Closed loop: ``closed_workers`` clients walk the
+    schedule in order, each submitting its next request only after
+    its previous one resolved (arrival times ignored).
+
+    Returns ``(outcomes, ttfts, elapsed_s)`` where outcomes is the
+    typed partition over the WHOLE schedule — it must sum to
+    ``len(trace)`` or the run is unaccountable."""
+    outcomes = {k: 0 for k in OUTCOMES}
+    ttfts = []
+    t0 = time.monotonic()
+    if open_loop:
+        futs = []
+        for req in trace:
+            lag = t0 + req["at_us"] / 1e6 / speed - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            try:
+                futs.append(submit_fn(req))
+            except Exception as exc:
+                outcomes[_classify(exc)] += 1
+        ttfts = _drain_futures(futs, outcomes, timeout=result_timeout)
+    else:
+        lock = threading.Lock()
+        it = iter(trace)
+
+        def client():
+            while True:
+                with lock:
+                    req = next(it, None)
+                if req is None:
+                    return
+                try:
+                    fut = submit_fn(req)
+                    res = fut.result(timeout=result_timeout)
+                except Exception as exc:
+                    with lock:
+                        outcomes[_classify(exc)] += 1
+                    continue
+                with lock:
+                    outcomes["served"] += 1
+                    ttft = getattr(res, "ttft_s", None)
+                    if ttft is not None:
+                        ttfts.append(ttft)
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(max(1, closed_workers))]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    return outcomes, ttfts, time.monotonic() - t0
+
+
+# --------------------------------------------------------- frontends --
+
+def _serving_backend(dim, seed=7):
+    """Jitted matmul backend: real XLA programs per bucket, so the
+    zero-recompile pin means what it says on the single-shot path."""
+    import jax
+    import jax.numpy as jnp
+    w = np.random.RandomState(seed).randn(dim, dim).astype(np.float32)
+
+    def _fwd(b):
+        return jnp.tanh(b @ w)
+
+    jfn = jax.jit(_fwd)
+
+    def fn(batch):
+        return np.asarray(jfn(batch))
+    return fn
+
+
+def run_serving(args, spec, trace, ring):
+    """Replay the schedule against a warmed ModelServer; returns the
+    per-frontend result block."""
+    from mxnet_tpu import serving
+    dim = args.feature_dim
+    if args.model:
+        import mxnet_tpu as mx
+        backend = mx.deploy.load_predictor(args.model)
+        srv = serving.ModelServer(backend, name="replay",
+                                  max_queue=args.max_queue)
+    else:
+        srv = serving.ModelServer(
+            _serving_backend(dim), buckets=[1, 2, 4, 8],
+            max_delay_ms=1.0, item_shape=(dim,), dtype="float32",
+            name="replay", max_queue=args.max_queue)
+    srv.start()
+    srv.warmup()
+
+    def submit(req):
+        x = request_rng(spec, req).randn(dim).astype(np.float32)
+        return srv.submit(x, deadline_ms=spec.deadline_ms,
+                          tenant=req["tenant"])
+
+    ring.record()
+    interval = max(0.05, spec.duration_s / 40.0)
+    ring.start(interval)
+    with serving.CompileCounter() as cc:
+        outcomes, _, elapsed = replay(
+            srv, trace, spec, submit, open_loop=not args.closed,
+            closed_workers=args.closed, speed=args.speed)
+    ring.stop()
+    ring.record()
+    stats = srv.stats()
+    server_label = srv._stats.server_label
+    srv.shutdown()
+    return {
+        "frontend": "serving",
+        "server": server_label,
+        "outcomes": outcomes,
+        "submitted": len(trace),
+        "elapsed_s": round(elapsed, 3),
+        "compiles_during_replay": cc.count,
+        "tenants": stats["tenants"],
+        "latency_ms": stats["latency_ms"],
+    }
+
+
+def run_llm(args, spec, trace, ring):
+    """Replay the schedule against a warmed LLMServer; returns the
+    per-frontend result block."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.llm import (TinyDecoder, DecoderConfig,
+                                       LLMServer)
+    model = TinyDecoder(DecoderConfig(
+        vocab_size=32, d_model=32, num_layers=2, num_heads=2,
+        d_ff=64, max_context=args.max_context))
+    srv = LLMServer(model, model.init_params(0), name="replay_llm",
+                    max_seqs=args.max_seqs, block_size=16,
+                    max_context=args.max_context,
+                    max_queue=args.max_queue)
+    srv.warmup()
+    srv.start()
+    max_prompt = max(2, args.max_context // 2)
+
+    def submit(req):
+        toks = prompt_tokens(spec, req, model.vocab_size)[:max_prompt]
+        return srv.submit(toks, req["new_tokens"],
+                          deadline_ms=spec.deadline_ms,
+                          tenant=req["tenant"])
+
+    ring.record()
+    interval = max(0.05, spec.duration_s / 40.0)
+    ring.start(interval)
+    with serving.CompileCounter() as cc:
+        outcomes, ttfts, elapsed = replay(
+            srv, trace, spec, submit, open_loop=not args.closed,
+            closed_workers=args.closed, speed=args.speed)
+    ring.stop()
+    ring.record()
+    stats = srv.stats()
+    srv.shutdown()
+    ttfts.sort()
+
+    def pct(p):
+        if not ttfts:
+            return None
+        return ttfts[min(len(ttfts) - 1,
+                         int(round(p / 100.0 * (len(ttfts) - 1))))]
+
+    return {
+        "frontend": "llm",
+        "server": srv._stats.server_label,
+        "outcomes": outcomes,
+        "submitted": len(trace),
+        "elapsed_s": round(elapsed, 3),
+        "compiles_during_replay": cc.count,
+        "tenants": stats["tenants"],
+        "tokens_generated": stats["tokens_generated"],
+        "ttft_ms": {"p50": round((pct(50) or 0) * 1e3, 3),
+                    "p99": round((pct(99) or 0) * 1e3, 3)},
+    }
+
+
+# ------------------------------------------------- SLO + capacity ----
+
+def _replay_windows(duration_s):
+    """Burn-rate windows scaled to the replay length (the env-driven
+    default window LENGTHS assume a long-lived server; a bounded
+    replay needs its windows inside the measured span). The burn
+    THRESHOLDS still honor MXNET_TPU_SLO_{FAST,SLOW}_BURN."""
+    from mxnet_tpu.observability import slo as slo_mod
+    fast, slow = slo_mod.burn_thresholds()
+    d = max(duration_s, 1.0)
+    return [(d / 2.0, d / 12.0, fast, slo_mod.STATUS_PAGE),
+            (d, d / 5.0, slow, slo_mod.STATUS_WARN)]
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def evaluate_and_report(args, spec, trace, results, rings, out_dir):
+    """SLO evaluation + capacity derivation + committed artifact."""
+    from mxnet_tpu.observability import SLO, SLOEngine, get_registry
+    from mxnet_tpu.observability import capacity as cap_mod
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import perf_capture
+    finally:
+        sys.path.pop(0)
+
+    windows = _replay_windows(spec.duration_s)
+    slo_reports, frontends, tenants = {}, [], {}
+    for blk in results:
+        ring = rings[blk["frontend"]]
+        server = blk["server"]
+        if blk["frontend"] == "serving":
+            lat = SLO.latency("serving_latency", args.slo_latency_ms,
+                              target=args.slo_target,
+                              labels={"server": server})
+            slos = [SLO.serving_availability(
+                        "serving_availability", server,
+                        target=args.availability_target), lat]
+            frontends.append(("serving", server, lat, ring))
+        else:
+            lat = SLO.ttft("llm_ttft", args.slo_ttft_ms,
+                           target=args.slo_target,
+                           labels={"server": server})
+            slos = [SLO.llm_availability(
+                        "llm_availability", server,
+                        target=args.availability_target), lat]
+            frontends.append(("llm", server, lat, ring))
+        engine = SLOEngine(slos, ring, windows=windows)
+        slo_reports.update(engine.evaluate())
+        tenants[blk["frontend"]] = blk["tenants"]
+
+    chips = 1
+    try:
+        import jax
+        chips = max(1, jax.local_device_count())
+    except Exception:
+        pass
+
+    rec = cap_mod.build_report(
+        rings[results[0]["frontend"]], slo_reports, frontends,
+        chips=chips,
+        user_model={"requests_per_user_per_s": args.rpu,
+                    "tokens_per_user_per_s": args.tpu},
+        trace={"spec": spec.to_dict(), "requests": len(trace),
+               "schedule_sha256": schedule_digest(trace)})
+    rec["tenants"] = tenants
+    rec["outcomes"] = {b["frontend"]: b["outcomes"] for b in results}
+    rec["compiles_during_replay"] = sum(b["compiles_during_replay"]
+                                        for b in results)
+
+    # refusal gates: an unhealthy replay cannot headline capacity
+    reasons = []
+    if rec["compiles_during_replay"]:
+        reasons.append(f"{rec['compiles_during_replay']} XLA "
+                       "recompiles during the measured window")
+    for blk in results:
+        total = sum(blk["outcomes"].values())
+        if total != blk["submitted"]:
+            reasons.append(
+                f"{blk['frontend']}: accounting drift — {total} "
+                f"outcomes for {blk['submitted']} submissions")
+        if blk["outcomes"]["failed"]:
+            reasons.append(f"{blk['frontend']}: "
+                           f"{blk['outcomes']['failed']} untyped/"
+                           "unexpected failures")
+    if reasons:
+        rec["skipped"] = "; ".join(reasons)
+
+    os.makedirs(out_dir, exist_ok=True)
+    metrics_log = os.path.join(out_dir, "load_replay_metrics.jsonl")
+    get_registry().write_snapshot(metrics_log)
+    rec["_capture"] = {
+        "tag": f"load_replay_seed{spec.seed}",
+        "metrics_log": metrics_log,
+        "captured_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+    }
+    path = perf_capture.emit_capacity_snapshot(rec, out_dir=out_dir)
+    return rec, path
+
+
+# -------------------------------------------------------------- main --
+
+def _smoke_check(args, spec, trace, results, rec, cap_path):
+    """The CI gate: determinism, zero recompiles, exact typed
+    partition, a well-formed committed capacity report, and a clean
+    exposition."""
+    from mxnet_tpu.observability import get_registry
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from metrics_dump import parse_exposition
+    finally:
+        sys.path.pop(0)
+    probs = []
+    if schedule_digest(generate_trace(spec)) != schedule_digest(trace):
+        probs.append("schedule not bit-identical across generations")
+    for blk in results:
+        if blk["compiles_during_replay"]:
+            probs.append(f"{blk['frontend']}: "
+                         f"{blk['compiles_during_replay']} recompiles")
+        if sum(blk["outcomes"].values()) != blk["submitted"]:
+            probs.append(f"{blk['frontend']}: partition "
+                         f"{blk['outcomes']} != {blk['submitted']}")
+        if blk["outcomes"]["failed"]:
+            probs.append(f"{blk['frontend']}: unexpected failures")
+        if not blk["tenants"]:
+            probs.append(f"{blk['frontend']}: no tenant attribution")
+    with open(cap_path) as f:
+        cap = json.load(f)
+    if cap.get("skipped"):
+        probs.append(f"capacity report skipped: {cap['skipped']}")
+    if cap.get("value") is None:
+        probs.append("capacity report has no headline value")
+    for fe in cap.get("frontends") or []:
+        if fe.get("chips_per_m_users") is None:
+            probs.append(f"{fe.get('kind')}: no chips_per_m_users")
+    if not cap.get("slo"):
+        probs.append("capacity report carries no SLO block")
+    else:
+        for name, rep in cap["slo"].items():
+            if rep.get("status_name") not in ("ok", "warn", "page",
+                                              "breach"):
+                probs.append(f"SLO {name}: no status")
+    try:
+        samples = parse_exposition(get_registry().expose())
+    except ValueError as exc:
+        samples = {}
+        probs.append(f"exposition malformed after replay: {exc}")
+    for prefix in ("mxtpu_slo_attainment", "mxtpu_slo_status",
+                   "mxtpu_slo_burn_rate", "mxtpu_ts_snapshots_total",
+                   "mxtpu_serving_tenant_requests_total",
+                   "mxtpu_llm_tenant_requests_total"):
+        if not any(n.startswith(prefix) for n, _ in samples):
+            probs.append(f"no {prefix}* series in exposition")
+    return probs
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="trace length in seconds")
+    ap.add_argument("--base-rps", type=float, default=20.0)
+    ap.add_argument("--diurnal-amp", type=float, default=0.5)
+    ap.add_argument("--diurnal-period", type=float, default=0.0,
+                    help="seconds per diurnal cycle (0 = one cycle "
+                         "over the whole trace)")
+    ap.add_argument("--burst-rate", type=float, default=0.2,
+                    help="expected burst windows per second")
+    ap.add_argument("--burst-mult", type=float, default=3.0)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--tenant-skew", type=float, default=1.2)
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request end-to-end deadline (0 = none)")
+    ap.add_argument("--frontend", choices=("serving", "llm", "both"),
+                    default="both")
+    ap.add_argument("--closed", type=int, default=0,
+                    help="closed-loop client count (0 = open loop at "
+                         "scheduled arrival times)")
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="open-loop time compression (2 = replay the "
+                         "trace twice as fast as scheduled)")
+    ap.add_argument("--model", default=None,
+                    help="predictor artifact for the serving front "
+                         "end (default: built-in jitted matmul)")
+    ap.add_argument("--feature-dim", type=int, default=16)
+    ap.add_argument("--max-seqs", type=int, default=4)
+    ap.add_argument("--max-context", type=int, default=64)
+    ap.add_argument("--max-queue", type=int, default=512)
+    ap.add_argument("--slo-latency-ms", type=float,
+                    default=_env_float("MXNET_TPU_SLO_LATENCY_MS",
+                                       250.0))
+    ap.add_argument("--slo-ttft-ms", type=float,
+                    default=_env_float("MXNET_TPU_SLO_TTFT_MS", 2500.0))
+    ap.add_argument("--slo-target", type=float,
+                    default=_env_float("MXNET_TPU_SLO_TARGET", 0.99),
+                    help="latency/TTFT SLO target fraction")
+    ap.add_argument("--availability-target", type=float, default=0.99)
+    ap.add_argument("--rpu", type=float, default=0.005,
+                    help="assumed requests/sec per active user")
+    ap.add_argument("--tpu", type=float, default=1.5,
+                    help="assumed decode tokens/sec per active user")
+    ap.add_argument("--out", default=None,
+                    help="directory for CAPACITY_rNN.json (default: "
+                         "a temp dir, printed)")
+    ap.add_argument("--trace-only", action="store_true",
+                    help="print the schedule digest + first requests "
+                         "and exit (no servers)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CI run against BOTH front ends; fail "
+                         "on recompiles, accounting drift, a "
+                         "malformed capacity report, or a dirty "
+                         "exposition")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.duration = min(args.duration, 2.5)
+        args.base_rps = min(args.base_rps, 16.0)
+        args.frontend = "both"
+        args.max_context = min(args.max_context, 64)
+        args.max_seqs = min(args.max_seqs, 4)
+
+    spec = TraceSpec(
+        seed=args.seed, duration_s=args.duration,
+        base_rps=args.base_rps, diurnal_amp=args.diurnal_amp,
+        diurnal_period_s=args.diurnal_period or None,
+        burst_rate=args.burst_rate, burst_mult=args.burst_mult,
+        tenants=args.tenants, tenant_skew=args.tenant_skew,
+        prompt_max=max(2, args.max_context // 2),
+        deadline_ms=args.deadline_ms if args.deadline_ms > 0 else None)
+    trace = generate_trace(spec)
+    digest = schedule_digest(trace)
+    print(f"trace: {len(trace)} requests over {spec.duration_s}s "
+          f"(seed {spec.seed}, sha256 {digest[:16]}...)")
+    if args.trace_only:
+        print(json.dumps(trace[:10], indent=1))
+        return 0
+
+    from mxnet_tpu.observability import TimeSeriesRing, get_registry
+    results, rings = [], {}
+    if args.frontend in ("serving", "both"):
+        rings["serving"] = TimeSeriesRing(get_registry())
+        results.append(run_serving(args, spec, trace,
+                                   rings["serving"]))
+        print(json.dumps(results[-1], indent=1))
+    if args.frontend in ("llm", "both"):
+        rings["llm"] = TimeSeriesRing(get_registry())
+        results.append(run_llm(args, spec, trace, rings["llm"]))
+        print(json.dumps(results[-1], indent=1))
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="load_replay_")
+    rec, cap_path = evaluate_and_report(args, spec, trace, results,
+                                        rings, out_dir)
+    print(f"CAPACITY json -> {cap_path}")
+    print(json.dumps({k: rec[k] for k in
+                      ("value", "unit", "slo_attained", "slo_statuses",
+                       "chips", "window_s") if k in rec}, indent=1))
+
+    if args.smoke:
+        probs = _smoke_check(args, spec, trace, results, rec, cap_path)
+        if probs:
+            for p in probs:
+                print(f"SMOKE problem: {p}")
+            print("SMOKE FAIL")
+            return 1
+        print("SMOKE PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,16 @@ into something readable:
 ``registry.expose()`` scrape would have returned at snapshot time), so
 offline captures and live scrapes are interchangeable downstream.
 
+``--delta A.jsonl [B.jsonl]`` renders the MOVEMENT between two
+snapshots — counter deltas + per-second rates and histogram
+percentile movement (cumulative p50/p99 at each end, plus the
+percentile of ONLY the window's observations from the diffed bucket
+counts). With one file, the first and last snapshot lines are
+compared. This is the offline/manual twin of
+``observability.timeseries.TimeSeriesRing.rate()`` — same reset
+handling, same bucket-delta percentile math (imported from the same
+module so the two can never drift).
+
 ``--smoke`` runs the full path in-process — instrument a 2-step
 training loop, a checkpoint write, a micro-batched serving burst and
 the XLA compile bridge with span tracing ON, then snapshot → JSONL →
@@ -145,6 +155,94 @@ def render_table(metrics):
             else:
                 val = f"{float(series['value']):.6g}"
             lines.append(f"{lname:<56} {rec['type']:>10} {val:>16}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------- delta --
+
+def render_delta(snap_a, snap_b):
+    """Counter rates + histogram-percentile movement between two
+    snapshot records (each ``{"ts", "metrics"}``). Only series that
+    MOVED are listed — an unchanged counter carries no information in
+    a delta view."""
+    sys.path.insert(0, REPO)
+    try:
+        from mxnet_tpu.observability.timeseries import (
+            diff_cum_counts, percentile_from_counts)
+    finally:
+        sys.path.pop(0)
+    ma, mb = snap_a["metrics"], snap_b["metrics"]
+    dt = float(snap_b.get("ts") or 0.0) - float(snap_a.get("ts") or 0.0)
+    rate_dt = dt if dt > 0 else None
+    lines = [f"# delta: ts {snap_a.get('ts')} -> {snap_b.get('ts')} "
+             f"({dt:.3f}s)",
+             f"{'metric':<56} {'type':>10} {'movement':>40}",
+             "-" * 108]
+    moved = 0
+
+    def _key(series):
+        return tuple(sorted((series.get("labels") or {}).items()))
+
+    for name in sorted(set(ma) | set(mb)):
+        rec = mb.get(name) or ma.get(name)
+        typ = rec["type"]
+        sa = {_key(s): s for s in (ma.get(name) or {}).get("series", [])}
+        sb = {_key(s): s for s in (mb.get(name) or {}).get("series", [])}
+        for key in sorted(set(sa) | set(sb)):
+            lname = name + ("{%s}" % ",".join(f"{k}={v}"
+                                              for k, v in key)
+                            if key else "")
+            a, b = sa.get(key), sb.get(key)
+            if typ == "histogram":
+                cb = b["counts"] if b else None
+                if cb is None:
+                    continue            # series vanished: no window
+                ca = a["counts"] if a else [0] * len(cb)
+                if a and tuple(a["buckets"]) != tuple(b["buckets"]):
+                    lines.append(
+                        f"{lname:<56} {typ:>10} "
+                        "bucket layout changed between snapshots; "
+                        "no delta")
+                    moved += 1
+                    continue
+                win = diff_cum_counts(ca, cb)
+                dcount = win[-1]
+                if not dcount:
+                    continue
+                edges = b["buckets"]
+                p50w = percentile_from_counts(edges, win, 50)
+                p99w = percentile_from_counts(edges, win, 99)
+                p50a = percentile_from_counts(
+                    edges, ca, 50) if a and ca[-1] else None
+                p50b = percentile_from_counts(edges, cb, 50)
+
+                def fmt_s(v):
+                    return f"{v * 1e3:.3g}ms" if v is not None else "—"
+                rate = (f" ({dcount / rate_dt:.6g}/s)"
+                        if rate_dt else "")
+                lines.append(
+                    f"{lname:<56} {typ:>10} "
+                    f"n+{dcount}{rate} p50 {fmt_s(p50a)}->"
+                    f"{fmt_s(p50b)} win p50={fmt_s(p50w)} "
+                    f"p99={fmt_s(p99w)}")
+                moved += 1
+            else:
+                vb = float(b["value"]) if b else 0.0
+                va = float(a["value"]) if a else 0.0
+                if typ == "counter" and vb < va:
+                    delta = vb             # reset: restart from zero
+                else:
+                    delta = vb - va
+                if delta == 0.0:
+                    continue
+                rate = (f" ({delta / rate_dt:+.6g}/s)"
+                        if typ == "counter" and rate_dt else "")
+                lines.append(f"{lname:<56} {typ:>10} "
+                             f"{va:.6g} -> {vb:.6g} ({delta:+.6g})"
+                             f"{rate}")
+                moved += 1
+    if not moved:
+        lines.append("(no series moved between the two snapshots)")
     return "\n".join(lines)
 
 
@@ -441,10 +539,33 @@ def main():
                     help="which snapshot line to render (default: last)")
     ap.add_argument("--smoke", action="store_true",
                     help="run the in-process end-to-end exporter check")
+    ap.add_argument("--delta", nargs="+", metavar="JSONL",
+                    help="render counter rates + histogram-percentile "
+                         "movement between two snapshots: last lines "
+                         "of two files, or first vs last line of one")
     args = ap.parse_args()
 
     if args.smoke:
         sys.exit(smoke())
+    if args.delta:
+        if len(args.delta) > 2:
+            ap.error("--delta takes one or two JSONL files")
+        snaps_a = load_snapshots(args.delta[0])
+        if len(args.delta) == 2:
+            snaps_b = load_snapshots(args.delta[1])
+            if not snaps_a or not snaps_b:
+                print("--delta: a snapshot file is empty",
+                      file=sys.stderr)
+                sys.exit(1)
+            a, b = snaps_a[-1], snaps_b[-1]
+        else:
+            if len(snaps_a) < 2:
+                print("--delta: need two snapshot lines in "
+                      f"{args.delta[0]}", file=sys.stderr)
+                sys.exit(1)
+            a, b = snaps_a[0], snaps_a[-1]
+        print(render_delta(a, b))
+        sys.exit(0)
     path = args.path or os.environ.get("MXNET_TPU_METRICS_LOG")
     if not path:
         ap.error("no path given and MXNET_TPU_METRICS_LOG unset")

@@ -389,6 +389,65 @@ def emit_llm_snapshot(rec, out_dir=None):
     return path
 
 
+def emit_capacity_snapshot(rec, out_dir=None):
+    """Write a ``CAPACITY_rNN.json`` for a load-replay capacity run;
+    returns its path.
+
+    Same skip-refusal contract as :func:`emit_bench_snapshot` /
+    :func:`emit_llm_snapshot`: a record that recompiled during the
+    measured window, lost requests, or produced no measurable rate is
+    still committed (the trajectory must show the attempt) but with a
+    top-level ``"skipped"`` marker and ``"value": null`` — an
+    unhealthy replay can never masquerade as a capacity headline.
+    ``rec`` is ``observability.capacity.build_report`` output plus the
+    replay's ``_capture`` block (tag, metrics_log, captured_at) and
+    any ``skipped`` reasons ``tools/load_replay.py`` attached."""
+    out_dir = out_dir or REPO
+    cap = rec.get("_capture", {})
+    snap = _last_metrics_snapshot(cap.get("metrics_log", ""))
+    nn = _next_round("CAPACITY_r", out_dir)
+    path = os.path.join(out_dir, f"CAPACITY_r{nn:02d}.json")
+    out = {
+        "round": nn,
+        "source": "tools/load_replay.py (observability registry)",
+        "captured_at": cap.get("captured_at", _now()),
+        "tag": cap.get("tag"),
+        "metric": rec.get("metric"),
+        "unit": rec.get("unit"),
+    }
+    if not _is_valid(rec):
+        out.update({
+            "skipped": rec.get("skipped") or (
+                "suspect" if rec.get("suspect") else "invalid"),
+            "value": None,
+            "detail": rec.get("detail"),
+        })
+    else:
+        out.update({
+            "value": rec.get("value"),
+            "slo_attained": rec.get("slo_attained"),
+            "slo": rec.get("slo"),
+            "frontends": rec.get("frontends"),
+            "chips": rec.get("chips"),
+            "user_model": rec.get("user_model"),
+            "window_s": rec.get("window_s"),
+            "snapshots": rec.get("snapshots"),
+            "trace": rec.get("trace"),
+            "tenants": rec.get("tenants"),
+            "device_kind": rec.get("device_kind"),
+            "xla_compiles": _metric_value(snap,
+                                          "mxtpu_xla_compile_total"),
+            "compiles_during_replay": rec.get("compiles_during_replay"),
+            "outcomes": rec.get("outcomes"),
+            "metrics_log": cap.get("metrics_log"),
+            "detail": rec.get("detail"),
+        })
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    return path
+
+
 def _captured_tags():
     """Config tags that already produced a valid capture (from the
     append-only log), so later windows spend their time on the
