@@ -9,10 +9,10 @@ The full LLM serving path in one file:
 2. load it back and wrap it in ``mx.serving.llm.LLMServer``: a fixed
    pool of KV blocks, per-sequence block tables, ragged attention over
    the paged cache, and token-level continuous batching — sequences
-   are admitted (prefill) and retired every engine step;
-3. ``warmup()`` pre-compiles every prefill length bucket plus the ONE
-   fixed decode shape, so the ragged load phase below runs with ZERO
-   XLA recompiles (the script asserts this);
+   are admitted (chunked prefill) and retired every engine step;
+3. ``warmup()`` pre-compiles the ONE fixed chunked-step shape (prompts
+   prefill in chunks THROUGH the decode program), so the ragged load
+   phase below runs with ZERO XLA recompiles (the script asserts this);
 4. verify a sample of generations token-for-token against eager
    per-sequence greedy decoding, then print tokens/sec, TTFT and
    KV-cache occupancy.

@@ -35,6 +35,21 @@ output (pinned by the garbage-invisibility test). Rows with
 to 1 over the null block and DISCARD their outputs — the null block
 accumulates stale K/V from padded writes, so those rows are
 unspecified values, not zeros.
+
+Multi-token queries (ISSUE 12): the same kernel generalizes from one
+query token per sequence to a CHUNK of ``Q`` query tokens per sequence
+— ``q`` shaped ``[S, Q, H, D]`` with a scalar-prefetched ``q_lens[i]``
+giving each row's valid token count (``0 <= q_lens[i] <= Q``; padded
+tail tokens and whole inactive rows produce DISCARDED outputs). Query
+token ``t`` of row ``i`` sits at absolute position
+``kv_lens[i] - q_lens[i] + t`` and attends CAUSALLY over the paged
+history: positions ``<= kv_lens[i] - q_lens[i] + t`` only, masked
+inside the kernel, online softmax unchanged. This one shape is
+chunked prefill (``q_lens[i]`` prompt tokens whose KV was just
+written), decode (``q_lens[i] == 1``) and speculative verify
+(``q_lens[i] == K + 1`` draft positions scored in one dispatch) — the
+"Ragged Paged Attention" unification (PAPERS.md): prefill and decode
+are the same multi-query-token kernel over the paged cache.
 """
 from __future__ import annotations
 
@@ -69,7 +84,273 @@ def ragged_attention_reference(q, k_pages, v_pages, block_tables,
     return out.astype(q.dtype)
 
 
+def ragged_chunk_attention_reference(q, k_pages, v_pages, block_tables,
+                                     kv_lens, q_lens, scale=None):
+    """Gather-based oracle for the multi-token chunk shape.
+
+    q: (S, Q, H, D); pages: (N, bs, H, D); block_tables: (S, MB)
+    int32; kv_lens/q_lens: (S,) int32. Query token ``t`` of row ``i``
+    attends over positions ``<= kv_lens[i] - q_lens[i] + t``. Outputs
+    for ``t >= q_lens[i]`` are unspecified (callers discard them)."""
+    S, Q, H, D = q.shape
+    bs = k_pages.shape[1]
+    MB = block_tables.shape[1]
+    s = scale if scale is not None else float(1.0 / (D ** 0.5))
+    k = k_pages[block_tables].reshape(S, MB * bs, H, D)
+    v = v_pages[block_tables].reshape(S, MB * bs, H, D)
+    logits = jnp.einsum("sqhd,skhd->shqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    pos = jnp.arange(MB * bs, dtype=jnp.int32)            # kv position
+    qpos = (kv_lens[:, None] - q_lens[:, None]
+            + jnp.arange(Q, dtype=jnp.int32)[None, :])    # (S, Q)
+    mask = pos[None, None, None, :] <= qpos[:, None, :, None]
+    logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # fully-masked (impossible for valid t; padded t attends somewhere)
+    out = jnp.einsum("shqk,skhd->sqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ragged_flat_attention_reference(q, k_pages, v_pages, block_tables,
+                                    seq_ids, positions, scale=None):
+    """Gather-based oracle for the FLAT ragged layout: ``q`` is a
+    packed ``[T, H, D]`` batch of query tokens from MANY sequences —
+    token ``t`` belongs to row ``seq_ids[t]`` of ``block_tables`` and
+    sits at absolute position ``positions[t]``, attending causally
+    over positions ``<= positions[t]`` of ITS sequence's paged
+    history. No per-sequence padding: the step computes exactly the
+    tokens that exist (prefill chunks, decodes and verify positions
+    packed together — the "[total_q_tokens]" shape of the Ragged
+    Paged Attention paper). Invalid/padded tokens should carry
+    ``seq_ids`` pointing at an all-null table row; their outputs are
+    unspecified and must be discarded."""
+    T, H, D = q.shape
+    bs = k_pages.shape[1]
+    MB = block_tables.shape[1]
+    s = scale if scale is not None else float(1.0 / (D ** 0.5))
+    tbl = block_tables[seq_ids]                       # (T, MB)
+    k = k_pages[tbl].reshape(T, MB * bs, H, D)
+    v = v_pages[tbl].reshape(T, MB * bs, H, D)
+    logits = jnp.einsum("thd,tkhd->htk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    pos = jnp.arange(MB * bs, dtype=jnp.int32)
+    mask = pos[None, None, :] <= positions[None, :, None]
+    logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("htk,tkhd->thd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 # ----------------------------------------------------------- pallas --
+
+
+def _flat_kernel(sid_ref, pos_ref, bt_ref, q_ref, k_ref, v_ref,
+                 o_ref, acc_ref, m_ref, l_ref, *, scale, block_size,
+                 num_blocks):
+    """Grid (T, MB): the decode kernel generalized to per-TOKEN
+    sequence indirection — the page DMA for grid step (t, j) is
+    index-mapped through ``block_tables[seq_ids[t], j]``."""
+    t, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    qpos = pos_ref[t]
+    base = j * block_size
+
+    @pl.when(base <= qpos)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)            # (H, D)
+        k = k_ref[...].astype(jnp.float32)            # (bs, H, D)
+        v = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)
+        s = jnp.where(pos <= qpos, s, _NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1,
+                                                  keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(j == num_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7))
+def _ragged_flat_pallas(q, k_pages, v_pages, block_tables, seq_ids,
+                        positions, scale, interpret):
+    T, H, D = q.shape
+    bs = k_pages.shape[1]
+    MB = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(T, MB),
+        in_specs=[
+            pl.BlockSpec((None, H, D),
+                         lambda t, j, sid, pos, bt: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bs, H, D),
+                         lambda t, j, sid, pos, bt:
+                         (bt[sid[t], j], 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bs, H, D),
+                         lambda t, j, sid, pos, bt:
+                         (bt[sid[t], j], 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((None, H, D),
+                               lambda t, j, sid, pos, bt: (t, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_flat_kernel, scale=scale,
+                               block_size=bs, num_blocks=MB)
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, H, D), q.dtype),
+        interpret=interpret,
+    )(seq_ids.astype(jnp.int32), positions.astype(jnp.int32),
+      block_tables.astype(jnp.int32), q, k_pages, v_pages)
+
+
+def ragged_flat_attention(q, k_pages, v_pages, block_tables, seq_ids,
+                          positions, scale=None, use_pallas=None,
+                          interpret=None):
+    """Flat-ragged paged attention entry point (packed
+    ``[total_q_tokens]`` batch, per-token sequence/position
+    indirection). Gated exactly like :func:`ragged_paged_attention`."""
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if scale is None:
+        scale = float(1.0 / (q.shape[-1] ** 0.5))
+    if not use_pallas:
+        return ragged_flat_attention_reference(
+            q, k_pages, v_pages, block_tables, jnp.asarray(seq_ids),
+            jnp.asarray(positions), scale)
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _ragged_flat_pallas(q, k_pages, v_pages,
+                               jnp.asarray(block_tables),
+                               jnp.asarray(seq_ids),
+                               jnp.asarray(positions),
+                               float(scale), bool(interpret))
+
+
+def _chunk_kernel(bt_ref, len_ref, qlen_ref, q_ref, k_ref, v_ref,
+                  o_ref, acc_ref, m_ref, l_ref, *, scale, block_size,
+                  num_blocks, q_tokens):
+    """Grid (S, MB): one (chunk x KV-page) tile per step. Scratch
+    carries the online softmax across a row's page steps; the causal
+    mask (query t at absolute position kv_len - q_len + t) is applied
+    in-kernel so one program covers prefill chunks, decode and
+    speculative verify."""
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    kv_len = len_ref[i]
+    q_len = qlen_ref[i]
+    base = j * block_size
+
+    # a block whose first position is past the LAST query's causal
+    # horizon (kv_len - 1) is fully masked for every query token:
+    # skip its compute (the page DMA still streams)
+    @pl.when(base < kv_len)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)            # (Q, H, D)
+        k = k_ref[...].astype(jnp.float32)            # (bs, H, D)
+        v = v_ref[...].astype(jnp.float32)
+        # batch over heads: (Q, H, D) x (bs, H, D) -> (H, Q, bs)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_size), 2)         # kv position
+        qpos = (kv_len - q_len + jax.lax.broadcasted_iota(
+            jnp.int32, (1, q_tokens, 1), 1))          # query position
+        mask = pos <= qpos                            # (1, Q, bs)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[...]                           # (H, Q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        # early query tokens see NOTHING in later pages: their whole
+        # tile row is masked and m stays at _NEG_INF — zero p
+        # explicitly instead of trusting exp(-inf - -inf)
+        p = jnp.exp(s - m_new) * mask.astype(jnp.float32)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=2,
+                                                  keepdims=True)
+        # (H, Q, bs) x (bs, H, D) batched over H -> (H, Q, D)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(j == num_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = jnp.transpose(
+            acc_ref[...] / l_safe, (1, 0, 2)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7))
+def _ragged_chunk_pallas(q, k_pages, v_pages, block_tables, kv_lens,
+                         q_lens, scale, interpret):
+    S, Q, H, D = q.shape
+    bs = k_pages.shape[1]
+    MB = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, MB),
+        in_specs=[
+            pl.BlockSpec((None, Q, H, D),
+                         lambda i, j, bt, ln, ql: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bs, H, D),
+                         lambda i, j, bt, ln, ql: (bt[i, j], 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bs, H, D),
+                         lambda i, j, bt, ln, ql: (bt[i, j], 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((None, Q, H, D),
+                               lambda i, j, bt, ln, ql: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((H, Q, D), jnp.float32),
+            pltpu.VMEM((H, Q, 1), jnp.float32),
+            pltpu.VMEM((H, Q, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_chunk_kernel, scale=scale,
+                               block_size=bs, num_blocks=MB,
+                               q_tokens=Q)
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, Q, H, D), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      q_lens.astype(jnp.int32), q, k_pages, v_pages)
 
 
 def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
@@ -160,13 +441,17 @@ def _ragged_decode_pallas(q, k_pages, v_pages, block_tables, kv_lens,
 
 
 def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
-                           scale=None, use_pallas=None, interpret=None):
-    """Paged decode attention entry point.
+                           q_lens=None, scale=None, use_pallas=None,
+                           interpret=None):
+    """Paged attention entry point — decode AND chunk shapes.
 
-    q: (S, H, D) — one query token per sequence; k_pages/v_pages:
-    (N, bs, H, D); block_tables: (S, MB) int32 page indices (pad unused
-    entries with the null block 0); kv_lens: (S,) int32 valid-token
-    counts (>= 1; keep inactive rows at 1 over the null block).
+    q: (S, H, D) — one query token per sequence (decode) — or
+    (S, Q, H, D) — a chunk of up to Q query tokens per sequence with
+    ``q_lens`` (S,) int32 valid counts (chunked prefill / speculative
+    verify). k_pages/v_pages: (N, bs, H, D); block_tables: (S, MB)
+    int32 page indices (pad unused entries with the null block 0);
+    kv_lens: (S,) int32 valid-token counts (>= 1; keep inactive rows
+    at 1 over the null block).
 
     ``use_pallas`` defaults to the flash_attention gate: the Pallas
     kernel on TPU, the gather reference elsewhere. Forcing
@@ -177,11 +462,24 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
         use_pallas = _on_tpu()
     if scale is None:
         scale = float(1.0 / (q.shape[-1] ** 0.5))
+    chunked = getattr(q, "ndim", len(getattr(q, "shape", ()))) == 4
+    if chunked and q_lens is None:
+        raise ValueError("chunk-shaped q (S, Q, H, D) requires q_lens")
     if not use_pallas:
+        if chunked:
+            return ragged_chunk_attention_reference(
+                q, k_pages, v_pages, block_tables, kv_lens,
+                jnp.asarray(q_lens), scale)
         return ragged_attention_reference(q, k_pages, v_pages,
                                           block_tables, kv_lens, scale)
     if interpret is None:
         interpret = not _on_tpu()
+    if chunked:
+        return _ragged_chunk_pallas(q, k_pages, v_pages,
+                                    jnp.asarray(block_tables),
+                                    jnp.asarray(kv_lens),
+                                    jnp.asarray(q_lens),
+                                    float(scale), bool(interpret))
     return _ragged_decode_pallas(q, k_pages, v_pages,
                                  jnp.asarray(block_tables),
                                  jnp.asarray(kv_lens),
@@ -190,9 +488,9 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
 
 @register("ragged_paged_attention", differentiable=False)
 def _ragged_op(q, k_pages, v_pages, block_tables, kv_lens, *,
-               scale=None, use_pallas=None):
-    """Registered decode-attention op: Pallas kernel on TPU, gather
-    reference elsewhere."""
+               q_lens=None, scale=None, use_pallas=None):
+    """Registered paged-attention op (decode + chunk shapes): Pallas
+    kernel on TPU, gather reference elsewhere."""
     return ragged_paged_attention(q, k_pages, v_pages, block_tables,
-                                  kv_lens, scale=scale,
+                                  kv_lens, q_lens=q_lens, scale=scale,
                                   use_pallas=use_pallas)

@@ -9,22 +9,33 @@ schedules *requests* (one forward pass each), this subsystem schedules
   ``[num_blocks, block_size, heads, head_dim]`` blocks, a strict
   free-list :class:`~.kv_cache.BlockAllocator`, per-sequence block
   tables padded with the reserved null block;
-- :mod:`mxnet_tpu.ops.ragged_attention` — decode attention over the
-  block-table-indirected cache for a batch of different-length
-  sequences (gather-based jnp reference + a Pallas kernel with
-  scalar-prefetched block tables, gated like ``ops/flash_attention``);
+- :mod:`mxnet_tpu.ops.ragged_attention` — MULTI-TOKEN ragged
+  attention over the block-table-indirected cache: the flat packed
+  ``[total_q_tokens]`` shape (and its per-row chunk twin) covers
+  chunked prefill, decode and speculative verify in one kernel
+  (gather-based jnp references + Pallas kernels with
+  scalar-prefetched block tables / lengths / per-token seq ids,
+  gated like ``ops/flash_attention``);
 - :mod:`.scheduler` / :mod:`.engine` — continuous batching: admit,
-  step and retire sequences every iteration; prefill rides the shared
-  pow2 :class:`~..bucketing.BucketSpec` discipline (page-aligned
-  length buckets), decode runs ONE fixed ``[max_seqs]`` shape —
-  zero steady-state recompiles after :meth:`~.server.LLMServer.warmup`;
-  KV pressure preempts the newest sequence (recompute policy);
-- :mod:`.server` — :class:`~.server.LLMServer`: Futures in, greedy
+  step and retire sequences every iteration; prompts prefill in
+  CHUNKS scheduled into the regular step, so the whole mixed
+  prefill/decode/verify batch runs ONE fixed-shape donated flat
+  program (packed tokens, no per-sequence padding) — zero
+  steady-state recompiles after :meth:`~.server.LLMServer.warmup`;
+  KV pressure preempts the newest sequence (recompute policy,
+  exact-stream resume);
+- :mod:`.sampling` — in-program temperature / top-k / top-p sampling
+  (:class:`~.sampling.SamplingParams` per sequence as traced
+  vectors, position-keyed PRNG) plus the speculative-decoding accept
+  rule (a small draft model proposes K tokens; the chunked step IS
+  the verify dispatch);
+- :mod:`.server` — :class:`~.server.LLMServer`: Futures in,
   generations out; drain-with-deadline on shutdown/preemption
   (sequences that cannot finish resolve with a typed
   :class:`~.server.SequenceEvictedError` carrying their partial
-  tokens); :mod:`.metrics` puts tokens/sec, TTFT, queue depth and
-  KV-block occupancy on the shared registry as ``mxtpu_llm_*``.
+  tokens); :mod:`.metrics` puts tokens/sec, TTFT, queue depth,
+  KV-block occupancy, chunk and accept-rate series on the shared
+  registry as ``mxtpu_llm_*``.
 
 See docs/SERVING.md ("LLM decoding") for the architecture and the
 block-table layout, docs/ENV_VARS.md for the ``MXNET_TPU_LLM_*`` knobs.
@@ -35,6 +46,7 @@ from .kv_cache import (BlockAllocator, PagedKVCache, KVCacheError,
                        NoFreeBlocksError, BlockAccountingError,
                        NULL_BLOCK)
 from .scheduler import Sequence, Scheduler
+from .sampling import SamplingParams, GREEDY
 from .model import DecoderConfig, TinyDecoder, greedy_decode_reference
 from .engine import LLMEngine
 from .metrics import LLMStats
@@ -43,7 +55,8 @@ from .server import LLMServer, GenerationResult
 __all__ = [
     "BlockAllocator", "PagedKVCache", "KVCacheError",
     "NoFreeBlocksError", "BlockAccountingError", "NULL_BLOCK",
-    "Sequence", "Scheduler", "DecoderConfig", "TinyDecoder",
+    "Sequence", "Scheduler", "SamplingParams", "GREEDY",
+    "DecoderConfig", "TinyDecoder",
     "greedy_decode_reference", "LLMEngine", "LLMStats", "LLMServer",
     "SequenceEvictedError", "DeadlineExceededError", "Overloaded",
     "GenerationResult",

@@ -3,26 +3,50 @@
 The execution core of ``mxnet_tpu.serving.llm``. One engine iteration
 (:meth:`LLMEngine.step`):
 
-1. **admit** — while a decode slot and enough KV blocks are free, pop
-   the oldest waiting sequence and PREFILL it: pad the prompt to a
-   power-of-two, page-aligned length bucket (the same
-   :class:`~..bucketing.BucketSpec` discipline the single-shot server
-   uses on the batch axis), run the dense causal forward once, write
-   the prompt's K/V into freshly allocated pages, and emit the first
-   generated token from the last real position's logits;
-2. **allocate** — any running sequence whose next token starts a new
-   page gets a block; under KV pressure the newest-admitted sequence
-   is preempted (blocks freed, generation folded into its prompt,
-   requeued — deterministic greedy decoding resumes the exact stream);
-3. **decode** — ONE fixed-shape jitted launch for the whole batch:
-   ``[max_seqs]`` tokens/positions/lengths + ``[max_seqs,
-   max_blocks_per_seq]`` block tables in, next tokens out, KV pages
-   donated through. Inactive slots ride along pointed at the null
-   block. The shape never depends on how many sequences are live or
-   how long they are — so after :meth:`warmup` (every prefill bucket
-   once + the decode program) steady state compiles NOTHING, no matter
-   how ragged the arrival/length/stop mix gets (asserted via the
+1. **admit** — while a decode slot is free and the pool can hold the
+   prompt, pop the oldest waiting sequence into a slot. Admission no
+   longer launches a dense bucketed prefill: the prompt's KV is
+   written in CHUNKS scheduled into the regular step — a prefill
+   chunk is just a multi-token decode, so long prompts never stall
+   running decodes behind a monolithic prefill launch;
+2. **plan + allocate** — each running sequence declares this step's
+   query tokens: the next ``prefill_chunk`` prompt tokens while its
+   prompt is still being written, one token in plain decode, or
+   ``K + 1`` positions (last committed token + K draft proposals) in
+   speculative decode. Blocks covering the step's KV writes are
+   allocated up front (on the sequence, so every failure path frees
+   them); under KV pressure the newest-admitted sequence is preempted
+   (blocks freed, generation folded into its prompt, requeued — the
+   position-keyed sampling PRNG resumes the exact stream);
+3. **step** — ONE fixed-shape jitted launch for the whole mixed
+   batch in the FLAT ragged layout: every row's query tokens packed
+   into one ``[total_q_tokens]`` batch (tokens / positions / seq_ids
+   / valid) + ``[max_seqs, max_blocks_per_seq]`` block tables in; the
+   flat ragged kernel attends causally over the paged cache —
+   per-token sequence indirection, NO per-sequence padding, so a
+   mixed step computes exactly the tokens that exist; temperature /
+   top-k / top-p sampling (per-sequence TRACED vectors,
+   position-keyed PRNG) and the speculative accept rule run
+   IN-PROGRAM on host-indexed per-row logit windows; committed
+   tokens come out, KV pages are donated through. The packed length
+   and the block-table width are bucketed on small warmed ladders
+   (pure-decode, common mixed, full) — so after :meth:`warmup`
+   (every (t, mb, greedy|sampled) rung, plus the draft program's
+   when speculation is on) steady state compiles NOTHING, no matter
+   how the arrival/length/stop/sampling mix shifts (asserted via the
    ``backend_compile`` counter in tier-1).
+
+Speculative decoding: a small DRAFT model proposes up to ``spec_k``
+tokens per sequence (one fixed-shape draft dispatch each, its KV pages
+indexed by the SAME block ids the target allocator handed out — one
+strict accounting for both pools), then the chunked step scores all
+``K + 1`` positions in one target dispatch and the standard accept
+rule commits ``n_acc + 1`` tokens. Rejected draft KV entries are
+rolled back by trimming the sequence's surplus blocks through the
+strict :class:`~.kv_cache.BlockAllocator` (never bypassed); the draft
+cache's committed-prefix watermark (``Sequence.draft_len``) rolls back
+with them. A draft failure degrades that step to plain decode —
+speculation is an optimization, never a correctness dependency.
 
 The engine is single-threaded by design (the serving worker
 discipline): :class:`~.server.LLMServer` owns the thread, the queue
@@ -31,41 +55,135 @@ and the futures; the engine owns device state and determinism.
 from __future__ import annotations
 
 import collections
-import os
 import time
 
 import numpy as np
 
-from ..bucketing import BucketSpec
 from ..envutil import env_int as _env_int
 from .kv_cache import PagedKVCache, KVCacheError, NULL_BLOCK
 from .scheduler import Scheduler, Sequence, RUNNING, FINISHED, EVICTED
+from .sampling import (TAG_SAMPLE, TAG_ACCEPT, TAG_DRAFT, row_keys,
+                       sample_and_probs, spec_accept,
+                       spec_accept_greedy)
 from ...observability.tracing import get_tracer
 from ...resilience import faults
 
 __all__ = ["LLMEngine"]
 
 
+def _make_step_fn(model, spec_k, sampled):
+    """Build the target step program body for (model, spec_k): ONE
+    program covering chunked prefill + decode + speculative verify
+    over the FLAT ragged layout — a packed ``[total_q_tokens]`` batch
+    (no per-sequence padding: a mixed step computes exactly the
+    tokens that exist).
+
+    ``sampled`` selects the variant: greedy (raw argmax accept, no
+    PRNG, no sorts — plain greedy traffic never pays sampling
+    arithmetic) or sampled (position-keyed PRNG + the full accept
+    rule). Inputs: tokens/positions/seq_ids/valid int32 [T] (packed);
+    block_tables int32 [S, MB]; win_idx int32 [S, K+1] — the flat
+    indices of each row's K+1 scored positions (host-computed);
+    draft_tokens int32 [S, K]; draft_probs f32 [S, K, V]; n_draft
+    int32 [S] (0 = plain row); sampling vectors [S] traced; counters
+    int32 [S] = the ABSOLUTE index of the first token each row could
+    emit (the PRNG anchor). Returns (tokens [S, K+1], n_accepted [S],
+    k_pages, v_pages): row i commits
+    ``tokens[i, :n_accepted[i] + 1]`` — for plain rows that is one
+    sampled/argmax token."""
+    import jax.numpy as jnp
+
+    def step(params, k_pages, v_pages, tokens, positions, seq_ids,
+             valid, block_tables, win_idx, draft_tokens, draft_probs,
+             n_draft, temperature, top_k, top_p, seeds, counters):
+        logits, k_pages2, v_pages2 = model.decode_flat(
+            params, tokens, positions, seq_ids, valid, k_pages,
+            v_pages, block_tables)
+        S = win_idx.shape[0]
+        K = spec_k
+        win = logits[win_idx]                         # [S, K+1, V]
+        if not sampled:
+            toks, n_acc = spec_accept_greedy(win, draft_tokens,
+                                             n_draft)
+            return toks, n_acc, k_pages2, v_pages2
+        seeds2 = jnp.broadcast_to(seeds[:, None], (S, K + 1))
+        ctr = counters[:, None] + jnp.arange(K + 1, dtype=jnp.int32)
+        accept_keys = row_keys(seeds2[:, :K], ctr[:, :K], TAG_ACCEPT)
+        sample_keys = row_keys(seeds2, ctr, TAG_SAMPLE)
+        toks, n_acc = spec_accept(
+            win, draft_tokens, draft_probs, n_draft, temperature,
+            top_k, top_p, accept_keys, sample_keys)
+        return toks, n_acc, k_pages2, v_pages2
+
+    return step
+
+
+def _make_draft_fn(model, sampled):
+    """Build the draft proposal program body: the same flat layout
+    against the draft cache, returning one proposal per row plus
+    (sampled variant) the full adjusted probability vector the accept
+    rule needs. The greedy variant proposes by raw argmax — the
+    greedy accept rule never reads probabilities, so it returns zeros
+    there. ``last_idx`` int32 [S]: the flat index of each row's last
+    fed token (0 for inactive rows; outputs discarded)."""
+    import jax.numpy as jnp
+
+    def draft(params, k_pages, v_pages, tokens, positions, seq_ids,
+              valid, block_tables, last_idx, temperature, top_k,
+              top_p, seeds, counters):
+        logits, k_pages2, v_pages2 = model.decode_flat(
+            params, tokens, positions, seq_ids, valid, k_pages,
+            v_pages, block_tables)
+        last_logits = logits[last_idx]                # [S, V]
+        if not sampled:
+            toks = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            probs = jnp.zeros_like(last_logits)
+            return toks, probs, k_pages2, v_pages2
+        keys = row_keys(seeds, counters, TAG_DRAFT)
+        toks, probs = sample_and_probs(last_logits, temperature,
+                                       top_k, top_p, keys)
+        return toks, probs, k_pages2, v_pages2
+
+    return draft
+
+
+def _cached_program(model, kind, key, build):
+    """One jitted program per (model, kind, key), cached ON the model
+    object: engines sharing a model (server restart, test fixtures,
+    fleet replicas) reuse compiled code instead of re-tracing — XLA
+    already caches per shape inside the jit, this makes the jit
+    object itself survive the engine."""
+    progs = model.__dict__.setdefault("_mxtpu_llm_programs", {})
+    full = (kind,) + key
+    if full not in progs:
+        progs[full] = build()
+    return progs[full]
+
+
 class LLMEngine:
-    """Token-level scheduler + fixed-shape jitted prefill/decode.
+    """Token-level scheduler + ONE fixed-shape jitted chunked step.
 
     ``model`` provides ``num_layers/num_heads/head_dim/vocab_size/
-    max_context`` plus the pure functions ``forward(params, tokens)``
-    and ``decode_step(params, tokens, positions, k_pages, v_pages,
-    block_tables, kv_lens)`` (see :class:`~.model.TinyDecoder`, the
-    reference implementation). ``params`` is its pytree.
+    max_context`` plus the pure function ``decode_chunk(params,
+    tokens, positions, q_lens, k_pages, v_pages, block_tables,
+    kv_lens)`` (see :class:`~.model.TinyDecoder`, the reference
+    implementation). ``params`` is its pytree.
 
     Config resolution: constructor arg > ``MXNET_TPU_LLM_*`` env var >
-    default. ``max_context`` must be a multiple of ``block_size`` (the
-    top prefill bucket is the full context); ``num_blocks`` must leave
-    room for at least one full-context sequence, which also guarantees
-    a lone sequence can never deadlock on allocation.
+    default. ``max_context`` must be a multiple of ``block_size`` (a
+    preempted near-full prompt must re-prefill whole); ``num_blocks``
+    must leave room for at least one full-context sequence, which also
+    guarantees a lone sequence can never deadlock on allocation.
+    ``prefill_chunk`` (``MXNET_TPU_LLM_PREFILL_CHUNK``) sets how many
+    prompt tokens one step writes; ``draft_model``/``draft_params`` +
+    ``spec_k`` (``MXNET_TPU_LLM_SPEC_K``) enable speculative decoding
+    (the draft must share the target's vocab and cover its context).
     """
 
     def __init__(self, model, params, max_seqs=None, block_size=None,
-                 num_blocks=None, max_context=None,
-                 prefill_buckets=None, stats=None, dtype="float32",
-                 breaker=None):
+                 num_blocks=None, max_context=None, prefill_chunk=None,
+                 draft_model=None, draft_params=None, spec_k=None,
+                 stats=None, dtype="float32", breaker=None):
         import jax
         import jax.numpy as jnp
         self.model = model
@@ -83,8 +201,7 @@ class LLMEngine:
         if max_context % block_size:
             raise ValueError(
                 f"max_context {max_context} must be a multiple of "
-                f"block_size {block_size} (the top prefill bucket is "
-                "the full context)")
+                f"block_size {block_size}")
         blocks_per_seq = max_context // block_size
         if num_blocks is None:
             num_blocks = _env_int(
@@ -96,114 +213,178 @@ class LLMEngine:
                 f"sequence ({blocks_per_seq} blocks + the null block)")
         self.max_seqs = int(max_seqs)
         self.max_context = int(max_context)
+        if prefill_chunk is None:
+            prefill_chunk = _env_int("MXNET_TPU_LLM_PREFILL_CHUNK", 16)
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.prefill_chunk = min(int(prefill_chunk), self.max_context)
+        if spec_k is None:
+            spec_k = _env_int("MXNET_TPU_LLM_SPEC_K",
+                              3 if draft_model is not None else 0)
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        self.spec_k = int(spec_k) if draft_model is not None else 0
+        self.draft_model = draft_model if self.spec_k > 0 else None
+        # per-row query budget: a prefill chunk or a K+1-position
+        # speculative verify, whichever is wider
+        self.q_tokens = max(self.prefill_chunk, self.spec_k + 1)
+        # the FLAT step packs every row's query tokens into one
+        # [total_q_tokens] batch — no per-sequence padding, so a
+        # mixed step computes exactly the tokens that exist. The
+        # packed length is bucketed on a three-rung ladder (all-rows
+        # decode/verify, half batch, full batch), and the BLOCK-TABLE
+        # width on a two-rung ladder (a dispatch whose longest row
+        # holds half the table attends over half the pages). Every
+        # (t, mb, variant) rung is warmed, so selection is
+        # recompile-free.
+        t_lo = self.max_seqs * (self.spec_k + 1)
+        t_hi = max(t_lo, self.max_seqs * self.q_tokens)
+        # the middle rungs are the EXACT packed lengths of the
+        # commonest mixed steps — one or two rows mid-prefill while
+        # the rest decode/verify — so those steps dispatch pad-free
+        mids = {min(t_hi, i * self.q_tokens
+                    + (self.max_seqs - i) * (self.spec_k + 1))
+                for i in (1, 2) if i <= self.max_seqs}
+        self._t_buckets = sorted({t_lo, t_hi} | mids)
+        # draft feeds are 1-2 tokens per row in steady state
+        # (catch-up + proposal) and chunk-wide during prefill
+        # mirroring
+        d_lo = self.max_seqs * min(2, self.q_tokens)
+        self._draft_t_buckets = sorted(
+            {d_lo, t_hi} | {max(d_lo, m) for m in mids})
+        mb = max_context // block_size
+        self._mb_widths = sorted({max(1, -(-mb // 2)), mb})
         self.cache = PagedKVCache(
             model.num_layers, model.num_heads, model.head_dim,
             block_size, num_blocks, max_context, dtype=dtype)
         self.scheduler = Scheduler(self.max_seqs)
-        if prefill_buckets is None:
-            env = os.environ.get("MXNET_TPU_LLM_PREFILL_BUCKETS")
-            if env:
-                prefill_buckets = [int(b) for b in env.split(",")
-                                   if b.strip()]
-        if prefill_buckets is not None:
-            spec = BucketSpec(prefill_buckets, axis=0)
-            bad = [b for b in spec.buckets
-                   if b % block_size or b > max_context]
-            if bad:
-                raise ValueError(
-                    f"prefill buckets {bad} must be multiples of "
-                    f"block_size {block_size} and <= max_context "
-                    f"{max_context}")
-            if spec.max_size < max_context:
-                raise ValueError(
-                    f"largest prefill bucket {spec.max_size} must "
-                    f"cover max_context {max_context} (preemption can "
-                    "requeue near-full prompts)")
-            self.prefill_spec = spec
-        else:
-            self.prefill_spec = BucketSpec.pow2(
-                max_context, axis=0, multiple_of=block_size)
         self._stats = stats
         self._params = jax.tree_util.tree_map(jnp.asarray, params)
         # donation is a TPU/HBM lever; CPU backends ignore it with a
         # warning per call site, so only request it where it works
         from ...ops.flash_attention import _on_tpu
         donate = (1, 2) if _on_tpu() else ()
-        self._decode_jit = jax.jit(self._decode_impl,
-                                   donate_argnums=donate)
-        self._prefill_jit = jax.jit(self._prefill_impl,
-                                    donate_argnums=donate)
+        # two VARIANTS (greedy / sampled) x two widths of the one
+        # step program — all warmed, so variant+width selection at
+        # dispatch time is recompile-free. Cached on the model object
+        # so engines sharing a model reuse compiled programs.
+        self._step_jits = {
+            sampled: _cached_program(
+                model, "step", (self.spec_k, sampled, donate),
+                lambda s=sampled: jax.jit(
+                    _make_step_fn(model, self.spec_k, s),
+                    donate_argnums=donate))
+            for sampled in (False, True)}
+        if self.draft_model is not None:
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab_size} != target "
+                    f"vocab {model.vocab_size}")
+            if draft_model.max_context < self.max_context:
+                raise ValueError(
+                    f"draft max_context {draft_model.max_context} < "
+                    f"engine max_context {self.max_context}")
+            # the draft's pages are indexed by the SAME block ids the
+            # target allocator hands out — its own allocator is never
+            # touched, so there is exactly one strict accounting
+            self.draft_cache = PagedKVCache(
+                draft_model.num_layers, draft_model.num_heads,
+                draft_model.head_dim, block_size, num_blocks,
+                max_context, dtype=dtype)
+            self._draft_params = jax.tree_util.tree_map(
+                jnp.asarray, draft_params)
+            self._draft_jits = {
+                sampled: _cached_program(
+                    draft_model, "draft", (sampled, donate),
+                    lambda s=sampled: jax.jit(
+                        _make_draft_fn(draft_model, s),
+                        donate_argnums=donate))
+                for sampled in (False, True)}
+        else:
+            self.draft_cache = None
         self._warmed = False
+        # reusable per-width host batch buffers (target + draft) and
+        # a shared position ramp — per-step host allocations compete
+        # directly with XLA for the core on small hosts
+        self._bufs = {}
+        self._draft_bufs = {}
+        self._arange = np.arange(self.q_tokens, dtype=np.int32)
+        self._device_get = jax.device_get
         # circuit breaker (shared with the server): successful
-        # prefill/decode dispatches close it, failing ones trip it —
-        # the server's submit path rejects while it is open
+        # step dispatches close it, failing ones trip it — the
+        # server's submit path rejects while it is open
         self._breaker = breaker
         # sequences finished but not yet handed to the caller — kept
         # OUTSIDE step()'s local event list so a step that finishes A
-        # and then raises on B's prefill cannot lose A (the server
-        # drains this in its error path too)
+        # and then raises on B cannot lose A (the server drains this
+        # in its error path too)
         self._finished_pending = []
         # (seq, reason) whose deadline expired / cancel was requested —
         # the server resolves them with DeadlineExceededError
         self._dead_pending = []
-        # (seq, exc) isolated out of a failing prefill/decode dispatch —
-        # the server resolves them with the ORIGINAL exception
+        # (seq, exc) isolated out of a failing dispatch — the server
+        # resolves them with the ORIGINAL exception
         self._poison_pending = []
-
-    # ---------------------------------------------- jitted programs --
-    def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
-                     block_tables, kv_lens):
-        import jax.numpy as jnp
-        logits, k_pages, v_pages = self.model.decode_step(
-            params, tokens, positions, k_pages, v_pages, block_tables,
-            kv_lens)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return nxt, k_pages, v_pages
-
-    def _prefill_impl(self, params, k_pages, v_pages, tokens,
-                      block_ids, t_real):
-        import jax.numpy as jnp
-        logits, k, v = self.model.forward(params, tokens[None, :])
-        L, _, Tp, H, D = k.shape
-        bs = k_pages.shape[2]
-        nb = block_ids.shape[0]
-        k = k[:, 0].reshape(L, nb, bs, H, D).astype(k_pages.dtype)
-        v = v[:, 0].reshape(L, nb, bs, H, D).astype(v_pages.dtype)
-        # padded tail blocks target the null page; real blocks land
-        # page-aligned because every prefill bucket is a block multiple
-        k_pages = k_pages.at[:, block_ids].set(k)
-        v_pages = v_pages.at[:, block_ids].set(v)
-        first = jnp.argmax(logits[0, t_real - 1]).astype(jnp.int32)
-        return first, k_pages, v_pages
 
     # ------------------------------------------------------- warmup --
     def warmup(self):
-        """Compile every program steady state can reach: one prefill
-        per length bucket + the one decode shape. Returns
-        {'prefill_<bucket>'|'decode': seconds}. After this, a mixed
-        prefill/decode workload cannot recompile."""
+        """Compile every program steady state can reach: the chunked
+        step at each of its two widths (+ the draft program's widths
+        when speculation is on). Returns {'step_qN'|'draft_qN':
+        seconds}. After this, a mixed chunked-prefill / sampled-decode
+        / speculative workload cannot recompile."""
         timings = {}
-        S, MB = self.max_seqs, self.cache.max_blocks_per_seq
-        bs = self.cache.block_size
-        for bucket in self.prefill_spec:
-            toks = np.zeros(bucket, np.int32)
-            blocks = np.full(bucket // bs, NULL_BLOCK, np.int32)
-            t0 = time.monotonic()
-            first, kp, vp = self._prefill_jit(
-                self._params, self.cache.k_pages, self.cache.v_pages,
-                toks, blocks, np.int32(1))
-            self.cache.swap(kp, vp)
-            np.asarray(first)
-            timings[f"prefill_{bucket}"] = time.monotonic() - t0
-        t0 = time.monotonic()
-        nxt, kp, vp = self._decode_jit(
-            self._params, self.cache.k_pages, self.cache.v_pages,
-            np.zeros(S, np.int32), np.zeros(S, np.int32),
-            np.full((S, MB), NULL_BLOCK, np.int32),
-            np.ones(S, np.int32))
-        self.cache.swap(kp, vp)
-        np.asarray(nxt)
-        timings["decode"] = time.monotonic() - t0
+        S, K = self.max_seqs, self.spec_k
+        V = self.model.vocab_size
+        temp = np.zeros(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        top_p = np.ones(S, np.float32)
+        seeds = np.zeros(S, np.int32)
+        counters = np.zeros(S, np.int32)
+        if self.draft_model is not None:
+            for T in self._draft_t_buckets:
+                for MB in self._mb_widths:
+                    tables = np.full((S, MB), NULL_BLOCK, np.int32)
+                    for sampled in (False, True):
+                        t0 = time.monotonic()
+                        tok, probs, kp, vp = self._draft_jits[sampled](
+                            self._draft_params,
+                            self.draft_cache.k_pages,
+                            self.draft_cache.v_pages,
+                            np.zeros(T, np.int32),
+                            np.zeros(T, np.int32),
+                            np.zeros(T, np.int32),
+                            np.zeros(T, np.int32), tables,
+                            np.zeros(S, np.int32), temp, top_k,
+                            top_p, seeds, counters)
+                        self.draft_cache.swap(kp, vp)
+                        np.asarray(tok)
+                        tag = "sampled" if sampled else "greedy"
+                        timings[f"draft_t{T}mb{MB}_{tag}"] = \
+                            time.monotonic() - t0
+        for T in self._t_buckets:
+            for MB in self._mb_widths:
+                tables = np.full((S, MB), NULL_BLOCK, np.int32)
+                for sampled in (False, True):
+                    t0 = time.monotonic()
+                    toks, n_acc, kp, vp = self._step_jits[sampled](
+                        self._params, self.cache.k_pages,
+                        self.cache.v_pages,
+                        np.zeros(T, np.int32),
+                        np.zeros(T, np.int32),
+                        np.zeros(T, np.int32),
+                        np.zeros(T, np.int32), tables,
+                        np.zeros((S, K + 1), np.int32),
+                        np.zeros((S, K), np.int32),
+                        np.zeros((S, K, V), np.float32),
+                        np.zeros(S, np.int32), temp, top_k, top_p,
+                        seeds, counters)
+                    self.cache.swap(kp, vp)
+                    np.asarray(toks)
+                    tag = "sampled" if sampled else "greedy"
+                    timings[f"step_t{T}mb{MB}_{tag}"] = \
+                        time.monotonic() - t0
         self._warmed = True
         return timings
 
@@ -239,52 +420,11 @@ class LLMEngine:
             self._stats.record_admission_state(
                 self.scheduler.num_waiting, self.scheduler.num_running)
 
-    def _prefill(self, seq, slot):
-        tracer = get_tracer()
-        T = len(seq.prompt)
-        nb = self.cache.blocks_for(T)
-        blocks = self.cache.allocator.alloc(nb)
-        bucket = self.prefill_spec.pick(T)
-        toks, _ = self.prefill_spec.pad(
-            np.asarray(seq.prompt, np.int32), bucket)
-        bs = self.cache.block_size
-        block_arr = np.full(bucket // bs, NULL_BLOCK, np.int32)
-        block_arr[:nb] = blocks
-        with tracer.span("mxtpu.llm.prefill", "llm") as sp:
-            sp.set("seq_id", seq.seq_id)
-            sp.set("prompt", T)
-            sp.set("bucket", bucket)
-            try:
-                # chaos-harness site: scripted raises / injected
-                # latency for "prefill fails on this prompt"
-                faults.check("llm.prefill")
-                first, kp, vp = self._prefill_jit(
-                    self._params, self.cache.k_pages,
-                    self.cache.v_pages, toks, block_arr, np.int32(T))
-                self.cache.swap(kp, vp)
-                first = int(np.asarray(first))
-            except BaseException:
-                # the blocks are not yet on the sequence: return them
-                # or they leak past every later free path (BaseException:
-                # an InjectedCrash "worker death" must not leak either)
-                self.cache.allocator.free(blocks)
-                raise
-        self.scheduler.place(seq, slot)
-        seq.block_ids = blocks
-        seq.seq_len = T
-        seq.generated.append(first)
-        seq.last_token = first
-        if self._stats:
-            self._stats.record_prefill(T)
-            self._stats.record_prefill_token()
-        if seq.t_first_token is None:
-            seq.t_first_token = time.monotonic()
-            if self._stats:
-                self._stats.record_first_token(
-                    seq.t_first_token - seq.t_submit)
-        return first
-
     def _admit(self, events):
+        """Place waiting sequences into free slots. Conservative KV
+        gate (the full prompt + one decode block must fit) keeps FIFO
+        admission from thrashing the preemption path; the prompt's KV
+        is then written chunk-by-chunk by the regular step."""
         while self.scheduler.num_waiting:
             slot = self.scheduler.free_slot()
             if slot is None:
@@ -296,30 +436,10 @@ class LLMEngine:
                 need += 1           # first decode opens a new page
             if not self.cache.allocator.can_alloc(need):
                 break               # FIFO: no head-of-line skipping
-            try:
-                self._prefill(seq, slot)
-            except Exception as exc:
-                if self._pages_deleted():
-                    raise       # KV pool gone: isolation impossible
-                # poison prompt: isolate it — fail ONLY this sequence
-                # (the server resolves its Future with this original
-                # exception) and keep admitting the rest
-                if (self.scheduler.waiting
-                        and self.scheduler.waiting[0] is seq):
-                    self.scheduler.waiting.popleft()
-                self.scheduler.release(seq, EVICTED, "poison")
-                self._poison_pending.append((seq, exc))
-                if self._stats:
-                    self._stats.record_poison()
-                if self._breaker is not None:
-                    self._breaker.record_failure(site="prefill")
-                events.append(("poisoned", seq))
-                continue
-            if self._breaker is not None:
-                self._breaker.record_success(site="prefill")
+            self.scheduler.place(seq, slot)
+            seq.seq_len = 0
+            seq.draft_len = 0
             events.append(("admitted", seq))
-            if seq.done or seq.seq_len + 1 >= self.max_context:
-                self._finish(seq, events)
 
     def _finish(self, seq, events):
         self.cache.allocator.free(seq.block_ids)
@@ -341,12 +461,24 @@ class LLMEngine:
         if self._stats:
             self._stats.record_preemption()
 
+    def _poison(self, seq, exc, events):
+        """Release ``seq`` as poison-isolated: blocks freed, slot
+        freed, the ORIGINAL exception queued for the server."""
+        if seq.block_ids:
+            self.cache.allocator.free(seq.block_ids)
+            seq.block_ids = []
+        self.scheduler.release(seq, EVICTED, "poison")
+        self._poison_pending.append((seq, exc))
+        if self._stats:
+            self._stats.record_poison()
+        events.append(("poisoned", seq))
+
     def _expire(self, events):
         """Lifecycle scan: release sequences whose end-to-end deadline
         expired or whose caller cancelled them (generate timeout).
-        Waiting ones die before costing a prefill; running ones free
-        their KV blocks and decode slot immediately. The server turns
-        the ``(seq, reason)`` records into typed
+        Waiting ones die before costing any prefill work; running ones
+        free their KV blocks and decode slot immediately. The server
+        turns the ``(seq, reason)`` records into typed
         ``DeadlineExceededError`` resolutions carrying partial tokens."""
         now = time.monotonic()
         if self.scheduler.waiting:
@@ -376,6 +508,436 @@ class LLMEngine:
             self._dead_pending.append((seq, reason))
             events.append(("expired", seq))
 
+    # ----------------------------------------------------- planning --
+    def _plan(self, seq, events):
+        """This step's work for one running sequence: which committed
+        tokens feed the chunk, how many draft slots it gets, and the
+        KV end position the allocator must cover. Returns the plan
+        dict or None (sequence was poison-isolated at prefill start)."""
+        if not seq.generated:
+            # prefilling (fresh prompt or preemption-folded resume —
+            # folding moves the generation INTO the prompt, so an
+            # empty generation list is exactly "prompt not complete";
+            # a 1-token prompt is a 1-token chunk that emits)
+            committed = seq.prompt
+            cl = len(committed)
+            remaining = cl - seq.seq_len
+            if seq.seq_len == 0:
+                try:
+                    # chaos-harness site: scripted raises for "prefill
+                    # fails on this prompt" — checked once per prefill
+                    # start, isolating exactly the poison sequence
+                    faults.check("llm.prefill")
+                except Exception as exc:
+                    self._poison(seq, exc, events)
+                    if self._breaker is not None:
+                        self._breaker.record_failure(site="prefill")
+                    return None
+            ntok = min(self.prefill_chunk, remaining)
+            return {"kind": "prefill", "tokens":
+                    committed[seq.seq_len:seq.seq_len + ntok],
+                    "ntok": ntok, "cl": cl, "committed": committed,
+                    "k": 0, "emit": ntok == remaining,
+                    "draft_tokens": [], "draft_probs": []}
+        # decode: one committed token outstanding. Speculate when the
+        # draft's committed prefix can catch up within ONE chunk feed
+        # (steady state: 1-2 tokens behind; a degraded draft recovers
+        # over catch-up-only feeds first)
+        cl = len(seq.prompt) + len(seq.generated)
+        k = 0
+        committed = None
+        if self.draft_model is not None:
+            committed = seq.prompt + seq.generated
+            if cl - seq.draft_len <= self.q_tokens:
+                rem_new = seq.max_new_tokens - seq.num_generated
+                k = max(0, min(self.spec_k, rem_new - 1,
+                               self.max_context - 1 - seq.seq_len))
+        return {"kind": "decode", "tokens": [seq.last_token],
+                "ntok": 1 + k, "cl": cl, "committed": committed,
+                "k": k, "emit": True,
+                "draft_tokens": [], "draft_probs": []}
+
+    def _allocate(self, seq, plan, events):
+        """Blocks covering this step's KV writes (positions
+        ``seq_len .. seq_len + ntok - 1``), allocated ONTO the
+        sequence before any dispatch so every failure path frees them;
+        under pressure preempt newest-admitted first."""
+        need = self.cache.blocks_for(seq.seq_len + plan["ntok"]) \
+            - len(seq.block_ids)
+        while need > 0 and not self.cache.allocator.can_alloc(need):
+            victim = self.scheduler.pick_victim(exclude=(seq,))
+            if victim is None:
+                raise KVCacheError(
+                    "lone sequence cannot allocate — num_blocks too "
+                    "small for max_context")
+            self._preempt(victim)
+            events.append(("preempted", victim))
+        if need > 0:
+            seq.block_ids.extend(self.cache.allocator.alloc(need))
+
+    # -------------------------------------------------- draft phase --
+    def _draft_dispatch(self, rows, feeds, counters_v):
+        """One fixed-shape draft launch (narrow width for 1-2-token
+        proposal feeds, chunk width while mirroring prefill).
+        ``feeds``: {seq: (tokens, start_pos)}; rows not in it ride
+        along inactive. Returns (tokens [S], probs [S, V]) as numpy."""
+        S = self.max_seqs
+        t_need = sum(len(t) for t, _ in feeds.values())
+        T = next(w for w in self._draft_t_buckets if w >= t_need)
+        mb_need = max(self.cache.blocks_for(start + len(t))
+                      for t, start in feeds.values())
+        MB = next(w for w in self._mb_widths if w >= mb_need)
+        bufs = self._draft_bufs.get((T, MB))
+        if bufs is None:
+            bufs = (np.zeros(T, np.int32),            # tokens
+                    np.zeros(T, np.int32),            # positions
+                    np.zeros(T, np.int32),            # seq_ids
+                    np.zeros(T, np.int32),            # valid
+                    np.full((S, MB), NULL_BLOCK, np.int32),
+                    np.zeros(S, np.int32),            # last_idx
+                    np.zeros(S, np.float32), np.zeros(S, np.int32),
+                    np.ones(S, np.float32), np.zeros(S, np.int32),
+                    np.zeros(S, np.int32))
+            self._draft_bufs[(T, MB)] = bufs
+        (tokens, positions, seq_ids, valid, tables, last_idx, temp,
+         top_k, top_p, seeds, counters) = bufs
+        valid.fill(0)       # see _batch_buffers: never-stale writes
+        off = 0
+        for seq in rows:
+            feed = feeds.get(seq)
+            if feed is None:
+                continue
+            toks, start = feed
+            i, n = seq.slot, len(toks)
+            tokens[off:off + n] = toks
+            positions[off:off + n] = start + self._arange[:n]
+            seq_ids[off:off + n] = i
+            valid[off:off + n] = 1
+            last_idx[i] = off + n - 1
+            nb = min(len(seq.block_ids), MB)
+            tables[i, :nb] = seq.block_ids[:nb]
+            tables[i, nb:] = NULL_BLOCK
+            sp = seq.sampling
+            temp[i] = sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+            seeds[i] = sp.seed
+            counters[i] = counters_v.get(seq, 0)
+            off += n
+        # chaos-harness site: scripted raises / injected latency /
+        # worker death mid-verify
+        faults.check("llm.draft")
+        sampled = any(s.sampling.temperature > 0 for s in feeds)
+        tok, probs, kp, vp = self._draft_jits[sampled](
+            self._draft_params, self.draft_cache.k_pages,
+            self.draft_cache.v_pages, tokens, positions, seq_ids,
+            valid, tables, last_idx, temp, top_k, top_p, seeds,
+            counters)
+        self.draft_cache.swap(kp, vp)
+        return self._device_get((tok, probs))
+
+    def _draft_propose(self, rows, plans):
+        """Run the draft model: mirror prefill chunks into the draft
+        cache, catch its committed prefix up, and propose up to K
+        tokens per speculative row (stored on the row's plan). A
+        failing draft dispatch DEGRADES the step to plain decode —
+        never poisons, never leaks (draft pages share the target's
+        block accounting)."""
+        if self.draft_model is None:
+            return
+        feeds, counters, proposing = {}, {}, []
+        for seq in rows:
+            plan = plans[seq]
+            if plan["kind"] == "prefill":
+                # mirror the target's chunk. Normally draft_len ==
+                # seq_len and this IS the same chunk; after a
+                # degraded draft step the mirror restarts from the
+                # draft's own watermark so its KV prefix never gaps
+                committed = plan["committed"]
+                end = min(seq.seq_len + plan["ntok"],
+                          seq.draft_len + self.q_tokens)
+                feeds[seq] = (committed[seq.draft_len:end],
+                              seq.draft_len)
+                plan["draft_fed"] = end - seq.draft_len
+            elif plan["k"] > 0:
+                # catch-up (bounded: <= 2 tokens in steady state) +
+                # the proposal input
+                feed = plan["committed"][seq.draft_len:plan["cl"]]
+                feeds[seq] = (feed, seq.draft_len)
+                plan["draft_fed"] = len(feed)
+                counters[seq] = plan["cl"]
+                proposing.append(seq)
+            elif seq.draft_len < plan["cl"]:
+                # a draft that fell behind (earlier degraded step):
+                # catch-up-only feed, one chunk per step, until the
+                # speculation gate in _plan re-opens
+                end = min(plan["cl"], seq.draft_len + self.q_tokens)
+                feeds[seq] = (plan["committed"][seq.draft_len:end],
+                              seq.draft_len)
+                plan["draft_fed"] = end - seq.draft_len
+        if not feeds:
+            return
+        try:
+            tok, probs = self._draft_dispatch(rows, feeds, counters)
+            for seq in proposing:
+                plans[seq]["draft_tokens"].append(int(tok[seq.slot]))
+                plans[seq]["draft_probs"].append(probs[seq.slot])
+            for r in range(1, self.spec_k):
+                feeds, counters = {}, {}
+                for seq in proposing:
+                    plan = plans[seq]
+                    if plan["k"] <= r:
+                        continue
+                    d_prev = plan["draft_tokens"][-1]
+                    feeds[seq] = ([d_prev], plan["cl"] + r - 1)
+                    counters[seq] = plan["cl"] + r
+                    plan["draft_fed"] += 1
+                if not feeds:
+                    break
+                tok, probs = self._draft_dispatch(rows, feeds,
+                                                  counters)
+                for seq in feeds:
+                    plans[seq]["draft_tokens"].append(
+                        int(tok[seq.slot]))
+                    plans[seq]["draft_probs"].append(probs[seq.slot])
+        except Exception:
+            if self._pages_deleted():
+                raise
+            # degrade: this step decodes without speculation; the
+            # draft prefix watermark is simply not advanced, so the
+            # next step's catch-up re-feeds deterministically
+            for seq in rows:
+                plan = plans[seq]
+                if plan["kind"] == "decode":
+                    plan["k"] = 0
+                    plan["ntok"] = 1
+                    plan["tokens"] = [seq.last_token]
+                    plan["draft_tokens"] = []
+                    plan["draft_probs"] = []
+                plan.pop("draft_fed", None)
+            if self._stats:
+                self._stats.record_spec_degraded()
+        else:
+            # proposals beyond what a row wanted never happen; trim
+            # the committed-token budget trackers
+            for seq in proposing:
+                plan = plans[seq]
+                plan["ntok"] = 1 + len(plan["draft_tokens"])
+                plan["k"] = len(plan["draft_tokens"])
+                plan["tokens"] = ([seq.last_token]
+                                  + plan["draft_tokens"])
+
+    # ------------------------------------------------- the one step --
+    def _batch_buffers(self, t, mb):
+        """Reusable host-side batch arrays for packed length ``t``
+        and block-table width ``mb`` (jax copies numpy inputs at the
+        call boundary, so reuse across dispatches is safe). ``valid``
+        is reset EVERY dispatch — a stale valid flag would scatter
+        garbage K/V through a stale (seq_id, position, table) combo
+        into blocks another sequence may own now; everything else
+        stale is masked or discarded."""
+        bufs = self._bufs.get((t, mb))
+        if bufs is None:
+            S, K = self.max_seqs, self.spec_k
+            V = self.model.vocab_size
+            bufs = (np.zeros(t, np.int32),            # tokens
+                    np.zeros(t, np.int32),            # positions
+                    np.zeros(t, np.int32),            # seq_ids
+                    np.zeros(t, np.int32),            # valid
+                    np.full((S, mb), NULL_BLOCK, np.int32),
+                    np.zeros((S, K + 1), np.int32),   # win_idx
+                    np.zeros((S, K), np.int32),       # draft tokens
+                    np.zeros((S, K, V), np.float32),  # draft probs
+                    np.zeros(S, np.int32),            # n_draft
+                    np.zeros(S, np.float32),          # temperature
+                    np.zeros(S, np.int32),            # top_k
+                    np.ones(S, np.float32),           # top_p
+                    np.zeros(S, np.int32),            # seeds
+                    np.zeros(S, np.int32))            # counters
+            self._bufs[(t, mb)] = bufs
+        return bufs
+
+    def _build_batch(self, rows, plans, t, mb):
+        bufs = self._batch_buffers(t, mb)
+        (tokens, positions, seq_ids, valid, tables, win_idx, d_toks,
+         d_probs, n_draft, temp, top_k, top_p, seeds,
+         counters) = bufs
+        valid.fill(0)
+        n_draft.fill(0)
+        off = 0
+        K1 = self.spec_k + 1
+        for seq in rows:
+            plan = plans[seq]
+            i, n = seq.slot, len(plan["tokens"])
+            tokens[off:off + n] = plan["tokens"]
+            positions[off:off + n] = seq.seq_len + self._arange[:n]
+            seq_ids[off:off + n] = i
+            valid[off:off + n] = 1
+            # the K+1 scored positions end at this row's last token
+            start = off + n - 1 - plan["k"]
+            win_idx[i] = np.clip(start + self._arange[:K1], 0, t - 1)
+            # blocks past the sliced width only cover positions the
+            # causal mask can never reach — truncation is invisible
+            nb = min(len(seq.block_ids), mb)
+            tables[i, :nb] = seq.block_ids[:nb]
+            tables[i, nb:] = NULL_BLOCK
+            k = plan["k"]
+            n_draft[i] = k
+            if k:
+                d_toks[i, :k] = plan["draft_tokens"]
+                d_probs[i, :k] = plan["draft_probs"]
+            sp = seq.sampling
+            temp[i] = sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+            seeds[i] = sp.seed
+            counters[i] = plan["cl"]
+            off += n
+        return bufs
+
+    def _dispatch(self, rows, plans):
+        """ONE fixed-shape launch for ``rows`` (slots not in ``rows``
+        ride along inactive on the null block — the shape, and
+        therefore the compiled program, never changes). Dispatch
+        failures propagate to the isolation logic in :meth:`step`."""
+        if any(plans[s]["kind"] == "decode" for s in rows):
+            # chaos-harness site: scripted raises / injected latency
+            faults.check("llm.decode")
+        t_need = sum(len(plans[s]["tokens"]) for s in rows)
+        t = next(w for w in self._t_buckets if w >= t_need)
+        mb_need = max(self.cache.blocks_for(
+            s.seq_len + plans[s]["ntok"]) for s in rows)
+        mb = next(w for w in self._mb_widths if w >= mb_need)
+        sampled = any(s.sampling.temperature > 0 for s in rows)
+        batch = self._build_batch(rows, plans, t, mb)
+        toks, n_acc, kp, vp = self._step_jits[sampled](
+            self._params, self.cache.k_pages, self.cache.v_pages,
+            *batch)
+        self.cache.swap(kp, vp)
+        return self._device_get((toks, n_acc))
+
+    def _sites(self, rows, plans):
+        sites = set()
+        for s in rows:
+            sites.add("prefill" if plans[s]["kind"] == "prefill"
+                      else "decode")
+        return sites
+
+    def _record_breaker(self, rows, plans, ok):
+        if self._breaker is None:
+            return
+        for site in self._sites(rows, plans):
+            if ok:
+                self._breaker.record_success(site=site)
+            else:
+                self._breaker.record_failure(site=site)
+
+    def _commit(self, rows, plans, toks, n_acc, events):
+        """Apply one successful dispatch's results to host state.
+        Returns the number of committed decode/verify tokens (the
+        throughput numerator; chunk-emitted first tokens are counted
+        by the prefill metrics)."""
+        decoded = 0
+        for seq in rows:
+            plan = plans[seq]
+            cl = plan["cl"]
+            if plan["kind"] == "prefill":
+                seq.seq_len += plan["ntok"]
+                if "draft_fed" in plan:
+                    seq.draft_len += plan["draft_fed"]
+                if self._stats:
+                    self._stats.record_prefill_chunk(plan["ntok"])
+                if not plan["emit"]:
+                    continue
+                # the prompt completed: its last position's logits
+                # sampled the first generated token
+                tok = int(toks[seq.slot, 0])
+                seq.generated.append(tok)
+                seq.last_token = tok
+                events.append(("token", seq))
+                if self._stats:
+                    self._stats.record_prefill(cl)
+                    self._stats.record_prefill_token()
+                if seq.t_first_token is None:
+                    seq.t_first_token = time.monotonic()
+                    if self._stats:
+                        self._stats.record_first_token(
+                            seq.t_first_token - seq.t_submit)
+                if seq.done or seq.seq_len + 1 >= self.max_context:
+                    self._finish(seq, events)
+                continue
+            # decode / speculative verify: commit the accepted drafts
+            # plus the replacement/bonus token, truncating at stop /
+            # max_new_tokens
+            kept = 0
+            for j in range(int(n_acc[seq.slot]) + 1):
+                tok = int(toks[seq.slot, j])
+                seq.generated.append(tok)
+                seq.last_token = tok
+                kept += 1
+                events.append(("token", seq))
+                if seq.done:
+                    break
+            seq.seq_len += kept
+            decoded += kept
+            if plan["k"]:
+                if self._stats:
+                    self._stats.record_spec(plan["k"],
+                                            int(n_acc[seq.slot]))
+                # roll rejected draft KV back through the STRICT
+                # allocator: blocks past the committed length return
+                # to the pool (their garbage can never be read — the
+                # kv_lens mask stops at seq_len, and a re-allocated
+                # block is rewritten before any mask exposes it)
+                seq.draft_len = min(cl + plan["k"] - 1, cl + kept - 1)
+                keep_blocks = self.cache.blocks_for(
+                    max(seq.seq_len, 1))
+                if len(seq.block_ids) > keep_blocks:
+                    self.cache.allocator.free(
+                        seq.block_ids[keep_blocks:])
+                    del seq.block_ids[keep_blocks:]
+            elif "draft_fed" in plan:
+                # catch-up-only feed advanced the draft prefix
+                seq.draft_len += plan["draft_fed"]
+            if seq.state == RUNNING and (
+                    seq.done or seq.seq_len + 1 >= self.max_context):
+                self._finish(seq, events)
+        return decoded
+
+    def _isolate(self, rows, plans, events):
+        """Bisect-retry a failing dispatch to isolate the poison
+        row(s): halves re-dispatch through the SAME fixed-shape
+        program (no recompiles); a failing singleton is evicted with
+        its dispatch exception, everything else keeps its tokens.
+        Returns the committed decode-token count."""
+        if len(rows) == 1:
+            try:
+                toks, n_acc = self._dispatch(rows, plans)
+            except Exception as exc:
+                if self._pages_deleted():
+                    raise       # KV pool gone mid-bisect: fatal
+                self._poison(rows[0], exc, events)
+                return 0
+            # a successful sub-dispatch proves the backend is healthy:
+            # recurring poison rows isolate forever without ever
+            # accumulating into a breaker trip
+            self._record_breaker(rows, plans, True)
+            return self._commit(rows, plans, toks, n_acc, events)
+        decoded = 0
+        mid = len(rows) // 2
+        for half in (rows[:mid], rows[mid:]):
+            try:
+                toks, n_acc = self._dispatch(half, plans)
+            except Exception:
+                if self._pages_deleted():
+                    raise       # KV pool gone mid-bisect: fatal
+                decoded += self._isolate(half, plans, events)
+            else:
+                self._record_breaker(half, plans, True)
+                decoded += self._commit(half, plans, toks, n_acc,
+                                        events)
+        return decoded
+
     # --------------------------------------------------------- step --
     def _pages_deleted(self):
         """True when the KV page buffers were consumed by a FAILED
@@ -385,90 +947,16 @@ class LLMEngine:
         verdict — so the isolation paths treat this as fatal engine
         state and re-raise instead, letting the server's worker-death
         cleanup resolve every Future typed."""
-        is_del = getattr(self.cache.k_pages, "is_deleted", None)
-        try:
-            return bool(is_del and is_del())
-        except Exception:       # non-jax array backends
-            return False
-
-    def _decode_batch(self, seqs):
-        """ONE fixed-shape decode launch for ``seqs`` (slots not in
-        ``seqs`` ride along inactive on the null block — the shape, and
-        therefore the compiled program, never changes). Returns the
-        next-token array indexed by slot; dispatch failures propagate
-        to the isolation logic in :meth:`step`."""
-        S, MB = self.max_seqs, self.cache.max_blocks_per_seq
-        toks = np.zeros(S, np.int32)
-        pos = np.zeros(S, np.int32)
-        lens = np.ones(S, np.int32)
-        tables = np.full((S, MB), NULL_BLOCK, np.int32)
-        for seq in seqs:
-            i = seq.slot
-            toks[i] = seq.last_token
-            pos[i] = seq.seq_len
-            lens[i] = seq.seq_len + 1
-            tables[i] = self.cache.table_row(seq.block_ids)
-        # chaos-harness site: scripted raises / injected latency
-        faults.check("llm.decode")
-        nxt, kp, vp = self._decode_jit(
-            self._params, self.cache.k_pages, self.cache.v_pages,
-            toks, pos, tables, lens)
-        self.cache.swap(kp, vp)
-        return np.asarray(nxt)
-
-    def _apply_tokens(self, seqs, nxt, events):
-        for seq in seqs:
-            tok = int(nxt[seq.slot])
-            seq.generated.append(tok)
-            seq.seq_len += 1
-            seq.last_token = tok
-            events.append(("token", seq))
-            if seq.done or seq.seq_len + 1 >= self.max_context:
-                self._finish(seq, events)
-
-    def _decode_isolate(self, seqs, events):
-        """Bisect-retry a failing decode dispatch to isolate the
-        poison row(s): halves re-dispatch through the SAME fixed-shape
-        program (no recompiles); a failing singleton is evicted with
-        its dispatch exception, everything else keeps its token.
-        Returns the sequences that made progress."""
-        if len(seqs) == 1:
+        for cache in (self.cache, self.draft_cache):
+            if cache is None:
+                continue
+            is_del = getattr(cache.k_pages, "is_deleted", None)
             try:
-                nxt = self._decode_batch(seqs)
-            except Exception as exc:
-                if self._pages_deleted():
-                    raise       # KV pool gone mid-bisect: fatal
-                seq = seqs[0]
-                self.cache.allocator.free(seq.block_ids)
-                seq.block_ids = []
-                self.scheduler.release(seq, EVICTED, "poison")
-                self._poison_pending.append((seq, exc))
-                if self._stats:
-                    self._stats.record_poison()
-                events.append(("poisoned", seq))
-                return []
-            # a successful sub-dispatch proves the backend is healthy:
-            # recurring poison rows isolate forever without ever
-            # accumulating into a breaker trip
-            if self._breaker is not None:
-                self._breaker.record_success(site="decode")
-            self._apply_tokens(seqs, nxt, events)
-            return list(seqs)
-        applied = []
-        mid = len(seqs) // 2
-        for half in (seqs[:mid], seqs[mid:]):
-            try:
-                nxt = self._decode_batch(half)
-            except Exception:
-                if self._pages_deleted():
-                    raise       # KV pool gone mid-bisect: fatal
-                applied += self._decode_isolate(half, events)
-            else:
-                if self._breaker is not None:
-                    self._breaker.record_success(site="decode")
-                self._apply_tokens(half, nxt, events)
-                applied += half
-        return applied
+                if bool(is_del and is_del()):
+                    return True
+            except Exception:       # non-jax array backends
+                pass
+        return False
 
     def step(self):
         """One engine iteration. Returns events:
@@ -480,50 +968,48 @@ class LLMEngine:
         self._admit(events)
         running = sorted(self.scheduler.running(),
                          key=lambda s: s.admit_index)
-        if not running:
-            self._record_block_gauges()
-            return events
-        # a sequence whose next position starts a new page needs a
-        # block now; under pressure preempt newest-admitted first
+        plans = {}
         for seq in running:
             if seq.state != RUNNING:
                 continue            # preempted by an earlier victim
-            if seq.seq_len % self.cache.block_size == 0:
-                while not self.cache.allocator.can_alloc(1):
-                    victim = self.scheduler.pick_victim(exclude=(seq,))
-                    if victim is None:
-                        raise KVCacheError(
-                            "lone sequence cannot allocate — "
-                            "num_blocks too small for max_context")
-                    self._preempt(victim)
-                    events.append(("preempted", victim))
-                seq.block_ids.append(self.cache.allocator.alloc(1)[0])
-        running = [s for s in running if s.state == RUNNING]
-        if not running:
+            plan = self._plan(seq, events)
+            if plan is None:
+                continue            # poison-isolated at prefill start
+            self._allocate(seq, plan, events)
+            plans[seq] = plan
+        rows = [s for s in running
+                if s.state == RUNNING and s in plans]
+        if not rows:
+            self._record_block_gauges()
+            return events
+        self._draft_propose(rows, plans)
+        rows = [s for s in rows if s.state == RUNNING]
+        if not rows:
             self._record_block_gauges()
             return events
         t0 = time.monotonic()
-        with tracer.span("mxtpu.llm.decode_step", "llm") as sp:
-            sp.set("running", len(running))
+        with tracer.span("mxtpu.llm.step", "llm") as sp:
+            sp.set("running", len(rows))
+            sp.set("prefilling", sum(
+                1 for s in rows if plans[s]["kind"] == "prefill"))
             try:
-                nxt = self._decode_batch(running)
+                toks, n_acc = self._dispatch(rows, plans)
             except Exception as exc:
                 if self._pages_deleted():
                     raise       # KV pool gone: isolation impossible
                 sp.set("error", repr(exc))
-                if self._breaker is not None:
-                    self._breaker.record_failure(site="decode")
+                self._record_breaker(rows, plans, False)
                 with tracer.span("mxtpu.llm.isolate", "llm") as isp:
-                    isp.set("n", len(running))
-                    advanced = self._decode_isolate(running, events)
+                    isp.set("n", len(rows))
+                    decoded = self._isolate(rows, plans, events)
             else:
-                if self._breaker is not None:
-                    self._breaker.record_success(site="decode")
-                self._apply_tokens(running, nxt, events)
-                advanced = running
+                self._record_breaker(rows, plans, True)
+                decoded = self._commit(rows, plans, toks, n_acc,
+                                       events)
         step_s = time.monotonic() - t0
-        if self._stats:
-            self._stats.record_decode_step(len(advanced), step_s)
+        if self._stats and any(plans[s]["kind"] == "decode"
+                               for s in rows if s in plans):
+            self._stats.record_decode_step(decoded, step_s)
         self._record_block_gauges()
         return events
 
@@ -550,9 +1036,12 @@ class LLMEngine:
     # -------------------------------------------------------- drain --
     def evict_all(self, reason="evicted"):
         """Release every live sequence (running AND waiting) into the
-        EVICTED state, freeing its blocks. Returns the evicted
-        sequences — the server turns them into
-        ``SequenceEvictedError`` resolutions, never silent drops."""
+        EVICTED state, freeing its blocks — including blocks a
+        sequence dying mid-verify still holds for speculative
+        positions (the draft cache shares them, so one free covers
+        both pools). Returns the evicted sequences — the server turns
+        them into ``SequenceEvictedError`` resolutions carrying
+        partial tokens, never silent drops."""
         out = []
         for seq in self.scheduler.running():
             self.cache.allocator.free(seq.block_ids)

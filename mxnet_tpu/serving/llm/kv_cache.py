@@ -11,8 +11,9 @@ handed out on demand:
   *block table* — an int32 row of page indices, padded with the
   reserved NULL block 0;
 - the attention kernel indirects every KV read through the block
-  table (:mod:`mxnet_tpu.ops.ragged_attention`), so blocks never need
-  to be contiguous or ordered;
+  table (:mod:`mxnet_tpu.ops.ragged_attention` — one multi-token
+  chunk shape for prefill, decode and speculative verify), so blocks
+  never need to be contiguous or ordered;
 - block 0 is never allocated: padded table entries, whole padded tail
   BLOCKS of a bucketed prompt, and inactive batch rows all point at
   it. Note the protection boundary precisely: pad positions that land

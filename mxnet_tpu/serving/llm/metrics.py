@@ -83,6 +83,30 @@ class LLMStats:
             "mxtpu_llm_kv_blocks_total",
             "Usable KV cache blocks (pool minus the null block).",
             lbl).labels(**s)
+        self._prefill_chunks = r.counter(
+            "mxtpu_llm_prefill_chunk_total",
+            "Prompt chunks written through the unified step (chunked "
+            "prefill).", lbl).labels(**s)
+        self._prefill_chunk_tokens = r.counter(
+            "mxtpu_llm_prefill_chunk_tokens_total",
+            "Prompt tokens written by prefill chunks (pad excluded).",
+            lbl).labels(**s)
+        self._spec_proposed = r.counter(
+            "mxtpu_llm_spec_proposed_total",
+            "Draft tokens proposed for speculative verification.",
+            lbl).labels(**s)
+        self._spec_accepted = r.counter(
+            "mxtpu_llm_spec_accept_total",
+            "Draft tokens accepted by the target verify step.",
+            lbl).labels(**s)
+        self._spec_degraded = r.counter(
+            "mxtpu_llm_spec_degraded_total",
+            "Steps that fell back to plain decode after a draft "
+            "dispatch failure.", lbl).labels(**s)
+        self._spec_accept_rate = r.gauge(
+            "mxtpu_llm_spec_accept_rate",
+            "Cumulative draft-token acceptance rate "
+            "(accepted / proposed).", lbl).labels(**s)
         self._tps = r.gauge(
             "mxtpu_llm_tokens_per_sec",
             "Decode throughput: smoothed per-step rate (EMA over "
@@ -160,6 +184,21 @@ class LLMStats:
             self._tokens.inc()
             self._gen_count += 1
 
+    def record_prefill_chunk(self, tokens):
+        self._prefill_chunks.inc()
+        self._prefill_chunk_tokens.inc(tokens)
+
+    def record_spec(self, proposed, accepted):
+        self._spec_proposed.inc(proposed)
+        self._spec_accepted.inc(accepted)
+        total = self._spec_proposed.value
+        if total > 0:
+            self._spec_accept_rate.set(
+                self._spec_accepted.value / total)
+
+    def record_spec_degraded(self):
+        self._spec_degraded.inc()
+
     def record_preemption(self):
         self._preemptions.inc()
 
@@ -211,6 +250,13 @@ class LLMStats:
                 "tokens_generated": int(self._tokens.value),
                 "prefill_tokens": int(self._prefill_tokens.value),
                 "prefills": int(self._prefills.value),
+                "prefill_chunks": int(self._prefill_chunks.value),
+                "prefill_chunk_tokens": int(
+                    self._prefill_chunk_tokens.value),
+                "spec_proposed": int(self._spec_proposed.value),
+                "spec_accepted": int(self._spec_accepted.value),
+                "spec_degraded": int(self._spec_degraded.value),
+                "spec_accept_rate": self._spec_accept_rate.value,
                 "decode_steps": int(self._decode_steps.value),
                 "preemptions": int(self._preemptions.value),
                 "queue_depth": int(self._queue_depth.value),

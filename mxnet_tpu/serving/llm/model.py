@@ -23,9 +23,12 @@ methods above) is what any decoder backend must provide.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
-from ...ops.ragged_attention import ragged_paged_attention
+from ...ops.ragged_attention import (ragged_paged_attention,
+                                     ragged_flat_attention)
 from ...ops.flash_attention import attention_reference
 
 __all__ = ["DecoderConfig", "TinyDecoder", "greedy_decode_reference"]
@@ -166,41 +169,54 @@ class TinyDecoder:
         return logits, jnp.stack(ks), jnp.stack(vs)
 
     # ------------------------------------------------------- decode --
-    def decode_step(self, params, tokens, positions, k_pages, v_pages,
-                    block_tables, kv_lens):
-        """One decode token per sequence against the paged cache.
+    def decode_chunk(self, params, tokens, positions, q_lens, k_pages,
+                     v_pages, block_tables, kv_lens):
+        """Up to Q tokens per sequence against the paged cache — the
+        ONE multi-query-token step chunked prefill, plain decode
+        (Q-slice of 1) and speculative verify all run through.
 
-        tokens/positions: int32 [S]; pages: [L, N, bs, H, Dh];
+        tokens/positions: int32 [S, Q]; q_lens: int32 [S] valid token
+        counts (0 = inactive row); pages: [L, N, bs, H, Dh];
         block_tables: int32 [S, MB]; kv_lens: int32 [S] — the valid
-        length INCLUDING the token being decoded (positions + 1 for
-        active rows, 1 for inactive rows over the null block).
+        length INCLUDING this chunk's tokens (so token ``t`` of row
+        ``i`` sits at absolute position ``kv_lens[i] - q_lens[i] + t``
+        and ``positions[i, t]`` must equal that for ``t < q_lens[i]``;
+        padded tails must carry an in-range position — the engine
+        clamps them to 0 and routes their K/V writes at the null
+        block).
 
-        Each layer first writes the new token's K/V at
-        ``(block_tables[i, pos // bs], pos % bs)`` — padding/inactive
-        rows target the null block — then attends over the whole paged
-        history. Returns (logits [S, V], k_pages, v_pages).
+        Each layer first scatters the chunk's K/V at
+        ``(block_tables[i, pos // bs], pos % bs)`` — padded tokens and
+        inactive rows target the null block — then attends CAUSALLY
+        over the paged history through the chunk kernel. Returns
+        (logits [S, Q, V], k_pages, v_pages).
         """
         import jax
         import jax.numpy as jnp
         c = self.config
-        S = tokens.shape[0]
+        S, Q = tokens.shape
         bs = k_pages.shape[2]
-        rows = jnp.arange(S)
-        bidx = block_tables[rows, positions // bs]     # [S] page ids
-        slot = positions % bs
+        valid = (jnp.arange(Q, dtype=jnp.int32)[None, :]
+                 < q_lens[:, None])                    # [S, Q]
+        bidx = jnp.where(valid,
+                         jnp.take_along_axis(block_tables,
+                                             positions // bs, axis=1),
+                         0)                            # null block
+        slot = jnp.where(valid, positions % bs, 0)
         h = params["embed"][tokens] + params["pos"][positions]
         for li, lp in enumerate(params["layers"]):
             x = _layer_norm(h, lp["ln1_g"], lp["ln1_b"])
-            q = (x @ lp["wq"]).reshape(S, c.num_heads, c.head_dim)
-            k = (x @ lp["wk"]).reshape(S, c.num_heads, c.head_dim)
-            v = (x @ lp["wv"]).reshape(S, c.num_heads, c.head_dim)
+            q = (x @ lp["wq"]).reshape(S, Q, c.num_heads, c.head_dim)
+            k = (x @ lp["wk"]).reshape(S, Q, c.num_heads, c.head_dim)
+            v = (x @ lp["wv"]).reshape(S, Q, c.num_heads, c.head_dim)
             k_pages = k_pages.at[li, bidx, slot].set(
                 k.astype(k_pages.dtype))
             v_pages = v_pages.at[li, bidx, slot].set(
                 v.astype(v_pages.dtype))
             att = ragged_paged_attention(q, k_pages[li], v_pages[li],
-                                         block_tables, kv_lens)
-            h = h + att.reshape(S, c.d_model) @ lp["wo"]
+                                         block_tables, kv_lens,
+                                         q_lens=q_lens)
+            h = h + att.reshape(S, Q, c.d_model) @ lp["wo"]
             x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
             h = h + jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"] \
                 + lp["b2"]
@@ -208,33 +224,147 @@ class TinyDecoder:
                              params["lnf_b"]) @ params["head"]
         return logits, k_pages, v_pages
 
+    def decode_flat(self, params, tokens, positions, seq_ids, valid,
+                    k_pages, v_pages, block_tables):
+        """The FLAT ragged step: a packed ``[T]`` batch of query
+        tokens from many sequences — no per-sequence padding, so a
+        mixed prefill/decode/verify step computes exactly the tokens
+        that exist (the "[total_q_tokens]" layout of the Ragged Paged
+        Attention paper; the engine's hot path).
+
+        tokens/positions/seq_ids: int32 [T] (packed; entries with
+        ``valid[t] == 0`` are bucket padding — their K/V writes
+        route to the null block and their outputs are garbage the
+        caller discards); valid: int32/bool [T]; block_tables: int32
+        [S, MB]. Causality is per token: token ``t`` attends over
+        positions ``<= positions[t]`` of sequence ``seq_ids[t]`` —
+        callers must have packed each sequence's tokens in position
+        order so later chunk tokens see earlier ones' writes.
+        Returns (logits [T, V], k_pages, v_pages).
+        """
+        import jax
+        import jax.numpy as jnp
+        c = self.config
+        T = tokens.shape[0]
+        bs = k_pages.shape[2]
+        vmask = valid.astype(bool)
+        bidx = jnp.where(
+            vmask,
+            block_tables[seq_ids, positions // bs], 0)  # null block
+        slot = jnp.where(vmask, positions % bs, 0)
+        h = params["embed"][tokens] + params["pos"][positions]
+        for li, lp in enumerate(params["layers"]):
+            x = _layer_norm(h, lp["ln1_g"], lp["ln1_b"])
+            q = (x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
+            k = (x @ lp["wk"]).reshape(T, c.num_heads, c.head_dim)
+            v = (x @ lp["wv"]).reshape(T, c.num_heads, c.head_dim)
+            k_pages = k_pages.at[li, bidx, slot].set(
+                k.astype(k_pages.dtype))
+            v_pages = v_pages.at[li, bidx, slot].set(
+                v.astype(v_pages.dtype))
+            att = ragged_flat_attention(q, k_pages[li], v_pages[li],
+                                        block_tables, seq_ids,
+                                        positions)
+            h = h + att.reshape(T, c.d_model) @ lp["wo"]
+            x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
+            h = h + jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"] \
+                + lp["b2"]
+        logits = _layer_norm(h, params["lnf_g"],
+                             params["lnf_b"]) @ params["head"]
+        return logits, k_pages, v_pages
+
+    def decode_step(self, params, tokens, positions, k_pages, v_pages,
+                    block_tables, kv_lens):
+        """One decode token per sequence: the Q=1 slice of
+        :meth:`decode_chunk` (kept for the single-token callers;
+        tokens/positions int32 [S]). Returns (logits [S, V],
+        k_pages, v_pages)."""
+        import jax.numpy as jnp
+        S = tokens.shape[0]
+        logits, k_pages, v_pages = self.decode_chunk(
+            params, tokens[:, None], positions[:, None],
+            jnp.ones(S, jnp.int32), k_pages, v_pages, block_tables,
+            kv_lens)
+        return logits[:, 0], k_pages, v_pages
+
+
+def _incremental_step(model, params, token, pos, k_cache, v_cache):
+    """One appended token against a dense (non-paged) KV cache —
+    the eager oracle's decode step. token/pos: int32 scalars; caches:
+    [L, max_context, H, Dh]. Writes the token's K/V at ``pos``, then
+    attends over positions ``<= pos``. Returns (logits [V], k_cache,
+    v_cache). Pure function of its inputs (jitted once per model)."""
+    import jax
+    import jax.numpy as jnp
+    from ...ops.flash_attention import _NEG_INF
+    c = model.config
+    scale = float(1.0 / (c.head_dim ** 0.5))
+    mask = jnp.arange(c.max_context, dtype=jnp.int32) <= pos
+    h = params["embed"][token] + params["pos"][pos]
+    for li, lp in enumerate(params["layers"]):
+        x = _layer_norm(h, lp["ln1_g"], lp["ln1_b"])
+        q = (x @ lp["wq"]).reshape(c.num_heads, c.head_dim)
+        k = (x @ lp["wk"]).reshape(c.num_heads, c.head_dim)
+        v = (x @ lp["wv"]).reshape(c.num_heads, c.head_dim)
+        k_cache = k_cache.at[li, pos].set(k)
+        v_cache = v_cache.at[li, pos].set(v)
+        s = jnp.einsum("hd,thd->ht", q.astype(jnp.float32),
+                       k_cache[li].astype(jnp.float32)) * scale
+        s = jnp.where(mask[None, :], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum("ht,thd->hd", p,
+                         v_cache[li].astype(jnp.float32)).astype(h.dtype)
+        h = h + att.reshape(c.d_model) @ lp["wo"]
+        x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
+        h = h + jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"] \
+            + lp["b2"]
+    logits = _layer_norm(h, params["lnf_g"],
+                         params["lnf_b"]) @ params["head"]
+    return logits, k_cache, v_cache
+
 
 def greedy_decode_reference(model, params, prompt_tokens,
                             max_new_tokens, stop_token=None):
     """Per-sequence eager greedy decoding — the oracle continuous
     batching must match token for token.
 
-    Recomputes the dense causal forward over the full prefix at every
-    step (no KV cache at all) and takes the prefix's last position's
-    argmax. The input is zero-padded to ``max_context`` so every step
-    runs the SAME shape: causal masking makes positions past the
-    prefix invisible to it, and one fixed shape keeps the oracle from
-    compiling one program per prefix length. Returns the generated
-    tokens (prompt excluded) as a list.
+    Incremental (append-only KV): ONE dense causal forward over the
+    ``max_context``-padded prompt fills a per-layer KV cache and emits
+    the first token; every later token runs a single-position
+    incremental step (:func:`_incremental_step`, jitted once per
+    model — fixed shape, so repeated oracle calls never recompile)
+    that appends its K/V and attends over the cached prefix. Same
+    greedy stream as the old recompute-everything oracle at a small
+    fraction of the work — parity suites stop paying a full padded
+    forward per emitted token. Returns the generated tokens (prompt
+    excluded) as a list.
     """
+    import jax
     import jax.numpy as jnp
     toks = [int(t) for t in prompt_tokens]
     out = []
     ctx = model.max_context
-    for _ in range(max_new_tokens):
-        padded = np.zeros(ctx, np.int32)
-        padded[:len(toks)] = toks
-        logits, _, _ = model.forward(params, jnp.asarray(padded[None]))
-        nxt = int(jnp.argmax(logits[0, len(toks) - 1]))
+    step = getattr(model, "_incr_jit", None)
+    if step is None:
+        step = jax.jit(functools.partial(_incremental_step, model))
+        model._incr_jit = step
+    padded = np.zeros(ctx, np.int32)
+    padded[:len(toks)] = toks
+    logits, k, v = model.forward(params, jnp.asarray(padded[None]))
+    # positions past the prompt hold pad garbage; each is overwritten
+    # by the incremental step that lands there before any mask
+    # exposes it
+    k_cache, v_cache = k[:, 0], v[:, 0]
+    nxt = int(jnp.argmax(logits[0, len(toks) - 1]))
+    for i in range(max_new_tokens):
         out.append(nxt)
         toks.append(nxt)
         if stop_token is not None and nxt == stop_token:
             break
-        if len(toks) >= ctx:
+        if len(toks) >= ctx or i == max_new_tokens - 1:
             break
+        logits, k_cache, v_cache = step(
+            params, jnp.int32(nxt), jnp.int32(len(toks) - 1),
+            k_cache, v_cache)
+        nxt = int(jnp.argmax(logits))
     return out

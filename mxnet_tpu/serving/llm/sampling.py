@@ -1,0 +1,296 @@
+"""In-program sampling for the LLM decode engine.
+
+Argmax-only is not a product: generation needs temperature / top-k /
+top-p sampling — but the engine's contract is ONE fixed-shape donated
+program with zero steady-state recompiles, so sampling must happen
+inside that program on the fixed ``[max_seqs]`` batch, with every
+per-sequence knob entering as a TRACED vector (a temperature change
+can never recompile) and the PRNG state derived IN-PROGRAM from data.
+
+The pieces, all pure ``jnp`` functions of fixed shapes:
+
+- :class:`SamplingParams` — per-sequence knobs riding
+  :class:`~.scheduler.Sequence`: ``temperature`` (0 = greedy),
+  ``top_k`` (0 = off), ``top_p`` (1 = off), ``seed``;
+- :func:`row_keys` — per-row PRNG keys split in-program from
+  ``fold_in(fold_in(PRNGKey(seed), counter), tag)`` where ``counter``
+  is the ABSOLUTE index of the token being sampled. Keys are a pure
+  function of (seed, position): preempt/restart re-prefills the folded
+  generation as forced tokens and the next sampled position derives
+  the exact same key — the sampled stream resumes bit-identically
+  (the PR 8 restart-determinism contract, extended to sampling);
+- :func:`adjusted_log_probs` — temperature scaling + top-k + top-p
+  masking + renormalization, the SHARED distribution transform (the
+  speculative accept rule must compare draft and target under the
+  same transform);
+- :func:`sample_tokens` — Gumbel-max categorical draw per row, with
+  ``temperature <= 0`` rows recovering the BIT-EXACT raw-logits argmax
+  (greedy stays greedy, pinned by parity tests);
+- :func:`spec_accept` — the standard speculative-sampling accept rule
+  over one verify dispatch's ``K+1`` scored positions: accept draft
+  ``d_j`` with probability ``min(1, p_j(d_j) / q_j(d_j))``; at the
+  first rejection sample from the residual ``max(p - q, 0)``
+  (renormalized); if every draft survives, sample the bonus token
+  from the last position. Greedy rows accept iff the draft equals the
+  target argmax — speculative greedy decoding is bit-identical to
+  target-only greedy decoding.
+"""
+from __future__ import annotations
+
+__all__ = ["SamplingParams", "GREEDY", "row_keys",
+           "adjusted_log_probs", "sample_tokens", "sample_and_probs",
+           "spec_accept", "spec_accept_greedy"]
+
+# PRNG stream tags: one sub-stream per purpose so the accept uniforms
+# and the draft model's proposal gumbels can never alias the target's
+# sampling gumbels at the same position
+TAG_SAMPLE = 0
+TAG_ACCEPT = 1
+TAG_DRAFT = 2
+
+
+class SamplingParams:
+    """Per-sequence sampling knobs (host-side; the engine batches them
+    into traced vectors). ``temperature <= 0`` means greedy (bit-exact
+    argmax); ``top_k == 0`` and ``top_p == 1.0`` disable those masks.
+    ``seed`` roots the per-sequence PRNG stream — two submissions with
+    the same seed, prompt and params produce the same tokens."""
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, temperature=0.0, top_k=0, top_p=1.0, seed=0):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, "
+                             f"got {temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 = off), "
+                             f"got {top_k}")
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+    @property
+    def greedy(self):
+        return self.temperature <= 0.0
+
+    def __repr__(self):
+        return (f"SamplingParams(temperature={self.temperature}, "
+                f"top_k={self.top_k}, top_p={self.top_p}, "
+                f"seed={self.seed})")
+
+
+GREEDY = SamplingParams()
+
+
+def row_keys(seeds, counters, tag):
+    """Per-row PRNG keys: ``fold_in(fold_in(PRNGKey(seed), counter),
+    tag)``. seeds/counters: int32 [...]; returns raw uint32 key data
+    of shape [..., 2]. Pure function of (seed, counter) — the
+    restart-determinism anchor."""
+    import jax
+
+    def one(seed, ctr):
+        k = jax.random.PRNGKey(seed)
+        k = jax.random.fold_in(k, ctr)
+        return jax.random.key_data(jax.random.fold_in(k, tag))
+
+    flat = jax.vmap(one)
+    for _ in range(getattr(seeds, "ndim", 1) - 1):
+        flat = jax.vmap(flat)
+    return flat(seeds, counters)
+
+
+def adjusted_log_probs(logits, temperature, top_k, top_p):
+    """Temperature + top-k + top-p transform, renormalized.
+
+    logits: f32 [..., V]; temperature/top_k/top_p broadcast over the
+    leading dims. Returns log-probs [..., V] with masked entries at
+    -inf. Rows with ``temperature <= 0`` get the transform evaluated
+    at a tiny positive temperature — callers must route greedy rows
+    through the raw argmax instead (:func:`sample_tokens` does)."""
+    import jax
+    import jax.numpy as jnp
+    V = logits.shape[-1]
+    t = jnp.maximum(temperature, 1e-6)[..., None]
+    scaled = logits.astype(jnp.float32) / t
+    # top-k: keep scores >= the k-th largest (traced k; 0 = keep all)
+    k_eff = jnp.where(top_k <= 0, V, jnp.clip(top_k, 1, V))
+    desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(
+        desc, (k_eff - 1).astype(jnp.int32)[..., None], axis=-1)
+    neg = jnp.float32(-jnp.inf)
+    masked = jnp.where(scaled >= kth, scaled, neg)
+    # top-p (nucleus) over the top-k-masked distribution: keep the
+    # smallest prefix of descending probabilities whose mass reaches
+    # top_p (the crossing token included; prob ties keep together)
+    probs = jax.nn.softmax(masked, axis=-1)
+    sp = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+    csum = jnp.cumsum(sp, axis=-1)
+    keep_sorted = (csum - sp) < top_p[..., None]
+    nkeep = jnp.sum(keep_sorted.astype(jnp.int32), axis=-1,
+                    keepdims=True)
+    thresh = jnp.take_along_axis(sp, nkeep - 1, axis=-1)
+    masked = jnp.where(probs >= thresh, masked, neg)
+    return jax.nn.log_softmax(masked, axis=-1)
+
+
+def _gumbel(keys, shape):
+    """Gumbel(0,1) noise from raw key data [..., 2] -> [..., *shape]."""
+    import jax
+
+    def one(kd):
+        return jax.random.gumbel(jax.random.wrap_key_data(kd), shape)
+
+    flat = jax.vmap(one)
+    for _ in range(keys.ndim - 2):
+        flat = jax.vmap(flat)
+    return flat(keys)
+
+
+def _uniform(keys):
+    """U(0,1) draw per raw key [..., 2] -> [...]."""
+    import jax
+
+    def one(kd):
+        return jax.random.uniform(jax.random.wrap_key_data(kd))
+
+    flat = jax.vmap(one)
+    for _ in range(keys.ndim - 2):
+        flat = jax.vmap(flat)
+    return flat(keys)
+
+
+def sample_tokens(logits, temperature, top_k, top_p, keys):
+    """One sampled token per row via the Gumbel-max trick.
+
+    logits: [..., V]; temperature/top_k/top_p: [...] traced vectors;
+    keys: raw key data [..., 2] from :func:`row_keys`. Rows with
+    ``temperature <= 0`` return the BIT-EXACT ``argmax(logits)`` —
+    greedy decoding is the temperature->0 limit and must not pick up
+    even a ULP of sampling arithmetic."""
+    import jax.numpy as jnp
+    greedy = temperature <= 0
+    lp = adjusted_log_probs(logits, temperature, top_k, top_p)
+    g = _gumbel(keys, lp.shape[-1:])
+    sampled = jnp.argmax(lp + g, axis=-1)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)
+
+
+def sample_and_probs(logits, temperature, top_k, top_p, keys):
+    """Draft-proposal helper: one sampled token per row PLUS the full
+    adjusted probability vector (the verify step's accept rule needs
+    ``q_j(v)`` for every v, not just the chosen token). Same greedy
+    recovery as :func:`sample_tokens`. Returns (tokens [...] int32,
+    probs [..., V] f32)."""
+    import jax.numpy as jnp
+    greedy = temperature <= 0
+    lp = adjusted_log_probs(logits, temperature, top_k, top_p)
+    probs = jnp.exp(lp)
+    g = _gumbel(keys, lp.shape[-1:])
+    sampled = jnp.argmax(lp + g, axis=-1)
+    toks = jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)
+    return toks, probs
+
+
+def spec_accept_greedy(target_logits, draft_tokens, n_draft):
+    """The greedy degenerate of :func:`spec_accept`: accept draft j
+    iff it equals the raw-logits argmax at its position; the
+    replacement/bonus token IS the argmax at the first open position.
+    No PRNG, no sorts — the engine dispatches this variant whenever
+    every active row is greedy, so plain greedy decoding never pays a
+    cycle of sampling arithmetic. Returns (tokens [S, K+1],
+    n_accepted [S])."""
+    import jax.numpy as jnp
+    S, K1, _ = target_logits.shape
+    K = K1 - 1
+    raw_arg = jnp.argmax(target_logits, axis=-1)     # [S, K+1]
+    jpos = jnp.arange(K, dtype=jnp.int32)[None, :]
+    live = jpos < n_draft[:, None]
+    accept = (draft_tokens == raw_arg[:, :K]) & live
+    prefix = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+    n_acc = jnp.sum(prefix, axis=1)
+    final = jnp.take_along_axis(raw_arg, n_acc[:, None],
+                                axis=1)[:, 0].astype(jnp.int32)
+    out = jnp.where(prefix.astype(bool), draft_tokens, 0)
+    out = jnp.concatenate([out, jnp.zeros((S, 1), jnp.int32)], axis=1)
+    out = out.at[jnp.arange(S), n_acc].set(final)
+    return out.astype(jnp.int32), n_acc.astype(jnp.int32)
+
+
+def spec_accept(target_logits, draft_tokens, draft_probs, n_draft,
+                temperature, top_k, top_p, accept_keys, sample_keys):
+    """The speculative-sampling accept rule over one verify dispatch.
+
+    target_logits: [S, K+1, V] — the target model's logits at the
+    K+1 scored positions (position j conditions on drafts < j);
+    draft_tokens: int32 [S, K]; draft_probs: f32 [S, K, V] — the draft
+    model's ADJUSTED probabilities at each proposal step (same
+    temperature/top-k/top-p transform); n_draft: int32 [S] — how many
+    proposals are live per row (rows near the context cap propose
+    fewer; 0 disables the rule and plain-samples position 0);
+    temperature/top_k/top_p: [S]; accept_keys: [S, K, 2] raw key data
+    (position-keyed); sample_keys: [S, K+1, 2].
+
+    Returns (tokens [S, K+1] int32, n_accepted [S] int32): row ``i``
+    commits ``tokens[i, :n_accepted[i] + 1]`` — the accepted drafts
+    plus the residual/bonus token. Greedy rows accept iff the draft
+    equals the raw-logits argmax and take the argmax as
+    replacement/bonus: speculative greedy == target-only greedy,
+    bit-exact."""
+    import jax.numpy as jnp
+    S, K1, V = target_logits.shape
+    K = K1 - 1
+    greedy = (temperature <= 0)[:, None]
+    t3 = temperature[:, None]
+    lp = adjusted_log_probs(target_logits, t3, top_k[:, None],
+                            top_p[:, None])          # [S, K+1, V]
+    p = jnp.exp(lp)
+    raw_arg = jnp.argmax(target_logits, axis=-1)     # [S, K+1]
+    jpos = jnp.arange(K, dtype=jnp.int32)[None, :]   # [S, K]
+    live = jpos < n_draft[:, None]
+    p_chosen = jnp.take_along_axis(
+        p[:, :K], draft_tokens[..., None], axis=-1)[..., 0]
+    q_chosen = jnp.take_along_axis(
+        draft_probs, draft_tokens[..., None], axis=-1)[..., 0]
+    u = _uniform(accept_keys)                        # [S, K]
+    stochastic = u * jnp.maximum(q_chosen, 1e-30) <= p_chosen
+    greedy_ok = draft_tokens == raw_arg[:, :K]
+    accept = jnp.where(greedy, greedy_ok, stochastic) & live
+    prefix = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+    n_acc = jnp.sum(prefix, axis=1)                  # [S]
+    # the position that emits the replacement (first reject) or bonus
+    # (all drafts accepted): index n_acc into the K+1 scored slots
+    pos = n_acc[:, None, None]
+    p_pos = jnp.take_along_axis(p, pos, axis=1)[:, 0]          # [S, V]
+    q_pad = jnp.concatenate(
+        [draft_probs, jnp.zeros((S, 1, V), draft_probs.dtype)],
+        axis=1)
+    rejected_draft = (n_acc < n_draft)[:, None]
+    q_pos = jnp.where(rejected_draft,
+                      jnp.take_along_axis(q_pad, pos, axis=1)[:, 0],
+                      0.0)
+    resid = jnp.maximum(p_pos - q_pos, 0.0)
+    rsum = jnp.sum(resid, axis=-1, keepdims=True)
+    # numerically empty residual (p <= q everywhere) => p == q:
+    # sampling from p is the same distribution
+    resid = jnp.where(rsum > 0, resid, p_pos)
+    rsum = jnp.sum(resid, axis=-1, keepdims=True)
+    rlog = jnp.log(jnp.maximum(resid / rsum, 1e-38))
+    g_all = _gumbel(sample_keys, (V,))               # [S, K+1, V]
+    g_pos = jnp.take_along_axis(g_all, pos, axis=1)[:, 0]
+    sampled = jnp.argmax(rlog + g_pos, axis=-1)
+    arg_pos = jnp.take_along_axis(
+        raw_arg, n_acc[:, None], axis=1)[:, 0]
+    final = jnp.where(greedy[:, 0], arg_pos, sampled).astype(jnp.int32)
+    # committed layout: accepted drafts then the final token
+    out = jnp.where(prefix.astype(bool), draft_tokens, 0)
+    out = jnp.concatenate(
+        [out, jnp.zeros((S, 1), jnp.int32)], axis=1)
+    rows = jnp.arange(S)
+    out = out.at[rows, n_acc].set(final)
+    return out.astype(jnp.int32), n_acc.astype(jnp.int32)

@@ -14,9 +14,11 @@ States::
 
 Preemption is restart-based: a sequence evicted for KV pressure goes
 back to the FRONT of the waiting queue with its prompt extended by
-everything it generated so far. Greedy decoding is deterministic, so
-re-prefilling that longer prompt resumes the exact token stream — no
-KV is saved, only block budget (the standard vLLM recompute policy).
+everything it generated so far. Greedy decoding is deterministic, and
+sampled decoding keys its PRNG on (seed, absolute position) — so
+re-prefilling that longer prompt resumes the exact token stream
+either way; no KV is saved, only block budget (the standard vLLM
+recompute policy).
 
 The scheduler is pure host-side bookkeeping (which sequence holds
 which slot); KV block accounting lives in
@@ -47,10 +49,10 @@ class Sequence:
                  "block_ids", "seq_len", "last_token", "t_submit",
                  "t_first_token", "admit_index", "preemptions",
                  "future", "span", "finish_reason", "deadline",
-                 "cancelled", "tenant")
+                 "cancelled", "tenant", "sampling", "draft_len")
 
     def __init__(self, prompt_tokens, max_new_tokens, stop_token=None,
-                 deadline=None, tenant=None):
+                 deadline=None, tenant=None, sampling=None):
         self.seq_id = next(_seq_ids)
         self.prompt = [int(t) for t in prompt_tokens]
         if not self.prompt:
@@ -86,6 +88,17 @@ class Sequence:
         # optional tenant attribution label (None = untagged); the
         # server's outcome paths record it on mxtpu_llm_tenant_*
         self.tenant = tenant
+        # per-sequence sampling knobs (None = greedy); the engine
+        # batches them into traced vectors — a temperature change can
+        # never recompile the decode program
+        if sampling is None:
+            from .sampling import GREEDY
+            sampling = GREEDY
+        self.sampling = sampling
+        # committed-token KV entries in the DRAFT cache (speculative
+        # decoding); mirrors seq_len during prefill, rolls back with
+        # rejected drafts
+        self.draft_len = 0
 
     def expired(self, now=None):
         if self.deadline is None:
@@ -184,13 +197,16 @@ class Scheduler:
     def preempt(self, seq):
         """KV-pressure eviction: fold the generation into the prompt
         and requeue at the FRONT (it was making progress; it resumes
-        first)."""
+        first). Folded tokens re-prefill as FORCED tokens; the
+        position-keyed sampling PRNG makes the resumed stream
+        bit-identical for greedy AND sampled sequences."""
         if seq.slot is not None:
             self.slots[seq.slot] = None
             seq.slot = None
         seq.prompt = seq.prompt + seq.generated
         seq.generated = []
         seq.seq_len = 0
+        seq.draft_len = 0
         seq.last_token = None
         seq.state = WAITING
         seq.preemptions += 1

@@ -59,6 +59,7 @@ from ..overload import (CircuitBreaker, resolve_deadline,
                         resolve_overload_knobs, shed_if_breaker_open)
 from .engine import LLMEngine
 from .metrics import LLMStats
+from .sampling import SamplingParams
 from .scheduler import Sequence
 from ..telemetry import compile_count
 from ...observability.tracing import get_tracer
@@ -86,13 +87,15 @@ class GenerationResult:
 
 
 class LLMServer:
-    """Serve autoregressive greedy decoding with continuous batching.
+    """Serve autoregressive decoding (greedy or sampled) with
+    continuous batching.
 
     ``model``/``params``: a decoder in paged form (see
     :class:`~.model.TinyDecoder`) and its parameter pytree. Engine
-    sizing kwargs (``max_seqs``, ``block_size``, ``num_blocks``,
-    ``max_context``, ``prefill_buckets``) pass through to
-    :class:`~.engine.LLMEngine`, each defaulting to its
+    kwargs (``max_seqs``, ``block_size``, ``num_blocks``,
+    ``max_context``, ``prefill_chunk``, and ``draft_model`` /
+    ``draft_params`` / ``spec_k`` for speculative decoding) pass
+    through to :class:`~.engine.LLMEngine`, each defaulting to its
     ``MXNET_TPU_LLM_*`` env var. Overload knobs: ``max_queue``
     (``MXNET_TPU_SERVE_MAX_QUEUE``), ``deadline_ms``
     (``MXNET_TPU_SERVE_DEADLINE_MS``), ``breaker_threshold`` /
@@ -171,7 +174,7 @@ class LLMServer:
         return len(self._pending) + self._engine.scheduler.num_waiting
 
     def submit(self, prompt_tokens, max_new_tokens, stop_token=None,
-               deadline_ms=None, tenant=None):
+               deadline_ms=None, tenant=None, sampling=None):
         """Enqueue one prompt; returns a Future resolving to a
         :class:`GenerationResult` (or raising a typed
         :class:`~..errors.ServingError` subclass:
@@ -179,11 +182,20 @@ class LLMServer:
         :class:`ServerClosed`; at submit time: :class:`Overloaded` /
         :class:`CircuitOpenError`).
 
+        ``sampling`` (optional): a
+        :class:`~.sampling.SamplingParams` — or a dict of its kwargs —
+        selecting temperature / top-k / top-p / seed for THIS
+        generation (default greedy). Per-sequence params ride the
+        fixed decode program as traced vectors: changing them never
+        recompiles.
+
         ``tenant`` (optional) attributes this generation's outcome —
         and its generated tokens — on the per-tenant series
         ``mxtpu_llm_tenant_requests_total`` /
         ``mxtpu_llm_tenant_tokens_total``; untagged requests create
         no tenant series."""
+        if isinstance(sampling, dict):
+            sampling = SamplingParams(**sampling)
         if not self._started:
             raise RuntimeError("server not started; call start()")
         try:
@@ -199,7 +211,8 @@ class LLMServer:
             raise
         prompt = [int(t) for t in np.asarray(prompt_tokens).ravel()]
         seq = Sequence(prompt, max_new_tokens, stop_token=stop_token,
-                       deadline=deadline, tenant=tenant)
+                       deadline=deadline, tenant=tenant,
+                       sampling=sampling)
         # validate shape/vocab NOW, on the caller's thread
         self._engine.add_validate(seq)
         from concurrent.futures import Future
@@ -253,7 +266,7 @@ class LLMServer:
 
     def generate(self, prompt_tokens, max_new_tokens, stop_token=None,
                  timeout=None, deadline_ms=None, reap_timeout=5.0,
-                 tenant=None):
+                 tenant=None, sampling=None):
         """Blocking single-prompt decode through the batcher.
 
         On ``timeout`` the underlying sequence is CANCELLED — its KV
@@ -266,7 +279,7 @@ class LLMServer:
         the typed error after this window instead)."""
         fut = self.submit(prompt_tokens, max_new_tokens,
                           stop_token=stop_token, deadline_ms=deadline_ms,
-                          tenant=tenant)
+                          tenant=tenant, sampling=sampling)
         from concurrent.futures import TimeoutError as FuturesTimeout
         try:
             return fut.result(timeout=timeout)
@@ -294,7 +307,9 @@ class LLMServer:
         snap = self._stats.snapshot()
         snap["compiles"] = compile_count()
         snap["kv_cache"] = self._engine.cache.stats()
-        snap["prefill_buckets"] = list(self._engine.prefill_spec)
+        snap["prefill_chunk"] = self._engine.prefill_chunk
+        snap["spec_k"] = self._engine.spec_k
+        snap["q_tokens"] = self._engine.q_tokens
         snap["max_seqs"] = self._engine.max_seqs
         return snap
 

@@ -209,6 +209,8 @@ def test_bert_small_forward_shapes():
     assert np.isfinite(pooled.asnumpy()).all()
 
 
+@pytest.mark.slow   # ~60s convergence loop (tier-1 budget, ISSUE 12);
+# attention-correctness coverage stays via the parity tests above
 def test_bert_tiny_convergence():
     """A tiny BERT must be able to fit a toy sequence-classification task
     (grads flow through embeddings, attention, layernorm, pooler)."""

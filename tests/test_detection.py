@@ -186,6 +186,8 @@ def test_ssd_300_forward_shapes():
     assert np.isfinite(cls_preds.asnumpy()).all()
 
 
+@pytest.mark.slow   # ~32s convergence loop (tier-1 budget);
+# SSD forward/anchor/NMS correctness stays in the fast tests above
 def test_ssd_toy_convergence():
     """A small SSD must learn to localize a synthetic box task: loss
     drops and mAP on the train set becomes high."""

@@ -42,7 +42,8 @@ CASES = [
                       "--batch-size", "32", "--max-loss", "110"]),
     ("adversary_fgsm.py", ["--epochs", "2", "--num-samples", "256",
                            "--batch-size", "64", "--min-drop", "0.02"]),
-    ("ssd_detect.py", ["--steps", "2", "--batch-size", "2"]),
+    pytest.param("ssd_detect.py", ["--steps", "2", "--batch-size", "2"],
+                 marks=pytest.mark.slow),   # ~49s (tier-1 budget)
     ("svm_digits.py", ["--epochs", "3", "--num-samples", "256",
                        "--batch-size", "64", "--min-acc", "0.12"]),
     # the L1-hinge branch is the other half of SVMOutput; pytest
@@ -58,7 +59,8 @@ CASES = [
     ("llm_serve_decode.py", ["--threads", "4", "--requests", "4",
                              "--max-context", "32",
                              "--max-new-tokens", "6"]),
-    ("nce_lm.py", ["--epochs", "3", "--max-ppl", "120"]),
+    pytest.param("nce_lm.py", ["--epochs", "3", "--max-ppl", "120"],
+                 marks=pytest.mark.slow),   # ~22s (tier-1 budget)
     ("rbm_digits.py", ["--epochs", "3", "--num-samples", "256",
                        "--max-recon-err", "0.12"]),
     # --check-uncertainty needs a longer trajectory than CI affords;
@@ -66,8 +68,10 @@ CASES = [
     # eval set), so a non-learning regression cannot pass it
     ("bayesian_sgld.py", ["--epochs", "100", "--burn-in", "70",
                           "--lr", "2e-4", "--max-rmse", "0.6"]),
-    ("stochastic_depth.py", ["--epochs", "5", "--num-samples", "1024",
-                             "--min-acc", "0.5"]),
+    pytest.param("stochastic_depth.py",
+                 ["--epochs", "5", "--num-samples", "1024",
+                  "--min-acc", "0.5"],
+                 marks=pytest.mark.slow),   # ~36s (tier-1 budget)
     ("train_imagenet.py", ["--benchmark", "1", "--num-layers", "18",
                            "--num-classes", "4", "--image-shape",
                            "3,16,16", "--batch-size", "4",
@@ -77,8 +81,9 @@ CASES = [
 ]
 
 
-@pytest.mark.parametrize("script,args", CASES,
-                         ids=[c[0] for c in CASES])
+@pytest.mark.parametrize(
+    "script,args", CASES,
+    ids=[getattr(c, "values", c)[0] for c in CASES])
 def test_example_runs(script, args):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

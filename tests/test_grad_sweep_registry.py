@@ -372,8 +372,20 @@ def test_registry_grad_coverage_is_total():
 
 _IDS = sorted(T)
 
+# the deformable/PSROI/attention templates cost 30-90s EACH of numeric
+# differencing — together over 300s of tier-1 (ISSUE 12 budget fix).
+# They still run under -m slow; the rest of the sweep keeps per-op
+# gradient coverage in the fast gate.
+_SLOW_IDS = {"_contrib_ModulatedDeformableConvolution",
+             "_contrib_DeformablePSROIPooling",
+             "scaled_dot_product_attention",
+             "_contrib_PSROIPooling",
+             "_contrib_hawkesll"}
 
-@pytest.mark.parametrize("name", _IDS)
+
+@pytest.mark.parametrize(
+    "name", [pytest.param(n, marks=pytest.mark.slow)
+             if n in _SLOW_IDS else n for n in _IDS])
 def test_numeric_gradient_tail(name):
     op, inputs, kwargs, grad_inputs, rtol, atol, eps = T[name]
     check_numeric_gradient(op, inputs, kwargs=kwargs,

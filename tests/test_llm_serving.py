@@ -157,13 +157,19 @@ def test_zero_recompiles_mixed_prefill_decode_staggered(model, params):
 
 
 def test_warmup_covers_every_bucket_once(model, params):
-    """A second warmup over the same engine compiles nothing: every
-    program steady state can reach is already cached."""
+    """A second warmup over the same engine compiles nothing: the ONE
+    chunked-step program is everything steady state can reach."""
     eng = LLMEngine(model, params, max_seqs=2, block_size=BS,
                     max_context=CTX)
     first = eng.warmup()
-    assert set(first) == {"prefill_8", "prefill_16", "prefill_32",
-                          "prefill_64", "decode"}
+    # every (packed length x table width) rung of the ONE flat
+    # program, in its greedy and sampled variants — nothing else is
+    # reachable in steady state
+    assert set(first) == {
+        f"step_t{t}mb{mb}_{v}" for t in eng._t_buckets
+        for mb in eng._mb_widths for v in ("greedy", "sampled")}
+    assert max(eng._t_buckets) == eng.max_seqs * eng.q_tokens
+    assert eng.cache.max_blocks_per_seq in eng._mb_widths
     with serving.CompileCounter() as cc:
         eng.warmup()
     assert cc.count == 0
@@ -460,5 +466,8 @@ def test_engine_sizing_guards(model, params):
                   max_context=CTX, num_blocks=4)       # < 1 full seq
     with pytest.raises(ValueError):
         LLMEngine(model, params, max_seqs=2, block_size=BS,
-                  max_context=CTX,
-                  prefill_buckets=[BS, CTX // 2])      # top < max_context
+                  max_context=CTX, prefill_chunk=0)    # no chunk
+    with pytest.raises(ValueError):
+        LLMEngine(model, params, max_seqs=2, block_size=BS,
+                  max_context=CTX, spec_k=-1,
+                  draft_model=model, draft_params=params)

@@ -170,8 +170,12 @@ R3_OPS = [
                    np.zeros(4, np.float32)], {"num_groups": 2}),
     ("InstanceNorm", [_r(2, 3, 5), np.ones(3, np.float32),
                       np.zeros(3, np.float32)], {}),
+    # exact index-copy op: the autograd side is exact, but f32
+    # central differences on unit-scale inputs carry ~5e-3 noise on
+    # near-zero elements — give the NUMERIC side the atol it needs
     ("im2col", [_r(1, 2, 5, 5)], {"kernel": (3, 3), "stride": (1, 1),
-                                  "pad": (1, 1)}),
+                                  "pad": (1, 1),
+                                  "_numeric_tol": (2e-2, 8e-3)}),
     ("_image_normalize", [_r(3, 4, 4)], {"mean": 0.2, "std": 0.7}),
     ("_contrib_count_sketch",
      [_r(2, 4), np.array([0.0, 1, 0, 2]),
@@ -181,16 +185,24 @@ R3_OPS = [
 
 ALL_CASES = UNARY_SMOOTH + BINARY + REDUCE_SHAPE + NN_OPS + R3_OPS
 
+# the python-tap-loop deformable/rotated-ROI forwards cost 13-18s each
+# under numeric differencing (tier-1 budget, ISSUE 12); they still run
+# under -m slow
+_SLOW_GRAD_OPS = {"_contrib_DeformableConvolution", "_contrib_RROIAlign"}
+
 
 @pytest.mark.parametrize(
-    "op,inputs,kwargs", ALL_CASES,
+    "op,inputs,kwargs",
+    [pytest.param(*c, marks=pytest.mark.slow)
+     if c[0] in _SLOW_GRAD_OPS else c for c in ALL_CASES],
     ids=[f"{c[0]}-{i}" for i, c in enumerate(ALL_CASES)])
 def test_numeric_gradient(op, inputs, kwargs):
     kwargs = dict(kwargs)
     grad_inputs = kwargs.pop("_numeric_grad_inputs", None)
+    rtol, atol = kwargs.pop("_numeric_tol", (2e-2, 2e-3))
     if grad_inputs == ():
         pytest.skip("no differentiable inputs")
-    check_numeric_gradient(op, inputs, kwargs, rtol=2e-2, atol=2e-3,
+    check_numeric_gradient(op, inputs, kwargs, rtol=rtol, atol=atol,
                            grad_inputs=grad_inputs)
 
 
@@ -199,7 +211,7 @@ def test_numeric_gradient(op, inputs, kwargs):
     ids=[f"{c[0]}-{i}" for i, c in enumerate(ALL_CASES)])
 def test_eager_jit_consistency(op, inputs, kwargs):
     kwargs = {k: v for k, v in kwargs.items()
-              if k != "_numeric_grad_inputs"}
+              if not k.startswith("_numeric_")}
     check_eager_jit_consistency(
         op, [np.asarray(x, np.float32) for x in inputs], kwargs)
 

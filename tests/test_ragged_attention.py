@@ -145,6 +145,143 @@ def test_garbage_in_unreferenced_blocks_is_invisible():
     np.testing.assert_array_equal(base, got)
 
 
+# ------------------------------------------ multi-token chunk shape --
+
+
+def _chunk_case(kv_lens, q_lens, num_blocks=64, seed=0):
+    """A paged cache + a [S, Q, H, D] query chunk: queries are the
+    LAST q_lens[i] positions of each sequence (the chunked-prefill /
+    verify layout)."""
+    rng = np.random.RandomState(seed)
+    S = len(kv_lens)
+    Q = max(q_lens)
+    MB = max(-(-int(t) // BS) for t in kv_lens)
+    k_pages = np.zeros((num_blocks, BS, H, D), np.float32)
+    v_pages = np.zeros((num_blocks, BS, H, D), np.float32)
+    tables = np.full((S, MB), NULL_BLOCK, np.int32)
+    pool = list(range(1, num_blocks))
+    np.random.RandomState(seed + 1000).shuffle(pool)
+    it = iter(pool)
+    dense = []
+    q = rng.randn(S, Q, H, D).astype(np.float32)
+    for i, t in enumerate(kv_lens):
+        t = int(t)
+        k_seq = rng.randn(t, H, D).astype(np.float32)
+        v_seq = rng.randn(t, H, D).astype(np.float32)
+        dense.append((k_seq, v_seq))
+        for j in range(-(-t // BS)):
+            b = next(it)
+            tables[i, j] = b
+            chunk = k_seq[j * BS:(j + 1) * BS]
+            k_pages[b, :len(chunk)] = chunk
+            chunk = v_seq[j * BS:(j + 1) * BS]
+            v_pages[b, :len(chunk)] = chunk
+    return (q, k_pages, v_pages, tables,
+            np.asarray(kv_lens, np.int32),
+            np.asarray(q_lens, np.int32), dense)
+
+
+@pytest.mark.parametrize("path", ["reference", "pallas"])
+def test_chunk_parity_vs_dense_causal_oracle(path):
+    """Multi-token queries: token t of row i (absolute position
+    kv_len - q_len + t) must equal single-query dense attention over
+    exactly its causal prefix — across block-boundary kv lengths,
+    chunk sizes from 1 (decode) to full-prefill, fragmented tables."""
+    kv_lens = [13, 5, 2 * BS, BS + 1]
+    q_lens = [5, 2, 1, BS + 1]       # verify-, decode- and prefill-like
+    q, kp, vp, bt, kl, ql, dense = _chunk_case(kv_lens, q_lens)
+    got = np.asarray(ragged_paged_attention(
+        q, kp, vp, bt, kl, q_lens=ql,
+        use_pallas=(path == "pallas"), interpret=True))
+    for i, (k_seq, v_seq) in enumerate(dense):
+        t, qn = int(kl[i]), int(ql[i])
+        for tt in range(qn):
+            pos = t - qn + tt
+            o = attention_reference(
+                jnp.asarray(q[i, tt][None, :, None, :]),
+                jnp.asarray(k_seq[:pos + 1].transpose(1, 0, 2)[None]),
+                jnp.asarray(v_seq[:pos + 1].transpose(1, 0, 2)[None]))
+            want = np.asarray(o)[0, :, 0, :]
+            np.testing.assert_allclose(got[i, tt], want,
+                                       rtol=2e-5, atol=2e-6)
+
+
+def test_chunk_q_len_one_matches_decode_kernel():
+    """The decode shape is the Q=1 slice of the chunk shape: both
+    kernels over the same buffers agree at f32 tolerance."""
+    lens = [5, 11, 24]
+    q, kp, vp, bt, kl, _ = _paged_case(lens, seed=13)
+    dec = np.asarray(ragged_paged_attention(
+        q, kp, vp, bt, kl, use_pallas=True, interpret=True))
+    chk = np.asarray(ragged_paged_attention(
+        q[:, None], kp, vp, bt, kl,
+        q_lens=np.ones(len(lens), np.int32),
+        use_pallas=True, interpret=True))[:, 0]
+    np.testing.assert_allclose(dec, chk, rtol=1e-5, atol=1e-6)
+
+
+def test_chunk_padded_tail_and_garbage_invisible():
+    """Padded query tokens (t >= q_len) and KV garbage beyond kv_len
+    must not perturb any VALID output row."""
+    kv_lens = [9, 17]
+    q_lens = [3, 5]
+    q, kp, vp, bt, kl, ql, _ = _chunk_case(kv_lens, q_lens, seed=5)
+    base = np.asarray(ragged_paged_attention(q, kp, vp, bt, kl,
+                                             q_lens=ql))
+    # poison everything the mask must hide: free blocks, null block,
+    # tail slots past kv_len, and the padded q rows themselves
+    used = set(bt.ravel().tolist()) - {NULL_BLOCK}
+    kp2, vp2, q2 = kp.copy(), vp.copy(), q.copy()
+    for b in range(kp.shape[0]):
+        if b not in used:
+            kp2[b] = 1e6
+            vp2[b] = -1e6
+    for i, t in enumerate(kv_lens):
+        last = bt[i, (t - 1) // BS]
+        kp2[last, t % BS or BS:] = 1e6
+        vp2[last, t % BS or BS:] = -1e6
+        q2[i, q_lens[i]:] = 1e6
+    got = np.asarray(ragged_paged_attention(q2, kp2, vp2, bt, kl,
+                                            q_lens=ql))
+    for i, qn in enumerate(q_lens):
+        np.testing.assert_array_equal(base[i, :qn], got[i, :qn])
+
+
+@pytest.mark.parametrize("path", ["reference", "pallas"])
+def test_flat_parity_vs_chunk_shape(path):
+    """The FLAT packed layout (the engine's hot path) must agree with
+    the per-row chunk shape over the same buffers: packing the valid
+    tokens of every row into one [T] batch with per-token
+    seq_ids/positions changes the layout, never the math."""
+    from mxnet_tpu.ops.ragged_attention import ragged_flat_attention
+    kv_lens = [13, 5, 2 * BS]
+    q_lens = [5, 2, 1]
+    q, kp, vp, bt, kl, ql, _ = _chunk_case(kv_lens, q_lens, seed=21)
+    chunk = np.asarray(ragged_paged_attention(
+        q, kp, vp, bt, kl, q_lens=ql,
+        use_pallas=(path == "pallas"), interpret=True))
+    # pack the valid tokens flat
+    flat_q, sids, poss, want = [], [], [], []
+    for i, qn in enumerate(q_lens):
+        for t in range(qn):
+            flat_q.append(q[i, t])
+            sids.append(i)
+            poss.append(kv_lens[i] - qn + t)
+            want.append(chunk[i, t])
+    got = np.asarray(ragged_flat_attention(
+        np.stack(flat_q), kp, vp, bt,
+        np.asarray(sids, np.int32), np.asarray(poss, np.int32),
+        use_pallas=(path == "pallas"), interpret=True))
+    np.testing.assert_allclose(got, np.stack(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_chunk_requires_q_lens():
+    q, kp, vp, bt, kl, _ = _paged_case([5], seed=1)
+    with pytest.raises(ValueError, match="q_lens"):
+        ragged_paged_attention(q[:, None], kp, vp, bt, kl)
+
+
 # ------------------------------------------------------- allocator --
 
 

@@ -32,6 +32,9 @@ def _digits_rec(prefix, images, labels, quality=3):  # PNG: lossless
     rec.close()
 
 
+@pytest.mark.slow   # ~390s: the single largest tier-1 cost (ISSUE 12
+# budget fix); MLP/LeNet convergence floors in test_train.py keep the
+# fast gate's accuracy coverage
 @pytest.mark.skipif(not native_pipeline_available(),
                     reason="native decode pipeline unavailable")
 def test_resnet18_digits_accuracy_floor(tmp_path):

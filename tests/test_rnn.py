@@ -199,6 +199,7 @@ class TestCells:
 
 
 class TestConvergence:
+    @pytest.mark.slow   # ~37s convergence loop (tier-1 budget)
     def test_char_lstm_learns_pattern(self):
         """Char-level LSTM on a deterministic cyclic sequence — the
         LSTM/CTC north-star config's recurrent half."""
@@ -239,6 +240,8 @@ class TestConvergence:
             last = float(loss)
         assert last < 0.5 * first, (first, last)
 
+    @pytest.mark.slow   # ~57s convergence loop (tier-1 budget);
+    # CTC correctness stays via test_ctc_torch_oracle.py
     def test_ctc_head_converges(self):
         """LSTM + CTC head trained to decreasing loss (north-star
         LSTM/CTC config; reference: example OCR pipelines)."""

@@ -656,6 +656,98 @@ def test_llm_preemption_mid_drain_under_injected_latency(model, params):
         guard.uninstall()
 
 
+def _spec_server(model, params, name):
+    """A speculative-decoding server for the mid-verify chaos cases:
+    small draft model sharing the target's vocab/context."""
+    draft = TinyDecoder(DecoderConfig(
+        vocab_size=VOCAB, d_model=8, num_layers=1, num_heads=1,
+        d_ff=16, max_context=CTX))
+    srv = LLMServer(model, params, name=name, max_seqs=2,
+                    block_size=BS, max_context=CTX, draft_model=draft,
+                    draft_params=draft.init_params(seed=5), spec_k=2)
+    srv.warmup()
+    srv.start()
+    return srv
+
+
+def test_llm_mid_verify_death_resolves_typed_partial_tokens(model,
+                                                            params):
+    """Chaos matrix (ISSUE 12): the engine thread dies MID-VERIFY —
+    between draft proposals and the commit, while sequences hold
+    speculative KV blocks. Every Future must resolve typed with its
+    partial tokens, the speculative blocks must come back (the draft
+    cache shares the target's block accounting — one free covers
+    both), and ``PagedKVCache.check()`` must be clean."""
+    srv = _spec_server(model, params, "llmc_midverify")
+    futs = [srv.submit([1 + i, 2, 3], 20) for i in range(3)]
+    # let real decode progress accumulate partial tokens first, then
+    # crash the 3rd draft dispatch: the worker dies holding proposals
+    # that were never verified or committed
+    deadline = time.monotonic() + 30
+    while (srv.stats()["tokens_generated"] < 3
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    assert srv.stats()["tokens_generated"] >= 3
+    faults.crash_at_point("llm.draft", nth=3)
+    typed = served = 0
+    for f in futs:
+        try:
+            f.result(timeout=30)
+            served += 1                  # finished before the crash
+        except ServingError:
+            typed += 1                   # typed worker-death ServerClosed
+    assert typed + served == 3           # nothing hangs, nothing raw
+    assert typed >= 1                    # the crash really landed
+    faults.reset()
+    deadline = time.monotonic() + 10
+    while srv.running and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(ServerClosed):
+        srv.submit([1], 1)
+    _assert_kv_clean(srv)
+
+
+def test_llm_drain_mid_verify_evicts_with_partial_tokens(model,
+                                                         params):
+    """Drain/evict while a verify round is parked mid-flight: the
+    deadline-bounded shutdown resolves every speculative sequence
+    with a typed SequenceEvictedError CARRYING the tokens committed
+    so far; draft-speculation blocks are freed and accounting is
+    exact."""
+    srv = _spec_server(model, params, "llmc_specdrain")
+    futs = [srv.submit([1 + i, 2, 3], CTX - 8) for i in range(3)]
+    deadline = time.monotonic() + 30
+    while (srv.stats()["tokens_generated"] < 3
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    assert srv.stats()["tokens_generated"] >= 3
+    gate = faults.block_at("llm.draft")      # park the next verify
+    assert gate.wait_reached(30)
+    done = threading.Event()
+
+    def _shutdown():
+        srv.shutdown(drain=True, deadline_ms=0.0)   # evict now, typed
+        done.set()
+
+    t = threading.Thread(target=_shutdown, daemon=True)
+    t.start()
+    gate.release()
+    assert done.wait(60)
+    faults.reset()
+    evicted = partial = served = 0
+    for f in futs:
+        try:
+            f.result(timeout=10)
+            served += 1
+        except SequenceEvictedError as e:
+            assert e.reason == "drain_deadline"
+            evicted += 1
+            partial += bool(e.tokens)
+    assert evicted + served == 3         # nothing silently dropped
+    assert evicted >= 1 and partial >= 1  # partials really carried
+    _assert_kv_clean(srv)
+
+
 def test_chaos_metrics_land_in_one_exposition(model, params):
     """The degradation is observable: the new overload series are
     present (and parseable) in one Prometheus exposition alongside the

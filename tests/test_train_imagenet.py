@@ -40,6 +40,8 @@ def rec_dataset(tmp_path_factory):
     return d
 
 
+@pytest.mark.slow   # ~42s; the synthetic-benchmark twin below keeps
+# the driver path in the fast gate (tier-1 budget, ISSUE 12)
 def test_train_imagenet_resnet50_rec(rec_dataset, tmp_path):
     import train_imagenet
     prefix = str(tmp_path / "r50")

@@ -174,8 +174,8 @@ def scan_llm(repo=REPO):
         m = re.search(r"BENCH_llm_r(\d+)\.json$", path)
         rnd = int(m.group(1)) if m else 0
         row = {"round": rnd, "status": "valid", "tokens_s": None,
-               "ttft_p50": None, "ttft_p99": None, "tag": "",
-               "note": ""}
+               "ttft_p50": None, "ttft_p99": None, "accept": None,
+               "tag": "", "note": ""}
         try:
             with open(path) as f:
                 rec = json.load(f)
@@ -195,6 +195,14 @@ def scan_llm(repo=REPO):
         ttft = rec.get("ttft_ms") or {}
         row["ttft_p50"] = ttft.get("p50")
         row["ttft_p99"] = ttft.get("p99")
+        # speculative-decoding draft acceptance (ISSUE 12): absent on
+        # pre-spec rounds and spec-off runs
+        row["accept"] = rec.get("spec_accept_rate")
+        knobs = rec.get("knobs") or {}
+        if knobs.get("MXNET_TPU_LLM_SPEC_K"):
+            row["note"] = (row["note"] + " " if row["note"] else "") \
+                + (f"spec_k={knobs['MXNET_TPU_LLM_SPEC_K']} "
+                   f"chunk={knobs.get('MXNET_TPU_LLM_PREFILL_CHUNK')}")
         if rec.get("overload"):
             ov = rec["overload"]
             row["note"] = (f"overload run: shed_rate="
@@ -209,8 +217,8 @@ def render_llm(rows):
         return pat % v if v is not None else "—"
     lines = [
         "| round | status | tokens/s | TTFT p50 (ms) | TTFT p99 (ms) "
-        "| config | note |",
-        "|---|---|---|---|---|---|---|",
+        "| accept rate | config | note |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         lines.append(
@@ -218,6 +226,7 @@ def render_llm(rows):
             f"| {fmt(r['tokens_s'], '%.1f')} "
             f"| {fmt(r['ttft_p50'], '%.2f')} "
             f"| {fmt(r['ttft_p99'], '%.2f')} "
+            f"| {fmt(r.get('accept'), '%.3f')} "
             f"| {r['tag']} | {r['note']} |")
     valid = [r for r in rows if r["status"] == "valid"
              and r["tokens_s"] is not None]

@@ -8,9 +8,10 @@ generation, repeat. Reported (and emitted into a BENCH json via
 ``tools/perf_capture.emit_llm_snapshot``, which refuses to headline a
 run that recompiled or lost requests): decode throughput in
 tokens/sec, time-to-first-token p50/p99, end-to-end request latency,
-KV-block occupancy, preemptions, and the XLA compile count observed
-DURING the measured window (0 is the healthy steady state — warmup
-pre-compiles every prefill bucket plus the one decode shape).
+KV-block occupancy, preemptions, speculative accept rate, and the
+XLA compile count observed DURING the measured window (0 is the
+healthy steady state — warmup pre-compiles every width and variant
+of the one chunked-step program).
 
 Serve an exported decoder artifact::
 
@@ -20,10 +21,15 @@ or, with no --model, a small built-in decoder (self-contained CI)::
 
     python tools/llm_bench.py --smoke
 
-``--smoke`` runs a tiny configuration and exit(1)s unless the run was
-recompile-free and lossless AND the emitted BENCH json carries the
-tokens/sec + TTFT + KV-occupancy fields — wired into tier-1 via
-tests/test_examples_smoke.py.
+``--smoke`` runs a tiny configuration exercising EVERY ISSUE-12 speed
+path — chunked prefill (multi-chunk prompts), mixed greedy+sampled
+traffic (``--temperature``), and speculative decoding through the
+built-in layer-truncated draft (``--spec-k``) — and exit(1)s unless
+the run was recompile-free and lossless, speculation really proposed
+and accepted drafts, AND the emitted BENCH json carries the
+tokens/sec + TTFT + KV-occupancy fields plus the
+``MXNET_TPU_LLM_{PREFILL_CHUNK,SPEC_K}`` knobs and the observed
+accept rate — wired into tier-1 via tests/test_examples_smoke.py.
 """
 import argparse
 import datetime
@@ -58,6 +64,46 @@ def _load_model(args):
     return _builtin_decoder(max_context=args.max_context)
 
 
+def _truncated_draft(model, params):
+    """The built-in draft: the TARGET model truncated to half its
+    layers (same embeddings/head/params). The cheap stand-in for a
+    distilled draft — it shares the target's token statistics, so
+    acceptance rates are meaningful, at roughly half the step cost."""
+    c = model.config
+    nl = max(1, c.num_layers // 2)
+    draft = TinyDecoder(DecoderConfig(
+        vocab_size=c.vocab_size, d_model=c.d_model, num_layers=nl,
+        num_heads=c.num_heads, d_ff=c.d_ff, max_context=c.max_context))
+    dparams = dict(params)
+    dparams["layers"] = list(params["layers"][:nl])
+    return draft, dparams
+
+
+def _engine_kw(args, model, params):
+    """Engine sizing + speed knobs shared by both run modes: chunked
+    prefill size and, with --spec-k > 0, the built-in layer-truncated
+    draft for speculative decoding."""
+    kw = dict(max_seqs=args.max_seqs, block_size=args.block_size,
+              max_context=min(args.max_context, model.max_context))
+    if args.prefill_chunk > 0:
+        kw["prefill_chunk"] = args.prefill_chunk
+    if args.spec_k > 0:
+        draft, dparams = _truncated_draft(model, params)
+        kw.update(draft_model=draft, draft_params=dparams,
+                  spec_k=args.spec_k)
+    return kw
+
+
+def _sampling_for(i, args):
+    """Request i's sampling params: greedy by default; with
+    --temperature > 0 every other request samples (seeded, so runs
+    stay reproducible) — the smoke gate exercises BOTH paths."""
+    if args.temperature > 0 and i % 2 == 1:
+        return {"temperature": args.temperature, "top_k": 8,
+                "top_p": 0.95, "seed": i}
+    return None
+
+
 def run_overload(args):
     """Open-loop saturation run: submissions ARRIVE faster than the
     engine can serve (``--arrival-rate`` req/s; 0 = flood) against a
@@ -71,11 +117,7 @@ def run_overload(args):
     model, params = _load_model(args)
     max_queue = args.max_queue or 2 * args.max_seqs
     srv = LLMServer(model, params, name="llm_bench_overload",
-                    max_seqs=args.max_seqs,
-                    block_size=args.block_size,
-                    max_context=min(args.max_context,
-                                    model.max_context),
-                    max_queue=max_queue)
+                    max_queue=max_queue, **_engine_kw(args, model, params))
     warm = srv.warmup()
     srv.start()
 
@@ -149,7 +191,10 @@ def run_overload(args):
         "requests": arrivals,
         "concurrency": 0,
         "max_seqs": stats["max_seqs"],
-        "prefill_buckets": stats["prefill_buckets"],
+        "prefill_chunk": stats["prefill_chunk"],
+        "spec_k": stats["spec_k"],
+        "spec_accept_rate": (round(stats["spec_accept_rate"], 4)
+                             if stats["spec_k"] else None),
         "warmup_s": {k: round(v, 4) for k, v in warm.items()},
         "tokens_per_sec": round(delivered, 2),
         "decode_tokens_per_sec_ema": round(stats["tokens_per_sec"], 2),
@@ -183,10 +228,7 @@ def run_overload(args):
 def run(args):
     model, params = _load_model(args)
     srv = LLMServer(model, params, name="llm_bench",
-                    max_seqs=args.max_seqs,
-                    block_size=args.block_size,
-                    max_context=min(args.max_context,
-                                    model.max_context))
+                    **_engine_kw(args, model, params))
     warm = srv.warmup()
     srv.start()
 
@@ -209,7 +251,9 @@ def run(args):
             for i in range(quota[tid]):
                 prompt = prompts[(tid + i) % len(prompts)]
                 n = 1 + (tid + i) % args.max_new_tokens
-                res = srv.generate(prompt, n, timeout=600)
+                res = srv.generate(
+                    prompt, n, timeout=600,
+                    sampling=_sampling_for(tid * 997 + i, args))
                 # a generation may legally end early at the context
                 # cap (finish_reason "length"), not only at n
                 want = min(n, srv.max_context - len(prompt))
@@ -249,7 +293,17 @@ def run(args):
         "requests": sum(quota),
         "concurrency": args.concurrency,
         "max_seqs": stats["max_seqs"],
-        "prefill_buckets": stats["prefill_buckets"],
+        "prefill_chunk": stats["prefill_chunk"],
+        "spec_k": stats["spec_k"],
+        "spec_accept_rate": (round(stats["spec_accept_rate"], 4)
+                             if stats["spec_k"] else None),
+        "spec_proposed": stats["spec_proposed"],
+        "spec_accepted": stats["spec_accepted"],
+        "prefill_chunks": stats["prefill_chunks"],
+        "sampled_requests": sum(
+            1 for tid in range(args.concurrency)
+            for i in range(quota[tid])
+            if _sampling_for(tid * 997 + i, args) is not None),
         "warmup_s": {k: round(v, 4) for k, v in warm.items()},
         "tokens_per_sec": round(delivered, 2),
         "decode_tokens_per_sec_ema": round(stats["tokens_per_sec"], 2),
@@ -294,6 +348,15 @@ def emit_bench(report, out_dir):
             "preemptions": report["preemptions"],
             "compiles_during_load": report["compiles_during_load"],
             "overload": report.get("overload"),
+            # the ISSUE-12 speed knobs + their observed effect ride
+            # the committed snapshot so the trend table can attribute
+            # the headline to a configuration
+            "knobs": {
+                "MXNET_TPU_LLM_PREFILL_CHUNK":
+                    report.get("prefill_chunk"),
+                "MXNET_TPU_LLM_SPEC_K": report.get("spec_k"),
+            },
+            "spec_accept_rate": report.get("spec_accept_rate"),
         },
         "_capture": {
             "tag": "llm_bench",
@@ -332,6 +395,18 @@ def main():
     ap.add_argument("--max-context", type=int, default=128)
     ap.add_argument("--max-new-tokens", type=int, default=16,
                     help="per-request generation lengths cycle 1..N")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="prompt tokens per chunked-prefill step "
+                         "(0 = engine default / "
+                         "MXNET_TPU_LLM_PREFILL_CHUNK)")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative decoding: draft tokens per "
+                         "verify step through a built-in half-size "
+                         "draft model (0 = off)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="> 0 samples every other request at this "
+                         "temperature (top-k 8 / top-p 0.95, seeded) "
+                         "so mixed greedy+sampled traffic is measured")
     ap.add_argument("--out", default=None,
                     help="directory for the BENCH_llm_rNN.json "
                          "(default: a temp dir, printed)")
@@ -359,6 +434,14 @@ def main():
         args.max_seqs = min(args.max_seqs, 4)
         args.max_context = min(args.max_context, 64)
         args.max_new_tokens = min(args.max_new_tokens, 8)
+        if not args.overload:
+            # the CI gate exercises ALL ISSUE-12 paths: chunked
+            # prefill (prompts above reach 2 chunks), mixed
+            # greedy+sampled traffic, and speculative decoding —
+            # under the same zero-recompile assertion
+            args.prefill_chunk = args.prefill_chunk or 16
+            args.spec_k = args.spec_k or 2
+            args.temperature = args.temperature or 0.8
 
     report = run_overload(args) if args.overload else run(args)
     out_dir = args.out or tempfile.mkdtemp(prefix="llm_bench_")
@@ -389,7 +472,21 @@ def main():
                   and bench.get("overload", {}).get("shed_rate")
                   == ov["shed_rate"])
         else:
-            ok = ok and report["completed"] == report["requests"]
+            ok = (ok and report["completed"] == report["requests"]
+                  # every ISSUE-12 path really ran, recompile-free:
+                  # multi-chunk prefill, speculation with a live
+                  # accept rate, sampled traffic — and the committed
+                  # snapshot carries the knobs + accept rate
+                  and report["prefill_chunks"] > report["requests"]
+                  and report["spec_proposed"] > 0
+                  and report["spec_accepted"] > 0
+                  and report["sampled_requests"] > 0
+                  and bench.get("knobs", {}).get(
+                      "MXNET_TPU_LLM_SPEC_K") == args.spec_k
+                  and bench.get("knobs", {}).get(
+                      "MXNET_TPU_LLM_PREFILL_CHUNK")
+                  == report["prefill_chunk"]
+                  and bench.get("spec_accept_rate") is not None)
         print("SMOKE", "PASS" if ok else "FAIL")
         sys.exit(0 if ok else 1)
 

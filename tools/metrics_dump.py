@@ -479,10 +479,12 @@ def smoke():
         print("SMOKE FAIL: no spans-dropped counter in exposition")
         return 1
     span_names = {s["name"] for s in tracer.snapshot()}
+    # mxtpu.llm.step is the unified chunked-prefill/decode/verify
+    # launch (ISSUE 12 folded the old prefill + decode_step spans
+    # into it)
     for needed in ("mxtpu.train_step", "mxtpu.train_step.dispatch",
                    "mxtpu.serving.request", "mxtpu.ckpt.write",
-                   "mxtpu.llm.request", "mxtpu.llm.prefill",
-                   "mxtpu.llm.decode_step"):
+                   "mxtpu.llm.request", "mxtpu.llm.step"):
         if needed not in span_names:
             print(f"SMOKE FAIL: no {needed} span recorded")
             return 1

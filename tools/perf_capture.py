@@ -375,6 +375,11 @@ def emit_llm_snapshot(rec, out_dir=None):
             "device_kind": extra.get("device_kind"),
             "xla_compiles": _metric_value(snap, "mxtpu_xla_compile_total"),
             "compiles_during_load": extra.get("compiles_during_load"),
+            # the decode-speed knobs (ISSUE 12) + the observed draft
+            # acceptance rate, so the trend table can attribute a
+            # headline to its chunk/speculation configuration
+            "knobs": extra.get("knobs"),
+            "spec_accept_rate": extra.get("spec_accept_rate"),
             "metrics_log": cap.get("metrics_log"),
             "span_stats": _span_stats(snap),
         })
