@@ -112,7 +112,8 @@ def ragged_chunk_attention_reference(q, k_pages, v_pages, block_tables,
 
 
 def ragged_flat_attention_reference(q, k_pages, v_pages, block_tables,
-                                    seq_ids, positions, scale=None):
+                                    seq_ids, positions, scale=None,
+                                    k_scales=None, v_scales=None):
     """Gather-based oracle for the FLAT ragged layout: ``q`` is a
     packed ``[T, H, D]`` batch of query tokens from MANY sequences —
     token ``t`` belongs to row ``seq_ids[t]`` of ``block_tables`` and
@@ -123,14 +124,24 @@ def ragged_flat_attention_reference(q, k_pages, v_pages, block_tables,
     packed together — the "[total_q_tokens]" shape of the Ragged
     Paged Attention paper). Invalid/padded tokens should carry
     ``seq_ids`` pointing at an all-null table row; their outputs are
-    unspecified and must be discarded."""
+    unspecified and must be discarded.
+
+    Quantized pages (ISSUE 13): with ``k_scales``/``v_scales``
+    ``(N, bs, H)`` f32, the int8 pages are dequantized per slot+head
+    right after the gather — ``k = int8 * scale`` — and everything
+    downstream runs in f32 exactly as the float path does."""
     T, H, D = q.shape
     bs = k_pages.shape[1]
     MB = block_tables.shape[1]
     s = scale if scale is not None else float(1.0 / (D ** 0.5))
     tbl = block_tables[seq_ids]                       # (T, MB)
-    k = k_pages[tbl].reshape(T, MB * bs, H, D)
-    v = v_pages[tbl].reshape(T, MB * bs, H, D)
+    k = k_pages[tbl]
+    v = v_pages[tbl]
+    if k_scales is not None:
+        k = k.astype(jnp.float32) * k_scales[tbl][..., None]
+        v = v.astype(jnp.float32) * v_scales[tbl][..., None]
+    k = k.reshape(T, MB * bs, H, D)
+    v = v.reshape(T, MB * bs, H, D)
     logits = jnp.einsum("thd,tkhd->htk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * s
     pos = jnp.arange(MB * bs, dtype=jnp.int32)
@@ -230,22 +241,138 @@ def _ragged_flat_pallas(q, k_pages, v_pages, block_tables, seq_ids,
       block_tables.astype(jnp.int32), q, k_pages, v_pages)
 
 
+def _flat_quant_kernel(sid_ref, pos_ref, bt_ref, q_ref, k_ref, v_ref,
+                       ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                       scale, block_size, num_blocks):
+    """The flat kernel's QUANTIZED-page variant: identical grid and
+    online softmax, but the K/V page tiles arrive int8 with per-slot
+    per-head f32 scale tiles (same ``bt[sid[t], j]`` index map), and
+    dequantization ``int8 * scale`` is fused right where the tile
+    lands in VMEM — the f32 pages never materialize in HBM."""
+    t, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    qpos = pos_ref[t]
+    base = j * block_size
+
+    @pl.when(base <= qpos)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)            # (H, D)
+        k = k_ref[...].astype(jnp.float32) \
+            * ks_ref[...][..., None]                  # (bs, H, D)
+        v = v_ref[...].astype(jnp.float32) \
+            * vs_ref[...][..., None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)
+        s = jnp.where(pos <= qpos, s, _NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1,
+                                                  keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(j == num_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(8, 9))
+def _ragged_flat_quant_pallas(q, k_pages, v_pages, k_scales, v_scales,
+                              block_tables, seq_ids, positions, scale,
+                              interpret):
+    T, H, D = q.shape
+    bs = k_pages.shape[1]
+    MB = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(T, MB),
+        in_specs=[
+            pl.BlockSpec((None, H, D),
+                         lambda t, j, sid, pos, bt: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bs, H, D),
+                         lambda t, j, sid, pos, bt:
+                         (bt[sid[t], j], 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bs, H, D),
+                         lambda t, j, sid, pos, bt:
+                         (bt[sid[t], j], 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            # the scale tiles ride the SAME scalar-prefetched
+            # block-table index map as their pages
+            pl.BlockSpec((None, bs, H),
+                         lambda t, j, sid, pos, bt:
+                         (bt[sid[t], j], 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, bs, H),
+                         lambda t, j, sid, pos, bt:
+                         (bt[sid[t], j], 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((None, H, D),
+                               lambda t, j, sid, pos, bt: (t, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_flat_quant_kernel, scale=scale,
+                               block_size=bs, num_blocks=MB)
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, H, D), jnp.float32),
+        interpret=interpret,
+    )(seq_ids.astype(jnp.int32), positions.astype(jnp.int32),
+      block_tables.astype(jnp.int32), q, k_pages, v_pages,
+      k_scales, v_scales)
+
+
 def ragged_flat_attention(q, k_pages, v_pages, block_tables, seq_ids,
                           positions, scale=None, use_pallas=None,
-                          interpret=None):
+                          interpret=None, k_scales=None, v_scales=None):
     """Flat-ragged paged attention entry point (packed
     ``[total_q_tokens]`` batch, per-token sequence/position
-    indirection). Gated exactly like :func:`ragged_paged_attention`."""
+    indirection). Gated exactly like :func:`ragged_paged_attention`.
+
+    ``k_scales``/``v_scales`` ``(N, bs, H)`` f32 select the QUANTIZED
+    page variant: pages are int8 and are dequantized per slot+head
+    inside the kernel (fused after the page DMA on the Pallas path,
+    right after the gather on the reference path)."""
     if use_pallas is None:
         use_pallas = _on_tpu()
     if scale is None:
         scale = float(1.0 / (q.shape[-1] ** 0.5))
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales or neither")
     if not use_pallas:
         return ragged_flat_attention_reference(
             q, k_pages, v_pages, block_tables, jnp.asarray(seq_ids),
-            jnp.asarray(positions), scale)
+            jnp.asarray(positions), scale, k_scales=k_scales,
+            v_scales=v_scales)
     if interpret is None:
         interpret = not _on_tpu()
+    if k_scales is not None:
+        return _ragged_flat_quant_pallas(
+            q, k_pages, v_pages, jnp.asarray(k_scales),
+            jnp.asarray(v_scales), jnp.asarray(block_tables),
+            jnp.asarray(seq_ids), jnp.asarray(positions),
+            float(scale), bool(interpret)).astype(q.dtype)
     return _ragged_flat_pallas(q, k_pages, v_pages,
                                jnp.asarray(block_tables),
                                jnp.asarray(seq_ids),

@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["env_int", "env_float"]
+__all__ = ["env_int", "env_float", "env_str"]
 
 
 def env_int(name, default):
@@ -18,3 +18,8 @@ def env_int(name, default):
 def env_float(name, default):
     v = os.environ.get(name)
     return float(v) if v else default
+
+
+def env_str(name, default):
+    v = os.environ.get(name)
+    return v if v else default

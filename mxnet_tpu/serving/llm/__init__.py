@@ -7,8 +7,15 @@ schedules *requests* (one forward pass each), this subsystem schedules
 
 - :mod:`.kv_cache` — a paged KV cache: a fixed pool of
   ``[num_blocks, block_size, heads, head_dim]`` blocks, a strict
-  free-list :class:`~.kv_cache.BlockAllocator`, per-sequence block
-  tables padded with the reserved null block;
+  REFCOUNTED :class:`~.kv_cache.BlockAllocator`, per-sequence block
+  tables padded with the reserved null block. Cross-request prefix
+  caching (ISSUE 13, ``MXNET_TPU_LLM_PREFIX_CACHE``) content-hashes
+  block-aligned prompt prefixes so identical prefixes share blocks
+  (copy-on-write on first divergence, LRU reclaim under pressure)
+  and skip their prefill chunks entirely; ``kv_dtype="int8"``
+  (``MXNET_TPU_LLM_KV_DTYPE``) stores per-slot-scale quantized pages
+  dequantized inside the ragged kernel — together the "10x effective
+  KV capacity per chip" lever;
 - :mod:`mxnet_tpu.ops.ragged_attention` — MULTI-TOKEN ragged
   attention over the block-table-indirected cache: the flat packed
   ``[total_q_tokens]`` shape (and its per-row chunk twin) covers
@@ -44,7 +51,7 @@ from ..errors import (DeadlineExceededError, Overloaded,
                       SequenceEvictedError)
 from .kv_cache import (BlockAllocator, PagedKVCache, KVCacheError,
                        NoFreeBlocksError, BlockAccountingError,
-                       NULL_BLOCK)
+                       NULL_BLOCK, prefix_block_hashes)
 from .scheduler import Sequence, Scheduler
 from .sampling import SamplingParams, GREEDY
 from .model import DecoderConfig, TinyDecoder, greedy_decode_reference
@@ -55,6 +62,7 @@ from .server import LLMServer, GenerationResult
 __all__ = [
     "BlockAllocator", "PagedKVCache", "KVCacheError",
     "NoFreeBlocksError", "BlockAccountingError", "NULL_BLOCK",
+    "prefix_block_hashes",
     "Sequence", "Scheduler", "SamplingParams", "GREEDY",
     "DecoderConfig", "TinyDecoder",
     "greedy_decode_reference", "LLMEngine", "LLMStats", "LLMServer",

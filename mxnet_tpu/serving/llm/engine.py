@@ -4,11 +4,17 @@ The execution core of ``mxnet_tpu.serving.llm``. One engine iteration
 (:meth:`LLMEngine.step`):
 
 1. **admit** — while a decode slot is free and the pool can hold the
-   prompt, pop the oldest waiting sequence into a slot. Admission no
-   longer launches a dense bucketed prefill: the prompt's KV is
-   written in CHUNKS scheduled into the regular step — a prefill
-   chunk is just a multi-token decode, so long prompts never stall
-   running decodes behind a monolithic prefill launch;
+   prompt, pop the oldest waiting sequence into a slot. The prefix
+   cache is consulted first (ISSUE 13): the longest registered chain
+   of block-aligned prompt-prefix blocks is ref()'d into the
+   sequence's table — those tokens' KV is SERVED, not recomputed, so
+   the sequence starts prefilling at the first uncached token (always
+   recomputing at least the last prompt token, whose logits emit the
+   first generation). Admission no longer launches a dense bucketed
+   prefill: the remaining prompt KV is written in CHUNKS scheduled
+   into the regular step — a prefill chunk is just a multi-token
+   decode, so long prompts never stall running decodes behind a
+   monolithic prefill launch;
 2. **plan + allocate** — each running sequence declares this step's
    query tokens: the next ``prefill_chunk`` prompt tokens while its
    prompt is still being written, one token in plain decode, or
@@ -59,8 +65,9 @@ import time
 
 import numpy as np
 
-from ..envutil import env_int as _env_int
-from .kv_cache import PagedKVCache, KVCacheError, NULL_BLOCK
+from ..envutil import env_int as _env_int, env_str as _env_str
+from .kv_cache import (PagedKVCache, KVCacheError, NULL_BLOCK,
+                       prefix_block_hashes)
 from .scheduler import Scheduler, Sequence, RUNNING, FINISHED, EVICTED
 from .sampling import (TAG_SAMPLE, TAG_ACCEPT, TAG_DRAFT, row_keys,
                        sample_and_probs, spec_accept,
@@ -71,7 +78,7 @@ from ...resilience import faults
 __all__ = ["LLMEngine"]
 
 
-def _make_step_fn(model, spec_k, sampled):
+def _make_step_fn(model, spec_k, sampled, quantized=False):
     """Build the target step program body for (model, spec_k): ONE
     program covering chunked prefill + decode + speculative verify
     over the FLAT ragged layout — a packed ``[total_q_tokens]`` batch
@@ -90,8 +97,43 @@ def _make_step_fn(model, spec_k, sampled):
     emit (the PRNG anchor). Returns (tokens [S, K+1], n_accepted [S],
     k_pages, v_pages): row i commits
     ``tokens[i, :n_accepted[i] + 1]`` — for plain rows that is one
-    sampled/argmax token."""
+    sampled/argmax token.
+
+    ``quantized`` selects the int8-KV variant: the f32 scale pools
+    ride the program right after the pages (donated with them) and
+    :meth:`model.decode_flat` quantizes on write / the ragged kernel
+    dequantizes on read."""
     import jax.numpy as jnp
+
+    def _accept(logits, win_idx, draft_tokens, draft_probs, n_draft,
+                temperature, top_k, top_p, seeds, counters):
+        S = win_idx.shape[0]
+        K = spec_k
+        win = logits[win_idx]                         # [S, K+1, V]
+        if not sampled:
+            return spec_accept_greedy(win, draft_tokens, n_draft)
+        seeds2 = jnp.broadcast_to(seeds[:, None], (S, K + 1))
+        ctr = counters[:, None] + jnp.arange(K + 1, dtype=jnp.int32)
+        accept_keys = row_keys(seeds2[:, :K], ctr[:, :K], TAG_ACCEPT)
+        sample_keys = row_keys(seeds2, ctr, TAG_SAMPLE)
+        return spec_accept(
+            win, draft_tokens, draft_probs, n_draft, temperature,
+            top_k, top_p, accept_keys, sample_keys)
+
+    if quantized:
+        def step(params, k_pages, v_pages, k_scales, v_scales, tokens,
+                 positions, seq_ids, valid, block_tables, win_idx,
+                 draft_tokens, draft_probs, n_draft, temperature,
+                 top_k, top_p, seeds, counters):
+            logits, kp2, vp2, ks2, vs2 = model.decode_flat(
+                params, tokens, positions, seq_ids, valid, k_pages,
+                v_pages, block_tables, k_scales=k_scales,
+                v_scales=v_scales)
+            toks, n_acc = _accept(logits, win_idx, draft_tokens,
+                                  draft_probs, n_draft, temperature,
+                                  top_k, top_p, seeds, counters)
+            return toks, n_acc, kp2, vp2, ks2, vs2
+        return step
 
     def step(params, k_pages, v_pages, tokens, positions, seq_ids,
              valid, block_tables, win_idx, draft_tokens, draft_probs,
@@ -99,26 +141,15 @@ def _make_step_fn(model, spec_k, sampled):
         logits, k_pages2, v_pages2 = model.decode_flat(
             params, tokens, positions, seq_ids, valid, k_pages,
             v_pages, block_tables)
-        S = win_idx.shape[0]
-        K = spec_k
-        win = logits[win_idx]                         # [S, K+1, V]
-        if not sampled:
-            toks, n_acc = spec_accept_greedy(win, draft_tokens,
-                                             n_draft)
-            return toks, n_acc, k_pages2, v_pages2
-        seeds2 = jnp.broadcast_to(seeds[:, None], (S, K + 1))
-        ctr = counters[:, None] + jnp.arange(K + 1, dtype=jnp.int32)
-        accept_keys = row_keys(seeds2[:, :K], ctr[:, :K], TAG_ACCEPT)
-        sample_keys = row_keys(seeds2, ctr, TAG_SAMPLE)
-        toks, n_acc = spec_accept(
-            win, draft_tokens, draft_probs, n_draft, temperature,
-            top_k, top_p, accept_keys, sample_keys)
+        toks, n_acc = _accept(logits, win_idx, draft_tokens,
+                              draft_probs, n_draft, temperature,
+                              top_k, top_p, seeds, counters)
         return toks, n_acc, k_pages2, v_pages2
 
     return step
 
 
-def _make_draft_fn(model, sampled):
+def _make_draft_fn(model, sampled, quantized=False):
     """Build the draft proposal program body: the same flat layout
     against the draft cache, returning one proposal per row plus
     (sampled variant) the full adjusted probability vector the accept
@@ -128,23 +159,53 @@ def _make_draft_fn(model, sampled):
     fed token (0 for inactive rows; outputs discarded)."""
     import jax.numpy as jnp
 
+    def _propose(logits, last_idx, temperature, top_k, top_p, seeds,
+                 counters):
+        last_logits = logits[last_idx]                # [S, V]
+        if not sampled:
+            toks = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            return toks, jnp.zeros_like(last_logits)
+        keys = row_keys(seeds, counters, TAG_DRAFT)
+        return sample_and_probs(last_logits, temperature, top_k,
+                                top_p, keys)
+
+    if quantized:
+        def draft(params, k_pages, v_pages, k_scales, v_scales,
+                  tokens, positions, seq_ids, valid, block_tables,
+                  last_idx, temperature, top_k, top_p, seeds,
+                  counters):
+            logits, kp2, vp2, ks2, vs2 = model.decode_flat(
+                params, tokens, positions, seq_ids, valid, k_pages,
+                v_pages, block_tables, k_scales=k_scales,
+                v_scales=v_scales)
+            toks, probs = _propose(logits, last_idx, temperature,
+                                   top_k, top_p, seeds, counters)
+            return toks, probs, kp2, vp2, ks2, vs2
+        return draft
+
     def draft(params, k_pages, v_pages, tokens, positions, seq_ids,
               valid, block_tables, last_idx, temperature, top_k,
               top_p, seeds, counters):
         logits, k_pages2, v_pages2 = model.decode_flat(
             params, tokens, positions, seq_ids, valid, k_pages,
             v_pages, block_tables)
-        last_logits = logits[last_idx]                # [S, V]
-        if not sampled:
-            toks = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-            probs = jnp.zeros_like(last_logits)
-            return toks, probs, k_pages2, v_pages2
-        keys = row_keys(seeds, counters, TAG_DRAFT)
-        toks, probs = sample_and_probs(last_logits, temperature,
-                                       top_k, top_p, keys)
+        toks, probs = _propose(logits, last_idx, temperature, top_k,
+                               top_p, seeds, counters)
         return toks, probs, k_pages2, v_pages2
 
     return draft
+
+
+def _make_copy_fn(n_arrays):
+    """Build the copy-on-write program body: copy page row ``src`` of
+    every pool array onto row ``dst`` (axis 1 — the block axis of the
+    ``[L, N, ...]`` pools). src/dst enter as traced scalars, so one
+    fixed-shape program (warmed once) serves every COW — a cache hit
+    diverging from its shared prefix never compiles anything."""
+    def copy(*args):
+        arrs, src, dst = args[:-2], args[-2], args[-1]
+        return tuple(a.at[:, dst].set(a[:, src]) for a in arrs)
+    return copy
 
 
 def _cached_program(model, kind, key, build):
@@ -183,7 +244,8 @@ class LLMEngine:
     def __init__(self, model, params, max_seqs=None, block_size=None,
                  num_blocks=None, max_context=None, prefill_chunk=None,
                  draft_model=None, draft_params=None, spec_k=None,
-                 stats=None, dtype="float32", breaker=None):
+                 stats=None, dtype="float32", breaker=None,
+                 prefix_cache=None, kv_dtype=None):
         import jax
         import jax.numpy as jnp
         self.model = model
@@ -255,25 +317,49 @@ class LLMEngine:
             {d_lo, t_hi} | {max(d_lo, m) for m in mids})
         mb = max_context // block_size
         self._mb_widths = sorted({max(1, -(-mb // 2)), mb})
+        # cross-request prefix caching (ISSUE 13): constructor arg >
+        # MXNET_TPU_LLM_PREFIX_CACHE env > on. Hits only rewrite host
+        # state (block tables, start offsets) — cache hit vs miss can
+        # never change a program shape.
+        if prefix_cache is None:
+            prefix_cache = bool(_env_int("MXNET_TPU_LLM_PREFIX_CACHE",
+                                         1))
+        self.prefix_enabled = bool(prefix_cache)
+        # quantized KV storage: constructor arg >
+        # MXNET_TPU_LLM_KV_DTYPE env > the float `dtype` arg
+        if kv_dtype is None:
+            kv_dtype = _env_str("MXNET_TPU_LLM_KV_DTYPE", dtype)
         self.cache = PagedKVCache(
             model.num_layers, model.num_heads, model.head_dim,
-            block_size, num_blocks, max_context, dtype=dtype)
+            block_size, num_blocks, max_context, dtype=kv_dtype,
+            prefix_cache=self.prefix_enabled)
+        self.quantized = self.cache.quantized
         self.scheduler = Scheduler(self.max_seqs)
         self._stats = stats
+        if stats is not None and self.prefix_enabled:
+            self.cache.on_prefix_evict = stats.record_prefix_evict
+        # engine-local prefix counters (mirrored onto mxtpu_llm_* when
+        # stats is attached; always available to tests/tools)
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefill_tokens_saved = 0
         self._params = jax.tree_util.tree_map(jnp.asarray, params)
         # donation is a TPU/HBM lever; CPU backends ignore it with a
         # warning per call site, so only request it where it works
         from ...ops.flash_attention import _on_tpu
-        donate = (1, 2) if _on_tpu() else ()
+        n_pools = 4 if self.quantized else 2
+        donate = tuple(range(1, 1 + n_pools)) if _on_tpu() else ()
         # two VARIANTS (greedy / sampled) x two widths of the one
         # step program — all warmed, so variant+width selection at
         # dispatch time is recompile-free. Cached on the model object
         # so engines sharing a model reuse compiled programs.
         self._step_jits = {
             sampled: _cached_program(
-                model, "step", (self.spec_k, sampled, donate),
+                model, "step",
+                (self.spec_k, sampled, self.quantized, donate),
                 lambda s=sampled: jax.jit(
-                    _make_step_fn(model, self.spec_k, s),
+                    _make_step_fn(model, self.spec_k, s,
+                                  self.quantized),
                     donate_argnums=donate))
             for sampled in (False, True)}
         if self.draft_model is not None:
@@ -291,18 +377,34 @@ class LLMEngine:
             self.draft_cache = PagedKVCache(
                 draft_model.num_layers, draft_model.num_heads,
                 draft_model.head_dim, block_size, num_blocks,
-                max_context, dtype=dtype)
+                max_context, dtype=kv_dtype)
             self._draft_params = jax.tree_util.tree_map(
                 jnp.asarray, draft_params)
             self._draft_jits = {
                 sampled: _cached_program(
-                    draft_model, "draft", (sampled, donate),
+                    draft_model, "draft",
+                    (sampled, self.quantized, donate),
                     lambda s=sampled: jax.jit(
-                        _make_draft_fn(draft_model, s),
+                        _make_draft_fn(draft_model, s,
+                                       self.quantized),
                         donate_argnums=donate))
                 for sampled in (False, True)}
         else:
             self.draft_cache = None
+        # the copy-on-write program: one fixed-shape jitted copy of
+        # block row src -> dst across every pool array (target K/V,
+        # quant scales, draft pools) — warmed once, dispatched when a
+        # sequence first writes into a block it still shares
+        if self.prefix_enabled:
+            n_arrs = len(self._cow_arrays())
+            cow_donate = tuple(range(n_arrs)) if _on_tpu() else ()
+            self._cow_jit = _cached_program(
+                model, "cow", (n_arrs, self.quantized, cow_donate,
+                               self.draft_model is not None),
+                lambda: jax.jit(_make_copy_fn(n_arrs),
+                                donate_argnums=cow_donate))
+        else:
+            self._cow_jit = None
         self._warmed = False
         # reusable per-width host batch buffers (target + draft) and
         # a shared position ramp — per-step host allocations compete
@@ -327,6 +429,127 @@ class LLMEngine:
         # resolves them with the ORIGINAL exception
         self._poison_pending = []
 
+    # -------------------------------------------- pool call helpers --
+    def _cow_arrays(self):
+        """Every device pool array a COW copy must cover, in the fixed
+        order the copy program was built for."""
+        arrs = [self.cache.k_pages, self.cache.v_pages]
+        if self.quantized:
+            arrs += [self.cache.k_scales, self.cache.v_scales]
+        if self.draft_cache is not None:
+            arrs += [self.draft_cache.k_pages, self.draft_cache.v_pages]
+            if self.quantized:
+                arrs += [self.draft_cache.k_scales,
+                         self.draft_cache.v_scales]
+        return arrs
+
+    def _cow_install(self, outs):
+        outs = list(outs)
+        if self.quantized:
+            self.cache.swap(outs[0], outs[1], outs[2], outs[3])
+            rest = outs[4:]
+        else:
+            self.cache.swap(outs[0], outs[1])
+            rest = outs[2:]
+        if self.draft_cache is not None:
+            if self.quantized:
+                self.draft_cache.swap(rest[0], rest[1], rest[2],
+                                      rest[3])
+            else:
+                self.draft_cache.swap(rest[0], rest[1])
+
+    def _call_step(self, sampled, batch):
+        """Dispatch one step program against the target pool, swapping
+        the donated page (and scale) buffers back in."""
+        jit = self._step_jits[sampled]
+        if self.quantized:
+            toks, n_acc, kp, vp, ks, vs = jit(
+                self._params, self.cache.k_pages, self.cache.v_pages,
+                self.cache.k_scales, self.cache.v_scales, *batch)
+            self.cache.swap(kp, vp, ks, vs)
+        else:
+            toks, n_acc, kp, vp = jit(
+                self._params, self.cache.k_pages, self.cache.v_pages,
+                *batch)
+            self.cache.swap(kp, vp)
+        return toks, n_acc
+
+    def _call_draft(self, sampled, batch):
+        jit = self._draft_jits[sampled]
+        if self.quantized:
+            tok, probs, kp, vp, ks, vs = jit(
+                self._draft_params, self.draft_cache.k_pages,
+                self.draft_cache.v_pages, self.draft_cache.k_scales,
+                self.draft_cache.v_scales, *batch)
+            self.draft_cache.swap(kp, vp, ks, vs)
+        else:
+            tok, probs, kp, vp = jit(
+                self._draft_params, self.draft_cache.k_pages,
+                self.draft_cache.v_pages, *batch)
+            self.draft_cache.swap(kp, vp)
+        return tok, probs
+
+    # ------------------------------------------------ prefix caching --
+    def _prefix_lookup(self, seq):
+        """Longest chain of registered blocks matching the prompt's
+        full-block prefix. Pure read — no refcounts move until the
+        admission actually proceeds. Returns ``(block_ids,
+        hit_tokens)`` with ``hit_tokens <= len(prompt) - 1``: at least
+        one prompt token is always recomputed, because its logits must
+        emit the first generated token. When the whole prompt is
+        block-aligned and fully cached that last token's chunk rewrites
+        the final SHARED block — the copy-on-write in
+        :meth:`_allocate` gives the sequence its private copy first."""
+        T = len(seq.prompt)
+        bs = self.cache.block_size
+        if seq.prefix_hashes is None:
+            seq.prefix_hashes = prefix_block_hashes(seq.prompt, bs)
+        hit = []
+        for h in seq.prefix_hashes:
+            bid = self.cache.prefix_get(h)
+            if bid is None:
+                break
+            hit.append(bid)
+        hit_tokens = min(len(hit) * bs, T - 1)
+        n_keep = -(-hit_tokens // bs) if hit_tokens > 0 else 0
+        return hit[:n_keep], hit_tokens
+
+    def _register_blocks(self, seq):
+        """Register the sequence's FULL, immutable blocks in the
+        prefix index (chained hashes over prompt + generated tokens,
+        truncated to KV actually written). First registration of a
+        hash wins; a block already registered (a hit this sequence is
+        itself sharing) is skipped by :meth:`PagedKVCache.register`."""
+        if not self.prefix_enabled:
+            return
+        bs = self.cache.block_size
+        tokens = seq.prompt + seq.generated
+        n_full = min(seq.seq_len, len(tokens)) // bs
+        n_full = min(n_full, len(seq.block_ids))
+        if n_full <= 0:
+            return
+        hashes = seq.prefix_hashes or []
+        if len(hashes) < n_full:
+            hashes = prefix_block_hashes(tokens[:n_full * bs], bs)
+            seq.prefix_hashes = hashes
+        for k in range(n_full):
+            self.cache.register(hashes[k], seq.block_ids[k])
+
+    def _cow_block(self, seq, bi):
+        """Copy-on-write block ``seq.block_ids[bi]``: allocate a
+        private copy, device-copy the page row across every pool
+        (target + scales + draft), repoint the sequence's table and
+        drop one reference on the shared original. One fixed-shape
+        dispatch — never a compile after warmup."""
+        old = seq.block_ids[bi]
+        new = self.cache.allocator.alloc(1)[0]
+        outs = self._cow_jit(*self._cow_arrays(), np.int32(old),
+                             np.int32(new))
+        self._cow_install(outs)
+        seq.block_ids[bi] = new
+        self.cache.allocator.free([old])
+        self.cache.cow_count += 1
+
     # ------------------------------------------------------- warmup --
     def warmup(self):
         """Compile every program steady state can reach: the chunked
@@ -348,17 +571,13 @@ class LLMEngine:
                     tables = np.full((S, MB), NULL_BLOCK, np.int32)
                     for sampled in (False, True):
                         t0 = time.monotonic()
-                        tok, probs, kp, vp = self._draft_jits[sampled](
-                            self._draft_params,
-                            self.draft_cache.k_pages,
-                            self.draft_cache.v_pages,
+                        tok, probs = self._call_draft(sampled, (
                             np.zeros(T, np.int32),
                             np.zeros(T, np.int32),
                             np.zeros(T, np.int32),
                             np.zeros(T, np.int32), tables,
                             np.zeros(S, np.int32), temp, top_k,
-                            top_p, seeds, counters)
-                        self.draft_cache.swap(kp, vp)
+                            top_p, seeds, counters))
                         np.asarray(tok)
                         tag = "sampled" if sampled else "greedy"
                         timings[f"draft_t{T}mb{MB}_{tag}"] = \
@@ -368,9 +587,7 @@ class LLMEngine:
                 tables = np.full((S, MB), NULL_BLOCK, np.int32)
                 for sampled in (False, True):
                     t0 = time.monotonic()
-                    toks, n_acc, kp, vp = self._step_jits[sampled](
-                        self._params, self.cache.k_pages,
-                        self.cache.v_pages,
+                    toks, n_acc = self._call_step(sampled, (
                         np.zeros(T, np.int32),
                         np.zeros(T, np.int32),
                         np.zeros(T, np.int32),
@@ -379,12 +596,20 @@ class LLMEngine:
                         np.zeros((S, K), np.int32),
                         np.zeros((S, K, V), np.float32),
                         np.zeros(S, np.int32), temp, top_k, top_p,
-                        seeds, counters)
-                    self.cache.swap(kp, vp)
+                        seeds, counters))
                     np.asarray(toks)
                     tag = "sampled" if sampled else "greedy"
                     timings[f"step_t{T}mb{MB}_{tag}"] = \
                         time.monotonic() - t0
+        if self._cow_jit is not None:
+            # warm the copy-on-write program (src == dst == the null
+            # block: a no-op copy with the real shapes)
+            t0 = time.monotonic()
+            outs = self._cow_jit(*self._cow_arrays(),
+                                 np.int32(NULL_BLOCK),
+                                 np.int32(NULL_BLOCK))
+            self._cow_install(outs)
+            timings["cow_copy"] = time.monotonic() - t0
         self._warmed = True
         return timings
 
@@ -415,33 +640,72 @@ class LLMEngine:
 
     def _record_block_gauges(self):
         if self._stats:
-            self._stats.record_blocks(self.cache.allocator.num_used,
-                                      self.cache.allocator.num_usable)
+            a = self.cache.allocator
+            self._stats.record_blocks(
+                a.num_used, a.num_usable, cached=a.num_cached,
+                shared=a.num_shared,
+                free=a.num_free - a.num_cached)
             self._stats.record_admission_state(
                 self.scheduler.num_waiting, self.scheduler.num_running)
 
     def _admit(self, events):
         """Place waiting sequences into free slots. Conservative KV
-        gate (the full prompt + one decode block must fit) keeps FIFO
-        admission from thrashing the preemption path; the prompt's KV
-        is then written chunk-by-chunk by the regular step."""
+        gate (the full prompt + one decode block must fit, prefix-hit
+        blocks discounted — they are ref'd, not allocated) keeps FIFO
+        admission from thrashing the preemption path; the UNCACHED
+        remainder of the prompt is then written chunk-by-chunk by the
+        regular step, so a hit sequence skips its hit tokens' prefill
+        chunks entirely."""
         while self.scheduler.num_waiting:
             slot = self.scheduler.free_slot()
             if slot is None:
                 break
             seq = self.scheduler.peek_waiting()
             T = len(seq.prompt)
-            need = self.cache.blocks_for(T)
+            hit, hit_tokens = ([], 0)
+            if self.prefix_enabled:
+                hit, hit_tokens = self._prefix_lookup(seq)
+            need = self.cache.blocks_for(T) - len(hit)
             if T % self.cache.block_size == 0:
                 need += 1           # first decode opens a new page
-            if not self.cache.allocator.can_alloc(need):
+            if hit_tokens and hit_tokens < len(hit) * \
+                    self.cache.block_size:
+                # truncated (block-aligned full) hit: the recompute
+                # chunk rewrites the FINAL hit block, which COWs when
+                # shared — reserve its private copy up front so the
+                # gate's promise ("admission never preempts to cover
+                # its own growth") holds
+                need += 1
+            # hit blocks sitting in the cached LRU count toward
+            # num_free but are about to be ref()'d by THIS admission —
+            # gate on need + those, or a hit sequence could admit into
+            # capacity it is itself consuming and then preempt healthy
+            # running sequences to cover its decode growth
+            cached_hits = sum(
+                1 for bid in hit
+                if self.cache.allocator.refcount(bid) == 0)
+            if not self.cache.allocator.can_alloc(need + cached_hits):
                 break               # FIFO: no head-of-line skipping
+            for bid in hit:
+                self.cache.allocator.ref(bid)
             self.scheduler.place(seq, slot)
-            seq.seq_len = 0
+            seq.block_ids = list(hit)
+            seq.seq_len = hit_tokens
             seq.draft_len = 0
+            seq.prefill_started = False
+            seq.cache_hit_tokens = hit_tokens
+            if self.prefix_enabled:
+                self.prefix_lookups += 1
+                if hit_tokens > 0:
+                    self.prefix_hits += 1
+                    self.prefill_tokens_saved += hit_tokens
+                if self._stats:
+                    self._stats.record_prefix_lookup(
+                        hit_tokens, tenant=seq.tenant)
             events.append(("admitted", seq))
 
     def _finish(self, seq, events):
+        self._register_blocks(seq)
         self.cache.allocator.free(seq.block_ids)
         seq.block_ids = []
         reason = ("stop_token" if (seq.stop_token is not None
@@ -522,11 +786,14 @@ class LLMEngine:
             committed = seq.prompt
             cl = len(committed)
             remaining = cl - seq.seq_len
-            if seq.seq_len == 0:
+            if not seq.prefill_started:
+                seq.prefill_started = True
                 try:
                     # chaos-harness site: scripted raises for "prefill
-                    # fails on this prompt" — checked once per prefill
-                    # start, isolating exactly the poison sequence
+                    # fails on this prompt" — checked once per
+                    # admission (a prefix-cache hit starts mid-prompt,
+                    # so the flag, not seq_len == 0, marks the start),
+                    # isolating exactly the poison sequence
                     faults.check("llm.prefill")
                 except Exception as exc:
                     self._poison(seq, exc, events)
@@ -561,10 +828,27 @@ class LLMEngine:
         """Blocks covering this step's KV writes (positions
         ``seq_len .. seq_len + ntok - 1``), allocated ONTO the
         sequence before any dispatch so every failure path frees them;
-        under pressure preempt newest-admitted first."""
+        under pressure preempt newest-admitted first.
+
+        Copy-on-write: a write-range block the sequence still SHARES
+        (refcount > 1 — a prefix-cache hit whose final block the
+        sequence is about to extend/rewrite) is copied to a private
+        block first, so shared prefix KV is immutable for every other
+        owner. COW capacity is reserved through the same
+        preempt-under-pressure loop."""
         need = self.cache.blocks_for(seq.seq_len + plan["ntok"]) \
             - len(seq.block_ids)
-        while need > 0 and not self.cache.allocator.can_alloc(need):
+        cow = []
+        if self.prefix_enabled and seq.block_ids:
+            bs = self.cache.block_size
+            first = seq.seq_len // bs
+            last = min((seq.seq_len + plan["ntok"] - 1) // bs,
+                       len(seq.block_ids) - 1)
+            cow = [bi for bi in range(first, last + 1)
+                   if self.cache.allocator.refcount(
+                       seq.block_ids[bi]) > 1]
+        total = max(need, 0) + len(cow)
+        while total > 0 and not self.cache.allocator.can_alloc(total):
             victim = self.scheduler.pick_victim(exclude=(seq,))
             if victim is None:
                 raise KVCacheError(
@@ -572,6 +856,10 @@ class LLMEngine:
                     "small for max_context")
             self._preempt(victim)
             events.append(("preempted", victim))
+        for bi in cow:
+            # a victim preemption above may have dropped the share
+            if self.cache.allocator.refcount(seq.block_ids[bi]) > 1:
+                self._cow_block(seq, bi)
         if need > 0:
             seq.block_ids.extend(self.cache.allocator.alloc(need))
 
@@ -628,12 +916,9 @@ class LLMEngine:
         # worker death mid-verify
         faults.check("llm.draft")
         sampled = any(s.sampling.temperature > 0 for s in feeds)
-        tok, probs, kp, vp = self._draft_jits[sampled](
-            self._draft_params, self.draft_cache.k_pages,
-            self.draft_cache.v_pages, tokens, positions, seq_ids,
-            valid, tables, last_idx, temp, top_k, top_p, seeds,
-            counters)
-        self.draft_cache.swap(kp, vp)
+        tok, probs = self._call_draft(sampled, (
+            tokens, positions, seq_ids, valid, tables, last_idx,
+            temp, top_k, top_p, seeds, counters))
         return self._device_get((tok, probs))
 
     def _draft_propose(self, rows, plans):
@@ -642,7 +927,18 @@ class LLMEngine:
         tokens per speculative row (stored on the row's plan). A
         failing draft dispatch DEGRADES the step to plain decode —
         never poisons, never leaks (draft pages share the target's
-        block accounting)."""
+        block accounting).
+
+        Prefix-cache interaction: catch-up feeds for a cache-hit
+        sequence write DRAFT-pool KV into rows of blocks whose TARGET
+        KV is shared (refcount > 1), without COW. This is safe by the
+        same determinism the cache's bit-exact parity rests on: the
+        draft KV of position p is a pure function of the committed
+        token prefix, so every owner of a shared block writes
+        byte-identical draft rows (pinned by the spec-parity suite).
+        Only the TARGET pool is strictly immutable-under-sharing —
+        its writes carry new per-sequence content and always COW
+        first (:meth:`_allocate`)."""
         if self.draft_model is None:
             return
         feeds, counters, proposing = {}, {}, []
@@ -810,10 +1106,7 @@ class LLMEngine:
         mb = next(w for w in self._mb_widths if w >= mb_need)
         sampled = any(s.sampling.temperature > 0 for s in rows)
         batch = self._build_batch(rows, plans, t, mb)
-        toks, n_acc, kp, vp = self._step_jits[sampled](
-            self._params, self.cache.k_pages, self.cache.v_pages,
-            *batch)
-        self.cache.swap(kp, vp)
+        toks, n_acc = self._call_step(sampled, batch)
         return self._device_get((toks, n_acc))
 
     def _sites(self, rows, plans):
@@ -849,14 +1142,20 @@ class LLMEngine:
                     self._stats.record_prefill_chunk(plan["ntok"])
                 if not plan["emit"]:
                     continue
-                # the prompt completed: its last position's logits
-                # sampled the first generated token
+                # the prompt completed: register its full immutable
+                # blocks in the prefix index (later identical prefixes
+                # hit them), then commit the first generated token —
+                # its logits came out of this chunk's last position
+                self._register_blocks(seq)
                 tok = int(toks[seq.slot, 0])
                 seq.generated.append(tok)
                 seq.last_token = tok
                 events.append(("token", seq))
                 if self._stats:
-                    self._stats.record_prefill(cl)
+                    # prefill work actually PAID: hit tokens' KV was
+                    # served from the cache, never written here
+                    self._stats.record_prefill(
+                        cl - seq.cache_hit_tokens)
                     self._stats.record_prefill_token()
                 if seq.t_first_token is None:
                     seq.t_first_token = time.monotonic()

@@ -78,11 +78,46 @@ class LLMStats:
             "Sequences in the decode batch.", lbl).labels(**s)
         self._blocks_in_use = r.gauge(
             "mxtpu_llm_kv_blocks_in_use",
-            "Allocated KV cache blocks.", lbl).labels(**s)
+            "Allocated KV cache blocks (refcount >= 1).",
+            lbl).labels(**s)
         self._blocks_total = r.gauge(
             "mxtpu_llm_kv_blocks_total",
             "Usable KV cache blocks (pool minus the null block).",
             lbl).labels(**s)
+        self._blocks_cached = r.gauge(
+            "mxtpu_llm_kv_blocks_cached",
+            "Zero-refcount blocks parked in the prefix-cache LRU "
+            "(reclaimable capacity holding reusable prefix KV).",
+            lbl).labels(**s)
+        self._blocks_shared = r.gauge(
+            "mxtpu_llm_kv_blocks_shared",
+            "Blocks owned by more than one live sequence "
+            "(refcount > 1).", lbl).labels(**s)
+        self._blocks_free = r.gauge(
+            "mxtpu_llm_kv_blocks_free",
+            "Strictly free blocks (not allocated, not cached).",
+            lbl).labels(**s)
+        self._prefix_lookups = r.counter(
+            "mxtpu_llm_prefix_lookup_total",
+            "Prefix-cache lookups (one per admission while the cache "
+            "is enabled).", lbl).labels(**s)
+        self._prefix_hits = r.counter(
+            "mxtpu_llm_prefix_hit_total",
+            "Admissions whose prompt prefix was served from cached "
+            "blocks.", lbl).labels(**s)
+        self._prefix_evicts = r.counter(
+            "mxtpu_llm_prefix_evict_total",
+            "Cached prefix blocks reclaimed LRU-oldest-first under KV "
+            "pressure.", lbl).labels(**s)
+        self._prefill_saved = r.counter(
+            "mxtpu_llm_prefill_tokens_saved_total",
+            "Prompt tokens whose prefill was skipped because their KV "
+            "was served from the prefix cache.", lbl).labels(**s)
+        self._tenant_saved = r.counter(
+            "mxtpu_llm_tenant_prefill_tokens_saved_total",
+            "Prefill tokens saved by prefix-cache hits, attributed "
+            "per tenant (tagged requests only).", ("server", "tenant"))
+        self._tenant_saved_children = {}
         self._prefill_chunks = r.counter(
             "mxtpu_llm_prefill_chunk_total",
             "Prompt chunks written through the unified step (chunked "
@@ -141,6 +176,17 @@ class LLMStats:
         return self._server
 
     # ---------------------------------------------------- recording --
+    def _labeled_child(self, counter, cache, **labels):
+        """Memoized per-label child lookup (engine-thread only — the
+        lock-free twin of TenantStats' guarded cache); one copy so the
+        eviction-reason and tenant-saved series cannot drift."""
+        key = tuple(sorted(labels.items()))
+        child = cache.get(key)
+        if child is None:
+            child = counter.labels(server=self._server, **labels)
+            cache[key] = child
+        return child
+
     def record_submit(self):
         self._submitted.inc()
 
@@ -148,9 +194,30 @@ class LLMStats:
         self._queue_depth.set(waiting)
         self._running.set(running)
 
-    def record_blocks(self, in_use, total):
+    def record_blocks(self, in_use, total, cached=0, shared=0,
+                      free=None):
         self._blocks_in_use.set(in_use)
         self._blocks_total.set(total)
+        self._blocks_cached.set(cached)
+        self._blocks_shared.set(shared)
+        self._blocks_free.set(total - in_use - cached
+                              if free is None else free)
+
+    def record_prefix_lookup(self, hit_tokens, tenant=None):
+        """One admission-time prefix-cache lookup: counts the lookup,
+        the hit (when any tokens were served from cache) and the
+        prefill tokens saved — attributed per tenant when tagged."""
+        self._prefix_lookups.inc()
+        if hit_tokens > 0:
+            self._prefix_hits.inc()
+            self._prefill_saved.inc(hit_tokens)
+            if tenant is not None:
+                self._labeled_child(
+                    self._tenant_saved, self._tenant_saved_children,
+                    tenant=str(tenant)).inc(hit_tokens)
+
+    def record_prefix_evict(self, n=1):
+        self._prefix_evicts.inc(n)
 
     def record_prefill(self, prompt_tokens):
         self._prefills.inc()
@@ -207,12 +274,8 @@ class LLMStats:
         self._latency.observe(latency_s)
 
     def record_evicted(self, reason):
-        child = self._evict_children.get(reason)
-        if child is None:
-            child = self._evicted.labels(server=self._server,
-                                         reason=reason)
-            self._evict_children[reason] = child
-        child.inc()
+        self._labeled_child(self._evicted, self._evict_children,
+                            reason=reason).inc()
 
     def record_failure(self, n=1):
         self._failed.inc(n)
@@ -263,6 +326,14 @@ class LLMStats:
                 "running_seqs": int(self._running.value),
                 "kv_blocks_in_use": int(self._blocks_in_use.value),
                 "kv_blocks_total": int(self._blocks_total.value),
+                "kv_blocks_cached": int(self._blocks_cached.value),
+                "kv_blocks_shared": int(self._blocks_shared.value),
+                "kv_blocks_free": int(self._blocks_free.value),
+                "prefix_lookups": int(self._prefix_lookups.value),
+                "prefix_hits": int(self._prefix_hits.value),
+                "prefix_evictions": int(self._prefix_evicts.value),
+                "prefill_tokens_saved": int(
+                    self._prefill_saved.value),
                 "tokens_per_sec": self._tps.value,
                 "ttft_ms": {
                     "p50": self._ttft.percentile(50) * 1e3,

@@ -225,7 +225,8 @@ class TinyDecoder:
         return logits, k_pages, v_pages
 
     def decode_flat(self, params, tokens, positions, seq_ids, valid,
-                    k_pages, v_pages, block_tables):
+                    k_pages, v_pages, block_tables, k_scales=None,
+                    v_scales=None):
         """The FLAT ragged step: a packed ``[T]`` batch of query
         tokens from many sequences — no per-sequence padding, so a
         mixed prefill/decode/verify step computes exactly the tokens
@@ -241,12 +242,22 @@ class TinyDecoder:
         callers must have packed each sequence's tokens in position
         order so later chunk tokens see earlier ones' writes.
         Returns (logits [T, V], k_pages, v_pages).
+
+        Quantized KV (ISSUE 13): with ``k_scales``/``v_scales``
+        ``[L, N, bs, H]`` f32 the pages are int8 — each token's K/V is
+        quantized symmetrically per (slot, head) on write (scale =
+        max|x|/127, stored alongside) and dequantized inside the
+        ragged kernel on read. Quantization is a pure function of the
+        written value, so a cached (prefix-shared) block holds exactly
+        the bytes a recomputing sequence would produce. Returns
+        (logits, k_pages, v_pages, k_scales, v_scales).
         """
         import jax
         import jax.numpy as jnp
         c = self.config
         T = tokens.shape[0]
         bs = k_pages.shape[2]
+        quantized = k_scales is not None
         vmask = valid.astype(bool)
         bidx = jnp.where(
             vmask,
@@ -258,19 +269,40 @@ class TinyDecoder:
             q = (x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
             k = (x @ lp["wk"]).reshape(T, c.num_heads, c.head_dim)
             v = (x @ lp["wv"]).reshape(T, c.num_heads, c.head_dim)
-            k_pages = k_pages.at[li, bidx, slot].set(
-                k.astype(k_pages.dtype))
-            v_pages = v_pages.at[li, bidx, slot].set(
-                v.astype(v_pages.dtype))
-            att = ragged_flat_attention(q, k_pages[li], v_pages[li],
-                                        block_tables, seq_ids,
-                                        positions)
+            if quantized:
+                ksc = jnp.maximum(
+                    jnp.max(jnp.abs(k), axis=-1) / 127.0, 1e-8)
+                vsc = jnp.maximum(
+                    jnp.max(jnp.abs(v), axis=-1) / 127.0, 1e-8)
+                kq = jnp.clip(jnp.round(k / ksc[..., None]),
+                              -127, 127).astype(jnp.int8)
+                vq = jnp.clip(jnp.round(v / vsc[..., None]),
+                              -127, 127).astype(jnp.int8)
+                k_pages = k_pages.at[li, bidx, slot].set(kq)
+                v_pages = v_pages.at[li, bidx, slot].set(vq)
+                k_scales = k_scales.at[li, bidx, slot].set(ksc)
+                v_scales = v_scales.at[li, bidx, slot].set(vsc)
+                att = ragged_flat_attention(
+                    q, k_pages[li], v_pages[li], block_tables,
+                    seq_ids, positions, k_scales=k_scales[li],
+                    v_scales=v_scales[li])
+            else:
+                k_pages = k_pages.at[li, bidx, slot].set(
+                    k.astype(k_pages.dtype))
+                v_pages = v_pages.at[li, bidx, slot].set(
+                    v.astype(v_pages.dtype))
+                att = ragged_flat_attention(q, k_pages[li],
+                                            v_pages[li],
+                                            block_tables, seq_ids,
+                                            positions)
             h = h + att.reshape(T, c.d_model) @ lp["wo"]
             x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
             h = h + jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"] \
                 + lp["b2"]
         logits = _layer_norm(h, params["lnf_g"],
                              params["lnf_b"]) @ params["head"]
+        if quantized:
+            return logits, k_pages, v_pages, k_scales, v_scales
         return logits, k_pages, v_pages
 
     def decode_step(self, params, tokens, positions, k_pages, v_pages,

@@ -49,7 +49,9 @@ class Sequence:
                  "block_ids", "seq_len", "last_token", "t_submit",
                  "t_first_token", "admit_index", "preemptions",
                  "future", "span", "finish_reason", "deadline",
-                 "cancelled", "tenant", "sampling", "draft_len")
+                 "cancelled", "tenant", "sampling", "draft_len",
+                 "prefill_started", "prefix_hashes",
+                 "cache_hit_tokens")
 
     def __init__(self, prompt_tokens, max_new_tokens, stop_token=None,
                  deadline=None, tenant=None, sampling=None):
@@ -99,6 +101,19 @@ class Sequence:
         # decoding); mirrors seq_len during prefill, rolls back with
         # rejected drafts
         self.draft_len = 0
+        # set by the engine when this admission's first prefill chunk
+        # has been planned (the poison-injection site fires exactly
+        # once per admission, even when a prefix-cache hit makes the
+        # first chunk start mid-prompt)
+        self.prefill_started = False
+        # chained per-block content hashes of the prompt's FULL blocks
+        # (computed at admission for the prefix-cache lookup, reused
+        # at registration time)
+        self.prefix_hashes = None
+        # prompt tokens served from the prefix cache THIS admission —
+        # prefill work the sequence never paid (credited on
+        # mxtpu_llm_prefill_tokens_saved_total)
+        self.cache_hit_tokens = 0
 
     def expired(self, now=None):
         if self.deadline is None:
@@ -208,6 +223,12 @@ class Scheduler:
         seq.seq_len = 0
         seq.draft_len = 0
         seq.last_token = None
+        # re-admission re-runs the prefix lookup over the folded
+        # prompt (its own registered blocks usually hit, making the
+        # resume cheap) and re-arms the once-per-admission sites
+        seq.prefill_started = False
+        seq.prefix_hashes = None
+        seq.cache_hit_tokens = 0
         seq.state = WAITING
         seq.preemptions += 1
         self.waiting.appendleft(seq)

@@ -311,6 +311,11 @@ class LLMServer:
         snap["spec_k"] = self._engine.spec_k
         snap["q_tokens"] = self._engine.q_tokens
         snap["max_seqs"] = self._engine.max_seqs
+        snap["prefix_cache"] = self._engine.prefix_enabled
+        snap["kv_dtype"] = self._engine.cache.dtype.name
+        lookups = snap.get("prefix_lookups", 0)
+        snap["prefix_hit_rate"] = (snap.get("prefix_hits", 0) / lookups
+                                   if lookups else 0.0)
         return snap
 
     # --------------------------------------------------------- drain --

@@ -1,0 +1,232 @@
+"""int8 KV quantization (ISSUE 13): the explicit tolerance contract.
+
+Quantized KV is NOT bit-exact against fp32 — so instead of silent
+drift this suite pins an explicit contract:
+
+- mechanics are exact where they can be: the quantized flat reference
+  equals the fp32 reference evaluated on the dequantized pages
+  bit-for-bit (dequantization is the only difference), and the Pallas
+  quantized kernel (interpret mode off-TPU) tracks the quantized
+  reference to float-accumulation tolerance;
+- per-element quantization error is bounded by half a scale step
+  (symmetric round-to-nearest, scale = max|x|/127);
+- per-layer model tolerance: ``decode_flat`` logits with int8 KV stay
+  within ``LOGIT_TOL`` of the fp32 run on the same inputs;
+- end-to-end greedy decoding with int8 KV agrees top-1, token for
+  token, with the fp32 eager oracle for the pinned seed/config;
+- int8 composes with the prefix cache bit-exactly (a cached quantized
+  block IS the bytes a recomputing sequence would write), and the
+  whole path stays zero-recompile with clean block accounting.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import jax.numpy as jnp  # noqa: E402
+from mxnet_tpu import serving  # noqa: E402
+from mxnet_tpu.ops.ragged_attention import (  # noqa: E402
+    ragged_flat_attention, ragged_flat_attention_reference)
+from mxnet_tpu.serving.llm import (  # noqa: E402
+    TinyDecoder, DecoderConfig, LLMEngine, Sequence,
+    greedy_decode_reference)
+
+VOCAB = 17
+BS = 8
+# small context: both int8 engines in this module share one set of
+# page/program shapes, so the quantized programs compile once
+CTX = 32
+
+# the per-layer contract: max |logits_int8 - logits_fp32| for one
+# decode_flat dispatch of this reference config (measured ~9e-3; the
+# bound leaves ~5x headroom without ever letting real drift hide)
+LOGIT_TOL = 0.05
+# kernel-vs-reference tolerance: both dequantize identically, the
+# only difference is online-softmax float accumulation order
+KERNEL_TOL = 2e-6
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TinyDecoder(DecoderConfig(
+        vocab_size=VOCAB, d_model=16, num_layers=2, num_heads=2,
+        d_ff=32, max_context=CTX))
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init_params(seed=0)
+
+
+def _quantize_pages(rng, n, bs, h, d):
+    kf = rng.randn(n, bs, h, d).astype(np.float32)
+    sc = np.maximum(np.abs(kf).max(-1) / 127.0, 1e-8).astype(np.float32)
+    kq = np.clip(np.round(kf / sc[..., None]), -127, 127).astype(np.int8)
+    return kf, kq, sc
+
+
+def test_quantization_error_bounded_by_half_scale_step():
+    rng = np.random.RandomState(0)
+    kf, kq, sc = _quantize_pages(rng, 6, BS, 2, 8)
+    deq = kq.astype(np.float32) * sc[..., None]
+    err = np.abs(deq - kf)
+    assert (err <= sc[..., None] * 0.5 + 1e-7).all()
+
+
+def test_quant_reference_equals_dequant_oracle_bitwise():
+    """The quantized reference path differs from fp32 ONLY by the
+    dequantize step: feeding the fp32 reference the dequantized pages
+    must reproduce it exactly."""
+    rng = np.random.RandomState(1)
+    _, kq, ks = _quantize_pages(rng, 9, BS, 2, 8)
+    _, vq, vs = _quantize_pages(rng, 9, BS, 2, 8)
+    q = rng.randn(6, 2, 8).astype(np.float32)
+    bt = np.array([[3, 1, 7, 0], [2, 5, 0, 0], [4, 6, 8, 1]], np.int32)
+    sid = np.array([0, 0, 1, 2, 2, 1], np.int32)
+    pos = np.array([3, 9, 14, 5, 30, 2], np.int32)
+    ref_q = ragged_flat_attention_reference(
+        jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+        jnp.asarray(bt), jnp.asarray(sid), jnp.asarray(pos),
+        k_scales=jnp.asarray(ks), v_scales=jnp.asarray(vs))
+    kd = kq.astype(np.float32) * ks[..., None]
+    vd = vq.astype(np.float32) * vs[..., None]
+    ref_f = ragged_flat_attention_reference(
+        jnp.asarray(q), jnp.asarray(kd), jnp.asarray(vd),
+        jnp.asarray(bt), jnp.asarray(sid), jnp.asarray(pos))
+    assert np.array_equal(np.asarray(ref_q), np.asarray(ref_f))
+
+
+def test_quant_pallas_kernel_matches_reference():
+    """The Pallas quantized-page kernel (interpret mode off-TPU) —
+    same scalar-prefetched block-table indexing, dequant fused at the
+    page tile — tracks the quantized gather reference within float
+    accumulation tolerance, over fragmented tables."""
+    rng = np.random.RandomState(2)
+    _, kq, ks = _quantize_pages(rng, 11, BS, 2, 8)
+    _, vq, vs = _quantize_pages(rng, 11, BS, 2, 8)
+    q = rng.randn(8, 2, 8).astype(np.float32)
+    bt = np.array([[9, 2, 5, 1], [7, 10, 0, 0], [3, 8, 6, 4]], np.int32)
+    sid = np.array([0, 0, 0, 1, 1, 2, 2, 2], np.int32)
+    pos = np.array([0, 7, 25, 8, 15, 3, 17, 31], np.int32)
+    ref = ragged_flat_attention_reference(
+        jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+        jnp.asarray(bt), jnp.asarray(sid), jnp.asarray(pos),
+        k_scales=jnp.asarray(ks), v_scales=jnp.asarray(vs))
+    pal = ragged_flat_attention(q, kq, vq, bt, sid, pos,
+                                use_pallas=True, interpret=True,
+                                k_scales=ks, v_scales=vs)
+    assert float(jnp.max(jnp.abs(pal - ref))) < KERNEL_TOL
+
+
+def test_quant_requires_both_scales():
+    q = np.zeros((1, 2, 8), np.float32)
+    kp = np.zeros((2, BS, 2, 8), np.int8)
+    with pytest.raises(ValueError, match="both"):
+        ragged_flat_attention(q, kp, kp, np.zeros((1, 1), np.int32),
+                              np.zeros(1, np.int32),
+                              np.zeros(1, np.int32),
+                              k_scales=np.ones((2, BS, 2), np.float32))
+
+
+def test_decode_flat_per_layer_logit_tolerance(model, params):
+    """The per-layer contract: one mixed flat dispatch, fp32 pools vs
+    int8 pools, same tokens — logits within LOGIT_TOL and identical
+    argmax at every position."""
+    rng = np.random.RandomState(3)
+    L, H, D = model.num_layers, model.num_heads, model.head_dim
+    N = 9
+    kp = jnp.zeros((L, N, BS, H, D), jnp.float32)
+    vp = jnp.zeros((L, N, BS, H, D), jnp.float32)
+    kq = jnp.zeros((L, N, BS, H, D), jnp.int8)
+    vq = jnp.zeros((L, N, BS, H, D), jnp.int8)
+    ks = jnp.ones((L, N, BS, H), jnp.float32)
+    vs = jnp.ones((L, N, BS, H), jnp.float32)
+    T = 16
+    toks = rng.randint(0, VOCAB, T).astype(np.int32)
+    pos = np.arange(T, dtype=np.int32)
+    sid = np.zeros(T, np.int32)
+    valid = np.ones(T, np.int32)
+    bt = np.zeros((4, 8), np.int32)
+    bt[0, :2] = [3, 5]
+    lf = model.decode_flat(params, toks, pos, sid, valid, kp, vp, bt)[0]
+    lq = model.decode_flat(params, toks, pos, sid, valid, kq, vq, bt,
+                           k_scales=ks, v_scales=vs)[0]
+    diff = float(jnp.max(jnp.abs(lf - lq)))
+    assert diff < LOGIT_TOL, f"int8 logit drift {diff} > {LOGIT_TOL}"
+    assert np.array_equal(np.asarray(jnp.argmax(lf, -1)),
+                          np.asarray(jnp.argmax(lq, -1)))
+
+
+@pytest.mark.slow   # the int8 engine compiles its own quantized
+# program set (~18s); the tolerance CONTRACT stays tier-1 via the
+# op-level and decode_flat tests above — this pins it end to end
+def test_engine_int8_greedy_top1_agreement(model, params):
+    """End to end: continuous-batched greedy decoding on int8 KV
+    agrees token for token with the fp32 eager oracle (pinned seed —
+    any disagreement is drift past the contract, not noise)."""
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, VOCAB, size=n).tolist()
+               for n in (3, 5, 8, 13, 16, 21)]
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefill_chunk=8,
+                    kv_dtype="int8")
+    assert eng.quantized and eng.cache.dtype.name == "int8"
+    eng.warmup()
+    seqs = [Sequence(p, 6) for p in prompts]
+    with serving.CompileCounter() as cc:
+        for s in seqs:
+            eng.add(s)
+        steps = 0
+        while eng.has_work():
+            eng.step()
+            steps += 1
+            assert steps < 1000
+    assert cc.count == 0, f"{cc.count} recompiles on the int8 path"
+    for p, s in zip(prompts, seqs):
+        ref = greedy_decode_reference(model, params, p, 6)
+        assert s.output_tokens() == ref, \
+            f"int8 greedy diverged from fp32 oracle on prompt {p}"
+    assert eng.cache.allocator.num_used == 0
+    eng.cache.check(live_block_ids=[])
+
+
+@pytest.mark.slow   # shares the int8 program set above
+def test_int8_prefix_cache_hit_equals_miss_bitexact(model, params):
+    """Quantization is a pure function of the written value, so a
+    shared quantized block holds exactly the bytes a recomputing
+    sequence would produce: cache-hit int8 decoding == cache-miss
+    int8 decoding, bit for bit."""
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, VOCAB, size=2 * BS + 3).tolist()
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefill_chunk=8,
+                    kv_dtype="int8")
+    eng.warmup()
+    first = Sequence(prompt, 8)       # miss: computes + registers
+    for s in (first,):
+        eng.add(s)
+    while eng.has_work():
+        eng.step()
+    second = Sequence(prompt, 8)      # hit: shares the int8 blocks
+    eng.add(second)
+    while eng.has_work():
+        eng.step()
+    assert second.cache_hit_tokens >= 2 * BS
+    assert first.output_tokens() == second.output_tokens()
+    eng.cache.check(live_block_ids=[])
+
+
+def test_kv_dtype_env_knob(model, params, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_LLM_KV_DTYPE", "int8")
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefill_chunk=8)
+    assert eng.quantized
+    assert eng.cache.k_scales is not None
+    assert eng.cache.stats()["kv_dtype"] == "int8"
+    monkeypatch.setenv("MXNET_TPU_LLM_KV_DTYPE", "float32")
+    eng2 = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                     max_context=CTX, prefill_chunk=8)
+    assert not eng2.quantized and eng2.cache.k_scales is None

@@ -228,9 +228,12 @@ def test_spec_greedy_bit_identical_to_target_only(model, params,
     recompiles after warmup."""
     from mxnet_tpu.serving.llm.metrics import LLMStats
     stats = LLMStats(server="spec_greedy_t")
-    eng = LLMEngine(model, params, max_seqs=3, block_size=BS,
+    # same (max_seqs, spec_k) as the other spec tests in this
+    # module: the compiled target-step and draft programs are shared,
+    # so only the first spec test pays the XLA warmup
+    eng = LLMEngine(model, params, max_seqs=2, block_size=BS,
                     max_context=CTX, draft_model=draft,
-                    draft_params=draft_params, spec_k=3, stats=stats)
+                    draft_params=draft_params, spec_k=2, stats=stats)
     warm = eng.warmup()
     assert any(k.startswith("draft_t") for k in warm)
     assert any(k.startswith("step_t") for k in warm)
@@ -294,31 +297,40 @@ def test_sampled_preemption_resumes_exact_stream(model, params):
     a pool too small for every sequence forces restart-based
     preemption; the position-keyed PRNG must resume each sampled
     stream bit-identically to an unpressured run."""
-    def run(num_blocks):
-        eng = LLMEngine(model, params, max_seqs=3, block_size=BS,
-                        max_context=CTX, num_blocks=num_blocks)
-        eng.warmup()
+    def make_seqs():
         rng = np.random.RandomState(5)
         seqs = []
         for i in range(3):
             prompt = rng.randint(0, VOCAB,
                                  size=int(rng.randint(4, 12))).tolist()
-            s = Sequence(prompt, 25, sampling=SamplingParams(
-                temperature=1.0, top_k=0, top_p=0.9, seed=7 * i + 1))
-            seqs.append(s)
-            eng.add(s)
+            seqs.append(Sequence(prompt, 25, sampling=SamplingParams(
+                temperature=1.0, top_k=0, top_p=0.9, seed=7 * i + 1)))
+        return seqs
+
+    def run(one_at_a_time):
+        # the SAME pool both ways (one compiled program set): batched
+        # admission overflows it and preempts; one-at-a-time never
+        # feels pressure — the unpressured reference stream
+        eng = LLMEngine(model, params, max_seqs=3, block_size=BS,
+                        max_context=CTX, num_blocks=11)  # 10 usable
+        eng.warmup()
+        seqs = make_seqs()
         preempts = steps = 0
-        while eng.has_work():
-            preempts += sum(1 for k, _ in eng.step()
-                            if k == "preempted")
-            steps += 1
-            assert steps < 3000
+        waves = ([[s] for s in seqs] if one_at_a_time else [seqs])
+        for wave in waves:
+            for s in wave:
+                eng.add(s)
+            while eng.has_work():
+                preempts += sum(1 for k, _ in eng.step()
+                                if k == "preempted")
+                steps += 1
+                assert steps < 3000
         assert eng.cache.allocator.num_used == 0
         eng.cache.check(live_block_ids=[])
         return [s.output_tokens() for s in seqs], preempts
 
-    pressured, preempts = run(num_blocks=11)     # 10 usable, 8/seq
-    free_run, _ = run(num_blocks=3 * (CTX // BS) + 1)
+    pressured, preempts = run(one_at_a_time=False)
+    free_run, _ = run(one_at_a_time=True)
     assert preempts >= 1, "pool was sized to force preemption"
     assert pressured == free_run
 
@@ -333,10 +345,13 @@ def test_spec_rollback_keeps_block_accounting_exact(model, params):
         d_ff=16, max_context=CTX))
     from mxnet_tpu.serving.llm.metrics import LLMStats
     stats = LLMStats(server="spec_acct_t")
+    # spec_k matches the module's other spec engines so the target
+    # step programs are shared; the adversarial draft still drives
+    # sustained rejections at K=2
     eng = LLMEngine(model, params, max_seqs=2, block_size=BS,
                     max_context=CTX, draft_model=bad_draft,
                     draft_params=bad_draft.init_params(seed=99),
-                    spec_k=4, stats=stats)
+                    spec_k=2, stats=stats)
     eng.warmup()
     seqs = []
     for i in range(4):

@@ -128,7 +128,9 @@ def test_zero_recompiles_mixed_prefill_decode_staggered(model, params):
     """After warmup, staggered arrivals with varying prompt lengths mix
     prefill and decode launches every which way — and compile
     NOTHING (the backend_compile counter must not move)."""
-    eng = LLMEngine(model, params, max_seqs=3, block_size=BS,
+    # same max_seqs as the bit-identical test above: the compiled
+    # program set is shared, so this test's warmup compiles nothing
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
                     max_context=CTX)
     eng.warmup()
     rng = np.random.RandomState(4)
@@ -163,11 +165,14 @@ def test_warmup_covers_every_bucket_once(model, params):
                     max_context=CTX)
     first = eng.warmup()
     # every (packed length x table width) rung of the ONE flat
-    # program, in its greedy and sampled variants — nothing else is
+    # program, in its greedy and sampled variants, plus the prefix
+    # cache's fixed-shape copy-on-write program — nothing else is
     # reachable in steady state
-    assert set(first) == {
-        f"step_t{t}mb{mb}_{v}" for t in eng._t_buckets
-        for mb in eng._mb_widths for v in ("greedy", "sampled")}
+    expect = {f"step_t{t}mb{mb}_{v}" for t in eng._t_buckets
+              for mb in eng._mb_widths for v in ("greedy", "sampled")}
+    if eng.prefix_enabled:
+        expect.add("cow_copy")
+    assert set(first) == expect
     assert max(eng._t_buckets) == eng.max_seqs * eng.q_tokens
     assert eng.cache.max_blocks_per_seq in eng._mb_widths
     with serving.CompileCounter() as cc:

@@ -1,0 +1,428 @@
+"""Cross-request prefix caching (ISSUE 13): the acceptance contract.
+
+- BIT-EXACT parity: greedy decode with the prefix cache ON (fp32 KV)
+  is identical to cache OFF across mixed shared/unshared batches —
+  including under KV-pressure preemption and speculative decoding —
+  because a cached block holds exactly the bytes the sequence would
+  have computed itself (per-token K/V is a deterministic function of
+  the shared prefix);
+- refcounted sharing rides the STRICT BlockAllocator accounting:
+  ``check()`` stays clean through hit/ref/free/COW/LRU churn, cached
+  blocks are reclaimable capacity (never leaks), and copy-on-write
+  gives a sequence a private block before its first divergent write
+  into a shared one;
+- zero steady-state recompiles under mixed hit/miss + sampled +
+  speculative traffic (cache hit vs miss never changes a program
+  shape; the COW copy is a warmed fixed-shape program).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mxnet_tpu import serving  # noqa: E402
+from mxnet_tpu.serving.llm import (  # noqa: E402
+    TinyDecoder, DecoderConfig, LLMEngine, LLMServer, Sequence,
+    greedy_decode_reference)
+from mxnet_tpu.serving.llm.kv_cache import (  # noqa: E402
+    prefix_block_hashes)
+from mxnet_tpu.serving.llm.sampling import SamplingParams  # noqa: E402
+
+VOCAB = 17
+BS = 8
+# CTX deliberately small: every engine in this module shares the
+# same page/program shapes (max_seqs=4, 8-token blocks, 32 context or
+# the one small pressure pool), so XLA compiles each program ONCE for
+# the whole module
+CTX = 32
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TinyDecoder(DecoderConfig(
+        vocab_size=VOCAB, d_model=16, num_layers=2, num_heads=2,
+        d_ff=32, max_context=CTX))
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init_params(seed=0)
+
+
+@pytest.fixture(scope="module")
+def draft(model):
+    """One layer-truncated draft shared by every speculative test in
+    this module (a fresh draft model per test would recompile its
+    programs)."""
+    return TinyDecoder(DecoderConfig(
+        vocab_size=VOCAB, d_model=16, num_layers=1, num_heads=2,
+        d_ff=32, max_context=CTX))
+
+
+@pytest.fixture(scope="module")
+def draft_params(params):
+    return {k: (v if k != "layers" else list(v[:1]))
+            for k, v in params.items()}
+
+
+def _run_all(eng, seqs):
+    for s in seqs:
+        eng.add(s)
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        steps += 1
+        assert steps < 2000
+    return steps
+
+
+def _shared_mix(rng, shared_prefix, n_shared=4, n_unshared=3):
+    """Mixed batch: n_unshared fully distinct prompts FIRST (so the
+    initial admission wave holds at most one copy of the shared
+    prefix — later shared admissions find it registered), then
+    n_shared prompts extending one shared prefix with distinct
+    tails."""
+    cases = []
+    for i in range(n_unshared):
+        cases.append((rng.randint(0, VOCAB,
+                                  size=int(rng.randint(2, 20))).tolist(),
+                      3 + i))
+    for i in range(n_shared):
+        tail = rng.randint(0, VOCAB, size=1 + i).tolist()
+        cases.append((shared_prefix + tail, 4 + (i % 3)))
+    return cases
+
+
+def test_chained_block_hashes_bind_whole_prefix():
+    a = prefix_block_hashes(list(range(16)), 8)
+    b = prefix_block_hashes(list(range(16)), 8)
+    assert a == b and len(a) == 2
+    # same second block content, different FIRST block -> different
+    # chained hash (equal hash k must imply equal whole prefix)
+    c = prefix_block_hashes([9] * 8 + list(range(8, 16)), 8)
+    assert c[1] != b[1]
+    # partial tail block never hashes
+    assert len(prefix_block_hashes(list(range(15)), 8)) == 1
+
+
+def test_cache_on_equals_cache_off_mixed_shared_batches(model, params):
+    """The headline parity pin: same mixed shared/unshared batch, same
+    admission order, cache ON vs OFF — every token stream identical,
+    and both equal the per-sequence eager oracle. The ON run must
+    actually hit (saved tokens > 0) for the comparison to mean
+    anything."""
+    rng = np.random.RandomState(7)
+    prefix = rng.randint(0, VOCAB, size=2 * BS).tolist()
+    cases = _shared_mix(rng, prefix)
+    outs = {}
+    for on in (False, True):
+        eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                        max_context=CTX, prefill_chunk=8,
+                        prefix_cache=on)
+        eng.warmup()
+        seqs = [Sequence(p, n) for p, n in cases]
+        _run_all(eng, seqs)
+        outs[on] = [s.output_tokens() for s in seqs]
+        assert eng.cache.allocator.num_used == 0
+        eng.cache.check(live_block_ids=[])
+        if on:
+            assert eng.prefix_lookups == len(cases)
+            assert eng.prefix_hits >= 3          # the shared tails hit
+            assert eng.prefill_tokens_saved >= 3 * 2 * BS - 1
+        else:
+            assert eng.prefix_lookups == 0
+    assert outs[True] == outs[False]
+    for (p, n), toks in zip(cases, outs[True]):
+        assert toks == greedy_decode_reference(model, params, p, n)
+
+
+def test_block_aligned_full_hit_cows_on_first_divergence(model, params):
+    """A prompt that is EXACTLY its cached blocks: the hit serves all
+    but the last token, whose recompute-chunk writes into the final
+    SHARED block — copy-on-write must give the new sequence a private
+    copy first (the original owner is still alive and attending over
+    that block). Streams stay bit-exact for both."""
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(0, VOCAB, size=2 * BS).tolist()   # aligned
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefill_chunk=8)
+    eng.warmup()
+    a = Sequence(prompt, 8)                # long-lived first owner
+    eng.add(a)
+    # step until A's prompt blocks are registered (prefill complete)
+    steps = 0
+    while not a.generated:
+        eng.step()
+        steps += 1
+        assert steps < 50
+    b = Sequence(prompt, 4)
+    eng.add(b)
+    while eng.has_work():
+        eng.step()
+        live = [s.block_ids for s in eng.scheduler.running()]
+        eng.cache.check(live_block_ids=live)
+    assert b.cache_hit_tokens == 2 * BS - 1
+    assert eng.cache.cow_count >= 1
+    ref_a = greedy_decode_reference(model, params, prompt, 8)
+    ref_b = greedy_decode_reference(model, params, prompt, 4)
+    assert a.output_tokens() == ref_a
+    assert b.output_tokens() == ref_b
+    eng.cache.check(live_block_ids=[])
+
+
+@pytest.mark.slow   # distinct small-pool page shape = its own full
+# XLA program set (~14s); tier-1 keeps preemption parity
+# (test_llm_serving), shared-refcount chaos (test_serving_chaos) and
+# the allocator-level LRU fuzz (test_ragged_attention)
+def test_preemption_with_shared_blocks_parity(model, params):
+    """KV pressure over a pool holding shared blocks: victims free
+    their REFERENCES (never a block another sequence still reads),
+    preempted sequences re-hit their own registered prefix on resume,
+    and every stream stays bit-exact."""
+    rng = np.random.RandomState(5)
+    prefix = rng.randint(0, VOCAB, size=BS).tolist()
+    cases = [(prefix + rng.randint(0, VOCAB, size=1 + i).tolist(), 8)
+             for i in range(4)]
+    # pool: one full-context sequence + barely any slack — decode
+    # growth must outrun it even WITH the shared prefix block
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, num_blocks=CTX // BS + 2,
+                    prefill_chunk=8)
+    eng.warmup()
+    seqs = [Sequence(p, n) for p, n in cases]
+    for s in seqs:
+        eng.add(s)
+    steps = 0
+    preempted = 0
+    while eng.has_work():
+        events = eng.step()
+        preempted += sum(1 for e, _ in events if e == "preempted")
+        live = [s.block_ids for s in eng.scheduler.running()]
+        eng.cache.check(live_block_ids=live)
+        steps += 1
+        assert steps < 2000
+    assert preempted >= 1, "pool was too large to exercise preemption"
+    for (p, n), s in zip(cases, seqs):
+        assert s.output_tokens() == greedy_decode_reference(
+            model, params, p, n)
+    eng.cache.check(live_block_ids=[])
+
+
+@pytest.mark.slow   # shares the small-pool program set above
+def test_hit_admission_counts_its_own_cached_blocks(model, params):
+    """Admission-gate regression: a cache-hit sequence's hit blocks
+    sit in the cached LRU, where they count as free capacity — but
+    the admission is about to consume them itself. The gate must
+    charge need + cached-hit blocks, or a hit sequence admits into
+    capacity it is consuming and then PREEMPTS a healthy running
+    sequence to cover its growth."""
+    rng = np.random.RandomState(17)
+    prompt_a = rng.randint(0, VOCAB, size=2 * BS).tolist()
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, num_blocks=CTX // BS + 2,
+                    prefill_chunk=8)                   # 5 usable
+    eng.warmup()
+    a = Sequence(prompt_a, 2)
+    _run_all(eng, [a])          # registers 2 blocks -> cached, 3 free
+    assert eng.cache.stats()["blocks_cached"] == 2
+    c = Sequence(rng.randint(0, VOCAB, size=2 * BS + 1).tolist(), 8)
+    eng.add(c)
+    steps = 0
+    while not c.generated:      # C running, holding the 3 free blocks
+        eng.step()
+        steps += 1
+        assert steps < 50
+    b = Sequence(prompt_a, 4)   # full hit on A's 2 cached blocks
+    eng.add(b)
+    for _ in range(3):
+        events = eng.step()
+        # B must WAIT (need + its own cached hits exceed capacity),
+        # never admit-then-preempt the healthy C
+        assert not any(e == "preempted" for e, _ in events)
+    assert b.state == "waiting" and c.state == "running"
+    while eng.has_work():
+        events = eng.step()
+        assert not any(e == "preempted" for e, _ in events)
+    assert b.cache_hit_tokens == 2 * BS - 1     # admitted after C freed
+    assert b.output_tokens() == greedy_decode_reference(
+        model, params, prompt_a, 4)
+    eng.cache.check(live_block_ids=[])
+
+
+@pytest.mark.slow   # its own tiny-pool page shape (~10s compile)
+def test_aligned_live_hit_reserves_cow_block(model, params):
+    """Admission-gate regression (the LIVE-shared twin of the test
+    above): a block-aligned full hit on blocks another RUNNING
+    sequence still owns must reserve the copy-on-write block up
+    front — with only one free block the hit request WAITS instead of
+    admitting, COWing the last free block away and then preempting
+    the healthy owner to cover its first decode page."""
+    rng = np.random.RandomState(23)
+    prompt = rng.randint(0, VOCAB, size=2 * BS).tolist()   # aligned
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, num_blocks=CTX // BS + 1,
+                    prefill_chunk=8)                       # 4 usable
+    eng.warmup()
+    a = Sequence(prompt, 8)
+    eng.add(a)
+    steps = 0
+    while len(a.block_ids) < 3:     # A running: 2 prompt + 1 decode
+        eng.step()                  # block all allocated (1 free left)
+        steps += 1
+        assert steps < 50
+    b = Sequence(prompt, 4)     # aligned full hit on A's LIVE blocks
+    eng.add(b)
+    while eng.has_work():
+        events = eng.step()
+        assert not any(e == "preempted" for e, _ in events), \
+            "hit admission preempted the healthy block owner"
+    assert a.output_tokens() == greedy_decode_reference(
+        model, params, prompt, 8)
+    assert b.output_tokens() == greedy_decode_reference(
+        model, params, prompt, 4)
+    eng.cache.check(live_block_ids=[])
+
+
+@pytest.mark.slow   # the speculative engine compiles its own
+# target-step + draft program set (~25s); tier-1 retains spec parity
+# without the cache (test_llm_sampling) and the no-spec zero-recompile
+# pin below — this test carries the full spec x cache cross product
+def test_speculative_decode_with_prefix_cache_parity(model, params,
+                                                     draft,
+                                                     draft_params):
+    """Greedy speculative decoding over cache-hit sequences: the
+    draft's catch-up feeds rebuild its (missing) KV for hit tokens,
+    rollback trims only private blocks, and spec+cache greedy equals
+    target-only greedy bit-exactly."""
+    dparams = draft_params
+    rng = np.random.RandomState(9)
+    prefix = rng.randint(0, VOCAB, size=2 * BS).tolist()
+    cases = _shared_mix(rng, prefix, n_shared=3, n_unshared=1)
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefill_chunk=4,
+                    draft_model=draft, draft_params=dparams,
+                    spec_k=2)
+    eng.warmup()
+    seqs = [Sequence(p, n) for p, n in cases]
+    with serving.CompileCounter() as cc:
+        # first wave registers the shared prefix; the rest hit it
+        _run_all(eng, seqs[:2])
+        _run_all(eng, seqs[2:])
+    assert cc.count == 0, \
+        f"{cc.count} recompiles under speculative cache-hit traffic"
+    assert eng.prefix_hits >= 2
+    for (p, n), s in zip(cases, seqs):
+        assert s.output_tokens() == greedy_decode_reference(
+            model, params, p, n)
+    assert eng.cache.allocator.num_used == 0
+    eng.cache.check(live_block_ids=[])
+
+
+@pytest.mark.slow   # shares the small-pool program set above
+def test_lru_eviction_reclaims_cached_blocks(model, params):
+    """Cached (zero-refcount) blocks are spare capacity: when the
+    strict free list runs short, the allocator reclaims them LRU-first
+    — dropping their index entries and counting
+    mxtpu_llm_prefix_evict_total — instead of preempting or failing."""
+    rng = np.random.RandomState(13)
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, num_blocks=CTX // BS + 2,
+                    prefill_chunk=8)
+    eng.warmup()
+    # churn distinct prompts through the tiny pool: finished
+    # sequences' registered blocks park in the LRU until the next
+    # admissions need the capacity back
+    for i in range(6):
+        s = Sequence(rng.randint(0, VOCAB, size=2 * BS + i).tolist(), 2)
+        _run_all(eng, [s])
+    assert eng.cache.prefix_evictions > 0
+    st = eng.cache.stats()
+    assert st["blocks_used"] == 0
+    assert st["blocks_cached"] + (st["blocks_free"]
+                                  - st["blocks_cached"]) >= 0
+    # reclaimable capacity is the whole pool again
+    assert eng.cache.allocator.num_free == eng.cache.allocator.num_usable
+    eng.cache.check(live_block_ids=[])
+
+
+def test_zero_recompiles_mixed_hit_miss_sampled(model, params):
+    """The zero-steady-state-recompile contract: cache hits, misses,
+    COW and sampled rows — the backend_compile counter must not move
+    after warmup() (the speculative variant of this pin rides the slow
+    spec-parity test above; cache hit vs miss never changes a program
+    shape either way)."""
+    rng = np.random.RandomState(21)
+    prefix = rng.randint(0, VOCAB, size=2 * BS).tolist()
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefill_chunk=8)
+    eng.warmup()
+    with serving.CompileCounter() as cc:
+        # wave 1 registers the shared prefix; wave 2 hits it (incl.
+        # an aligned full-prompt hit that COWs, and sampled rows)
+        _run_all(eng, [Sequence(prefix + [0], 4)])
+        seqs = [Sequence(prefix + [i], 4,
+                         sampling=SamplingParams(temperature=0.8,
+                                                 seed=i)
+                         if i % 2 else None)
+                for i in range(1, 3)]
+        seqs.append(Sequence(prefix, 3))            # aligned full hit
+        seqs.append(Sequence(rng.randint(0, VOCAB, size=5).tolist(), 3))
+        _run_all(eng, seqs)
+    assert cc.count == 0, f"{cc.count} recompiles in steady state"
+    assert eng.prefix_hits >= 3
+    assert eng.cache.allocator.num_used == 0
+    eng.cache.check(live_block_ids=[])
+
+
+def test_server_stats_and_exposition(model, params):
+    """The server path: hit telemetry lands in stats() and every new
+    mxtpu_llm_prefix_* / kv-blocks-breakdown series lands in one
+    Prometheus exposition, with per-tenant saved-token attribution."""
+    from mxnet_tpu.observability import get_registry
+    srv = LLMServer(model, params, name="prefix_stats", max_seqs=4,
+                    block_size=BS, max_context=CTX, prefill_chunk=8)
+    srv.warmup()
+    srv.start()
+    prompt = list(range(BS)) + [1, 2]
+    # first generation registers the prefix; the rest hit it
+    srv.submit(prompt, 3, tenant="acme").result(timeout=60)
+    futs = [srv.submit(prompt, 3, tenant="acme") for _ in range(2)]
+    for f in futs:
+        f.result(timeout=60)
+    st = srv.stats()
+    srv.shutdown()
+    assert st["prefix_cache"] is True
+    assert st["kv_dtype"] == "float32"
+    assert st["prefix_lookups"] == 3
+    assert st["prefix_hits"] >= 1
+    assert st["prefill_tokens_saved"] >= BS
+    assert 0 < st["prefix_hit_rate"] <= 1
+    assert st["kv_cache"]["prefix_blocks"] >= 1
+    text = get_registry().expose()
+    for series in ("mxtpu_llm_prefix_lookup_total",
+                   "mxtpu_llm_prefix_hit_total",
+                   "mxtpu_llm_prefix_evict_total",
+                   "mxtpu_llm_prefill_tokens_saved_total",
+                   "mxtpu_llm_kv_blocks_cached",
+                   "mxtpu_llm_kv_blocks_shared",
+                   "mxtpu_llm_kv_blocks_free",
+                   "mxtpu_llm_tenant_prefill_tokens_saved_total"):
+        assert series in text, f"{series} missing from exposition"
+
+
+def test_env_gate_disables_prefix_cache(model, params, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_LLM_PREFIX_CACHE", "0")
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefill_chunk=8)
+    assert eng.prefix_enabled is False
+    eng.warmup()
+    s1 = Sequence(list(range(2 * BS)), 2)
+    s2 = Sequence(list(range(2 * BS)), 2)
+    _run_all(eng, [s1, s2])
+    assert eng.prefix_lookups == 0 and eng.prefix_hits == 0
+    assert s1.output_tokens() == s2.output_tokens()
+    st = eng.cache.stats()
+    assert st["prefix_blocks"] == 0 and st["blocks_cached"] == 0

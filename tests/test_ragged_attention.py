@@ -349,6 +349,119 @@ def test_allocator_fuzz_1k_schedules_never_leaks():
     assert a.num_free == a.num_usable
 
 
+def test_allocator_fuzz_1k_refcount_cow_lru_churn():
+    """The ISSUE-13 extension of the schedule fuzz: 1k random steps of
+    alloc / share (ref) / free / register-cacheable / LRU reclaim,
+    with a shadow refcount model checked against ``check()`` and the
+    partition counters every step. Sharing and caching must never
+    break the exact {free, refcounted, cached} partition."""
+    rng = np.random.RandomState(1)
+    reclaimed = []
+    a = BlockAllocator(33, reclaim_cb=reclaimed.append)
+    live = []                       # per-"sequence" block-id lists
+    shadow = {}                     # block -> expected refcount
+    for step in range(1000):
+        r = rng.rand()
+        if live and r < 0.30:
+            # retire one sequence: decref every block it owns
+            seq_blocks = live.pop(rng.randint(len(live)))
+            a.free(seq_blocks)
+            for b in seq_blocks:
+                shadow[b] -= 1
+                if shadow[b] == 0:
+                    del shadow[b]
+        elif live and r < 0.45:
+            # a "prefix hit": a new sequence refs an existing block
+            donor = live[rng.randint(len(live))]
+            b = donor[rng.randint(len(donor))]
+            a.ref(b)
+            shadow[b] += 1
+            live.append([b])
+        elif live and r < 0.55:
+            # register a random live block in the "prefix index"
+            donor = live[rng.randint(len(live))]
+            a.mark_cacheable(donor[rng.randint(len(donor))])
+        else:
+            want = int(rng.randint(1, 6))
+            if a.can_alloc(want):
+                got = a.alloc(want)     # may reclaim LRU cached blocks
+                live.append(got)
+                for b in got:
+                    assert b not in shadow      # reclaim gave it back
+                    shadow[b] = 1
+            else:
+                with pytest.raises(NoFreeBlocksError):
+                    a.alloc(want)
+        a.check()
+        assert a._ref == shadow
+        assert a.num_used == len(shadow)
+        assert (a.num_used + a.num_cached
+                + (a.num_free - a.num_cached)) == a.num_usable
+    # a reclaimed block must have been handed out again, never leaked
+    for seq_blocks in live:
+        a.free(seq_blocks)
+    a.check()
+    assert a.num_used == 0
+    assert a.num_free == a.num_usable
+
+
+def test_allocator_ref_and_cache_lifecycle():
+    """Directed coverage of the sharing lifecycle: ref of free blocks
+    is an error, cached blocks revive through ref(), reclaim fires the
+    callback and drops LRU-oldest first."""
+    dropped = []
+    a = BlockAllocator(5, reclaim_cb=dropped.append)   # 4 usable
+    b1, b2 = a.alloc(2)
+    with pytest.raises(BlockAccountingError):
+        a.ref(99)
+    a.ref(b1)                       # shared
+    assert a.refcount(b1) == 2 and a.num_shared == 1
+    a.free([b1])
+    assert a.refcount(b1) == 1 and a.num_shared == 0
+    with pytest.raises(BlockAccountingError):
+        a.mark_cacheable(77)        # not allocated
+    a.mark_cacheable(b1)
+    a.mark_cacheable(b2)
+    a.free([b1])                    # -> cached LRU (oldest)
+    a.free([b2])                    # -> cached LRU (newest)
+    assert a.num_cached == 2 and a.num_used == 0
+    assert a.num_free == a.num_usable    # cached = reclaimable
+    a.ref(b2)                       # hit revives from the LRU
+    assert a.refcount(b2) == 1 and a.num_cached == 1
+    got = a.alloc(3)                # must reclaim b1 (LRU) + 2 free
+    assert dropped == [b1]
+    assert b1 in got
+    a.check()
+    with pytest.raises(BlockAccountingError):
+        a.free([b1, b1])            # duplicate in one call
+
+
+def test_paged_cache_check_refcount_aware():
+    """check(live_block_ids) validates the refcounted ownership
+    multiset exactly: legal sharing passes, drifted refcounts and
+    leaks raise."""
+    c = PagedKVCache(num_layers=1, num_heads=2, head_dim=4,
+                     block_size=8, num_blocks=9, max_context=32,
+                     prefix_cache=True)
+    b1, b2 = c.allocator.alloc(2)
+    c.allocator.ref(b1)
+    assert c.check(live_block_ids=[[b1, b2], [b1]])
+    with pytest.raises(BlockAccountingError):
+        c.check(live_block_ids=[[b1, b2]])      # refcount drift
+    with pytest.raises(BlockAccountingError):
+        c.check(live_block_ids=[[b1, b1], [b1], [b2]])  # dup in one seq
+    # registered + fully released blocks are CACHED capacity, not leaks
+    c.register("h1", b1)
+    c.allocator.free([b1])          # one reference per call: a
+    c.allocator.free([b1])          # sequence never owns a block twice
+    with pytest.raises(BlockAccountingError):
+        c.allocator.free([b1])      # double free past zero
+    c.allocator.free([b2])
+    assert c.check(live_block_ids=[])
+    assert c.stats()["blocks_cached"] == 1
+    assert c.prefix_get("h1") == b1
+
+
 def test_paged_cache_table_row_and_sizing():
     c = PagedKVCache(num_layers=2, num_heads=2, head_dim=4,
                      block_size=8, num_blocks=9, max_context=32)

@@ -782,3 +782,92 @@ def test_chaos_metrics_land_in_one_exposition(model, params):
     finally:
         sys.path.pop(0)
     parse_exposition(text)      # raises on malformed exposition
+
+
+# ---------------------------------------- shared-block chaos (ISSUE 13)
+
+def test_llm_worker_death_with_live_shared_blocks(model, params):
+    """Chaos satellite: the engine thread dies while sequences SHARE
+    prefix-cache blocks mid-flight. Every Future resolves typed,
+    refcounts settle to zero, and the pool partition (free + cached)
+    is exact — a shared block is decref'd once per owner, never
+    double-freed, never leaked."""
+    srv = _llm(model, params, "llmc_share_death")
+    prefix = list(range(BS))                # one full shared block
+    # wave 1 registers the prefix, then the crash lands mid-decode of
+    # a wave of cache-hit sequences
+    srv.submit(prefix + [1], 2).result(timeout=30)
+    faults.crash_at_point("llm.worker", nth=2)
+    futs = [srv.submit(prefix + [2 + i], 8) for i in range(3)]
+    for f in futs:
+        try:
+            f.result(timeout=30)
+        except BaseException:
+            pass                            # typed resolution is the pin
+    faults.reset()
+    deadline = time.monotonic() + 10
+    while srv.running and time.monotonic() < deadline:
+        time.sleep(0.01)
+    eng = srv.engine
+    assert eng.prefix_hits >= 1             # sharing really happened
+    _assert_kv_clean(srv)                   # refcounts settled to zero
+    # cached blocks survive the crash as reclaimable capacity
+    assert (eng.cache.allocator.num_free
+            == eng.cache.allocator.num_usable)
+
+
+def test_llm_drain_with_shared_blocks_refcounts_settle(model, params):
+    """Immediate drain (evict now, typed) over live cache-hit
+    sequences: evicting one owner of a shared block must not free it
+    from under the other — check(live) stays clean at every point and
+    both evictions carry their partial tokens."""
+    srv = _llm(model, params, "llmc_share_drain")
+    prefix = list(range(BS))
+    srv.submit(prefix + [1], 2).result(timeout=30)     # register
+    gate = faults.block_at("llm.decode")
+    f1 = srv.submit(prefix + [2], 20)
+    assert gate.wait_reached(30)
+    f2 = srv.submit(prefix + [3], 20)
+    eng = srv.engine
+    done = threading.Event()
+
+    def _shutdown():
+        srv.shutdown(drain=True, deadline_ms=0.0)
+        done.set()
+
+    t = threading.Thread(target=_shutdown, daemon=True)
+    t.start()
+    gate.release()
+    assert done.wait(60)
+    outcomes = 0
+    for f in (f1, f2):
+        try:
+            f.result(timeout=10)
+            outcomes += 1
+        except SequenceEvictedError:
+            outcomes += 1
+    assert outcomes == 2
+    assert eng.prefix_hits >= 1
+    _assert_kv_clean(srv)
+
+
+def test_llm_poison_with_shared_prefix_isolated(model, params):
+    """A poison prompt that HITS the prefix cache: its isolation frees
+    only its own references — the healthy sequence sharing the same
+    blocks keeps decoding bit-exact, and the shared blocks stay
+    readable (cached) afterwards."""
+    srv = _llm(model, params, "llmc_share_pois")
+    prefix = list(range(BS))
+    first = srv.submit(prefix + [1], 2).result(timeout=30)
+    faults.script("llm.prefill", [ValueError("poison shared prompt")])
+    f_bad = srv.submit(prefix + [2], 4)     # poisoned, shares blocks
+    f_ok = srv.submit(prefix + [3], 4)      # healthy, shares blocks
+    with pytest.raises(ValueError, match="poison shared prompt"):
+        f_bad.result(timeout=30)
+    ref = greedy_decode_reference(model, params, prefix + [3], 4)
+    assert f_ok.result(timeout=30).tokens == ref
+    srv.shutdown()
+    st = srv.stats()
+    assert st["poison_isolated"] == 1
+    assert st["prefix_hits"] >= 1
+    _assert_kv_clean(srv)

@@ -175,7 +175,7 @@ def scan_llm(repo=REPO):
         rnd = int(m.group(1)) if m else 0
         row = {"round": rnd, "status": "valid", "tokens_s": None,
                "ttft_p50": None, "ttft_p99": None, "accept": None,
-               "tag": "", "note": ""}
+               "hit_rate": None, "tag": "", "note": ""}
         try:
             with open(path) as f:
                 rec = json.load(f)
@@ -198,6 +198,14 @@ def scan_llm(repo=REPO):
         # speculative-decoding draft acceptance (ISSUE 12): absent on
         # pre-spec rounds and spec-off runs
         row["accept"] = rec.get("spec_accept_rate")
+        # prefix-cache hit rate (ISSUE 13): absent on pre-cache
+        # rounds and runs without shared-prefix traffic
+        pf = rec.get("prefix") or {}
+        row["hit_rate"] = pf.get("hit_rate")
+        if pf.get("ttft_ms_control"):
+            row["note"] = (row["note"] + " " if row["note"] else "") \
+                + (f"saved={pf.get('prefill_tokens_saved')}tok "
+                   f"ctl_ttft_p50={pf['ttft_ms_control'].get('p50')}")
         knobs = rec.get("knobs") or {}
         if knobs.get("MXNET_TPU_LLM_SPEC_K"):
             row["note"] = (row["note"] + " " if row["note"] else "") \
@@ -217,8 +225,8 @@ def render_llm(rows):
         return pat % v if v is not None else "—"
     lines = [
         "| round | status | tokens/s | TTFT p50 (ms) | TTFT p99 (ms) "
-        "| accept rate | config | note |",
-        "|---|---|---|---|---|---|---|---|",
+        "| accept rate | hit rate | config | note |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         lines.append(
@@ -227,6 +235,7 @@ def render_llm(rows):
             f"| {fmt(r['ttft_p50'], '%.2f')} "
             f"| {fmt(r['ttft_p99'], '%.2f')} "
             f"| {fmt(r.get('accept'), '%.3f')} "
+            f"| {fmt(r.get('hit_rate'), '%.3f')} "
             f"| {r['tag']} | {r['note']} |")
     valid = [r for r in rows if r["status"] == "valid"
              and r["tokens_s"] is not None]

@@ -214,6 +214,23 @@ def prompt_tokens(spec, req, vocab):
         0, vocab, size=req["prompt_len"]).tolist()
 
 
+def tenant_prefix_tokens(spec, tenant, vocab, block_size):
+    """Tenant ``t``'s deterministic shared system-prompt prefix
+    (ISSUE 13): every one of the tenant's requests opens with these
+    tokens, so Zipf-skewed replay traffic actually exercises the
+    cross-request prefix cache the way production system prompts do.
+    Seeded per (trace seed, tenant); length from the same
+    bounded-Pareto family as the prompt mix, floored at one KV block
+    so a prefix can be cached at all."""
+    idx = int(str(tenant).lstrip("t"))
+    rng = np.random.RandomState((spec.seed * 7919 + idx + 1)
+                                % (2 ** 31 - 1))
+    length = max(int(block_size),
+                 _bounded_pareto(rng.uniform(), spec.prompt_min,
+                                 spec.prompt_max, spec.prompt_alpha))
+    return rng.randint(0, vocab, size=length).tolist()
+
+
 # ------------------------------------------------------------ replay --
 
 OUTCOMES = ("served", "shed", "expired", "evicted", "failed")
@@ -380,16 +397,26 @@ def run_llm(args, spec, trace, ring):
     model = TinyDecoder(DecoderConfig(
         vocab_size=32, d_model=32, num_layers=2, num_heads=2,
         d_ff=64, max_context=args.max_context))
+    block_size = 16
+    # prefix_cache pinned ON: the tenant system-prompt workload (and
+    # the smoke's hit-rate gate) exists to exercise it, regardless of
+    # the ambient MXNET_TPU_LLM_PREFIX_CACHE value
     srv = LLMServer(model, model.init_params(0), name="replay_llm",
-                    max_seqs=args.max_seqs, block_size=16,
+                    max_seqs=args.max_seqs, block_size=block_size,
                     max_context=args.max_context,
-                    max_queue=args.max_queue)
+                    max_queue=args.max_queue, prefix_cache=True)
     srv.warmup()
     srv.start()
     max_prompt = max(2, args.max_context // 2)
+    # each Zipf tenant's requests share one deterministic system
+    # prompt — the reuse pattern the prefix cache monetizes
+    prefixes = {f"t{k:02d}": tenant_prefix_tokens(
+        spec, f"t{k:02d}", model.vocab_size, block_size)
+        for k in range(spec.tenants)}
 
     def submit(req):
-        toks = prompt_tokens(spec, req, model.vocab_size)[:max_prompt]
+        body = prompt_tokens(spec, req, model.vocab_size)
+        toks = (prefixes[req["tenant"]] + body)[:max_prompt]
         return srv.submit(toks, req["new_tokens"],
                           deadline_ms=spec.deadline_ms,
                           tenant=req["tenant"])
@@ -424,6 +451,16 @@ def run_llm(args, spec, trace, ring):
         "tokens_generated": stats["tokens_generated"],
         "ttft_ms": {"p50": round((pct(50) or 0) * 1e3, 3),
                     "p99": round((pct(99) or 0) * 1e3, 3)},
+        # cross-request KV reuse over the tenant system prompts: the
+        # hit rate belongs in the capacity report — saved prefill is
+        # saved chip time
+        "prefix": {
+            "lookups": stats["prefix_lookups"],
+            "hits": stats["prefix_hits"],
+            "hit_rate": round(stats["prefix_hit_rate"], 4),
+            "prefill_tokens_saved": stats["prefill_tokens_saved"],
+            "evictions": stats["prefix_evictions"],
+        },
     }
 
 
@@ -502,6 +539,9 @@ def evaluate_and_report(args, spec, trace, results, rings, out_dir):
     rec["outcomes"] = {b["frontend"]: b["outcomes"] for b in results}
     rec["compiles_during_replay"] = sum(b["compiles_during_replay"]
                                         for b in results)
+    for blk in results:
+        if blk["frontend"] == "llm" and "prefix" in blk:
+            rec["llm_prefix"] = blk["prefix"]
 
     # refusal gates: an unhealthy replay cannot headline capacity
     reasons = []
@@ -560,6 +600,15 @@ def _smoke_check(args, spec, trace, results, rec, cap_path):
             probs.append(f"{blk['frontend']}: unexpected failures")
         if not blk["tenants"]:
             probs.append(f"{blk['frontend']}: no tenant attribution")
+        if blk["frontend"] == "llm":
+            pf = blk.get("prefix", {})
+            if not pf.get("hits"):
+                probs.append("llm: tenant system prompts produced no "
+                             "prefix-cache hits")
+            if ("llm_prefix" not in rec
+                    or rec["llm_prefix"].get("hit_rate") is None):
+                probs.append("capacity report carries no llm_prefix "
+                             "hit-rate block")
     with open(cap_path) as f:
         cap = json.load(f)
     if cap.get("skipped"):

@@ -378,11 +378,19 @@ def smoke():
     dec = TinyDecoder(DecoderConfig(vocab_size=16, d_model=16,
                                     num_layers=1, num_heads=2,
                                     d_ff=32, max_context=32))
+    # prefix_cache pinned ON explicitly: the assertions below depend
+    # on it, and the smoke must pass regardless of the ambient
+    # MXNET_TPU_LLM_PREFIX_CACHE value
     lsrv = serving.LLMServer(dec, dec.init_params(0), name="smoke_llm",
-                             max_seqs=2, block_size=8, max_context=32)
+                             max_seqs=2, block_size=8, max_context=32,
+                             prefix_cache=True)
     lsrv.warmup()
     lsrv.start()
-    lfuts = [lsrv.submit([1 + i, 2, 3], 3) for i in range(4)]
+    # the prompts share one full (8-token) block: the first
+    # admissions register it, later ones hit the prefix cache — so
+    # the mxtpu_llm_prefix_* series carry real traffic
+    shared = [1, 2, 3, 4, 5, 6, 7, 8]
+    lfuts = [lsrv.submit(shared + [9 + i], 3) for i in range(4)]
     for f in lfuts:
         f.result(timeout=60)
     lsrv.shutdown()
@@ -451,6 +459,32 @@ def smoke():
         return 1
     if not any(n.startswith("mxtpu_llm_ttft_seconds") for n, _ in samples):
         print("SMOKE FAIL: no TTFT histogram in exposition")
+        return 1
+    # prefix caching (ISSUE 13): every lookup counted, the shared
+    # block really hit, saved prefill tokens credited, and the
+    # cached/shared/free block breakdown + evict counter all land in
+    # the same exposition
+    if samples.get(("mxtpu_llm_prefix_lookup_total", lbl)) != 4:
+        print("SMOKE FAIL: prefix lookups not counted "
+              f"({samples.get(('mxtpu_llm_prefix_lookup_total', lbl))})")
+        return 1
+    if not samples.get(("mxtpu_llm_prefix_hit_total", lbl)):
+        print("SMOKE FAIL: shared-prefix burst produced no "
+              "prefix-cache hits")
+        return 1
+    if samples.get(("mxtpu_llm_prefill_tokens_saved_total", lbl),
+                   0) < 8:
+        print("SMOKE FAIL: prefill-tokens-saved not credited "
+              f"({samples.get(('mxtpu_llm_prefill_tokens_saved_total', lbl))})")
+        return 1
+    for gauge in ("mxtpu_llm_kv_blocks_cached",
+                  "mxtpu_llm_kv_blocks_shared",
+                  "mxtpu_llm_kv_blocks_free"):
+        if (gauge, lbl) not in samples:
+            print(f"SMOKE FAIL: no {gauge} gauge in exposition")
+            return 1
+    if ("mxtpu_llm_prefix_evict_total", lbl) not in samples:
+        print("SMOKE FAIL: no prefix-evict counter in exposition")
         return 1
     if samples[("mxtpu_training_steps_total", ())] < 2:
         print("SMOKE FAIL: step timer did not count 2 steps")

@@ -388,6 +388,12 @@ def emit_llm_snapshot(rec, out_dir=None):
         # AT overload, not just underload
         if extra.get("overload") is not None:
             out["overload"] = extra["overload"]
+        # shared-prefix runs (llm_bench --prefix-share, ISSUE 13)
+        # carry the prefix-cache economics — hit rate, prefill tokens
+        # saved, and the cache-off TTFT control from the same config —
+        # so the trend table can attribute a TTFT win to the cache
+        if extra.get("prefix") is not None:
+            out["prefix"] = extra["prefix"]
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
         f.write("\n")
@@ -444,6 +450,10 @@ def emit_capacity_snapshot(rec, out_dir=None):
                                           "mxtpu_xla_compile_total"),
             "compiles_during_replay": rec.get("compiles_during_replay"),
             "outcomes": rec.get("outcomes"),
+            # prefix-cache hit rate over the tenant system prompts
+            # (ISSUE 13): saved prefill is saved chip time, so the
+            # reuse economics belong next to the capacity headline
+            "llm_prefix": rec.get("llm_prefix"),
             "metrics_log": cap.get("metrics_log"),
             "detail": rec.get("detail"),
         })
