@@ -172,6 +172,9 @@ def bench_train_compiled(dtype, layout, batch, train_iters,
     return {
         "train_img_s": batch / train_dt, "train_flops": train_flops,
         "train_dt": train_dt, "final_loss": final_loss, "dev": dev,
+        # --mesh lever: which SPMD mesh the step compiled over (None =
+        # single-device replica path)
+        "mesh": os.environ.get("MXNET_TPU_MESH") or None,
     }
 
 
@@ -458,6 +461,14 @@ def _parse_flags():
                     help="train via gluon CompiledTrainStep (1, default) "
                          "or the jax-scan control loop (0) "
                          "(env BENCH_COMPILED_STEP)")
+    ap.add_argument("--mesh",
+                    help="SPMD device mesh for the compiled train step "
+                         "('8', 'dp=4,tp=2', ... — parallel.parse_mesh "
+                         "spelling; env MXNET_TPU_MESH). The whole "
+                         "step then runs as ONE donated SPMD program "
+                         "with in-program gradient reduce; see "
+                         "tools/multichip_bench.py for the 1..N-device "
+                         "scaling protocol")
     ap.add_argument("--iters", type=int, help="env BENCH_ITERS")
     ap.add_argument("--train-iters", type=int,
                     help="env BENCH_TRAIN_ITERS")
@@ -471,6 +482,7 @@ def _parse_flags():
     args = ap.parse_args()
     for flag, env in (("batch", "BENCH_BATCH"), ("dtype", "BENCH_DTYPE"),
                       ("layout", "BENCH_LAYOUT"), ("remat", "BENCH_REMAT"),
+                      ("mesh", "MXNET_TPU_MESH"),
                       ("compiled_step", "BENCH_COMPILED_STEP"),
                       ("bn_fused_bwd", "MXNET_TPU_BN_FUSED_BWD"),
                       ("iters", "BENCH_ITERS"),

@@ -229,7 +229,8 @@ class Trainer:
         return replicated and getattr(
             self._kvstore, "fused_reduce_compatible", False)
 
-    def compile_step(self, loss_fn, buckets=None, donate=True, remat=None):
+    def compile_step(self, loss_fn, buckets=None, donate=True, remat=None,
+                     mesh=None, param_spec=None):
         """Compile the WHOLE training step — forward + loss + backward +
         cross-context gradient reduce + optimizer update — into one
         buffer-donating XLA program per input signature
@@ -251,10 +252,24 @@ class Trainer:
         ``mxtpu_train_step_fallback_total``. ``remat`` ('full'/'dots')
         rematerializes the backward for memory headroom (bigger
         batches). See docs/PERFORMANCE.md.
+
+        ``mesh`` (a ``jax.sharding.Mesh``, a ``parse_mesh`` string like
+        ``"dp=4,tp=2"``, or the ``MXNET_TPU_MESH`` env default) turns
+        the step into ONE SPMD program over the device mesh: batches
+        shard over ``dp``, weights follow ``param_spec`` (e.g.
+        ``parallel.auto_spec(net, mesh)``; default replicated), and the
+        gradient reduce happens in-program — still one dispatch per
+        step at any device count. The trainer must be single-context;
+        per-context replicas and a mesh are two incompatible placements
+        (``mesh_multictx`` fallback). See docs/PERFORMANCE.md §SPMD.
         """
+        import os
         from ..jit import CompiledTrainStep
+        if mesh is None:
+            mesh = os.environ.get("MXNET_TPU_MESH") or None
         return CompiledTrainStep(self, loss_fn, buckets=buckets,
-                                 donate=donate, remat=remat)
+                                 donate=donate, remat=remat, mesh=mesh,
+                                 param_spec=param_spec)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + optimizer update (reference: trainer.py:329).

@@ -35,6 +35,16 @@ statistics ops — BatchNorm in training mode — see the padded rows; for
 those nets a tail batch is shape-stable but not numerically identical
 to an unpadded step. See docs/PERFORMANCE.md.)
 
+SPMD mesh mode (``compile_step(mesh=...)`` / ``MXNET_TPU_MESH``): the
+same step program partitions over a ``parallel.make_mesh`` device mesh —
+weights/optimizer slots placed per ``param_spec`` (replicated by
+default, megatron splits via ``parallel.auto_spec``), batches sharded
+over ``dp``, and XLA's GSPMD emits the in-program gradient psum. No
+per-context Python loop, no host-side allreduce: one donated dispatch
+per step at any device count, with the bucket-tail masking and AMP
+overflow-skip semantics unchanged under sharding. Evidence rides the
+``mxtpu_spmd_*`` series (docs/OBSERVABILITY.md).
+
 Guarded fallback: anything the trace cannot express — sparse gradients,
 host-sync/host-state optimizers, data-dependent Python control flow
 (detected at trace time), ``grad_req='add'`` accumulation, kvstores
@@ -57,7 +67,7 @@ __all__ = ["CompiledTrainStep", "step_buckets_config", "pick_train_bucket",
 # trace-time fallback reasons that are deterministic for this trainer /
 # loss_fn — retrying them every step would re-pay a failed trace
 _STICKY_REASONS = ("trace_failed", "unrecordable", "state_leaf",
-                   "exec_failed")
+                   "exec_failed", "mesh_multictx")
 
 
 class _Fallback(Exception):
@@ -194,7 +204,7 @@ class CompiledTrainStep:
     MAX_EXEC_FAILURES = 3
 
     def __init__(self, trainer, loss_fn, buckets=None, donate=True,
-                 remat=None):
+                 remat=None, mesh=None, param_spec=None):
         if remat not in (None, "", "full", "dots"):
             raise ValueError(
                 f"remat must be None, 'full' or 'dots', got {remat!r}")
@@ -202,6 +212,17 @@ class CompiledTrainStep:
         self._loss_fn = loss_fn
         self._donate = donate
         self._remat = remat or None
+        if isinstance(mesh, str):
+            from .parallel.mesh import parse_mesh
+            mesh = parse_mesh(mesh)
+        # SPMD mesh mode: every program input is placed onto `mesh` as
+        # one global array (weights/slots per `param_spec`, batches
+        # dp-sharded) and the SAME step program partitions over it —
+        # XLA's GSPMD emits the in-program gradient psum, so multi-chip
+        # training keeps the 1-dispatch/zero-host-round-trip contract.
+        self._mesh = mesh
+        self._param_spec = param_spec
+        self._wspecs = {}        # param name -> clamped PartitionSpec
         self._buckets = step_buckets_config(buckets)
         self._max_batch = 0
         # mid-run resume: a trainer restored from a checkpoint carries
@@ -246,6 +267,15 @@ class CompiledTrainStep:
             if p.grad_req != "null" and (p.stype == "row_sparse"
                                          or p.grad_stype == "row_sparse"):
                 return "sparse_grad"
+        if self._mesh is not None:
+            # the mesh IS the multi-device story: per-context replicas
+            # and a device mesh are two incompatible placements for the
+            # same weight — a trainer built over replicated contexts
+            # keeps the replica path (deterministic for this trainer,
+            # hence sticky)
+            if any(p._data is not None and len(p._data) > 1
+                   for p in tr._params):
+                return "mesh_multictx"
         return None
 
     def _obs_metrics(self):
@@ -262,7 +292,15 @@ class CompiledTrainStep:
     def _pick_bucket(self, n):
         if self._buckets == "auto":
             self._max_batch = max(self._max_batch, n)
-        return pick_train_bucket(n, self._buckets, self._max_batch)
+        bucket = pick_train_bucket(n, self._buckets, self._max_batch)
+        if self._mesh is not None and self._buckets is not None:
+            # keep the batch axis evenly shardable over dp; the extra
+            # pad rows are masked like any other tail padding. (With
+            # bucketing off the batch stays unpadded — an indivisible
+            # batch then simply replicates, still correct SPMD.)
+            from .parallel.mesh import round_up_to_dp
+            bucket = round_up_to_dp(bucket, self._mesh)
+        return bucket
 
     # ------------------------------------------------------------- call --
     def __call__(self, *args):
@@ -347,9 +385,17 @@ class CompiledTrainStep:
             _fused.rollback_counts(opt, work)
             raise _Fallback("unrecordable")
 
+        mesh_pin = None
+        if self._mesh is not None:
+            # place weights + optimizer slots onto the mesh as global
+            # arrays (no-op once placed: donation hands back outputs
+            # with the same, constraint-pinned shardings)
+            mesh_pin = self._place_mesh(work, weight_nds, state_nds,
+                                        state_defs)
+
         nts = [p for p in tr._params
                if p.grad_req == "null" and p._data is not None]
-        key = (in_fmt, opaque, bucket, engaged,
+        key = (in_fmt, opaque, bucket, engaged, self._mesh,
                self._buckets is not None, type(opt), tuple(rec.program),
                tuple(state_defs),
                tuple((tuple(a.shape[1:]) if a.shape[:1] == (n,)
@@ -364,6 +410,8 @@ class CompiledTrainStep:
             raise _Fallback("unhashable_signature") from None
 
         batch_vals = self._stage_batch(arrays, n, bucket)
+        if self._mesh is not None:
+            batch_vals = self._place_batch(batch_vals, bucket)
         weights = [w._data for w in weight_nds]
         states = [s._data for s in state_nds]
         scalars = tuple(rec.slot_values)
@@ -384,16 +432,27 @@ class CompiledTrainStep:
                                 rec.program, work, nts, in_fmt, flags,
                                 opaque, bucket, engaged,
                                 (weights, states, scalars, ls, n,
-                                 rng_base, rng_draw, batch_vals))
+                                 rng_base, rng_draw, batch_vals),
+                                mesh_pin=mesh_pin)
                         except _Fallback:
                             _fused.rollback_counts(opt, work)
                             raise
                     self._cache[key] = entry
                     obs["bucket_compiles"].labels(bucket=str(bucket)).inc()
+                    if self._mesh is not None:
+                        from .parallel.mesh import note_mesh, spmd_metrics
+                        sobs = spmd_metrics()
+                        sobs["programs"].labels(
+                            devices=str(self._mesh.devices.size),
+                            bucket=str(bucket)).inc()
+                        note_mesh(self._mesh)
         compiled, meta = entry
 
         nt_all = meta["nt_params"]
-        nt_vals = [p._get_primary()._data for p in nt_all]
+        if self._mesh is not None:
+            nt_vals = self._place_nt(nt_all)
+        else:
+            nt_vals = [p._get_primary()._data for p in nt_all]
         try:
             with _tracer().span("mxtpu.train_step.dispatch", "step"):
                 outs = compiled(weights, nt_vals, states, scalars, ls, n,
@@ -449,6 +508,13 @@ class CompiledTrainStep:
 
         obs["dispatch"].inc()
         obs["compiled"].inc()
+        if self._mesh is not None:
+            from .parallel.mesh import spmd_metrics
+            sobs = spmd_metrics()
+            sobs["dispatch"].inc()
+            if meta.get("spmd_grad_bytes"):
+                sobs["bytes"].labels(collective="grad_reduce").inc(
+                    meta["spmd_grad_bytes"])
         if bucket != n:
             obs["padded_rows"].inc(bucket - n)
         tobs = tr._obs_metrics()
@@ -465,9 +531,75 @@ class CompiledTrainStep:
         self.last_reason = None
         return self._package(meta, loss_out, extras, n, bucket)
 
+    # --------------------------------------------------- mesh placement --
+    def _leaf_spec_of(self, name, shape):
+        """Clamped PartitionSpec for a named parameter on this mesh
+        (cached: the clamp result is a pure function of name+shape)."""
+        from jax.sharding import PartitionSpec
+        from .parallel.mesh import leaf_spec
+        spec = self._wspecs.get(name)
+        if spec is None:
+            raw = self._param_spec(name, tuple(shape)) \
+                if self._param_spec else PartitionSpec()
+            spec = leaf_spec(raw, tuple(shape), self._mesh)
+            self._wspecs[name] = spec
+        return spec
+
+    def _place_mesh(self, work, weight_nds, state_nds, state_defs):
+        """Move weights and optimizer slots onto the mesh (no-op when
+        already placed). Slots ride their parameter's spec when
+        weight-shaped, else replicate. Returns (wspecs, sspecs) aligned
+        with weight_nds/state_nds for the build's output pinning."""
+        from .parallel.mesh import leaf_spec, place_global
+        mesh = self._mesh
+        wspecs, sspecs = [], []
+        si = 0
+        for k, (i, param) in enumerate(work):
+            w = weight_nds[k]
+            spec = self._leaf_spec_of(param.name, w.shape)
+            wspecs.append(spec)
+            w._data = place_global(w._data, mesh, spec)
+            for _ in range(state_defs[k].num_leaves):
+                leaf = state_nds[si]
+                lspec = leaf_spec(spec, tuple(leaf.shape), mesh)
+                leaf._data = place_global(leaf._data, mesh, lspec)
+                sspecs.append(lspec)
+                si += 1
+        return wspecs, sspecs
+
+    def _place_nt(self, nt_params):
+        """Place non-trainable program inputs (frozen weights, BN
+        stats) on the mesh; returns their values in input order."""
+        from .parallel.mesh import place_global
+        vals = []
+        for p in nt_params:
+            nd = p._get_primary()
+            spec = self._leaf_spec_of(p.name, nd.shape)
+            nd._data = place_global(nd._data, self._mesh, spec)
+            vals.append(nd._data)
+        return vals
+
+    def _place_batch(self, batch_vals, bucket):
+        """Shard padded batch inputs over dp (replicate anything not
+        batch-major). Multi-process meshes treat each process's value as
+        its local shard of the global batch — the dist_sync layout."""
+        from jax.sharding import PartitionSpec
+        from .parallel.mesh import batch_spec, place_global
+        mesh = self._mesh
+        out = []
+        for v in batch_vals:
+            nd = getattr(v, "ndim", 0)
+            if nd and getattr(v, "shape", ())[:1] == (bucket,):
+                spec = batch_spec(nd, mesh, bucket)
+            else:
+                spec = PartitionSpec()
+            host_has = "local_shard" if len(spec) else "full"
+            out.append(place_global(v, mesh, spec, host_has=host_has))
+        return out
+
     # ------------------------------------------------------------ build --
     def _build(self, program, work, nts, in_fmt, flags, opaque, bucket,
-               engaged, sample_inputs):
+               engaged, sample_inputs, mesh_pin=None):
         """Trace + AOT-compile the whole-step program for one signature.
         Two passes: the first lowering discovers parameters the loss
         reads or writes outside the Trainer's set; those are promoted to
@@ -486,11 +618,20 @@ class CompiledTrainStep:
             meta = {"nt_params": nt_all, "aux_params": None,
                     "single": True, "loss_scalar": False,
                     "reads": set(), "writes": set()}
+            if self._mesh is not None:
+                dp = dict(self._mesh.shape).get("dp", 1)
+                if dp > 1 and bucket and bucket % dp == 0:
+                    # logical per-step gradient-psum payload over dp:
+                    # one grad the size of every trainable weight
+                    meta["spmd_grad_bytes"] = sum(
+                        int(a.size) * a.dtype.itemsize for a in w)
             fn = self._make_fn(entries, trainables, nt_all, in_fmt, flags,
-                               opaque, bucket, engaged, meta)
+                               opaque, bucket, engaged, meta,
+                               mesh_pin=mesh_pin)
             jitted = jax.jit(fn, donate_argnums=(0, 2) if self._donate
                              else ())
-            nt_vals = [p._get_primary()._data for p in nt_all]
+            nt_vals = self._place_nt(nt_all) if self._mesh is not None \
+                else [p._get_primary()._data for p in nt_all]
             try:
                 lowered = jitted.lower(w, nt_vals, s, sc, ls, n, rb, rd,
                                        bv)
@@ -543,9 +684,18 @@ class CompiledTrainStep:
         return compiled, meta
 
     def _make_fn(self, entries, trainables, nts, in_fmt, flags, opaque,
-                 bucket, engaged, meta):
+                 bucket, engaged, meta, mesh_pin=None):
         import jax
         import jax.numpy as jnp
+        if mesh_pin is not None:
+            # pin the updated weights/slots to their input shardings so
+            # XLA aliases the donated buffers and the next step's
+            # placement check is a no-op — without the constraint GSPMD
+            # may propagate a different output layout and every step
+            # would pay a reshard
+            from jax.sharding import NamedSharding
+            _w_sh = [NamedSharding(self._mesh, s) for s in mesh_pin[0]]
+            _s_sh = [NamedSharding(self._mesh, s) for s in mesh_pin[1]]
         from . import _rng, autograd
         from .gluon.block import _regroup
         from .gluon.parameter import _TRACE_STACK
@@ -664,6 +814,11 @@ class CompiledTrainStep:
                          for nw, ow in zip(new_w, weights)]
                 new_s = [jnp.where(flag, ns, os_)
                          for ns, os_ in zip(new_s, states)]
+            if mesh_pin is not None:
+                new_w = [jax.lax.with_sharding_constraint(v, sh)
+                         for v, sh in zip(new_w, _w_sh)]
+                new_s = [jax.lax.with_sharding_constraint(v, sh)
+                         for v, sh in zip(new_s, _s_sh)]
             return new_w, new_s, aux_vals, loss_v, extras, flag
 
         return step_fn

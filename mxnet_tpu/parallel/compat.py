@@ -1,0 +1,37 @@
+"""One gated jax-version compat surface for the SPMD stack.
+
+jax moved ``shard_map`` from ``jax.experimental.shard_map`` into the
+top-level namespace in 0.6 and introduced the vma ("varying manual
+axes") type system (``lax.pvary``) at the same time. Every module that
+lowers onto ``shard_map`` — ``parallel.pipeline``,
+``parallel.ring_attention``, tests that build ad-hoc collectives — must
+resolve the same three symbols the same way, so they live here instead
+of per-module try/except blocks (the PR 1 shim covered the library
+modules but not direct ``from jax import shard_map`` imports; this
+module is the one import path that works on both sides):
+
+- :func:`shard_map` — the per-device-rank mapping transform itself.
+- :func:`pvary` — vma varying-ness annotation; identity on pre-0.6 jax,
+  which has no vma types and needs no annotation.
+- :data:`SHARD_MAP_KWARGS` — extra kwargs for ``shard_map``: pre-vma jax
+  runs a ``check_rep`` pass that rejects legitimate per-rank
+  switch/accumulate patterns the pvary annotations would legitimize, so
+  it is disabled there (``{"check_rep": False}``) and empty on 0.6+.
+"""
+from __future__ import annotations
+
+from jax import lax
+
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6)
+except ImportError:  # pre-0.6 jax keeps it in the experimental namespace
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+#: vma varying-ness annotation: identity on pre-0.6 jax.
+pvary = getattr(lax, "pvary", lambda x, axes: x)
+
+#: extra shard_map kwargs: pre-vma jax's check_rep pass rejects per-rank
+#: switch/accum patterns the pvary annotations would legitimize.
+SHARD_MAP_KWARGS = {} if hasattr(lax, "pvary") else {"check_rep": False}
+
+__all__ = ["shard_map", "pvary", "SHARD_MAP_KWARGS"]

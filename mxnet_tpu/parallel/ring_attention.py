@@ -18,17 +18,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # pre-0.6 jax keeps it in the experimental namespace
-    from jax.experimental.shard_map import shard_map
 
-# vma varying-ness annotation: identity on pre-0.6 jax, which has
-# no vma type system and needs no annotation
-_pvary = getattr(lax, "pvary", lambda x, axes: x)
-# pre-vma jax: its check_rep pass rejects per-rank switch/accum
-# patterns the pvary annotations would legitimize — disable it there
-_SM_KW = {} if hasattr(lax, "pvary") else {"check_rep": False}
+from .compat import shard_map, pvary as _pvary, \
+    SHARD_MAP_KWARGS as _SM_KW
 
 __all__ = ["ring_attention", "sequence_shard"]
 

@@ -8,10 +8,16 @@ ONE compiled XLA program: gradients never materialize per-replica; XLA
 lowers the mean over 'dp' to a psum on ICI and fuses the optimizer update
 into it. Buffers are donated, so weights update in place in HBM (the
 reference needed kWriteInplace optimizer kernels for this).
+
+Placement (``mesh.place_global`` / ``batch_spec`` / ``leaf_spec``) and
+the ``mxtpu_spmd_*`` evidence series are shared with
+``jit.CompiledTrainStep``'s mesh mode — one SPMD machinery, two front
+ends (functional here, gluon-Trainer there). lr/wd enter the step as
+traced scalars, so schedules never recompile; the remaining optimizer
+hyperparameters bake at first trace.
 """
 from __future__ import annotations
 
-import itertools
 from typing import Callable, Dict, Optional
 
 import numpy as _np
@@ -22,50 +28,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import optimizer as opt_mod
 from ..ndarray import NDArray
 from .functional import functional_call, extract_params, load_params
-from .mesh import local_mesh
+from .mesh import (local_mesh, leaf_spec, place_global as _to_global,
+                   round_up_to_dp, spans_processes as _spans_processes,
+                   spmd_metrics, note_mesh, to_host as _to_host)
 
 __all__ = ["ShardedTrainer", "shard_batch"]
-
-
-import functools
-
-
-@functools.lru_cache(maxsize=64)
-def _spans_processes(mesh: Mesh) -> bool:
-    # cached: scanning mesh.devices.flat in Python on every step would
-    # cost thousands of attribute reads per step on big slices
-    pid = jax.process_index()
-    return any(d.process_index != pid for d in mesh.devices.flat)
-
-
-def _to_global(arr, mesh: Mesh, spec: P, host_has: str = "full"):
-    """Place a host array onto a (possibly multi-process) mesh. Within
-    one process this is a plain device_put. Across processes the meaning
-    of the host array matters (``host_has``):
-    - "full": every process holds the whole (global-shape) array —
-      parameters/optimizer state. Replicated specs broadcast rank 0's
-      values (the reference dist_sync init semantics: kvstore_dist.h
-      Init pushes rank-0 weights), so ranks cannot silently train on
-      divergent 'replicated' parameters; sharded specs slice each
-      process's addressable shards out of its full copy
-      (make_array_from_callback) — NOT concatenation.
-    - "local_shard": each process holds only its own piece — batches.
-      The global array is the concatenation of every process's local
-      array along the sharded axis (host_local_array_to_global_array),
-      the reference's dist_sync data layout."""
-    if _spans_processes(mesh):
-        from jax.experimental import multihost_utils
-        arr = _np.asarray(arr)
-        sharding = NamedSharding(mesh, spec)
-        replicated = all(ax is None for ax in (spec or ())) or spec == P()
-        if host_has == "full":
-            if replicated:
-                arr = multihost_utils.broadcast_one_to_all(arr)
-            return jax.make_array_from_callback(
-                arr.shape, sharding, lambda idx: arr[idx])
-        return multihost_utils.host_local_array_to_global_array(
-            arr, mesh, spec)
-    return jax.device_put(arr, NamedSharding(mesh, spec))
 
 
 def shard_batch(x, mesh: Mesh, axis: str = "dp"):
@@ -91,9 +58,10 @@ class ShardedTrainer:
 
     Notes
     -----
-    The optimizer's hyperparameters are baked per compilation; changing
-    lr triggers a cheap retrace (XLA caches by step signature). The
-    reference pays a kernel launch per parameter per step instead.
+    lr and wd enter the compiled step as traced scalars — schedules and
+    ``set_learning_rate`` never recompile. The remaining optimizer
+    hyperparameters (momentum, betas, eps, ...) bake at first trace.
+    The reference pays a kernel launch per parameter per step instead.
     """
 
     def __init__(self, block, loss_fn, optimizer="sgd",
@@ -145,22 +113,34 @@ class ShardedTrainer:
         self._trainable = [
             n for n in self._names
             if block.collect_params()[n].grad_req != "null"]
-        # shard/replicate parameters onto the mesh
-        specs = {n: (self._param_spec(n, params[n].shape)
-                     if self._param_spec else P())
+        # shard/replicate parameters onto the mesh; leaf_spec clamps a
+        # requested spec to what the shape/mesh actually divides, so a
+        # param_spec over an indivisible dim degrades to replicated
+        # instead of a placement error
+        specs = {n: leaf_spec(
+                     self._param_spec(n, params[n].shape)
+                     if self._param_spec else P(),
+                     tuple(params[n].shape), self._mesh)
                  for n in self._names}
         self._params = {n: _to_global(params[n], self._mesh, specs[n])
                         for n in self._names}
         # optimizer states live with their parameter, same sharding
+        # (weight-shaped slots; anything else replicates via leaf_spec)
         self._opt_states = {}
         for i, n in enumerate(self._trainable):
             st = self._optimizer.create_state(i, NDArray(params[n]))
             self._opt_states[n] = jax.tree_util.tree_map(
                 lambda a, s=specs[n]: _to_global(
-                    a._data if isinstance(a, NDArray) else a,
-                    self._mesh, s), st,
+                    a._data if isinstance(a, NDArray) else a, self._mesh,
+                    leaf_spec(s, tuple(a.shape), self._mesh)), st,
                 is_leaf=lambda a: isinstance(a, NDArray))
         self._specs = specs
+        # logical per-step gradient-psum payload over dp (one grad the
+        # size of every trainable weight), for mxtpu_spmd_collective_*
+        self._grad_bytes = sum(
+            int(self._params[n].size) * self._params[n].dtype.itemsize
+            for n in self._trainable)
+        note_mesh(self._mesh)
         if self._restore_pending is not None:
             self._apply_restore(self._restore_pending)
             self._restore_pending = None
@@ -173,10 +153,11 @@ class ShardedTrainer:
         block, loss_fn, optimizer = self._block, self._loss_fn, \
             self._optimizer
         trainable = self._trainable
+        mesh, specs = self._mesh, self._specs
 
         trainer = self
 
-        def step(params, opt_states, rng, t, n_real, x, y):
+        def step(params, opt_states, hyper, rng, t, n_real, x, y):
             def objective(trn_params):
                 full = dict(params)
                 full.update(trn_params)
@@ -201,22 +182,54 @@ class ShardedTrainer:
 
             new_params = dict(params)
             new_states = {}
-            for i, n in enumerate(trainable):
-                w = NDArray(params[n])
-                g = NDArray(grads[n])
-                st = jax.tree_util.tree_map(NDArray, opt_states[n])
-                # seed the update count with the TRACED step so Adam-family
-                # bias correction uses the true t under jit (the Python
-                # counter would bake t=1 into the compiled program)
-                optimizer._index_update_count[i] = t - 1
-                optimizer.update_multi_precision(i, w, g, st)
-                new_params[n] = w._data
-                new_states[n] = jax.tree_util.tree_map(
-                    lambda a: a._data if isinstance(a, NDArray) else a, st,
-                    is_leaf=lambda a: isinstance(a, NDArray))
+            # lr/wd ride as traced scalars so schedules and manual
+            # set_learning_rate never recompile the SPMD program; the
+            # scheduler (host state) is evaluated OUTSIDE the trace.
+            # num_update/_index_update_count are restored too — the
+            # traced t seeds them below, and a tracer left behind would
+            # kill the next step's host-side scheduler sync
+            saved = (optimizer.lr, optimizer.wd, optimizer.lr_scheduler,
+                     optimizer.num_update,
+                     dict(optimizer._index_update_count))
+            optimizer.lr, optimizer.wd = hyper
+            optimizer.lr_scheduler = None
+            try:
+                for i, n in enumerate(trainable):
+                    w = NDArray(params[n])
+                    g = NDArray(grads[n])
+                    st = jax.tree_util.tree_map(NDArray, opt_states[n])
+                    # seed the update count with the TRACED step so
+                    # Adam-family bias correction uses the true t under
+                    # jit (the Python counter would bake t=1 into the
+                    # compiled program)
+                    optimizer._index_update_count[i] = t - 1
+                    optimizer.update_multi_precision(i, w, g, st)
+                    new_params[n] = w._data
+                    new_states[n] = jax.tree_util.tree_map(
+                        lambda a: a._data if isinstance(a, NDArray)
+                        else a, st,
+                        is_leaf=lambda a: isinstance(a, NDArray))
+            finally:
+                (optimizer.lr, optimizer.wd, optimizer.lr_scheduler,
+                 optimizer.num_update) = saved[:4]
+                optimizer._index_update_count.clear()
+                optimizer._index_update_count.update(saved[4])
             # aux states (BN running stats) ride along, replicated
             for n, v in aux.items():
                 new_params[n] = v
+            # pin outputs to their input shardings: donated buffers
+            # alias and the next step's inputs need no reshard (GSPMD
+            # would otherwise be free to pick another output layout)
+            new_params = {
+                n: jax.lax.with_sharding_constraint(
+                    v, NamedSharding(mesh, specs.get(n, P())))
+                for n, v in new_params.items()}
+            new_states = {
+                n: jax.tree_util.tree_map(
+                    lambda a, s=specs[n]: jax.lax.with_sharding_constraint(
+                        a, NamedSharding(mesh, leaf_spec(
+                            s, tuple(a.shape), mesh))), st)
+                for n, st in new_states.items()}
             return new_params, new_states, loss
 
         donate = (0, 1) if self._donate else ()
@@ -243,9 +256,11 @@ class ShardedTrainer:
             }
             # the SPMD step is a compiled whole-step program too: it
             # reports on the same mxtpu_train_step_* series the
-            # jit.CompiledTrainStep path feeds
+            # jit.CompiledTrainStep path feeds, plus the shared
+            # mxtpu_spmd_* evidence series
             from ..jit import _metrics as _step_metrics
             obs.update(_step_metrics())
+            obs["spmd"] = spmd_metrics()
         return obs
 
     def _pick_bucket(self, n, can_pad):
@@ -260,10 +275,7 @@ class ShardedTrainer:
             return n
         from ..jit import pick_train_bucket
         b = pick_train_bucket(n, self._buckets, self._max_batch)
-        dp = dict(self._mesh.shape).get("dp", 1)
-        if b % dp:
-            b += dp - (b % dp)
-        return b
+        return round_up_to_dp(b, self._mesh)
 
     @staticmethod
     def _pad_rows(v, bucket):
@@ -293,14 +305,34 @@ class ShardedTrainer:
             else y._data
         self._rngkey, sub = jax.random.split(self._rngkey)
         t = jnp.asarray(self._step_count + 1, jnp.float32)
+        opt = self._optimizer
+        if opt.lr_scheduler is not None:
+            # schedules key off num_update, which only the eager path
+            # advances — sync it to the traced step count so a restored
+            # run resumes its schedule at the right position
+            opt.num_update = max(opt.num_update, self._step_count)
+        # plain python floats: jit traces them as weak-typed scalars, so
+        # every lr/wd value reuses the same compiled program
+        hyper = (float(opt.learning_rate), float(opt.wd))
+        cache_size = getattr(self._step_jit, "_cache_size", None)
+        progs0 = cache_size() if callable(cache_size) else None
         self._params, self._opt_states, loss = self._step_jit(
-            self._params, self._opt_states, sub, t, n, xb, yb)
+            self._params, self._opt_states, hyper, sub, t, n, xb, yb)
         self._step_count += 1
         obs["secs"].observe(_time.monotonic() - t0)
         obs["steps"].inc()
         obs["dispatch"].inc()
         obs["compiled"].inc()
         obs["examples"].inc(n)  # real rows, not the padded bucket
+        sobs = obs["spmd"]
+        sobs["dispatch"].inc()
+        if progs0 is not None and cache_size() > progs0:
+            sobs["programs"].labels(
+                devices=str(self._mesh.devices.size),
+                bucket=str(bucket)).inc()
+        if dict(self._mesh.shape).get("dp", 1) > 1:
+            sobs["bytes"].labels(collective="grad_reduce").inc(
+                self._grad_bytes)
         from ..resilience import faults
         from ..resilience import async_writer as _aw
         _aw.note_step_overlap()
@@ -460,17 +492,3 @@ def _is_sharded(arr):
         return len(arr.devices()) > 1
     except Exception:
         return False
-
-
-def _to_host(arr):
-    """Full host value of a (possibly sharded) global array. Fully
-    addressable arrays are a plain device_get; multi-process global
-    arrays need the allgather (only the checkpoint writer pays it)."""
-    try:
-        addressable = arr.is_fully_addressable
-    except AttributeError:
-        addressable = True
-    if addressable:
-        return _np.asarray(jax.device_get(arr))
-    from jax.experimental import multihost_utils
-    return _np.asarray(multihost_utils.process_allgather(arr, tiled=True))

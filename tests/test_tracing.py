@@ -467,6 +467,57 @@ def test_bench_trend_classifies_artifacts(tmp_path):
     assert doc.read_text().count(bt.BEGIN) == 1
 
 
+def test_bench_trend_multichip_classification(tmp_path):
+    """tools/bench_trend.py MULTICHIP trajectory: legacy replica-loop
+    dryruns render as structure-only rows, failed/skipped rounds are
+    never evidence, and the SPMD points table labels tolerance-gated
+    parity honestly (never as bit-exact)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_trend as bt
+    finally:
+        sys.path.pop(0)
+    rounds = {
+        1: {"n_devices": 8, "rc": 1, "ok": False},          # failed dryrun
+        2: {"n_devices": 8, "rc": 0, "ok": True},           # legacy dryrun
+        3: {"round": 3, "ok": False, "skipped": False, "value": None,
+            "points": [], "errors": ["devices=8: boom"]},   # failed SPMD
+        4: {"round": 4, "ok": True, "skipped": False, "value": 1.0,
+            "tag": "spmd", "timing_evidence": False,
+            "points": [
+                {"devices": 1, "mesh": {"dp": 1}, "step_ms": 2.0,
+                 "dispatches_per_step": 1.0, "speedup_vs_1dev": 1.0,
+                 "parity_ok": True, "parity_kind": "bitexact"},
+                # legacy key (pre-rename artifacts): renders the same
+                {"devices": 8, "mesh": {"dp": 4, "tp": 2}, "step_ms": 6.0,
+                 "dispatches_per_step": 1.0, "scaling_efficiency": 0.33,
+                 "parity_ok": True, "parity_kind": "tolerance"},
+            ]},
+    }
+    for n, rec in rounds.items():
+        (tmp_path / f"MULTICHIP_r{n:02d}.json").write_text(json.dumps(rec))
+    rows = {r["round"]: r for r in bt.scan_multichip(str(tmp_path))}
+    assert rows[1]["status"] == "invalid"
+    assert rows[2]["status"] == "legacy" and not rows[2]["points"]
+    assert rows[3]["status"] == "invalid"
+    assert rows[4]["status"] == "valid" and len(rows[4]["points"]) == 2
+    table = bt.render_multichip(
+        sorted(rows.values(), key=lambda r: r["round"]))
+    lines = table.splitlines()
+    dp_row = next(l for l in lines if "1 (dp1)" in l)
+    tp_row = next(l for l in lines if "8 (dp4×tp2)" in l)
+    assert "bit-exact" in dp_row
+    assert "tol" in tp_row and "bit-exact" not in tp_row
+    assert "structure evidence only" in table
+    assert "1.0 dispatch/step" in table
+    doc = tmp_path / "PERF.md"
+    bt.splice(str(doc), table, begin=bt.MC_BEGIN, end=bt.MC_END,
+              heading=bt.MC_HEADING)
+    bt.splice(str(doc), table, begin=bt.MC_BEGIN, end=bt.MC_END,
+              heading=bt.MC_HEADING)
+    assert doc.read_text().count(bt.MC_BEGIN) == 1
+
+
 def test_rollup_library_diff_report(tmp_path):
     """observability.rollup: per-op-family attribution + the A/B diff
     report perf levers are judged on (device-lane only, scan wrapper

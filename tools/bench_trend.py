@@ -8,7 +8,12 @@ Two trajectories, one classification discipline:
 - ``BENCH_llm_r*.json`` (decode serving) -> the tokens/sec + TTFT
   table between the ``LLM_BENCH_TREND`` markers (appended on first
   run), so the serving-economics headline has the same committed,
-  honestly-classified history as training MFU.
+  honestly-classified history as training MFU;
+- ``MULTICHIP_r*.json`` (SPMD scaling) -> the devices → step-time /
+  dispatches-per-step / T1/TN-speedup table between the
+  ``MULTICHIP_TREND`` markers (tools/multichip_bench.py emits the
+  point-based shape; older rounds only recorded the dryrun tail and
+  render as structure-only rows).
 
 The bench trajectory is only evidence if every artifact is classified
 honestly: BENCH_r01–r03 are rc=1 / suspect-timing artifacts and r05
@@ -44,6 +49,9 @@ END = "<!-- BENCH_TREND:END -->"
 LLM_BEGIN = ("<!-- LLM_BENCH_TREND:BEGIN "
              "(tools/bench_trend.py — do not edit by hand) -->")
 LLM_END = "<!-- LLM_BENCH_TREND:END -->"
+MC_BEGIN = ("<!-- MULTICHIP_TREND:BEGIN "
+            "(tools/bench_trend.py — do not edit by hand) -->")
+MC_END = "<!-- MULTICHIP_TREND:END -->"
 HEADING = ("\n## Bench trend (MFU / throughput per round)\n\n"
            "Regenerate with `python tools/bench_trend.py` after "
            "every new `BENCH_rNN.json`; rows the table marks "
@@ -54,6 +62,16 @@ LLM_HEADING = ("\n## LLM decode bench trend (tokens/sec + TTFT per "
                "every new `BENCH_llm_rNN.json` (tools/llm_bench.py); "
                "skipped rows recompiled or lost requests and are not "
                "evidence.\n\n")
+MC_HEADING = ("\n## Multi-chip SPMD scaling trend (devices → step "
+              "time / dispatches)\n\n"
+              "Regenerate with `python tools/bench_trend.py` after "
+              "every new `MULTICHIP_rNN.json` "
+              "(tools/multichip_bench.py). CPU virtual-device step "
+              "times share one host's FLOPs — the evidence here is "
+              "program STRUCTURE (dispatches/step, recompiles, "
+              "bit-exact parity), not chip scaling; the T1/TN speedup "
+              "column becomes meaningful on real multi-chip "
+              "captures.\n\n")
 
 
 def _round_of(path, rec):
@@ -250,6 +268,119 @@ def render_llm(rows):
     return "\n".join(lines)
 
 
+def scan_multichip(repo=REPO):
+    """Classified rows for the ``MULTICHIP_r*.json`` trajectory. The
+    point-based shape (tools/multichip_bench.py) renders the scaling
+    curve; the legacy driver shape ({n_devices, rc, ok, tail}) only
+    certifies that the dryrun ran, so those rows carry no numbers."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json"))):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", path)
+        rnd = int(m.group(1)) if m else 0
+        row = {"round": rnd, "status": "valid", "points": [],
+               "dispatches": None, "tag": "", "note": ""}
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            row.update(status="invalid", note=f"unreadable: {e}")
+            rows.append(row)
+            continue
+        if isinstance(rec.get("round"), int):
+            row["round"] = rec["round"]
+        if "points" not in rec:                    # legacy dryrun shape
+            ok = rec.get("ok") and rec.get("rc", 1) == 0 \
+                and not rec.get("skipped")
+            row.update(
+                status="legacy" if ok else "invalid",
+                tag=f"{rec.get('n_devices', '?')}-device dryrun",
+                note="replica-loop dryrun (pre-SPMD): ran, no scaling "
+                     "points recorded" if ok else
+                     f"rc={rec.get('rc')}: dryrun failed")
+            rows.append(row)
+            continue
+        row["tag"] = rec.get("tag") or ""
+        if rec.get("skipped") or not rec.get("ok") \
+                or rec.get("value") is None:
+            row.update(status="skipped" if rec.get("skipped")
+                       else "invalid",
+                       note=f"skipped={rec.get('skipped')} "
+                            f"errors={rec.get('errors')}")
+            rows.append(row)
+            continue
+        row["dispatches"] = float(rec["value"])
+        row["points"] = rec["points"]
+        if not rec.get("timing_evidence", True):
+            row["note"] = "structure evidence only (CPU virtual devices)"
+        rows.append(row)
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def render_multichip(rows):
+    def fmt(v, pat):
+        return pat % v if v is not None else "—"
+    lines = [
+        "| round | status | devices (mesh) | step ms | T1/TN "
+        "| disp/step | parity | config | note |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        if not r["points"]:
+            lines.append(
+                f"| r{r['round']:02d} | {r['status']} | — | — | — | — "
+                f"| — | {r['tag']} | {r['note']} |")
+            continue
+        for i, pt in enumerate(r["points"]):
+            mesh = "×".join(f"{k}{v}" for k, v in
+                            (pt.get("mesh") or {}).items())
+            head = (f"| r{r['round']:02d} | {r['status']} "
+                    if i == 0 else "| | ")
+            # parity_kind (bitexact/tolerance) is the honest label;
+            # legacy artifacts only carried parity_bitexact
+            ok = pt.get("parity_ok", pt.get("parity_bitexact"))
+            kind = pt.get("parity_kind") or "bitexact"
+            parity = ("—" if ok is None else "FAIL" if ok is False
+                      else "tol" if kind == "tolerance" else "bit-exact")
+            # legacy artifacts carried the T1/TN value mislabeled as
+            # scaling_efficiency
+            spd = pt.get("speedup_vs_1dev", pt.get("scaling_efficiency"))
+            lines.append(
+                head + f"| {pt['devices']} ({mesh}) "
+                f"| {fmt(pt.get('step_ms'), '%.2f')} "
+                f"| {fmt(spd, '%.2f')} "
+                f"| {fmt(pt.get('dispatches_per_step'), '%.1f')} "
+                f"| {parity} "
+                f"| {r['tag'] if i == 0 else ''} "
+                f"| {r['note'] if i == 0 else ''} |")
+    valid = [r for r in rows if r["status"] == "valid" and r["points"]]
+    if valid:
+        best = valid[-1]
+        # the parity claim must come from the points, not prose — a
+        # tolerance-gated dp point (e.g. a real-pod capture with no
+        # bit-exact CPU oracle) must never render as bit-exact
+        kinds = {(pt.get("parity_kind") or "bitexact")
+                 for pt in best["points"]
+                 if pt.get("parity_ok") and pt.get("devices", 1) > 1}
+        parity_note = (
+            "every multi-device point bit-exact vs the replica-loop "
+            "oracle" if kinds == {"bitexact"}
+            else "multi-device parity tolerance-gated"
+            if kinds == {"tolerance"}
+            else "parity per point as the rows above label it "
+            "(bit-exact / tol)" if kinds
+            else "no multi-device parity evidence")
+        lines.append(
+            f"\nLatest SPMD curve: r{best['round']:02d} — "
+            f"{max(pt['devices'] for pt in best['points'])} devices at "
+            f"**{best['dispatches']:.1f} dispatch/step**, "
+            f"{parity_note}.")
+    else:
+        lines.append("\nNo SPMD scaling round yet (legacy dryruns "
+                     "only).")
+    return "\n".join(lines)
+
+
 def splice(doc_path, table, begin=BEGIN, end=END, heading=HEADING):
     block = f"{begin}\n\n{table}\n\n{end}"
     try:
@@ -280,9 +411,10 @@ def main():
     args = ap.parse_args()
     rows = scan(args.repo)
     llm_rows = scan_llm(args.repo)
-    if not rows and not llm_rows:
-        print("no BENCH_r*.json or BENCH_llm_r*.json found",
-              file=sys.stderr)
+    mc_rows = scan_multichip(args.repo)
+    if not rows and not llm_rows and not mc_rows:
+        print("no BENCH_r*.json, BENCH_llm_r*.json or "
+              "MULTICHIP_r*.json found", file=sys.stderr)
         return 1
     doc = args.doc or os.path.join(args.repo, "docs",
                                    "PERFORMANCE.md")
@@ -297,6 +429,12 @@ def main():
         if not args.dry_run:
             splice(doc, llm_table, begin=LLM_BEGIN, end=LLM_END,
                    heading=LLM_HEADING)
+    if mc_rows:
+        mc_table = render_multichip(mc_rows)
+        print("\n" + mc_table)
+        if not args.dry_run:
+            splice(doc, mc_table, begin=MC_BEGIN, end=MC_END,
+                   heading=MC_HEADING)
     if not args.dry_run:
         print(f"\nwrote {doc}")
     return 0

@@ -259,6 +259,16 @@ def smoke():
     import tempfile
     import numpy as np
     sys.path.insert(0, REPO)
+    # the SPMD segment below needs a (virtual) device mesh; harmless
+    # when the caller (tests/conftest.py) already forced a count
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
+    # an ambient mesh would silently turn the replica-path trainer
+    # below into an SPMD step and skew the dispatch-count assertions
+    os.environ.pop("MXNET_TPU_MESH", None)
     import mxnet_tpu as mx
     from mxnet_tpu import nd, serving
     from mxnet_tpu.gluon import nn, Trainer
@@ -294,6 +304,26 @@ def smoke():
     for _ in range(2):
         step(x, y)
     step(x[:5], y[:5])   # ragged tail -> padded bucket, not a retrace
+
+    # SPMD mesh mode (ISSUE 14): the same whole-step program over a
+    # 2-device dp mesh — one donated dispatch per step, in-program
+    # gradient psum — must land on the mxtpu_spmd_* series
+    import jax
+    from mxnet_tpu import parallel
+    n_dev = min(2, len(jax.devices()))
+    smesh = parallel.local_mesh(n_dev)
+    snet = nn.Dense(4, in_units=3)
+    snet.initialize()
+    strainer = Trainer(snet.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    sstep = strainer.compile_step(lambda a, b: loss_fn(snet(a), b),
+                                  mesh=smesh)
+    for _ in range(2):
+        sstep(x, y)
+    if sstep.last_reason is not None:
+        print(f"SMOKE FAIL: SPMD mesh step fell back to eager "
+              f"({sstep.last_reason})")
+        return 1
 
     # resilience: one checkpoint commit + restore, then a sharded+async
     # save so the mxtpu_ckpt_async_* series land in the exposition
@@ -489,9 +519,11 @@ def smoke():
     if samples[("mxtpu_training_steps_total", ())] < 2:
         print("SMOKE FAIL: step timer did not count 2 steps")
         return 1
-    if samples.get(("mxtpu_train_step_dispatch_total", ())) != 3 or \
-            samples.get(("mxtpu_train_step_compiled_total", ())) != 3:
-        print("SMOKE FAIL: compiled train step did not report 3 "
+    # 3 replica-path compiled steps + 2 SPMD mesh steps share the
+    # mxtpu_train_step_* series (one whole-step machinery, two modes)
+    if samples.get(("mxtpu_train_step_dispatch_total", ())) != 5 or \
+            samples.get(("mxtpu_train_step_compiled_total", ())) != 5:
+        print("SMOKE FAIL: compiled train step did not report 5 "
               "one-dispatch steps "
               f"(dispatch={samples.get(('mxtpu_train_step_dispatch_total', ()))})")
         return 1
@@ -501,6 +533,32 @@ def smoke():
     if not any(n == "mxtpu_train_step_bucket_compiles_total"
                for n, _ in samples):
         print("SMOKE FAIL: no per-bucket compile counter in exposition")
+        return 1
+    # SPMD evidence series (ISSUE 14): the 2-step mesh burst must land
+    # in the SAME exposition — dispatch count, per-(devices,bucket)
+    # program builds, the mesh-shape gauges and (dp>1) the in-program
+    # gradient-reduce payload
+    if samples.get(("mxtpu_spmd_step_dispatch_total", ())) != 2:
+        print("SMOKE FAIL: SPMD steps not counted "
+              f"({samples.get(('mxtpu_spmd_step_dispatch_total', ()))})")
+        return 1
+    slbl = (("bucket", "8"), ("devices", str(n_dev)))
+    if samples.get(("mxtpu_spmd_program_compiles_total", slbl)) != 1:
+        print("SMOKE FAIL: SPMD program build not counted once under "
+              f"(devices={n_dev}, bucket=8)")
+        return 1
+    if samples.get(("mxtpu_spmd_mesh_devices", ())) != n_dev:
+        print("SMOKE FAIL: SPMD mesh-devices gauge not set")
+        return 1
+    if samples.get(("mxtpu_spmd_mesh_axis_extent",
+                    (("axis", "dp"),))) != n_dev:
+        print("SMOKE FAIL: SPMD dp axis-extent gauge not set")
+        return 1
+    if n_dev > 1 and samples.get(
+            ("mxtpu_spmd_collective_bytes_total",
+             (("collective", "grad_reduce"),)), 0) <= 0:
+        print("SMOKE FAIL: no in-program gradient-reduce bytes "
+              "accounted for the dp>1 mesh")
         return 1
 
     # tracer export: Perfetto-loadable Chrome trace JSON + the

@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""1→N-device SPMD train-step scaling protocol → MULTICHIP_rNN.json.
+
+One subprocess per device count (the XLA virtual-device count is fixed
+at backend init, so every point needs a fresh interpreter): each worker
+drives the PRODUCTION path — a gluon ``Trainer`` + ``compile_step``
+SPMD mesh mode — through a fixed-global-batch (strong-scaling) train
+protocol and reports, per point:
+
+- ``step_ms``            median host time per step (timed window)
+- ``dispatches_per_step`` from ``mxtpu_spmd_step_dispatch_total`` —
+                          the acceptance gate is EXACTLY 1
+- ``recompiles``         backend_compile counter over the timed window
+                          (gate: 0 — lr changes mid-window on purpose)
+- ``grad_reduce_bytes``  logical per-step psum payload
+- ``parity_bitexact``    weights after 2 steps == a per-shard
+                          replica-loop oracle (summed in device order),
+                          bitwise — the correctness gate
+
+plus one composition point (``dp=4,tp=2`` with ``auto_spec``-derived
+megatron splits) gated on tolerance parity vs a single-device run
+(``parity_kind: tolerance`` — never labeled bit-exact).
+
+Evidence hygiene (PR 6 contract): CPU virtual devices share one host's
+FLOPs, so **step_ms here is dispatch/correctness evidence, not kernel
+timing** — the committed artifact says so (``timing_evidence``) and the
+headline ``value`` is the dispatch count, not a speed. A point that
+fails a gate marks the artifact ``ok: false``; a worker that fails to
+run marks it ``skipped`` with ``value: null`` instead of reusing
+anything stale.
+
+    python tools/multichip_bench.py --out MULTICHIP_r06.json --round 6
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WARMUP, TIMED = 3, 12
+BATCH, IN_DIM, HIDDEN, CLASSES = 64, 64, 256, 10
+
+
+def _force_cpu(n):
+    flags = os.environ.get("XLA_FLAGS", "")
+    import re
+    pat = r"--xla_force_host_platform_device_count=\d+"
+    new = f"--xla_force_host_platform_device_count={n}"
+    flags = re.sub(pat, new, flags) if re.search(pat, flags) \
+        else (flags + " " + new).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _build_net(seed):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+    import mxnet_tpu.autograd as ag
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(HIDDEN, activation="relu", in_units=IN_DIM),
+                nn.Dense(HIDDEN, activation="relu", in_units=HIDDEN),
+                nn.Dense(CLASSES, in_units=HIDDEN))
+    net.initialize(init=mx.initializer.Xavier())
+    with ag.pause(train_mode=False):
+        net(nd.array(np.zeros((1, IN_DIM), np.float32)))
+    return net
+
+
+def _data(steps):
+    import numpy as np
+    rng = np.random.RandomState(42)
+    X = rng.randn(steps, BATCH, IN_DIM).astype(np.float32)
+    Y = (np.arange(steps * BATCH).reshape(steps, BATCH)
+         % CLASSES).astype(np.float32)
+    return X, Y
+
+
+def worker(n_devices, mesh_spec):
+    """One scaling point; prints a single JSON line."""
+    _force_cpu(n_devices)
+    import time
+    import numpy as np
+    import mxnet_tpu as mx  # noqa: F401  (registers ops)
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.observability import (get_registry,
+                                         install_jax_monitoring_bridge)
+    import mxnet_tpu.autograd as ag
+
+    install_jax_monitoring_bridge()
+    reg = get_registry()
+    compiles = reg.counter("mxtpu_xla_compile_total")
+    sdispatch = reg.counter("mxtpu_spmd_step_dispatch_total")
+    LOSS = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = parallel.parse_mesh(mesh_spec or str(n_devices))
+    dp = dict(mesh.shape).get("dp", 1)
+
+    net = _build_net(0)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    spec = parallel.auto_spec(net, mesh) if "tp" in dict(mesh.shape) \
+        and dict(mesh.shape)["tp"] > 1 else None
+    step = tr.compile_step(lambda x, y: LOSS(net(x), y), mesh=mesh,
+                           param_spec=spec)
+    X, Y = _data(WARMUP + TIMED)
+    for s in range(WARMUP):
+        step(nd.array(X[s]), nd.array(Y[s]))
+    if step.last_reason is not None:
+        print(json.dumps({"devices": n_devices, "error":
+                          f"fell back to eager: {step.last_reason}"}))
+        return 1
+
+    # parity gate: replica-loop oracle — per-shard eager grads summed in
+    # device order, applied through the same user-facing trainer.step
+    parity, parity_kind = None, None
+    if spec is None and BATCH % dp == 0:
+        parity_kind = "bitexact"
+        net_o = _build_net(0)
+        tr_o = gluon.Trainer(net_o.collect_params(), "sgd",
+                             {"learning_rate": 0.05, "momentum": 0.9})
+        per = BATCH // dp
+        for s in range(WARMUP):
+            shard_grads = []
+            for c in range(dp):
+                with ag.record():
+                    l = LOSS(net_o(nd.array(X[s][c * per:(c + 1) * per])),
+                             nd.array(Y[s][c * per:(c + 1) * per]))
+                l.backward()
+                shard_grads.append({k: p.list_grad()[0]._data for k, p
+                                    in net_o.collect_params().items()})
+            for k, p in net_o.collect_params().items():
+                tot = shard_grads[0][k]
+                for g in shard_grads[1:]:
+                    tot = tot + g[k]
+                p.list_grad()[0]._data = tot
+            tr_o.step(BATCH)
+        parity = all(
+            (pa.data().asnumpy() == pb.data().asnumpy()).all()
+            for (_, pa), (_, pb) in zip(
+                sorted(net.collect_params().items()),
+                sorted(net_o.collect_params().items())))
+    elif spec is not None:
+        # tp composition point: tolerance parity vs a single-device run
+        parity_kind = "tolerance"
+        net_o = _build_net(0)
+        tr_o = gluon.Trainer(net_o.collect_params(), "sgd",
+                             {"learning_rate": 0.05, "momentum": 0.9})
+        for s in range(WARMUP):
+            with ag.record():
+                l = LOSS(net_o(nd.array(X[s])), nd.array(Y[s]))
+            l.backward()
+            tr_o.step(BATCH)
+        parity = all(
+            np.allclose(pa.data().asnumpy(), pb.data().asnumpy(),
+                        rtol=1e-5, atol=1e-6)
+            for (_, pa), (_, pb) in zip(
+                sorted(net.collect_params().items()),
+                sorted(net_o.collect_params().items())))
+
+    # timed window: lr changes every step on purpose — the zero-recompile
+    # contract is part of what this artifact certifies
+    c0, d0 = compiles.value, sdispatch.value
+    times = []
+    loss = None
+    for s in range(WARMUP, WARMUP + TIMED):
+        tr.set_learning_rate(0.05 / (s + 1))
+        t0 = time.perf_counter()
+        loss = step(nd.array(X[s]), nd.array(Y[s]))
+        float(loss.asnumpy()[0])         # host fetch = sync
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    from tools.metrics_dump import parse_exposition
+    samples = parse_exposition(reg.expose())
+    gb = samples.get(("mxtpu_spmd_collective_bytes_total",
+                      (("collective", "grad_reduce"),)), 0)
+    print(json.dumps({
+        "devices": n_devices,
+        "mesh": {k: int(v) for k, v in dict(mesh.shape).items()
+                 if int(v) > 1} or {"dp": 1},
+        "tp_sharded_params": len(getattr(spec, "specs", {}) or {})
+        if spec else 0,
+        "step_ms": round(times[len(times) // 2] * 1e3, 3),
+        "dispatches_per_step": (sdispatch.value - d0) / TIMED,
+        "recompiles": compiles.value - c0,
+        "grad_reduce_bytes_per_step": gb / max(
+            sdispatch.value, 1) if gb else 0.0,
+        "parity_ok": parity,
+        "parity_kind": parity_kind,
+        "final_loss": float(loss.asnumpy().mean()),
+    }))
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--devices", default="1,2,4,8",
+                    help="comma-separated device counts (default 1,2,4,8)")
+    ap.add_argument("--tp-point", default="1",
+                    help="1 (default) adds a dp=4,tp=2 composition point "
+                         "at 8 devices; 0 skips it")
+    ap.add_argument("--out", default=None,
+                    help="write the snapshot JSON here (default: print)")
+    ap.add_argument("--round", type=int, default=0,
+                    help="bench round number recorded in the artifact")
+    ap.add_argument("--worker", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--worker-mesh", default="", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.worker is not None:
+        sys.path.insert(0, REPO)
+        return worker(args.worker, args.worker_mesh)
+
+    jobs = [(int(n), "") for n in args.devices.split(",")]
+    if args.tp_point != "0":
+        jobs.append((8, "dp=4,tp=2"))
+    points, errors = [], []
+    for n, mesh_spec in jobs:
+        env = os.environ.copy()
+        env.pop("XLA_FLAGS", None)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--worker", str(n)]
+        if mesh_spec:
+            cmd += ["--worker-mesh", mesh_spec]
+        try:
+            p = subprocess.run(cmd, capture_output=True, text=True,
+                               env=env, cwd=REPO, timeout=900)
+        except subprocess.TimeoutExpired:
+            errors.append(f"devices={n} mesh={mesh_spec or n}: "
+                          "worker timed out after 900s")
+            continue
+        line = (p.stdout or "").strip().splitlines()
+        rec = None
+        if line:
+            try:
+                rec = json.loads(line[-1])
+            except ValueError:
+                pass
+        if p.returncode != 0 or rec is None or rec.get("error"):
+            tail = (p.stderr or "").strip().splitlines()
+            errors.append(f"devices={n} mesh={mesh_spec or n}: "
+                          + (rec or {}).get(
+                              "error", tail[-1] if tail else
+                              f"rc={p.returncode}"))
+            continue
+        points.append(rec)
+
+    base = next((pt for pt in points if pt["devices"] == 1), None)
+    for pt in points:
+        # T1/TN speedup vs the 1-device point — NOT efficiency (that
+        # would be T1/(N*TN)); named honestly so a real-pod capture
+        # can't be misread as efficiency-near-1-is-good
+        pt["speedup_vs_1dev"] = round(
+            base["step_ms"] / pt["step_ms"], 3) \
+            if base and pt["step_ms"] else None
+    gates_ok = bool(points) and not errors and all(
+        pt["dispatches_per_step"] == 1.0 and pt["recompiles"] == 0
+        and pt["parity_ok"] in (True, None) for pt in points) \
+        and all(pt.get("parity_ok") is True
+                for pt in points if pt["devices"] > 1)
+    record = {
+        "metric": "spmd_dispatches_per_step",
+        # the headline this artifact can honestly certify on CPU
+        # virtual devices: program structure, not speed
+        "value": (max(pt["dispatches_per_step"] for pt in points)
+                  if points and gates_ok else None),
+        "unit": "program launches per training step (gate: 1.0)",
+        "round": args.round or None,
+        "tag": f"spmd mlp{IN_DIM}x{HIDDEN} bs{BATCH} strong-scaling",
+        "backend": "cpu-virtual-devices",
+        "timing_evidence": False,
+        "note": ("step_ms on xla_force_host_platform_device_count "
+                 "devices shares ONE host's FLOPs: read it as "
+                 "dispatch-overhead/correctness evidence, never as chip "
+                 "scaling. Gates: 1 dispatch/step, 0 recompiles across "
+                 "per-step lr changes, bit-exact vs the per-shard "
+                 "replica-loop oracle (dp points) / tolerance parity "
+                 "vs single-device (tp point)."),
+        "protocol": {"global_batch": BATCH, "warmup": WARMUP,
+                     "timed_steps": TIMED, "optimizer": "sgd+momentum",
+                     "model": f"MLP {IN_DIM}-{HIDDEN}-{HIDDEN}-{CLASSES}"},
+        "points": points,
+        "ok": gates_ok,
+        "skipped": False if points else "no scaling point completed",
+        "errors": errors,
+    }
+    out = json.dumps(record, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+        print(f"wrote {args.out} (ok={gates_ok})")
+    else:
+        print(out)
+    return 0 if gates_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
