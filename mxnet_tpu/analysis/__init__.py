@@ -1,0 +1,29 @@
+"""mxlint — AST static analysis enforcing this repo's JAX invariants.
+
+Fourteen PRs of invariants — zero steady-state recompiles, one
+donated program per step, no host syncs on compiled paths, strict KV
+block accounting, annotated lock discipline, and a documented catalog
+for every metric / env var / fault site — used to live as convention
+and runtime pins. This package makes them build-time checkable, the
+way the reference framework's ``tools/lint`` + cpplint wiring keeps
+its engine invariants honest at 256k LoC.
+
+Pure stdlib + ``ast``; never imports the modules it checks.
+``tools/mxlint.py`` is the CLI (it loads this package standalone, no
+jax import); ``tests/test_analysis.py`` wires the same engine into
+tier-1 in-process. See docs/ANALYSIS.md for the rule catalog,
+suppression & baseline workflow, and how to write a rule.
+
+    from mxnet_tpu import analysis
+    result = analysis.run("/path/to/repo")
+    for f in result.findings: print(f.path, f.line, f.rule)
+"""
+from .core import (Finding, FileCtx, Rule, RunResult, run, lint_source,
+                   load_config, collect_files, DEFAULT_CONFIG)
+from .rules import ALL_RULES, RULES_BY_ID
+from . import baseline, reporters
+
+__all__ = ["Finding", "FileCtx", "Rule", "RunResult", "run",
+           "lint_source", "load_config", "collect_files",
+           "DEFAULT_CONFIG", "ALL_RULES", "RULES_BY_ID", "baseline",
+           "reporters"]

@@ -1,0 +1,62 @@
+"""The committed baseline: grandfathered findings that predate a rule.
+
+A rule should land enforcing its invariant everywhere — but a rule
+retrofitted onto fourteen PRs of code meets findings that are wrong to
+fix in the same PR and wrong to suppress forever. Those go in the
+baseline file: an explicit, reviewable JSON ledger of ``rule`` +
+``file:line`` (+ the message for humans) that ``--check`` subtracts
+from a run. Entries burn down honestly — they match on exact
+file:line, so touching the code invalidates the entry and the finding
+comes back until it is fixed or consciously re-baselined.
+
+The catalog-drift rules are required to keep an EMPTY baseline: docs
+drift is always fixable in the PR that causes it.
+"""
+from __future__ import annotations
+
+import json
+
+VERSION = 1
+
+
+def load_baseline(path):
+    """-> {(rule, path, line)} plus the raw entries; empty when the
+    file does not exist."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return set(), []
+    entries = data.get("findings", [])
+    keys = {(e["rule"], e["path"], int(e["line"])) for e in entries}
+    return keys, entries
+
+
+def write_baseline(path, findings):
+    data = {
+        "version": VERSION,
+        "comment": ("grandfathered mxlint findings; entries match on "
+                    "exact rule+file:line and must burn down, not "
+                    "grow — see docs/ANALYSIS.md"),
+        "findings": [f.to_dict() for f in
+                     sorted(findings, key=lambda f: f.sort_key())],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def diff(findings, baseline_keys):
+    """Partition a run against the baseline. Returns ``(new, known,
+    stale)``: findings not in the baseline, findings the baseline
+    covers, and baseline keys no current finding matches (fixed code —
+    the entry should be deleted)."""
+    new, known, seen = [], [], set()
+    for f in findings:
+        if f.key() in baseline_keys:
+            known.append(f)
+            seen.add(f.key())
+        else:
+            new.append(f)
+    stale = sorted(baseline_keys - seen)
+    return new, known, stale

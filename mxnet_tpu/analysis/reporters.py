@@ -1,0 +1,67 @@
+"""Finding reporters: compiler-style text and a stable JSON schema.
+
+The JSON schema is a contract (tests pin it): top-level ``version`` /
+``tool`` / ``findings`` / ``summary``; each finding carries exactly
+``rule, path, line, col, message``. CI and editors parse this —
+additions are fine, renames and removals are not.
+"""
+from __future__ import annotations
+
+import json
+
+JSON_SCHEMA_VERSION = 1
+
+
+def format_text(findings, summary=None):
+    lines = [f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}"
+             for f in findings]
+    if summary is not None:
+        lines.append(summary)
+    return "\n".join(lines)
+
+
+def summarize(result, new=None, stale=None):
+    by_rule = result.by_rule()
+    parts = [f"{len(result.files)} files",
+             f"{len(result.findings)} findings"]
+    if new is not None:
+        parts.append(f"{len(new)} new")
+    if stale:
+        parts.append(f"{len(stale)} stale baseline entries")
+    if result.suppressed_count:
+        parts.append(f"{result.suppressed_count} suppressed")
+    parts.append(f"{result.elapsed_s:.2f}s")
+    head = "mxlint: " + ", ".join(parts)
+    if by_rule:
+        head += "  [" + " ".join(f"{k}={v}"
+                                 for k, v in by_rule.items()) + "]"
+    return head
+
+
+def to_json(result, new=None, stale=None):
+    """The stable JSON document (as a dict; ``dumps`` it yourself or
+    via :func:`format_json`)."""
+    doc = {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "mxlint",
+        "findings": [f.to_dict() for f in result.findings],
+        "summary": {
+            "files": len(result.files),
+            "findings": len(result.findings),
+            "suppressed": result.suppressed_count,
+            "by_rule": result.by_rule(),
+            "elapsed_s": round(result.elapsed_s, 3),
+        },
+    }
+    if new is not None:
+        doc["summary"]["new"] = len(new)
+        doc["new_findings"] = [f.to_dict() for f in new]
+    if stale is not None:
+        doc["summary"]["stale_baseline"] = len(stale)
+        doc["stale_baseline"] = [
+            {"rule": r, "path": p, "line": ln} for r, p, ln in stale]
+    return doc
+
+
+def format_json(result, new=None, stale=None):
+    return json.dumps(to_json(result, new=new, stale=stale), indent=1)

@@ -1,0 +1,25 @@
+"""The shipped rule set. Each rule is one repo invariant; the engine
+(:mod:`..core`) is rule-agnostic — adding a rule is writing a class
+with an ``id`` and a check method and listing it here (see
+docs/ANALYSIS.md "writing a new rule")."""
+from .jax_rules import (HostSyncRule, DonatedReuseRule,
+                        RecompileHazardRule)
+from .kv_rules import KVLeakRule
+from .lock_rules import GuardedByRule
+from .catalog_rules import (MetricCatalogRule, EnvCatalogRule,
+                            FaultCatalogRule)
+
+ALL_RULES = [
+    HostSyncRule,
+    DonatedReuseRule,
+    RecompileHazardRule,
+    KVLeakRule,
+    GuardedByRule,
+    MetricCatalogRule,
+    EnvCatalogRule,
+    FaultCatalogRule,
+]
+
+RULES_BY_ID = {cls.id: cls for cls in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_ID"] + [c.__name__ for c in ALL_RULES]

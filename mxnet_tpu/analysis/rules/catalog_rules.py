@@ -1,0 +1,192 @@
+"""catalog-drift: code and docs catalogs must not diverge.
+
+Every ``mxtpu_*`` series declared on the metrics registry, every
+``MXNET_TPU_*`` environment variable the code reads, and every
+``faults.point()``/``faults.check()`` site is an operational surface
+someone will grep the docs for at 3am. The docs catalogs
+(docs/OBSERVABILITY.md, docs/ENV_VARS.md, docs/RESILIENCE.md) are the
+contract; these rules fail the build when code grows a surface the
+catalog does not name. The policy is full names: a docs row must
+spell every series out (no ``_foo`` suffix shorthand), because a
+shorthand row is exactly what let fourteen PRs drift.
+
+These rules are project-scope: they read the docs files off disk, and
+only treat ``catalog_paths`` (default: ``mxnet_tpu``) as declaration
+sites — tools and tests may mention names freely.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Rule
+
+_METRIC_DECLS = {"counter", "gauge", "histogram"}
+_METRIC_RE = re.compile(r"^mxtpu_[a-z0-9_]+$")
+_ENV_RE = re.compile(r"^MXNET_TPU_[A-Z0-9_]+$")
+
+
+def _read_doc(root, rel):
+    path = os.path.join(root, rel)
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _in_catalog(ctx, config):
+    return any(ctx.path == p or ctx.path.startswith(p.rstrip("/") + "/")
+               for p in config.get("catalog_paths", ()))
+
+
+class MetricCatalogRule(Rule):
+    """metric-catalog: every registry-declared ``mxtpu_*`` series has
+    a docs/OBSERVABILITY.md row naming it in full."""
+
+    id = "metric-catalog"
+    scope = "project"
+    description = ("mxtpu_* series declared on the registry missing "
+                   "from the docs catalog")
+
+    def check_project(self, ctxs, root, config):
+        doc_rel = config["metric_docs"]
+        doc = _read_doc(root, doc_rel)
+        if doc is None:
+            return [Rule.finding(self, doc_rel, 1,
+                                 f"metric catalog {doc_rel} missing")]
+        documented = set(re.findall(r"mxtpu_[a-z0-9_]+", doc))
+        out = []
+        for ctx in ctxs:
+            if not _in_catalog(ctx, config) \
+                    or "mxtpu_" not in ctx.source:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _METRIC_DECLS
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                name = node.args[0].value
+                if _METRIC_RE.match(name) and name not in documented:
+                    out.append(self.finding(
+                        ctx.path, node,
+                        f"metric {name!r} is declared here but has "
+                        f"no row in {doc_rel} — add it to the "
+                        f"catalog (full name, not a suffix "
+                        f"shorthand)"))
+        return out
+
+
+class EnvCatalogRule(Rule):
+    """envvar-catalog: every ``MXNET_TPU_*`` env var the code reads
+    has a docs/ENV_VARS.md row (default + which module reads it)."""
+
+    id = "envvar-catalog"
+    scope = "project"
+    description = ("MXNET_TPU_* env var read in code missing from "
+                   "docs/ENV_VARS.md")
+
+    def check_project(self, ctxs, root, config):
+        doc_rel = config["env_docs"]
+        doc = _read_doc(root, doc_rel)
+        if doc is None:
+            return [Rule.finding(self, doc_rel, 1,
+                                 f"env catalog {doc_rel} missing")]
+        documented = set(re.findall(r"MXNET_TPU_[A-Z0-9_]+", doc))
+        out = []
+        for ctx in ctxs:
+            if not _in_catalog(ctx, config) \
+                    or "MXNET_TPU_" not in ctx.source:
+                continue
+            docstrings = self._docstring_nodes(ctx.tree)
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and _ENV_RE.match(node.value)):
+                    continue
+                if node in docstrings:
+                    continue
+                if node.value not in documented:
+                    out.append(self.finding(
+                        ctx.path, node,
+                        f"env var {node.value!r} is read here but "
+                        f"has no row in {doc_rel} — document its "
+                        f"default and effect"))
+        return out
+
+    def _docstring_nodes(self, tree):
+        out = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Module, ast.ClassDef,
+                                 ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                body = node.body
+                if (body and isinstance(body[0], ast.Expr)
+                        and isinstance(body[0].value, ast.Constant)):
+                    out.add(body[0].value)
+        return out
+
+
+class FaultCatalogRule(Rule):
+    """fault-catalog: every named fault-injection site
+    (``faults.point(...)`` / ``faults.check(...)``) appears in the
+    docs/RESILIENCE.md fault-site catalog, so the chaos matrix stays
+    discoverable. Dynamic names (f-strings) are matched on their
+    literal prefix (``ckpt.shard:`` for ``f"ckpt.shard:{k}"``)."""
+
+    id = "fault-catalog"
+    scope = "project"
+    description = ("faults.point()/check() site missing from the "
+                   "docs fault-site catalog")
+
+    def check_project(self, ctxs, root, config):
+        doc_rel = config["fault_docs"]
+        doc = _read_doc(root, doc_rel)
+        if doc is None:
+            return [Rule.finding(self, doc_rel, 1,
+                                 f"fault catalog {doc_rel} missing")]
+        out = []
+        for ctx in ctxs:
+            if not _in_catalog(ctx, config) \
+                    or "faults." not in ctx.source:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("point", "check")
+                        and self._on_faults(node.func.value)
+                        and node.args):
+                    continue
+                name = self._site_name(node.args[0])
+                if name is None:
+                    out.append(self.finding(
+                        ctx.path, node,
+                        f"faults.{node.func.attr}() site name has no "
+                        f"literal prefix — undocumentable; start it "
+                        f"with a literal subsystem prefix"))
+                elif name not in doc:
+                    out.append(self.finding(
+                        ctx.path, node,
+                        f"fault site {name!r} is not named in "
+                        f"{doc_rel} — add it to the fault-site "
+                        f"catalog"))
+        return out
+
+    def _on_faults(self, value):
+        return (isinstance(value, ast.Name) and value.id == "faults") \
+            or (isinstance(value, ast.Attribute)
+                and value.attr == "faults")
+
+    def _site_name(self, arg):
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if (isinstance(arg, ast.JoinedStr) and arg.values
+                and isinstance(arg.values[0], ast.Constant)
+                and isinstance(arg.values[0].value, str)
+                and arg.values[0].value):
+            return arg.values[0].value
+        return None

@@ -1,0 +1,482 @@
+"""Rules enforcing the compiled-path JAX invariants.
+
+Three rules share one reachability analysis: a function is *compiled*
+when it is decorated with / passed to ``jax.jit`` (or ``jit`` /
+``pjit`` / ``partial(jax.jit, ...)``), is defined lexically inside a
+compiled function, or is called by simple name from a compiled
+function in the same module (transitive closure). This is how the
+repo's step builders work — ``jit.CompiledTrainStep`` and the LLM
+engine define local ``fn``/``step`` functions and hand them to
+``jax.jit`` by name — so name-level reachability inside one module
+covers the real compiled paths without importing anything.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, parent_map
+
+_JIT_NAMES = {"jit", "pjit"}
+_SHAPE_ATTRS = {"shape", "dtype", "ndim", "size"}
+_STATIC_CALLS = {"len", "range", "isinstance", "getattr", "hasattr",
+                 "type"}
+_NP_NAMES = {"np", "numpy", "onp"}
+
+
+def _is_jit_func(func):
+    """Does this expression name the jit transform itself?"""
+    if isinstance(func, ast.Name):
+        return func.id in _JIT_NAMES
+    if isinstance(func, ast.Attribute):
+        return func.attr in _JIT_NAMES
+    return False
+
+
+def _is_jit_call(call):
+    """``jax.jit(...)`` / ``jit(...)`` / ``partial(jax.jit, ...)``."""
+    if not isinstance(call, ast.Call):
+        return False
+    if _is_jit_func(call.func):
+        return True
+    name = (call.func.attr if isinstance(call.func, ast.Attribute)
+            else call.func.id if isinstance(call.func, ast.Name)
+            else "")
+    if name == "partial" and call.args:
+        return _is_jit_func(call.args[0]) or _is_jit_call(call.args[0])
+    return False
+
+
+def _jit_decorated(fn):
+    for dec in fn.decorator_list:
+        if _is_jit_func(dec) or _is_jit_call(dec):
+            return True
+    return False
+
+
+def _binding_scope(fn, parents):
+    """The scope a ``def`` binds its name into: the nearest enclosing
+    FunctionDef/Module — or the ClassDef, for methods (which are NOT
+    reachable as a bare name from nested scopes)."""
+    cur = parents.get(fn)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef, ast.Module)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _resolve(name, use_site, defs_by_name, parents):
+    """Defs named ``name`` visible from ``use_site`` under lexical
+    scoping: the def's binding scope must be an ancestor scope of the
+    use site (methods only resolve inside their own class body). This
+    is what keeps a module's unrelated ``step`` method from being
+    conflated with a local ``step`` passed to jax.jit."""
+    ancestors = {use_site}
+    cur = use_site
+    while cur in parents:
+        cur = parents[cur]
+        ancestors.add(cur)
+    out = []
+    for fn in defs_by_name.get(name, ()):
+        scope = _binding_scope(fn, parents)
+        if scope not in ancestors:
+            continue
+        if isinstance(scope, ast.ClassDef):
+            # class namespaces are skipped by nested-function lookup:
+            # a method is only reachable by bare name at class-body
+            # level (between methods), never from inside one
+            site_scope = use_site
+            while site_scope in parents and not isinstance(
+                    site_scope, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef,
+                                 ast.Module)):
+                site_scope = parents[site_scope]
+            if site_scope is not scope:
+                continue
+        out.append(fn)
+    return out
+
+
+def compiled_functions(tree, parents=None):
+    """All function defs reachable from a jit entry point in this
+    module: {FunctionDef/AsyncFunctionDef: reason string}."""
+    parents = parents or parent_map(tree)
+    defs_by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    compiled = {}
+
+    def mark(fn, reason):
+        if fn not in compiled:
+            compiled[fn] = reason
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _jit_decorated(node):
+                mark(node, "decorated with jax.jit")
+        if (isinstance(node, ast.Call) and _is_jit_call(node)
+                and node.args):
+            target = node.args[0]
+            if _is_jit_func(target) or isinstance(target, ast.Call):
+                # partial(jax.jit, ...) — the fn rides elsewhere
+                continue
+            if isinstance(target, ast.Name):
+                for fn in _resolve(target.id, node, defs_by_name,
+                                   parents):
+                    mark(fn, f"passed to jax.jit as {target.id!r}")
+
+    # lexical nesting + same-module call graph, to fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for fn, reason in list(compiled.items()):
+            for sub in ast.walk(fn):
+                if (isinstance(sub, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))
+                        and sub is not fn and sub not in compiled):
+                    compiled[sub] = f"defined inside compiled " \
+                                    f"{fn.name!r}"
+                    changed = True
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)):
+                    for callee in _resolve(sub.func.id, sub,
+                                           defs_by_name, parents):
+                        if callee not in compiled:
+                            compiled[callee] = (
+                                f"called from compiled {fn.name!r}")
+                            changed = True
+    return compiled
+
+
+def _compiled(ctx):
+    """Per-file cached :func:`compiled_functions` — HostSyncRule and
+    RecompileHazardRule share one reachability fixpoint per file."""
+    if "compiled_functions" not in ctx.memo:
+        ctx.memo["compiled_functions"] = compiled_functions(
+            ctx.tree, ctx.parents())
+    return ctx.memo["compiled_functions"]
+
+
+def _own_nodes(fn):
+    """Walk ``fn``'s body without descending into nested function
+    defs (they are analyzed on their own)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _param_names(fn):
+    a = fn.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+class HostSyncRule(Rule):
+    """host-sync: no host round-trips on traced values inside a
+    compiled path.
+
+    Inside a compiled function, the parameters are tracers; anything
+    derived from them (excluding the static ``.shape``/``.dtype``/
+    ``.ndim``/``len()`` surface) is a tracer. ``float()``/``int()``/
+    ``bool()``/``np.asarray()``/``np.array()`` on a tracer forces a
+    device->host sync per step — the zero-host-round-trip contract the
+    compiled train step and the LLM decode step are built on. A bare
+    ``.item()`` inside a compiled function is flagged uncondition-
+    ally: there is nothing to call it on there that is not traced.
+    """
+
+    id = "host-sync"
+    description = ("float()/int()/bool()/.item()/np.asarray on traced "
+                   "values inside jit-compiled code")
+
+    def check_file(self, ctx):
+        if "jit" not in ctx.source:
+            return []
+        out = []
+        for fn, reason in _compiled(ctx).items():
+            tainted = set(_param_names(fn))
+            # two passes so taint flows through forward references in
+            # loops; assignments only, statement granularity
+            for _ in range(2):
+                for node in _own_nodes(fn):
+                    if isinstance(node, ast.Assign):
+                        if self._traced(node.value, tainted):
+                            for t in node.targets:
+                                for n in ast.walk(t):
+                                    if isinstance(n, ast.Name):
+                                        tainted.add(n.id)
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr == "item"
+                        and not node.args):
+                    out.append(self.finding(
+                        ctx.path, node,
+                        f".item() inside compiled function "
+                        f"{fn.name!r} ({reason}) forces a host sync "
+                        f"per step"))
+                elif (isinstance(f, ast.Name)
+                      and f.id in ("float", "int", "bool")
+                      and len(node.args) == 1
+                      and self._traced(node.args[0], tainted)):
+                    out.append(self.finding(
+                        ctx.path, node,
+                        f"{f.id}() on a traced value inside compiled "
+                        f"function {fn.name!r} ({reason}) — pass it "
+                        f"as a traced arg or keep it on device"))
+                elif (isinstance(f, ast.Attribute)
+                      and f.attr in ("asarray", "array")
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id in _NP_NAMES
+                      and node.args
+                      and self._traced(node.args[0], tainted)):
+                    out.append(self.finding(
+                        ctx.path, node,
+                        f"np.{f.attr}() on a traced value inside "
+                        f"compiled function {fn.name!r} ({reason}) "
+                        f"materializes it on host — use jnp"))
+        return out
+
+    def _traced(self, expr, tainted):
+        """Does ``expr`` mention a tainted name, outside the static
+        shape/dtype surface?"""
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _SHAPE_ATTRS:
+                return False
+            return self._traced(expr.value, tainted)
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Name) and f.id in _STATIC_CALLS:
+                return False
+            return any(self._traced(c, tainted)
+                       for c in ast.iter_child_nodes(expr))
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        return any(self._traced(c, tainted)
+                   for c in ast.iter_child_nodes(expr))
+
+
+class DonatedReuseRule(Rule):
+    """donated-reuse: a buffer passed through a donated argument
+    position is dead — XLA may already have reused its memory.
+
+    Tracks, per function scope: ``f = jax.jit(step, donate_argnums=
+    (i, ...))`` then ``f(a, b, ...)`` — the names at donated positions
+    must not be read again in that scope unless rebound first (the
+    blessed idiom is ``params = f(params, ...)``).
+    """
+
+    id = "donated-reuse"
+    description = ("a name passed at a donate_argnums position is "
+                   "read after the donating call")
+
+    def check_file(self, ctx):
+        if "donate_argnums" not in ctx.source:
+            return []
+        out = []
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            out.extend(self._check_scope(ctx, scope))
+        return out
+
+    def _check_scope(self, ctx, scope):
+        body = (scope.body if isinstance(
+            scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module))
+            else [])
+        nodes = []
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            nodes.append(n)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+        jitted = {}                      # name -> donated indices
+        for n in nodes:
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)):
+                continue
+            call = n.value
+            if not (isinstance(call, ast.Call) and _is_jit_call(call)):
+                continue
+            donated = []
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    for c in ast.walk(kw.value):
+                        if (isinstance(c, ast.Constant)
+                                and isinstance(c.value, int)):
+                            donated.append(c.value)
+            if donated:
+                jitted[n.targets[0].id] = sorted(set(donated))
+
+        if not jitted:
+            return []
+        # (arg name, donating-statement lineno span) — a store
+        # anywhere from the statement on (incl. `x = f(x)` itself)
+        # rebinds the name and re-arms it. ``nodes`` holds every
+        # statement level (an `if` AND the assign inside it), so each
+        # call keeps only its INNERMOST enclosing statement's span —
+        # one donation per call site, not one per nesting level.
+        call_spans = {}
+        for stmt in nodes:
+            if not isinstance(stmt, ast.stmt):
+                continue
+            start = stmt.lineno
+            end = getattr(stmt, "end_lineno", stmt.lineno)
+            for n in ast.walk(stmt):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Name)
+                        and n.func.id in jitted):
+                    prev = call_spans.get(id(n))
+                    if prev is None or end - start < prev[2] - prev[1]:
+                        call_spans[id(n)] = (n, start, end)
+        donations = []
+        for n, start, end in call_spans.values():
+            for idx in jitted[n.func.id]:
+                if idx < len(n.args) and isinstance(n.args[idx],
+                                                    ast.Name):
+                    donations.append((n.args[idx].id, start, end))
+        if not donations:
+            return []
+
+        loads, stores = {}, {}
+        for n in nodes:
+            if isinstance(n, ast.Name):
+                d = loads if isinstance(n.ctx, ast.Load) else stores
+                d.setdefault(n.id, []).append(n)
+        out = []
+        for name, start, after in donations:
+            for load in sorted(loads.get(name, ()),
+                               key=lambda n: (n.lineno, n.col_offset)):
+                if load.lineno <= after:
+                    continue
+                rebound = any(start <= s.lineno <= load.lineno
+                              for s in stores.get(name, ()))
+                if not rebound:
+                    out.append(self.finding(
+                        ctx.path, load,
+                        f"{name!r} was donated (donate_argnums) on "
+                        f"line {after} and read again here — the "
+                        f"buffer may already be reused; rebind the "
+                        f"result instead"))
+                break
+        return out
+
+
+class RecompileHazardRule(Rule):
+    """recompile-hazard: a compiled function closing over a mutable
+    Python value re-traces every time that value changes.
+
+    The repo's discipline (lr/scale/sampling params ride as traced
+    args, never as closures) exists precisely so steady state never
+    recompiles. This rule flags a compiled function reading a closure
+    variable that its enclosing scope treats as mutable: reassigned
+    after the compiled function exists, assigned more than once,
+    augmented (``+=``), or assigned inside a loop.
+    """
+
+    id = "recompile-hazard"
+    description = ("compiled function closes over a Python value its "
+                   "enclosing scope mutates — each change re-traces")
+
+    def check_file(self, ctx):
+        if "jit" not in ctx.source:
+            return []
+        parents = ctx.parents()
+        out = []
+        for fn in _compiled(ctx):
+            encl = parents.get(fn)
+            while encl is not None and not isinstance(
+                    encl, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                encl = parents.get(encl)
+            if encl is None:
+                continue
+            local = set(_param_names(fn)) | {"self", "cls"}
+            for node in _own_nodes(fn):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, (ast.Store, ast.Del)):
+                    local.add(node.id)
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    local.add(node.name)
+                if isinstance(node, ast.comprehension):
+                    for n in ast.walk(node.target):
+                        if isinstance(n, ast.Name):
+                            local.add(n.id)
+            seen = set()
+            for node in _own_nodes(fn):
+                if not (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id not in local
+                        and node.id not in seen):
+                    continue
+                fn_loops = set()
+                cur = parents.get(fn)
+                while cur is not None and cur is not encl:
+                    if isinstance(cur, (ast.For, ast.While)):
+                        fn_loops.add(cur)
+                    cur = parents.get(cur)
+                why = self._mutable_in(node.id, encl, fn, parents,
+                                       fn_loops)
+                if why:
+                    seen.add(node.id)
+                    out.append(self.finding(
+                        ctx.path, node,
+                        f"compiled function {fn.name!r} closes over "
+                        f"{node.id!r}, which the enclosing scope "
+                        f"{why} — each new value re-traces; pass it "
+                        f"as a traced argument instead"))
+        return out
+
+    def _mutable_in(self, name, encl, fn, parents, fn_loops=()):
+        """Why ``name`` is mutable in scope ``encl`` (None = static).
+
+        A loop the compiled function is itself defined in
+        (``fn_loops``) does not count: a fresh def + fresh jit per
+        iteration is the bucket-ladder idiom (one trace each), not a
+        recompile of one program."""
+        assigns = []
+        stack = list(encl.body)
+        while stack:
+            n = stack.pop()
+            if n is fn or isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+            if isinstance(n, ast.AugAssign) and isinstance(
+                    n.target, ast.Name) and n.target.id == name:
+                return "augments (+=)"
+            if isinstance(n, ast.Name) and isinstance(
+                    n.ctx, ast.Store) and n.id == name:
+                assigns.append(n)
+        if not assigns:
+            return None
+        for a in assigns:
+            cur = parents.get(a)
+            while cur is not None and cur is not encl:
+                if (isinstance(cur, (ast.For, ast.While))
+                        and cur not in fn_loops):
+                    return "assigns inside a loop"
+                cur = parents.get(cur)
+            if a.lineno > fn.lineno:
+                return f"reassigns on line {a.lineno} (after the " \
+                       f"compiled function exists)"
+        # any number of assignments strictly BEFORE the compiled
+        # function exists is sequential setup (e.g. conditionally
+        # wrapping a loss_fn in jax.checkpoint), not mutation
+        return None

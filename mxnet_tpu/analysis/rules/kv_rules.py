@@ -1,0 +1,123 @@
+"""Rules enforcing the strict KV block-accounting invariant.
+
+The paged KV cache is strict by design: a leaked block, double free or
+refcount drift raises ``BlockAccountingError`` in the chaos gate (and
+``InjectedCrash`` — a simulated SIGKILL — is a ``BaseException``
+precisely so cleanup code catching ``Exception`` cannot pretend it ran
+on a real crash). These rules make the two bug classes PR 9 fixed by
+hand statically visible:
+
+- an ``alloc()`` whose blocks do not land somewhere the engine's
+  cleanup path owns (``seq.block_ids``) and is not covered by a
+  try/finally (or except-with-free) is a leak the moment any dispatch
+  between alloc and installation raises;
+- an ``except Exception`` handler that frees blocks runs its cleanup
+  for ordinary failures but NOT for ``InjectedCrash``/``KeyboardInt-
+  errupt`` — exactly the crash the chaos harness injects. Block-
+  freeing cleanup belongs in ``finally`` or ``except BaseException``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+
+def _calls_free(nodes):
+    for stmt in nodes:
+        for n in ast.walk(stmt):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "free"):
+                return True
+    return False
+
+
+def _touches_block_ids(stmt):
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Attribute) and "block_ids" in n.attr:
+            return True
+    return False
+
+
+class KVLeakRule(Rule):
+    """kv-leak: KV block allocations must be crash-safe.
+
+    An ``.alloc()`` call is safe when (a) its blocks flow into a
+    ``*.block_ids`` attribute in the same statement (the engine's
+    release/preempt/poison paths free ``seq.block_ids`` on every exit),
+    or (b) an enclosing ``try`` frees blocks in its ``finally`` or an
+    exception handler. Anything else leaks the blocks if any statement
+    between the alloc and wherever they are recorded raises. Also
+    flags ``except Exception`` handlers whose body frees blocks — that
+    cleanup must survive ``BaseException`` crashes (use ``finally`` or
+    ``except BaseException``).
+    """
+
+    id = "kv-leak"
+    description = ("block alloc not dominated by a crash-safe "
+                   "cleanup path / block-freeing except Exception")
+
+    def check_file(self, ctx):
+        if ("alloc" not in ctx.source
+                and "except Exception" not in ctx.source):
+            return []
+        if "allocator" not in ctx.source \
+                and "BlockAllocator" not in ctx.source:
+            return []
+        parents = ctx.parents()
+        out = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "alloc"
+                    and "allocator" in ctx.segment(node.func)):
+                if not self._alloc_safe(node, parents):
+                    out.append(self.finding(
+                        ctx.path, node,
+                        "allocated blocks do not reach *.block_ids in "
+                        "this statement and no enclosing try frees "
+                        "them — a raise before they are recorded "
+                        "leaks them (wrap in try/except BaseException "
+                        "that frees, or install into block_ids "
+                        "directly)"))
+            if isinstance(node, ast.ExceptHandler) \
+                    and self._is_plain_exception(node.type) \
+                    and _calls_free(node.body):
+                out.append(self.finding(
+                    ctx.path, node,
+                    "except Exception frees KV blocks — this cleanup "
+                    "is skipped by BaseException crashes (Injected"
+                    "Crash, KeyboardInterrupt) and leaks the blocks; "
+                    "use finally or except BaseException"))
+        return out
+
+    def _is_plain_exception(self, type_node):
+        if isinstance(type_node, ast.Name):
+            return type_node.id == "Exception"
+        if isinstance(type_node, ast.Tuple):
+            return any(isinstance(e, ast.Name) and e.id == "Exception"
+                       for e in type_node.elts)
+        return False
+
+    def _alloc_safe(self, call, parents):
+        # (a) result lands in *.block_ids within the same statement
+        stmt = call
+        while stmt is not None and not isinstance(stmt, ast.stmt):
+            stmt = parents.get(stmt)
+        if stmt is not None and _touches_block_ids(stmt):
+            return True
+        # (b) an enclosing try frees in finally or a handler
+        node = call
+        while node is not None:
+            node = parents.get(node)
+            if isinstance(node, ast.Try):
+                if _calls_free(node.finalbody):
+                    return True
+                for h in node.handlers:
+                    if _calls_free(h.body):
+                        return True
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                break
+        return False

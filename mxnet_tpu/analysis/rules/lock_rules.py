@@ -1,0 +1,121 @@
+"""guarded-by: annotated lock discipline on shared mutable state.
+
+Serving/engine/allocator classes share state between caller threads
+and worker threads. The discipline is declared inline, where the
+attribute is born::
+
+    self._q = collections.deque()   # guarded-by: _lock
+
+After that, every ``self._q`` access in any *other* method of the
+class must sit lexically inside ``with self._lock:``. Two escapes:
+
+- ``__init__`` is exempt (the object is not published yet);
+- a method that documents its contract as "lock held by caller" opts
+  out whole with a ``# guarded-by: caller`` comment on (or right
+  above / right below) its ``def`` line — the private-helper idiom
+  of ``CircuitBreaker._set_state``.
+
+The checker is lexical on purpose: it cannot prove a lock is held
+across calls, but it makes the common bug — a "cheap read" property
+added months later without the lock — impossible to merge silently.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+
+class GuardedByRule(Rule):
+    id = "guarded-by"
+    scope = "file"
+    description = ("attributes annotated '# guarded-by: <lock>' must "
+                   "only be accessed under 'with self.<lock>'")
+
+    def check_file(self, ctx):
+        if not ctx.guarded_by:
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    def _annotations(self, ctx, cls):
+        """attr name -> lock name, from guarded-by comments attached
+        to ``self.X = ...`` statements anywhere in the class."""
+        locks = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            span = range(node.lineno,
+                         getattr(node, "end_lineno", node.lineno) + 1)
+            lock = next((ctx.guarded_by[ln] for ln in span
+                         if ln in ctx.guarded_by), None)
+            if lock is None or lock == "caller":
+                continue
+            for t in targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    locks[t.attr] = lock
+        return locks
+
+    def _method_waived(self, ctx, method):
+        first_body = method.body[0].lineno if method.body \
+            else method.lineno
+        for ln in range(method.lineno - 1, first_body + 1):
+            if ctx.guarded_by.get(ln) == "caller":
+                return True
+        return False
+
+    def _check_class(self, ctx, cls):
+        locks = self._annotations(ctx, cls)
+        if not locks:
+            return []
+        parents = ctx.parents()
+        out = []
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            if method.name == "__init__" \
+                    or self._method_waived(ctx, method):
+                continue
+            for node in ast.walk(method):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                        and node.attr in locks):
+                    continue
+                lock = locks[node.attr]
+                if not self._under_lock(node, parents, lock):
+                    out.append(self.finding(
+                        ctx.path, node,
+                        f"self.{node.attr} is guarded-by "
+                        f"self.{lock} but accessed outside 'with "
+                        f"self.{lock}:' in {cls.name}."
+                        f"{method.name}() (annotate the method "
+                        f"'# guarded-by: caller' if the caller "
+                        f"holds it)"))
+        return out
+
+    def _under_lock(self, node, parents, lock):
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    e = item.context_expr
+                    # with self._lock: / with self._cv: — and the
+                    # acquire-with-timeout form
+                    # with self._lock.acquire(...) is NOT a context
+                    # manager idiom here, so attribute match only
+                    if (isinstance(e, ast.Attribute)
+                            and isinstance(e.value, ast.Name)
+                            and e.value.id == "self"
+                            and e.attr == lock):
+                        return True
+            cur = parents.get(cur)
+        return False

@@ -77,10 +77,10 @@ class MicroBatchQueue:
     behavior)."""
 
     def __init__(self, max_depth=None):
-        self._q = collections.deque()
         self._lock = threading.Lock()
+        self._q = collections.deque()         # guarded-by: _lock
         self._nonempty = threading.Condition(self._lock)
-        self._closed = False
+        self._closed = False                  # guarded-by: _lock
         self.max_depth = int(max_depth) if max_depth else None
 
     # -------------------------------------------------------- producer --
@@ -152,10 +152,12 @@ class MicroBatchQueue:
 
     @property
     def closed(self):
-        return self._closed
+        with self._lock:
+            return self._closed
 
     def depth(self):
-        return len(self._q)
+        with self._lock:
+            return len(self._q)
 
     def drain(self):
         """Pop and return every queued request (worker-death cleanup:

@@ -542,11 +542,19 @@ class LLMEngine:
         drop one reference on the shared original. One fixed-shape
         dispatch — never a compile after warmup."""
         old = seq.block_ids[bi]
-        new = self.cache.allocator.alloc(1)[0]
-        outs = self._cow_jit(*self._cow_arrays(), np.int32(old),
-                             np.int32(new))
-        self._cow_install(outs)
-        seq.block_ids[bi] = new
+        new = None
+        try:
+            new = self.cache.allocator.alloc(1)[0]
+            outs = self._cow_jit(*self._cow_arrays(), np.int32(old),
+                                 np.int32(new))
+            self._cow_install(outs)
+            seq.block_ids[bi] = new
+        except BaseException:
+            # a failed copy dispatch must not leak the private block:
+            # it is in no block table yet, so no cleanup path owns it
+            if new is not None and seq.block_ids[bi] != new:
+                self.cache.allocator.free([new])
+            raise
         self.cache.allocator.free([old])
         self.cache.cow_count += 1
 

@@ -116,10 +116,10 @@ class LLMServer:
         self.max_queue, self.default_deadline_ms = \
             resolve_overload_knobs(max_queue, deadline_ms)
         self._cv = threading.Condition()
-        self._pending = []
-        self._closed = False
-        self._drain = True
-        self._deadline = None
+        self._pending = []            # guarded-by: _cv
+        self._closed = False          # guarded-by: _cv
+        self._drain = True            # guarded-by: _cv
+        self._deadline = None         # guarded-by: _cv
         self._worker = None
         self._started = False
         self._guard_watcher = None
@@ -154,7 +154,8 @@ class LLMServer:
 
     @property
     def running(self):
-        return self._started and not self._closed
+        with self._cv:
+            return self._started and not self._closed
 
     def warmup(self):
         """Pre-compile every prefill bucket + the decode program.
@@ -169,7 +170,7 @@ class LLMServer:
         return self._engine.warmup()
 
     # -------------------------------------------------------- submit --
-    def _queue_depth(self):
+    def _queue_depth(self):   # guarded-by: caller
         """Admission backlog: sequences holding NO KV blocks yet."""
         return len(self._pending) + self._engine.scheduler.num_waiting
 

@@ -120,14 +120,14 @@ class CircuitBreaker:
             jitter=0.1, seed=os.getpid())
         self._on_state = on_state
         self._lock = threading.Lock()
-        self._state = BREAKER_CLOSED
+        self._state = BREAKER_CLOSED    # guarded-by: _lock
         # consecutive failures PER SITE ("dispatch", "prefill",
         # "decode", ...): a success only resets its own site's run, so
         # a hard-down prefill path trips the breaker even while decode
         # launches for already-admitted sequences keep succeeding
-        self._failures = {}
-        self._trips = 0             # consecutive OPENs without a close
-        self._reopen_at = 0.0
+        self._failures = {}         # guarded-by: _lock
+        self._trips = 0             # guarded-by: _lock — consecutive
+        self._reopen_at = 0.0       # guarded-by: _lock   OPENs unclosed
         if on_state is not None:
             on_state(BREAKER_CLOSED)
 
@@ -147,7 +147,7 @@ class CircuitBreaker:
 
     # -------------------------------------------------- transitions --
     def _set_state(self, state):
-        # lock held by caller
+        # guarded-by: caller (every transition site holds self._lock)
         if state == self._state:
             return
         self._state = state
@@ -155,7 +155,7 @@ class CircuitBreaker:
             self._on_state(state)
 
     def _maybe_half_open(self):
-        # lock held by caller
+        # guarded-by: caller (admit/allow_dispatch hold self._lock)
         if (self._state == BREAKER_OPEN
                 and time.monotonic() >= self._reopen_at):
             self._set_state(BREAKER_HALF_OPEN)

@@ -125,8 +125,8 @@ class OverloadStats:
             "mxtpu_serving_breaker_state",
             "Dispatch circuit breaker: 0 closed, 1 open (rejecting), "
             "2 half-open (probing).", lbl).labels(**s)
-        self._shed_children = {}
         self._shed_lock = threading.Lock()
+        self._shed_children = {}    # guarded-by: _shed_lock
 
     def record_shed(self, reason):
         with self._shed_lock:
@@ -196,8 +196,8 @@ class TenantStats:
             tokens_metric,
             "Tokens generated for tagged tenants' requests.",
             ("server", "tenant")) if tokens_metric else None
-        self._children = {}
         self._lock = threading.Lock()
+        self._children = {}         # guarded-by: _lock
 
     def record(self, tenant, outcome, n=1):
         if tenant is None:

@@ -1,0 +1,515 @@
+"""mxlint (mxnet_tpu.analysis) — the static-analysis gate.
+
+Per-rule fixtures prove one true positive AND one near-miss
+non-finding each, the suppression/baseline machinery round-trips, the
+JSON reporter schema is pinned, and the full-tree smoke asserts the
+repo itself lints clean (findings ⊆ committed baseline) fast — this
+test IS the tier-1 wiring of ``tools/mxlint.py --check``, run
+in-process (one engine pass, no subprocess-per-file).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import baseline as baseline_mod
+from mxnet_tpu.analysis import reporters
+from mxnet_tpu.analysis.rules import RULES_BY_ID
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CATALOG_RULES = ("metric-catalog", "envvar-catalog", "fault-catalog")
+
+
+def rules_of(src, rule_id):
+    return [f for f in analysis.lint_source(src) if f.rule == rule_id]
+
+
+# ------------------------------------------------------------------ host-sync
+
+HOST_SYNC_TP = '''
+import jax
+import numpy as np
+def step(params, x):
+    loss = (params * x).sum()
+    v = loss.item()
+    s = float(loss)
+    a = np.asarray(loss)
+    return loss
+f = jax.jit(step)
+'''
+
+# near miss: shape-derived values are static under trace, and eager
+# code may sync freely
+HOST_SYNC_OK = '''
+import jax
+import numpy as np
+def step(params, x):
+    n = float(x.shape[0])
+    k = int(len(params))
+    return params * x / n * k
+f = jax.jit(step)
+def eager_loop(x):
+    return float(x.sum())
+'''
+
+
+def test_host_sync_true_positive():
+    lines = {f.line for f in rules_of(HOST_SYNC_TP, "host-sync")}
+    assert lines == {6, 7, 8}, lines
+
+
+def test_host_sync_near_miss():
+    assert rules_of(HOST_SYNC_OK, "host-sync") == []
+
+
+def test_host_sync_reaches_transitive_callees():
+    src = '''
+import jax
+def inner(x):
+    return float(x)
+def outer(x):
+    return inner(x) + 1
+f = jax.jit(outer)
+'''
+    assert len(rules_of(src, "host-sync")) == 1
+
+
+def test_host_sync_ignores_same_name_method():
+    # a class method named like a jitted local must not be conflated
+    src = '''
+import jax
+class Trainer:
+    def step(self, x):
+        return float(x)
+def make():
+    def step(x):
+        return x * 2
+    return jax.jit(step)
+'''
+    assert rules_of(src, "host-sync") == []
+
+
+# -------------------------------------------------------------- donated-reuse
+
+DONATED_TP = '''
+import jax
+def train(params, grads):
+    f = jax.jit(apply, donate_argnums=(0,))
+    out = f(params, grads)
+    return params.copy()
+'''
+
+DONATED_OK = '''
+import jax
+def train(params, grads):
+    f = jax.jit(apply, donate_argnums=(0,))
+    params = f(params, grads)
+    return params
+'''
+
+
+def test_donated_reuse_true_positive():
+    fs = rules_of(DONATED_TP, "donated-reuse")
+    assert len(fs) == 1 and fs[0].line == 6
+
+
+def test_donated_reuse_near_miss_rebind():
+    assert rules_of(DONATED_OK, "donated-reuse") == []
+
+
+def test_donated_reuse_nested_statement_single_finding():
+    # the donating call sits under an `if` — every statement level
+    # sees it, but exactly ONE finding (and one baseline entry) must
+    # come out
+    src = '''
+import jax
+def train(params, grads, flag):
+    f = jax.jit(apply, donate_argnums=(0,))
+    if flag:
+        out = f(params, grads)
+    return params.copy()
+'''
+    assert len(rules_of(src, "donated-reuse")) == 1
+
+
+# ----------------------------------------------------------- recompile-hazard
+
+RECOMPILE_TP = '''
+import jax
+def make():
+    lr = 0.1
+    def step(x):
+        return x * lr
+    j = jax.jit(step)
+    lr = 0.2
+    return j
+'''
+
+# near misses: a closure assigned once before the compile is static
+# config; a fresh def + fresh jit per loop iteration is the
+# bucket-ladder idiom (one trace each), not a recompile
+RECOMPILE_OK = '''
+import jax
+def make(cfg):
+    scale = cfg["scale"]
+    def step(x):
+        return x * scale
+    return jax.jit(step)
+def ladder(widths):
+    jits = {}
+    for w in widths:
+        def stepw(x):
+            return x[:w]
+        jits[w] = jax.jit(stepw)
+    return jits
+'''
+
+
+def test_recompile_hazard_true_positive():
+    fs = rules_of(RECOMPILE_TP, "recompile-hazard")
+    assert len(fs) == 1 and "lr" in fs[0].message
+
+
+def test_recompile_hazard_near_miss():
+    assert rules_of(RECOMPILE_OK, "recompile-hazard") == []
+
+
+# ------------------------------------------------------------------- kv-leak
+
+KV_TP = '''
+class Engine:
+    def grow(self, n):
+        blocks = self.cache.allocator.alloc(n)
+        self.dispatch(blocks)
+        self.table.extend(blocks)
+'''
+
+KV_OK = '''
+class Engine:
+    def grow(self, seq, n):
+        seq.block_ids.extend(self.cache.allocator.alloc(n))
+    def cow(self, n):
+        new = None
+        try:
+            new = self.cache.allocator.alloc(1)[0]
+            self.dispatch(new)
+        except BaseException:
+            if new is not None:
+                self.cache.allocator.free([new])
+            raise
+'''
+
+KV_EXCEPT_TP = '''
+class Engine:
+    def run(self, seq):
+        try:
+            self.dispatch(seq)
+        except Exception:
+            self.cache.allocator.free(seq.block_ids)
+            raise
+'''
+
+KV_EXCEPT_OK = '''
+class Engine:
+    def run(self, seq):
+        try:
+            self.dispatch(seq)
+        except BaseException:
+            self.cache.allocator.free(seq.block_ids)
+            raise
+'''
+
+
+def test_kv_leak_true_positive():
+    fs = rules_of(KV_TP, "kv-leak")
+    assert len(fs) == 1 and fs[0].line == 4
+
+
+def test_kv_leak_near_miss_safe_patterns():
+    assert rules_of(KV_OK, "kv-leak") == []
+
+
+def test_kv_leak_flags_block_freeing_except_exception():
+    fs = rules_of(KV_EXCEPT_TP, "kv-leak")
+    assert len(fs) == 1 and "BaseException" in fs[0].message
+
+
+def test_kv_leak_base_exception_handler_clean():
+    assert rules_of(KV_EXCEPT_OK, "kv-leak") == []
+
+
+# ---------------------------------------------------------------- guarded-by
+
+GUARDED_TP = '''
+import threading
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = []        # guarded-by: _lock
+    def depth(self):
+        return len(self._q)
+'''
+
+GUARDED_OK = '''
+import threading
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = []        # guarded-by: _lock
+        self._q.append(0)   # __init__ is pre-publication
+    def depth(self):
+        with self._lock:
+            return len(self._q)
+    def _pop_locked(self):  # guarded-by: caller
+        return self._q.pop()
+'''
+
+
+def test_guarded_by_true_positive():
+    fs = rules_of(GUARDED_TP, "guarded-by")
+    assert len(fs) == 1 and fs[0].line == 8
+
+
+def test_guarded_by_near_miss_locked_waived_init():
+    assert rules_of(GUARDED_OK, "guarded-by") == []
+
+
+def test_guarded_by_wrong_lock_still_flagged():
+    src = '''
+import threading
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+        self._q = []        # guarded-by: _lock
+    def depth(self):
+        with self._other:
+            return len(self._q)
+'''
+    assert len(rules_of(src, "guarded-by")) == 1
+
+
+# ------------------------------------------------------------- catalog rules
+
+def _mini_project(tmp_path, code, obs="", env="", res=""):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "mod.py").write_text(code)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "OBS.md").write_text(obs)
+    (tmp_path / "docs" / "ENV.md").write_text(env)
+    (tmp_path / "docs" / "RES.md").write_text(res)
+    config = dict(analysis.DEFAULT_CONFIG)
+    config.update(paths=["pkg"], catalog_paths=["pkg"],
+                  metric_docs="docs/OBS.md", env_docs="docs/ENV.md",
+                  fault_docs="docs/RES.md")
+    return analysis.run(str(tmp_path), config=config)
+
+
+CATALOG_CODE = '''
+import os
+from x import faults
+def setup(r):
+    c = r.counter("mxtpu_widget_spins_total", "help")
+    lim = os.environ.get("MXNET_TPU_WIDGET_LIMIT", "4")
+    faults.check("widget.spin")
+    return c, lim
+'''
+
+
+def test_catalog_rules_flag_drift(tmp_path):
+    result = _mini_project(tmp_path, CATALOG_CODE)
+    by_rule = result.by_rule()
+    assert by_rule.get("metric-catalog") == 1
+    assert by_rule.get("envvar-catalog") == 1
+    assert by_rule.get("fault-catalog") == 1
+    assert all(f.path == "pkg/mod.py" for f in result.findings)
+
+
+def test_catalog_rules_documented_clean(tmp_path):
+    result = _mini_project(
+        tmp_path, CATALOG_CODE,
+        obs="| `mxtpu_widget_spins_total` | counter | spins |\n",
+        env="| `MXNET_TPU_WIDGET_LIMIT` | 4 | widget cap |\n",
+        res="| `widget.spin` | check | the spin dispatch |\n")
+    assert result.findings == []
+
+
+def test_catalog_ignores_docstrings_and_non_catalog_paths(tmp_path):
+    # env names in docstrings and metric strings outside declaration
+    # calls are mentions, not declarations
+    code = '''
+"""Reads MXNET_TPU_WIDGET_LIMIT someday."""
+NAMES = ["mxtpu_not_a_declaration"]
+'''
+    result = _mini_project(tmp_path, code)
+    assert result.findings == []
+
+
+# ---------------------------------------------------- suppressions + baseline
+
+def test_suppression_inline_and_wrong_rule():
+    suppressed = KV_TP.replace(
+        "alloc(n)",
+        "alloc(n)   # mxlint: disable=kv-leak  scratch, caller frees")
+    assert analysis.lint_source(suppressed) == []
+    wrong = KV_TP.replace(
+        "alloc(n)", "alloc(n)   # mxlint: disable=host-sync  nope")
+    assert len([f for f in analysis.lint_source(wrong)
+                if f.rule == "kv-leak"]) == 1
+
+
+def test_suppression_standalone_line_covers_next_line():
+    src = '''
+class Engine:
+    def grow(self, n):
+        # mxlint: disable=kv-leak  handed to the caller-owned pool
+        blocks = self.cache.allocator.alloc(n)
+        self.dispatch(blocks)
+'''
+    assert analysis.lint_source(src) == []
+
+
+def test_suppression_file_level():
+    src = "# mxlint: disable-file=kv-leak  fixture corpus\n" + KV_TP
+    assert analysis.lint_source(src) == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = analysis.lint_source(KV_TP, path="pkg/mod.py")
+    assert findings
+    path = tmp_path / "baseline.json"
+    baseline_mod.write_baseline(str(path), findings)
+    keys, entries = baseline_mod.load_baseline(str(path))
+    assert all(set(e) >= {"rule", "path", "line", "message"}
+               for e in entries)
+    new, known, stale = baseline_mod.diff(findings, keys)
+    assert new == [] and len(known) == len(findings) and stale == []
+    # the baseline matches exact lines: a moved finding comes back new
+    moved = [analysis.Finding(f.rule, f.path, f.line + 5, f.col,
+                              f.message) for f in findings]
+    new, _, stale = baseline_mod.diff(moved, keys)
+    assert len(new) == len(findings) and len(stale) == len(findings)
+
+
+def test_baseline_missing_file_is_empty(tmp_path):
+    keys, entries = baseline_mod.load_baseline(
+        str(tmp_path / "nope.json"))
+    assert keys == set() and entries == []
+
+
+def test_minimal_toml_parser_handles_comments():
+    # on Python 3.10 (the repo floor, no tomllib) this parser IS the
+    # production config path — trailing comments after quoted values
+    # and per-line comments inside multi-line arrays must not corrupt
+    # values (a corrupted `paths` silently lints zero files)
+    from mxnet_tpu.analysis.core import _parse_toml_minimal
+    data = _parse_toml_minimal('''
+[tool.mxlint]
+baseline = "tools/b.json"   # the gate ledger
+paths = [
+  "mxnet_tpu",   # core
+  "tools#x",
+]   # end
+limit = 3  # int
+strict = true
+''')
+    t = data["tool"]["mxlint"]
+    assert t["baseline"] == "tools/b.json"
+    assert t["paths"] == ["mxnet_tpu", "tools#x"]
+    assert t["limit"] == 3 and t["strict"] is True
+
+
+def test_collect_files_excludes_segments_not_substrings(tmp_path):
+    # "dist"/"build" excludes must not swallow distill.py / build_x.py
+    pkg = tmp_path / "pkg"
+    (pkg / "dist").mkdir(parents=True)
+    (pkg / "native" / "_build").mkdir(parents=True)
+    for rel in ("mod.py", "distill.py", "build_utils.py",
+                "dist/skip.py", "native/_build/gen.py"):
+        (pkg / rel).write_text("x = 1\n")
+    files = analysis.collect_files(
+        str(tmp_path), ["pkg"], ["dist", "native/_build"])
+    assert files == ["pkg/build_utils.py", "pkg/distill.py",
+                     "pkg/mod.py"]
+
+
+# ------------------------------------------------------------- JSON reporter
+
+def test_json_reporter_schema_stable(tmp_path):
+    result = _mini_project(tmp_path, CATALOG_CODE)
+    doc = reporters.to_json(result, new=result.findings, stale=[])
+    assert set(doc) == {"version", "tool", "findings", "summary",
+                        "new_findings", "stale_baseline"}
+    assert doc["version"] == reporters.JSON_SCHEMA_VERSION
+    assert doc["tool"] == "mxlint"
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message"}
+    assert set(doc["summary"]) == {
+        "files", "findings", "suppressed", "by_rule", "elapsed_s",
+        "new", "stale_baseline"}
+    json.dumps(doc)   # serializable
+
+
+# --------------------------------------------------------- full-tree smoke --
+
+@pytest.fixture(scope="module")
+def tree_result():
+    return analysis.run(REPO_ROOT)
+
+
+def test_full_tree_clean_against_baseline(tree_result):
+    config = analysis.load_config(REPO_ROOT)
+    keys, entries = baseline_mod.load_baseline(
+        os.path.join(REPO_ROOT, config["baseline"]))
+    new, known, stale = baseline_mod.diff(tree_result.findings, keys)
+    assert new == [], (
+        "mxlint found new violations — fix them, suppress with a "
+        "justified '# mxlint: disable=RULE reason', or re-baseline "
+        "deliberately:\n" + "\n".join(
+            f"{f.path}:{f.line}: {f.rule}: {f.message}"
+            for f in new))
+    assert stale == [], (
+        f"stale baseline entries (fixed code): {stale} — delete them "
+        f"from {config['baseline']}")
+    # the catalog-drift rules carry NO grandfathered findings: docs
+    # drift is always fixable in the PR that causes it
+    bad = [e for e in entries if e["rule"] in CATALOG_RULES]
+    assert bad == [], f"catalog drift must be fixed, not baselined: {bad}"
+
+
+def test_full_tree_is_fast(tree_result):
+    # pure-ast full-tree pass; the CLI promises seconds, the gate <10s
+    assert tree_result.elapsed_s < 10.0, tree_result.elapsed_s
+    assert len(tree_result.files) > 150   # actually scanned the tree
+
+
+def test_full_tree_parses_everything(tree_result):
+    assert tree_result.parse_errors == []
+
+
+def test_rule_registry_complete(tree_result):
+    # every shipped rule has an id, a scope, and a description
+    for rule_id, cls in RULES_BY_ID.items():
+        assert rule_id and cls.scope in ("file", "project")
+        assert cls.description
+
+
+def test_cli_check_standalone(tmp_path):
+    # the CLI loads mxnet_tpu/analysis WITHOUT importing mxnet_tpu
+    # (no jax) — pin that property for real: poisoned jax/mxnet_tpu
+    # modules shadow the installed ones via PYTHONPATH, so ANY import
+    # of either crashes the subprocess instead of silently passing
+    for name in ("jax", "mxnet_tpu", "numpy"):
+        (tmp_path / f"{name}.py").write_text(
+            f"raise RuntimeError('mxlint must not import {name}')\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "mxlint.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": str(tmp_path)})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "mxlint:" in proc.stdout

@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""mxlint CLI — run the repo's static-analysis rules over the tree.
+
+    python tools/mxlint.py                 # lint configured paths
+    python tools/mxlint.py --check         # CI gate: new findings -> rc 1
+    python tools/mxlint.py --format json   # machine-readable report
+    python tools/mxlint.py --write-baseline
+    python tools/mxlint.py mxnet_tpu/serving   # lint a subtree
+
+Configuration lives in ``[tool.mxlint]`` in pyproject.toml (paths,
+excludes, baseline location, docs catalogs). Findings already in the
+committed baseline (tools/mxlint_baseline.json) are subtracted; what
+remains fails ``--check``. See docs/ANALYSIS.md.
+
+Deliberately loads ``mxnet_tpu/analysis`` standalone (stdlib-only, by
+file path) instead of importing ``mxnet_tpu`` — a full-tree run costs
+about a second and never touches jax.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis(root):
+    """Import mxnet_tpu/analysis as a standalone package (alias
+    ``mxlint_analysis``) so this CLI never imports mxnet_tpu itself."""
+    pkg_dir = os.path.join(root, "mxnet_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "mxlint_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["mxlint_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: [tool.mxlint] "
+                         "paths)")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root (default: the tools/ parent)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: quiet on success, rc 1 on any "
+                         "finding not in the baseline")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: config)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baselined or not")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write ALL current findings to the baseline "
+                         "file and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: "
+                         "all)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    # the analysis package always loads from THIS checkout; --root only
+    # chooses the tree being linted
+    analysis = _load_analysis(REPO_ROOT)
+
+    if args.list_rules:
+        for cls in analysis.ALL_RULES:
+            scope = f"[{cls.scope}]"
+            print(f"{cls.id:20s} {scope:9s} {cls.description}")
+        return 0
+
+    config = analysis.load_config(args.root)
+    rules = None
+    if args.rules:
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in wanted if r not in analysis.RULES_BY_ID]
+        if unknown:
+            ap.error(f"unknown rule ids: {unknown} "
+                     f"(see --list-rules)")
+        rules = [analysis.RULES_BY_ID[r]() for r in wanted]
+    files = None
+    if args.paths:
+        files = analysis.collect_files(args.root, args.paths,
+                                       config["exclude"])
+
+    result = analysis.run(args.root, config=config, rules=rules,
+                          files=files)
+
+    baseline_path = os.path.join(
+        args.root, args.baseline or config["baseline"])
+    if args.write_baseline:
+        analysis.baseline.write_baseline(baseline_path,
+                                         result.findings)
+        print(f"mxlint: wrote {len(result.findings)} baseline "
+              f"entries to {os.path.relpath(baseline_path, args.root)}")
+        return 0
+
+    keys, _ = (analysis.baseline.load_baseline(baseline_path)
+               if not args.no_baseline else (set(), []))
+    new, known, stale = analysis.baseline.diff(result.findings, keys)
+
+    if args.format == "json":
+        print(analysis.reporters.format_json(result, new=new,
+                                             stale=stale))
+    else:
+        shown = result.findings if args.no_baseline else new
+        summary = analysis.reporters.summarize(result, new=new,
+                                               stale=stale)
+        out = analysis.reporters.format_text(shown, summary=summary)
+        if args.check and not new and not shown:
+            out = summary
+        print(out)
+        for rule, path, line in stale:
+            print(f"mxlint: stale baseline entry {path}:{line} "
+                  f"[{rule}] — the code moved or was fixed; delete "
+                  f"the entry (or --write-baseline)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
