@@ -26,7 +26,8 @@ import struct
 import numpy as _np
 
 __all__ = ["export_predictor", "load_predictor", "Predictor",
-           "export_decoder", "load_decoder"]
+           "export_decoder", "load_decoder",
+           "flatten_params", "unflatten_params"]
 
 _MAGIC = b"MXTPUPRED1"
 _LLM_MAGIC = b"MXTPULLM01"
@@ -151,7 +152,13 @@ def load_predictor(path_or_bytes, donate_input=False):
 # straight into serving.llm.LLMServer.
 
 
-def _flatten_params(tree, prefix=""):
+def flatten_params(tree, prefix=""):
+    """Flatten a param pytree (nested dict/list/tuple of arrays) to a
+    flat ``{dot.joined.path: ndarray}`` dict — the shape decoder
+    artifacts serialize and sharded checkpoints
+    (``resilience.checkpoint.write_checkpoint``) store. Invert with
+    :func:`unflatten_params`; ``serving.fleet`` publish builders use
+    the pair to hot-swap LLM weights through checkpoint manifests."""
     out = {}
     if isinstance(tree, (dict, list, tuple)) and not tree:
         # an empty container flattens to nothing and would silently
@@ -173,13 +180,44 @@ def _flatten_params(tree, prefix=""):
                     f"unsupported param key {prefix + k!r}: decoder "
                     "artifact keys must be non-empty, non-numeric and "
                     "'.'-free (list positions serialize as digits)")
-            out.update(_flatten_params(v, f"{prefix}{k}."))
+            out.update(flatten_params(v, f"{prefix}{k}."))
     elif isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
-            out.update(_flatten_params(v, f"{prefix}{i}."))
+            out.update(flatten_params(v, f"{prefix}{i}."))
     else:
         out[prefix[:-1]] = _np.asarray(tree)
     return out
+
+
+def unflatten_params(flat):
+    """Inverse of :func:`flatten_params`: rebuild the param pytree
+    from a flat ``{dot.joined.path: ndarray}`` dict. All-digit path
+    segments become LIST indices (the convention flatten enforces by
+    refusing digit dict keys), everything else dict keys."""
+    params = {}
+    for key, arr in flat.items():
+        parts = str(key).split(".")
+        node = params
+        for i, p in enumerate(parts[:-1]):
+            nxt_is_idx = parts[i + 1].isdigit()
+            if p.isdigit():
+                p = int(p)
+                while len(node) <= p:
+                    node.append({} if not nxt_is_idx else [])
+                node = node[p]
+            else:
+                if p not in node:
+                    node[p] = [] if nxt_is_idx else {}
+                node = node[p]
+        leaf = parts[-1]
+        if leaf.isdigit():
+            li = int(leaf)
+            while len(node) <= li:
+                node.append(None)
+            node[li] = arr
+        else:
+            node[leaf] = arr
+    return params
 
 
 def export_decoder(model, params, path=None):
@@ -189,7 +227,7 @@ def export_decoder(model, params, path=None):
     given. Load with :func:`load_decoder`, serve with
     ``serving.llm.LLMServer``."""
     import io
-    flat = _flatten_params(params)
+    flat = flatten_params(params)
     buf = io.BytesIO()
     _np.savez(buf, **flat)
     blob = buf.getvalue()
@@ -229,28 +267,5 @@ def load_decoder(path_or_bytes):
     if missing:
         raise ValueError(f"decoder artifact missing arrays: "
                          f"{sorted(missing)[:4]}")
-    params = {}
-    for key, arr in flat.items():
-        parts = key.split(".")
-        node = params
-        for i, p in enumerate(parts[:-1]):
-            nxt_is_idx = parts[i + 1].isdigit()
-            if p.isdigit():
-                p = int(p)
-                while len(node) <= p:
-                    node.append({} if not nxt_is_idx else [])
-                node = node[p]
-            else:
-                if p not in node:
-                    node[p] = [] if nxt_is_idx else {}
-                node = node[p]
-        leaf = parts[-1]
-        if leaf.isdigit():
-            li = int(leaf)
-            while len(node) <= li:
-                node.append(None)
-            node[li] = arr
-        else:
-            node[leaf] = arr
     model = TinyDecoder(DecoderConfig.from_dict(meta["config"]))
-    return model, params
+    return model, unflatten_params(flat)

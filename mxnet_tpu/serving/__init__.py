@@ -30,7 +30,13 @@ production request path:
   deadline expired — partial tokens carried on the LLM path) and
   ``SequenceEvictedError`` (decode drain/eviction, partial tokens);
 - :mod:`.overload` — the :class:`CircuitBreaker` behind
-  "degrade to rejection instead of crash-looping".
+  "degrade to rejection instead of crash-looping";
+- :mod:`.fleet` — N named models behind one router
+  (:class:`~.fleet.FleetRouter`): atomic weight hot-swap from sharded
+  checkpoints (publish→warm→drain→handover→prune, crash anywhere
+  leaves a consistent fleet), per-tenant token-bucket quotas +
+  interactive/batch lanes, and the continuous fine-tune→publish loop
+  (:class:`~.fleet.FineTunePublisher`).
 
 See docs/SERVING.md for architecture, bucketing math, the
 overload/failure state machine and env vars.
@@ -47,6 +53,8 @@ from .telemetry import (CompileCounter, EventLog, ServingStats,
                         compile_count)
 from . import llm
 from .llm import LLMServer, LLMEngine, GenerationResult
+from . import fleet
+from .fleet import FleetRouter, FleetStats, FineTunePublisher
 
 __all__ = ["ModelServer", "MicroBatchQueue", "Request",
            "ServingError", "ServerClosed", "Overloaded",
@@ -55,4 +63,5 @@ __all__ = ["ModelServer", "MicroBatchQueue", "Request",
            "BucketSpec", "bucket_sizes", "pick_bucket", "pad_batch",
            "pad_to_bucket", "waste_fraction",
            "CompileCounter", "EventLog", "ServingStats", "compile_count",
-           "llm", "LLMServer", "LLMEngine", "GenerationResult"]
+           "llm", "LLMServer", "LLMEngine", "GenerationResult",
+           "fleet", "FleetRouter", "FleetStats", "FineTunePublisher"]

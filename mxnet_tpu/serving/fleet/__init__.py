@@ -1,0 +1,10 @@
+"""Zero-downtime fleet serving: a multi-model router with atomic
+weight hot-swap, per-tenant quotas, priority lanes, and a continuous
+fine-tune->publish loop. See docs/SERVING.md ("Fleet & rollouts")."""
+from .metrics import FleetStats
+from .quota import LANES, TenantQuota, TokenBucket
+from .router import PUBLISH_PHASES, FleetRouter
+from .trainloop import FineTunePublisher
+
+__all__ = ["FleetRouter", "FleetStats", "FineTunePublisher", "LANES",
+           "PUBLISH_PHASES", "TenantQuota", "TokenBucket"]

@@ -120,6 +120,12 @@ class LLMServer:
         self._closed = False          # guarded-by: _cv
         self._drain = True            # guarded-by: _cv
         self._deadline = None         # guarded-by: _cv
+        # quiesce/resume (fleet hot-swap drain): admission gate +
+        # exact live-Future count via done-callbacks — quiesce() waits
+        # on _live, not on engine polling, so the gap between popping
+        # _pending and engine.add() can never look "drained"
+        self._quiesced = False        # guarded-by: _cv
+        self._live = 0                # guarded-by: _cv
         self._worker = None
         self._started = False
         self._guard_watcher = None
@@ -232,6 +238,13 @@ class LLMServer:
                     seq.span.finish()
                 raise ServerClosed(
                     "server is draining; no new sequences admitted")
+            if self._quiesced:
+                if seq.span is not None:
+                    seq.span.set("error", "ServerClosed")
+                    seq.span.finish()
+                raise ServerClosed(
+                    "server is quiesced; admission paused "
+                    "(resume() re-opens)")
             if (self.max_queue is not None
                     and self._queue_depth() >= self.max_queue):
                 depth = self._queue_depth()
@@ -245,7 +258,9 @@ class LLMServer:
                     f"{self.max_queue}); request shed",
                     reason="queue_full", depth=depth)
             self._pending.append(seq)
+            self._live += 1
             self._cv.notify_all()
+        seq.future.add_done_callback(self._live_dec)
         self._stats.record_submit()
         self._stats.record_tenant(tenant, "submitted")
         return seq.future
@@ -352,6 +367,48 @@ class LLMServer:
         self._guard_stop.set()
 
     close = shutdown
+
+    # ------------------------------------------------------- quiesce --
+    def _live_dec(self, _fut=None):
+        """Done-callback: one admitted generation Future resolved."""
+        with self._cv:
+            self._live -= 1
+            self._cv.notify_all()
+
+    def quiesce(self, timeout=None):
+        """Stop admitting NEW sequences and wait until every admitted
+        Future has resolved (completion, eviction, deadline — any
+        typed outcome). Unlike :meth:`shutdown` the engine thread, KV
+        pools, and compiled programs stay warm: :meth:`resume`
+        re-opens admission without rebuilding anything (the fleet
+        hot-swap drain runs on exactly this). While quiesced,
+        ``submit`` raises a typed :class:`ServerClosed`.
+
+        Returns True once drained; False if ``timeout`` (seconds)
+        expired with sequences still live — the server STAYS quiesced
+        and the caller picks resume() or shutdown() (whose drain path
+        evicts stragglers typed, with their partial tokens)."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._cv:
+            self._quiesced = True
+            while self._live > 0:
+                rem = (None if deadline is None
+                       else deadline - time.monotonic())
+                if rem is not None and rem <= 0:
+                    return False
+                self._cv.wait(rem if rem is not None else 0.5)
+            return True
+
+    def resume(self):
+        """Re-open admission after :meth:`quiesce`. Idempotent."""
+        with self._cv:
+            self._quiesced = False
+
+    @property
+    def admitting(self):
+        with self._cv:
+            return not self._quiesced and not self._closed
 
     def attach_preemption_guard(self, guard, poll_s=0.05,
                                 deadline_ms=None):

@@ -169,6 +169,14 @@ class ModelServer:
         self._started = False
         self._abort = None      # set to an abort reason string
         self._inflight = []     # popped batch the worker owns right now
+        # quiesce/resume lifecycle (fleet hot-swap drain): an admission
+        # gate plus EXACT in-flight accounting — `_live` counts Futures
+        # admitted but not yet resolved, maintained by done-callbacks,
+        # so quiesce() can wait for true zero without touching the
+        # queue (whose close() is permanent)
+        self._lifecycle = threading.Condition()
+        self._admitting = True  # guarded-by: _lifecycle
+        self._live = 0          # guarded-by: _lifecycle
         self._drained = threading.Event()
         self._guard_watcher = None
         self._guard_stop = threading.Event()
@@ -343,15 +351,30 @@ class ModelServer:
             # request whose span is still missing.
             req.span = tracer.begin("mxtpu.serving.request", "serving",
                                     tracer.current())
+        # admission gate + live increment are ONE critical section:
+        # after quiesce() observes _live == 0 with admission closed, no
+        # straggler submit can slip a request past it
+        with self._lifecycle:
+            if not self._admitting:
+                if req.span is not None:
+                    req.span.set("error", "ServerClosed")
+                    req.span.finish()
+                    req.span = None
+                raise ServerClosed(
+                    "server is quiesced; admission paused "
+                    "(resume() re-opens)")
+            self._live += 1
         try:
             fut = self._queue.enqueue(req)
         except ServerClosed:
+            self._live_dec()
             if req.span is not None:
                 req.span.set("error", "ServerClosed")
                 req.span.finish()
                 req.span = None
             raise
         except Overloaded as exc:
+            self._live_dec()
             self._stats.record_shed("queue_full")
             self._stats.record_tenant(tenant, "shed")
             self._events.emit("shed", reason="queue_full",
@@ -361,6 +384,7 @@ class ModelServer:
                 req.span.finish()
                 req.span = None
             raise
+        fut.add_done_callback(self._live_dec)
         self._stats.record_submit()
         self._stats.record_tenant(tenant, "submitted")
         self._stats.record_queue_depth(self._queue.depth())
@@ -419,6 +443,46 @@ class ModelServer:
         self._events.close()
 
     close = shutdown
+
+    # ---------------------------------------------------- quiesce --
+    def _live_dec(self, _fut=None):
+        """Done-callback / rollback: one admitted Future resolved."""
+        with self._lifecycle:
+            self._live -= 1
+            self._lifecycle.notify_all()
+
+    def quiesce(self, timeout=None):
+        """Stop admitting NEW requests and wait until every already-
+        admitted Future has resolved. Unlike :meth:`shutdown` this
+        leaves the worker thread, queue, and compiled programs warm —
+        :meth:`resume` re-opens admission with zero rebuild cost (the
+        fleet hot-swap drain runs on exactly this). While quiesced,
+        ``submit`` raises a typed :class:`ServerClosed`.
+
+        Returns True once drained; False if ``timeout`` (seconds)
+        expired with work still in flight (the server STAYS quiesced —
+        the caller decides between resume() and shutdown())."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._lifecycle:
+            self._admitting = False
+            while self._live > 0:
+                rem = (None if deadline is None
+                       else deadline - time.monotonic())
+                if rem is not None and rem <= 0:
+                    return False
+                self._lifecycle.wait(rem if rem is not None else 0.5)
+            return True
+
+    def resume(self):
+        """Re-open admission after :meth:`quiesce`. Idempotent."""
+        with self._lifecycle:
+            self._admitting = True
+
+    @property
+    def admitting(self):
+        with self._lifecycle:
+            return self._admitting
 
     def attach_preemption_guard(self, guard, poll_s=0.05):
         """Drain on preemption: once ``guard`` (a

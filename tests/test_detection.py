@@ -169,6 +169,9 @@ def test_roi_align_values_and_grad():
     assert float(g.sum()) == pytest.approx(8.0, rel=1e-5)
 
 
+@pytest.mark.slow   # ~17s of full-net compile on 1 CPU (tier-1
+# budget); the multibox/roi/nms op tests above keep the detection
+# math in the fast gate
 def test_ssd_300_forward_shapes():
     from mxnet_tpu.gluon.model_zoo import ssd_300_vgg16_reduced
 

@@ -18,10 +18,15 @@ CASES = [
     ("recommender_mf.py", ["--steps", "4", "--batch-size", "32",
                            "--users", "20", "--items", "15"]),
     ("dcgan.py", ["--steps", "2", "--batch-size", "4"]),
-    ("bert_pretrain_mlm.py", ["--steps", "2", "--batch-size", "4",
-                              "--seq-len", "8", "--vocab", "16"]),
-    ("train_cifar_gluon.py", ["--steps", "2", "--batch-size", "4",
-                              "--model", "resnet18_v1"]),
+    pytest.param("bert_pretrain_mlm.py",
+                 ["--steps", "2", "--batch-size", "4",
+                  "--seq-len", "8", "--vocab", "16"],
+                 marks=pytest.mark.slow),   # ~11s (tier-1 budget)
+    pytest.param("train_cifar_gluon.py",
+                 ["--steps", "2", "--batch-size", "4",
+                  "--model", "resnet18_v1"],
+                 marks=pytest.mark.slow),   # ~11s (tier-1 budget);
+    # gluon-training coverage stays fast via mnist/multi_task/lenet
     ("train_mnist_mlp.py", ["--epochs", "1", "--batch-size", "32"]),
     ("char_lstm.py", ["--epochs", "1", "--seq-len", "8",
                       "--batch-size", "4"]),
@@ -56,9 +61,11 @@ CASES = [
       "--image-size", "32"]),
     ("serve_predictor.py", ["--threads", "4", "--requests", "8",
                             "--max-batch", "4", "--feature-dim", "16"]),
-    ("llm_serve_decode.py", ["--threads", "4", "--requests", "4",
-                             "--max-context", "32",
-                             "--max-new-tokens", "6"]),
+    pytest.param("llm_serve_decode.py",
+                 ["--threads", "4", "--requests", "4",
+                  "--max-context", "32", "--max-new-tokens", "6"],
+                 marks=pytest.mark.slow),   # ~18s (tier-1 budget);
+    # test_llm_serving's decoder-artifact roundtrip keeps fast coverage
     pytest.param("nce_lm.py", ["--epochs", "3", "--max-ppl", "120"],
                  marks=pytest.mark.slow),   # ~22s (tier-1 budget)
     ("rbm_digits.py", ["--epochs", "3", "--num-samples", "256",
@@ -66,8 +73,10 @@ CASES = [
     # --check-uncertainty needs a longer trajectory than CI affords;
     # the 0.6 RMSE gate beats the constant-zero baseline (0.64 on this
     # eval set), so a non-learning regression cannot pass it
-    ("bayesian_sgld.py", ["--epochs", "100", "--burn-in", "70",
-                          "--lr", "2e-4", "--max-rmse", "0.6"]),
+    pytest.param("bayesian_sgld.py",
+                 ["--epochs", "100", "--burn-in", "70",
+                  "--lr", "2e-4", "--max-rmse", "0.6"],
+                 marks=pytest.mark.slow),   # ~18s (tier-1 budget)
     pytest.param("stochastic_depth.py",
                  ["--epochs", "5", "--num-samples", "1024",
                   "--min-acc", "0.5"],
@@ -111,6 +120,9 @@ def test_serve_bench_smoke():
     assert "SMOKE PASS" in p.stdout
 
 
+@pytest.mark.slow   # ~34s on 1 CPU (tier-1 budget); the llm serving
+# bit-exactness/zero-recompile gates in tests/test_llm_serving.py and
+# test_metrics_dump_smoke keep fast coverage of the same invariants
 def test_llm_bench_smoke():
     """tools/llm_bench.py --smoke: the continuous-batching decode load
     generator must complete losslessly with zero recompiles during

@@ -1,0 +1,530 @@
+"""Zero-downtime fleet (ISSUE 16): FleetRouter atomic weight hot-swap,
+per-tenant quotas + priority lanes, quiesce()/resume(), the
+fine-tune->publish loop, and the fleet chaos matrix.
+
+The invariants pinned here:
+
+- zero in-flight loss across a hot-swap under load: every submitted
+  Future resolves served / shed / evicted-typed, the partition sums to
+  the number submitted, the swap performs ZERO XLA compiles (the chat
+  builder reuses the decoder model object, so published weights enter
+  the cached programs as traced arguments), and post-swap responses
+  are bit-exact vs the eager reference over the new weights;
+- crash-anywhere consistency: an InjectedCrash at any publish phase
+  before the handover commit rolls BACK (old version serving,
+  admission resumed, half-published replica invisible); after it rolls
+  FORWARD (new version serving, old replica retired typed);
+- quota isolation: the greedy tenant alone degrades to typed
+  ``Overloaded(reason="quota")``; the batch lane depth-caps without
+  touching interactive traffic.
+
+Budget discipline: ONE module-scoped kit owns the TinyDecoder and the
+shared jitted matmul — the first server of each kind pays the compile
+cost once, and every later server/router build in the module reuses
+the cached programs compile-free. The fast gate keeps a single
+kill-mid-swap row and the quota/lane tests; the full crash-at-every-
+phase matrix, bounded-drain eviction, and the FleetRouter load replay
+are ``@pytest.mark.slow``.
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mxnet_tpu import deploy, serving  # noqa: E402
+from mxnet_tpu.serving import (  # noqa: E402
+    DeadlineExceededError, Overloaded, SequenceEvictedError,
+    ServerClosed)
+from mxnet_tpu.serving.llm import (  # noqa: E402
+    TinyDecoder, DecoderConfig, LLMServer, greedy_decode_reference)
+from mxnet_tpu.resilience import faults  # noqa: E402
+from mxnet_tpu.resilience.faults import InjectedCrash  # noqa: E402
+from mxnet_tpu.observability import get_registry  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VOCAB, BS, CTX, DIM = 17, 8, 32, 4
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _expo():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from metrics_dump import parse_exposition
+    finally:
+        sys.path.pop(0)
+    return parse_exposition(get_registry().expose())
+
+
+class Kit:
+    """Module-scoped warm kit: one decoder model object + one shared
+    jitted matmul. Every server built through these factories hits the
+    programs the first build compiled — hot-swap warmups and fresh
+    per-test routers cost zero compiles."""
+
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+        self.model = TinyDecoder(DecoderConfig(
+            vocab_size=VOCAB, d_model=16, num_layers=1, num_heads=2,
+            d_ff=32, max_context=CTX))
+        self.params1 = self.model.init_params(0)
+        self.params2 = self.model.init_params(1)
+        self.rank_jit = jax.jit(lambda w, b: jnp.tanh(b @ w))
+        self.dense_jit = jax.jit(lambda w, b, x: jnp.tanh(x @ w.T + b))
+        self.w1 = np.random.RandomState(7).randn(DIM, DIM) \
+            .astype(np.float32)
+
+    def ref(self, params, prompt, n):
+        return greedy_decode_reference(self.model, params, prompt, n)
+
+    # publish() hands builders the FLAT checkpoint array dict; the
+    # chat builder restores the decoder pytree from it
+    def chat_builder(self, name):
+        def build(arrays):
+            return LLMServer(self.model,
+                             deploy.unflatten_params(arrays),
+                             name=name, max_seqs=2, block_size=BS,
+                             max_context=CTX)
+        return build
+
+    def rank_builder(self, name):
+        def build(arrays):
+            w = np.asarray(arrays["w"], np.float32)
+            return serving.ModelServer(
+                lambda batch: np.asarray(self.rank_jit(w, batch)),
+                buckets=[1, 2], max_delay_ms=1.0, item_shape=(DIM,),
+                dtype="float32", name=name)
+        return build
+
+    def chat_router(self, tag, **router_kw):
+        build = self.chat_builder(f"fc_{tag}")
+        srv = build(deploy.flatten_params(self.params1))
+        srv.warmup()
+        srv.start()
+        router = serving.FleetRouter(name=f"fleet_{tag}", **router_kw)
+        router.add_model("chat", srv, version=1, builder=build)
+        return router
+
+    def rank_router(self, tag, **router_kw):
+        build = self.rank_builder(f"fr_{tag}")
+        srv = build({"w": self.w1})
+        srv.warmup()
+        srv.start()
+        router = serving.FleetRouter(name=f"fleet_{tag}", **router_kw)
+        router.add_model("rank", srv, version=1, builder=build)
+        return router
+
+
+@pytest.fixture(scope="module")
+def kit():
+    return Kit()
+
+
+# ------------------------------------------------- quiesce / resume --
+def test_quiesce_resume_model_server(kit):
+    """quiesce() is distinct from close(): admission pauses TYPED,
+    running work finishes, resume() reopens — nothing torn down."""
+    srv = kit.rank_builder("fq1")({"w": kit.w1})
+    srv.warmup()
+    srv.start()
+    x = np.ones(DIM, np.float32)
+    gate = faults.block_at("serving.dispatch")
+    f1 = srv.submit(x)
+    assert gate.wait_reached(30)
+    # in-flight work pending -> a bounded quiesce reports not-drained
+    assert srv.quiesce(timeout=0.2) is False
+    assert not srv.admitting
+    with pytest.raises(ServerClosed, match="quiesced"):
+        srv.submit(x)
+    gate.release()
+    assert srv.quiesce(timeout=30) is True
+    np.testing.assert_allclose(f1.result(timeout=30),
+                               np.tanh(x @ kit.w1), rtol=1e-5)
+    srv.resume()
+    assert srv.admitting
+    np.testing.assert_allclose(srv.submit(x).result(timeout=30),
+                               np.tanh(x @ kit.w1), rtol=1e-5)
+    srv.shutdown()
+
+
+@pytest.mark.slow   # ~12s on 1 CPU (tier-1 budget); the ModelServer
+# quiesce test above and the hot-swap drain (publish runs quiesce/
+# resume on the LLM path) keep fast coverage
+def test_quiesce_resume_llm_server(kit):
+    srv = kit.chat_builder("fq2")(deploy.flatten_params(kit.params1))
+    srv.warmup()
+    srv.start()
+    gate = faults.block_at("llm.decode")
+    f1 = srv.submit([1, 2, 3], 4)
+    assert gate.wait_reached(30)
+    assert srv.quiesce(timeout=0.2) is False
+    with pytest.raises(ServerClosed, match="quiesced"):
+        srv.submit([1], 1)
+    gate.release()
+    assert srv.quiesce(timeout=60) is True
+    assert f1.result(timeout=30).tokens == kit.ref(kit.params1,
+                                                   [1, 2, 3], 4)
+    srv.resume()
+    assert srv.admitting
+    assert srv.submit([2, 3], 2).result(timeout=30).tokens \
+        == kit.ref(kit.params1, [2, 3], 2)
+    srv.shutdown()
+
+
+# ------------------------------------------------ hot-swap fast gate --
+def test_hot_swap_zero_loss_bitexact(kit):
+    """The tentpole invariant: publish v2 while concurrent traffic
+    streams in — zero compiles, zero unresolved Futures, the typed
+    partition sums exactly, and post-swap tokens are bit-exact vs the
+    eager reference over the NEW weights."""
+    router = kit.chat_router("swap")
+    prompts = [[(i % (VOCAB - 1)) + 1, ((i + 3) % (VOCAB - 1)) + 1]
+               for i in range(24)]
+    futs, errs = [], []
+    outcomes = dict.fromkeys(("served", "shed", "evicted", "expired"),
+                             0)
+    olock = threading.Lock()
+
+    def pump(k):
+        for i in range(k, len(prompts), 2):
+            try:
+                fut = router.submit(
+                    "chat", prompts[i], 4, tenant=f"t{i % 3}")
+                with olock:
+                    futs.append(fut)
+            except Overloaded:              # typed shed at admission
+                with olock:
+                    outcomes["shed"] += 1
+            except Exception as exc:        # pragma: no cover
+                errs.append(exc)
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=pump, args=(k,))
+               for k in range(2)]
+    with serving.CompileCounter() as cc:
+        for th in threads:
+            th.start()
+        time.sleep(0.05)
+        assert router.publish(
+            "chat", 2,
+            arrays=deploy.flatten_params(kit.params2)) == 2
+        for th in threads:
+            th.join()
+        for f in futs:
+            try:
+                f.result(timeout=60)
+                outcomes["served"] += 1
+            except SequenceEvictedError:
+                outcomes["evicted"] += 1
+            except Overloaded:
+                outcomes["shed"] += 1
+            except DeadlineExceededError:
+                outcomes["expired"] += 1
+    assert cc.count == 0, f"{cc.count} recompiles during hot-swap"
+    assert not errs, errs                  # no untyped submit failure
+    # every request resolved TYPED: the partition covers all 24 exactly
+    assert sum(outcomes.values()) == len(prompts)
+    assert outcomes["served"] >= 1
+    assert router.active_version("chat") == 2
+    for p in prompts[:2]:
+        assert router.generate("chat", p, 5, timeout=60).tokens \
+            == kit.ref(kit.params2, p, 5)
+    assert router.server("chat").engine.cache.check(live_block_ids=[])
+    router.shutdown()
+
+
+def test_kill_mid_swap_rolls_back(kit):
+    """Fast chaos row: the publisher dies at the drain phase (after
+    the new replica warmed, before the commit) — the old version keeps
+    serving, admission resumes, the half-published replica is
+    invisible, and the rolled_back outcome lands on the registry."""
+    router = kit.chat_router("kill")
+    old_srv = router.server("chat")
+    faults.crash_at_point("fleet.publish:drain")
+    f = router.submit("chat", [1, 2], 3)
+    with pytest.raises(InjectedCrash):
+        router.publish("chat", 2,
+                       arrays=deploy.flatten_params(kit.params2))
+    assert router.active_version("chat") == 1
+    assert router.server("chat") is old_srv
+    assert old_srv.admitting
+    assert f.result(timeout=30).tokens == kit.ref(kit.params1,
+                                                  [1, 2], 3)
+    assert router.generate("chat", [3], 2, timeout=30).tokens \
+        == kit.ref(kit.params1, [3], 2)
+    samples = _expo()
+    key = ("mxtpu_fleet_swap_total",
+           (("fleet", "fleet_kill"), ("model", "chat"),
+            ("outcome", "rolled_back"), ("phase", "drain")))
+    assert samples.get(key) == 1
+    router.shutdown()
+
+
+# -------------------------------------------------- quotas and lanes --
+def test_quota_shed_isolation(kit):
+    """The greedy tenant ALONE degrades to typed Overloaded(quota);
+    the polite tenant and untagged traffic are untouched."""
+    router = kit.rank_router("quota", quota_rps=0.001, quota_burst=2)
+    x = np.ones(DIM, np.float32)
+    greedy = [router.submit("rank", x, tenant="greedy")
+              for _ in range(2)]
+    with pytest.raises(Overloaded) as ei:
+        router.submit("rank", x, tenant="greedy")
+    assert ei.value.reason == "quota"
+    ok = [router.submit("rank", x, tenant="polite"),
+          router.submit("rank", x)]
+    for f in greedy + ok:
+        np.testing.assert_allclose(f.result(timeout=30),
+                                   np.tanh(x @ kit.w1), rtol=1e-5)
+    samples = _expo()
+    key = ("mxtpu_fleet_quota_shed_total",
+           (("fleet", "fleet_quota"), ("tenant", "greedy")))
+    assert samples.get(key) == 1
+    router.shutdown()
+
+
+def test_batch_lane_depth_cap(kit):
+    """The batch lane depth-caps with typed Overloaded(lane_full);
+    interactive traffic is unaffected by a saturated batch lane."""
+    router = kit.rank_router("lane", batch_lane_depth=1)
+    x = np.ones(DIM, np.float32)
+    gate = faults.block_at("serving.dispatch")
+    f1 = router.submit("rank", x, lane="batch")
+    assert gate.wait_reached(30)
+    with pytest.raises(Overloaded) as ei:
+        router.submit("rank", x, lane="batch")
+    assert ei.value.reason == "lane_full"
+    f2 = router.submit("rank", x)               # interactive lane
+    with pytest.raises(ValueError, match="unknown lane"):
+        router.submit("rank", x, lane="bulk")
+    gate.release()
+    for f in (f1, f2):
+        np.testing.assert_allclose(f.result(timeout=30),
+                                   np.tanh(x @ kit.w1), rtol=1e-5)
+    router.shutdown()
+
+
+def test_route_poison_surfaces_typed(kit):
+    """The fleet.route chaos site: a scripted upstream shed surfaces
+    AS the scripted typed error; the next request routes normally."""
+    router = kit.rank_router("poison")
+    x = np.ones(DIM, np.float32)
+    faults.script("fleet.route",
+                  [Overloaded("injected upstream shed",
+                              reason="quota")])
+    with pytest.raises(Overloaded):
+        router.submit("rank", x)
+    np.testing.assert_allclose(
+        router.generate("rank", x, timeout=30),
+        np.tanh(x @ kit.w1), rtol=1e-5)
+    router.shutdown()
+
+
+# ------------------------------------------- fine-tune -> publish ----
+def test_finetune_publish_loop(kit, tmp_path):
+    """The continuous loop: CompiledTrainStep job -> sharded-manifest
+    checkpoint -> auto-publish into the live router, training and
+    serving on ONE metrics registry; the served output is bit-exact vs
+    the trained weights after every round."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon import nn, Trainer
+    import mxnet_tpu.autograd as ag
+    from mxnet_tpu.resilience.checkpoint import latest_checkpoint
+    from mxnet_tpu.serving.fleet import FineTunePublisher
+
+    mx.random.seed(3)
+    net = nn.Dense(DIM)
+    net.initialize()
+    with ag.pause(train_mode=False):
+        net(nd.array(np.zeros((1, DIM), np.float32)))
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    loss = gluon.loss.L2Loss()
+    step = tr.compile_step(lambda a, b: loss(net(a), b))
+    rng = np.random.RandomState(5)
+    X = rng.randn(8, DIM).astype(np.float32)
+    Y = rng.randn(8, DIM).astype(np.float32)
+
+    def get_arrays():
+        return {k: p.data().asnumpy()
+                for k, p in net.collect_params().items()}
+
+    def build(arrays):
+        wk = next(k for k in arrays if k.endswith("weight"))
+        bk = next(k for k in arrays if k.endswith("bias"))
+        w = np.asarray(arrays[wk], np.float32)
+        b = np.asarray(arrays[bk], np.float32)
+        return serving.ModelServer(
+            lambda batch: np.asarray(kit.dense_jit(w, b, batch)),
+            buckets=[1, 2], max_delay_ms=1.0, item_shape=(DIM,),
+            dtype="float32", name="fleet_ft_m")
+
+    srv = build(get_arrays())
+    srv.warmup()
+    srv.start()
+    router = serving.FleetRouter(name="fleet_ft")
+    router.add_model("m", srv, version=0, builder=build)
+    pub = FineTunePublisher(router, "m", lambda: step(nd.array(X),
+                                                      nd.array(Y)),
+                            get_arrays, str(tmp_path),
+                            steps_per_publish=2, num_shards=2,
+                            version_start=1)
+    assert pub.run(rounds=2) == 2
+    assert pub.step == 4
+    assert router.active_version("m") == 2
+    # the serving weights ARE the step-4 training weights, bit-exact
+    arrays = get_arrays()
+    wk = next(k for k in arrays if k.endswith("weight"))
+    bk = next(k for k in arrays if k.endswith("bias"))
+    x = np.ones(DIM, np.float32)
+    np.testing.assert_allclose(
+        router.generate("m", x, timeout=30),
+        np.tanh(x @ arrays[wk].T + arrays[bk]), rtol=1e-5, atol=1e-6)
+    # the loop went through a SHARDED manifest commit
+    ckpt_dir, _manifest = latest_checkpoint(str(tmp_path))
+    assert ckpt_dir is not None
+    assert any(f.startswith("shard-") for f in os.listdir(ckpt_dir))
+    # one registry carries the step that trained the weights AND the
+    # swap that started serving them
+    samples = _expo()
+    names = {n for n, _ in samples}
+    assert "mxtpu_train_step_dispatch_total" in names
+    key = ("mxtpu_fleet_swap_total",
+           (("fleet", "fleet_ft"), ("model", "m"),
+            ("outcome", "ok"), ("phase", "handover")))
+    assert samples.get(key) == 2
+    router.shutdown()
+
+
+# ------------------------------------------------ slow: chaos matrix --
+@pytest.mark.slow
+def test_publish_crash_matrix(kit):
+    """Crash at EVERY publish phase boundary (plus the route-flip/
+    quiesce gap), with requests in flight: before the handover commit
+    the fleet rolls back — v1 serving, admission open, every Future
+    served bit-exact; after it (prune) the fleet rolls forward — v2
+    serving, the old replica retired typed, KV accounting clean on
+    both replicas."""
+    router = kit.chat_router("matrix")
+    pre_commit = ("fleet.publish:load", "fleet.publish:warm",
+                  "fleet.publish:drain", "fleet.drain",
+                  "fleet.publish:handover")
+    for site in pre_commit:
+        faults.reset()
+        faults.crash_at_point(site)
+        futs = [router.submit("chat", [1, 2], 3),
+                router.submit("chat", [4], 2)]
+        with pytest.raises(InjectedCrash):
+            router.publish("chat", 2,
+                           arrays=deploy.flatten_params(kit.params2))
+        assert router.active_version("chat") == 1, site
+        srv = router.server("chat")
+        assert srv.admitting, site
+        assert futs[0].result(timeout=60).tokens \
+            == kit.ref(kit.params1, [1, 2], 3), site
+        assert futs[1].result(timeout=60).tokens \
+            == kit.ref(kit.params1, [4], 2), site
+        assert router.generate("chat", [5], 2, timeout=60).tokens \
+            == kit.ref(kit.params1, [5], 2), site
+        assert srv.engine.cache.check(live_block_ids=[]), site
+
+    # prune: the crash lands AFTER the commit -> roll forward
+    faults.reset()
+    faults.crash_at_point("fleet.publish:prune")
+    old_srv = router.server("chat")
+    with pytest.raises(InjectedCrash):
+        router.publish("chat", 2,
+                       arrays=deploy.flatten_params(kit.params2))
+    assert router.active_version("chat") == 2
+    new_srv = router.server("chat")
+    assert new_srv is not old_srv
+    assert router.generate("chat", [1, 2], 3, timeout=60).tokens \
+        == kit.ref(kit.params2, [1, 2], 3)
+    # the failure handler finished retiring the old replica
+    assert not old_srv.admitting
+    with pytest.raises(ServerClosed):
+        old_srv.submit([1], 1)
+    assert old_srv.engine.cache.check(live_block_ids=[])
+    assert new_srv.engine.cache.check(live_block_ids=[])
+    samples = _expo()
+    rolled = {phase for (n, lbls) in samples
+              if n == "mxtpu_fleet_swap_total"
+              and dict(lbls).get("fleet") == "fleet_matrix"
+              and dict(lbls).get("outcome") == "rolled_back"
+              for phase in [dict(lbls)["phase"]]}
+    assert rolled == {"load", "warm", "drain", "handover"}
+    key = ("mxtpu_fleet_swap_total",
+           (("fleet", "fleet_matrix"), ("model", "chat"),
+            ("outcome", "failed"), ("phase", "prune")))
+    assert samples.get(key) == 1
+    router.shutdown()
+
+
+@pytest.mark.slow
+def test_bounded_drain_evicts_typed(kit):
+    """A straggler that outlives the drain deadline resolves TYPED at
+    prune — SequenceEvictedError with its partial tokens — while the
+    swap still commits and the new version serves bit-exact."""
+    router = kit.chat_router("evict")
+    old_srv = router.server("chat")
+    # slow every decode step so the straggler cannot finish inside the
+    # publish window; reset before measuring the new replica
+    faults.delay_at("llm.decode", 0.1)
+    straggler = router.submit("chat", [1, 2], 28)
+    time.sleep(0.3)
+    with serving.CompileCounter() as cc:
+        assert router.publish(
+            "chat", 2, arrays=deploy.flatten_params(kit.params2),
+            drain_timeout=0.05) == 2
+    faults.reset()
+    assert cc.count == 0
+    with pytest.raises(SequenceEvictedError) as ei:
+        straggler.result(timeout=60)
+    assert isinstance(ei.value.tokens, list)    # partial generation
+    assert router.active_version("chat") == 2
+    assert router.generate("chat", [3], 2, timeout=60).tokens \
+        == kit.ref(kit.params2, [3], 2)
+    assert old_srv.engine.cache.check(live_block_ids=[])
+    router.shutdown()
+
+
+# --------------------------------------------- slow: fleet replay ----
+@pytest.mark.slow
+def test_fleet_replay_capacity(tmp_path):
+    """tools/load_replay.py --fleet end to end in a clean process:
+    seeded Zipf-tenant trace through the router, hot-swap mid-replay
+    from a sharded checkpoint, and a capacity report that does NOT
+    refuse itself — zero compiles, exact per-model partition, swap
+    committed, per-model + fleet-total chips-per-M-users present."""
+    import json
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "load_replay.py"),
+         "--fleet", "--duration", "4", "--base-rps", "12",
+         "--out", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=560)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
+    cap = json.loads((tmp_path / "CAPACITY_r01.json").read_text())
+    assert not cap.get("skipped")
+    assert cap["value"] and cap["value"] > 0
+    assert cap["compiles_during_replay"] == 0
+    assert cap["detail"]["swap"]["final_active_version"] == 2
+    assert cap["detail"]["swap"]["sharded_checkpoint"] is True
+    for model, oc in cap["outcomes"].items():
+        assert oc["failed"] == 0, (model, oc)
+    assert {fe["model"] for fe in cap["frontends"]} == {"chat", "rank"}
+    for fe in cap["frontends"]:
+        assert fe["chips_per_m_users"] > 0
+        assert fe["availability"] == 1.0

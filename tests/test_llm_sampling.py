@@ -221,6 +221,9 @@ def test_spec_accept_first_token_marginal_matches_target():
 
 
 # --------------------------------------------------- engine streams --
+@pytest.mark.slow   # ~30s on 1 CPU (tier-1 budget); the
+# deterministic-spec-sampled-streams and spec-accounting tests in
+# this file keep fast speculative coverage
 def test_spec_greedy_bit_identical_to_target_only(model, params,
                                                   draft, draft_params):
     """Greedy + speculation == greedy without speculation == the eager
@@ -292,6 +295,8 @@ def test_spec_sampled_stream_is_deterministic(model, params, draft,
     assert all(len(t) == 12 for t in a)
 
 
+@pytest.mark.slow   # ~19s on 1 CPU (tier-1 budget); kv-pressure
+# preemption-resume in test_llm_serving keeps fast coverage
 def test_sampled_preemption_resumes_exact_stream(model, params):
     """Restart determinism EXTENDED TO SAMPLING (the PR 8 contract):
     a pool too small for every sequence forces restart-based

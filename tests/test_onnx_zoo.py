@@ -49,6 +49,8 @@ def _roundtrip(sym, data_shape, rtol=2e-4, atol=2e-5):
     return blob
 
 
+@pytest.mark.slow   # ~13s on 1 CPU (tier-1 budget); mobilenet +
+# bert-layer roundtrips keep fast zoo coverage
 def test_roundtrip_resnet50():
     import os
     import sys

@@ -15,6 +15,8 @@ from mxnet_tpu import nd
 import mxnet_tpu.autograd as ag
 
 
+@pytest.mark.slow   # ~13s on 1 CPU (tier-1 budget); the lane-
+# classification and pause/resume tests keep fast coverage
 def test_profiler_capture_and_dumps(tmp_path):
     from mxnet_tpu import profiler
     from mxnet_tpu.gluon import nn

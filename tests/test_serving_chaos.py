@@ -670,6 +670,9 @@ def _spec_server(model, params, name):
     return srv
 
 
+@pytest.mark.slow   # ~23s on 1 CPU (tier-1 budget); the
+# drain-mid-verify case below keeps the typed-partial-tokens
+# contract in the fast gate
 def test_llm_mid_verify_death_resolves_typed_partial_tokens(model,
                                                             params):
     """Chaos matrix (ISSUE 12): the engine thread dies MID-VERIFY —

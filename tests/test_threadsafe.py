@@ -79,6 +79,8 @@ def test_concurrent_inference_matches_serial():
             np.testing.assert_array_equal(results[tid][i], serial[tid])
 
 
+@pytest.mark.slow   # ~11s on 1 CPU (tier-1 budget); the other
+# five concurrency tests here keep thread-safety in the fast gate
 def test_concurrent_first_call_trace_races():
     """The FIRST call from every thread simultaneously: the trace itself
     races. All outputs must still be bit-identical to a serial run."""

@@ -39,6 +39,8 @@ def _evaluate(net, loader):
     return metric.get()[1]
 
 
+@pytest.mark.slow   # ~19s on 1 CPU (tier-1 budget); mlp_mnist
+# convergence below keeps a fast training-convergence gate
 @pytest.mark.parametrize("hybridize", [True])
 def test_lenet_mnist_convergence(hybridize):
     mx.random.seed(0)

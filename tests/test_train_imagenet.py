@@ -73,6 +73,9 @@ def test_train_imagenet_resnet50_rec(rec_dataset, tmp_path):
     del mod
 
 
+@pytest.mark.slow   # ~24s on 1 CPU (tier-1 budget); the
+# train_imagenet.py example run in test_examples_smoke keeps the
+# north-star protocol in the fast gate
 def test_train_imagenet_synthetic_benchmark():
     import train_imagenet
     mod = train_imagenet.main([

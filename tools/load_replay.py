@@ -37,6 +37,16 @@ number submitted, or the capacity report refuses itself.
     python tools/load_replay.py --smoke              # tiny CI gate
     python tools/load_replay.py --duration 30 --base-rps 50 \
         --frontend both --out .                      # committed run
+    python tools/load_replay.py --fleet --duration 12 \
+        --out .     # FleetRouter replay + mid-replay weight hot-swap
+
+``--fleet`` (ISSUE 16) routes the same schedule through a
+:class:`~mxnet_tpu.serving.fleet.FleetRouter` hosting both front ends
+as named models (tenant-parity target map, interactive/batch lanes)
+and hot-swaps the LLM's weights MID-REPLAY from a sharded checkpoint;
+the aggregated report carries per-model and fleet-total
+chips-per-M-users and refuses itself if the swap recompiled, dropped a
+request, or failed to commit.
 """
 import argparse
 import datetime
@@ -464,6 +474,357 @@ def run_llm(args, spec, trace, ring):
     }
 
 
+# ------------------------------------------------------- fleet mode --
+#
+# ``--fleet`` replays the SAME seeded Zipf-tenant schedule against a
+# FleetRouter hosting two named models — "chat" (LLMServer, TinyDecoder)
+# and "rank" (ModelServer, jitted matmul) — with a weight hot-swap of
+# "chat" fired mid-replay from a pre-written SHARDED checkpoint. The
+# whole window (replay + publish + warmup of the v2 replica) runs under
+# ONE CompileCounter: the zero-recompile pin covers the swap, because
+# the chat builder reuses the same decoder model object (published
+# weights enter the cached programs as traced arguments) and the rank
+# builder reuses one shared jitted function. Outcomes are partitioned
+# PER MODEL and the capacity report aggregates per-model and
+# fleet-total chips-per-M-users under the same refusal contract.
+
+FLEET_MODELS = ("chat", "rank")
+
+
+def _fleet_target(req):
+    """Tenant-parity target map: even tenants chat, odd tenants rank —
+    deterministic from the schedule, so the per-model split is part of
+    the trace's bit-identity."""
+    return "chat" if int(req["tenant"].lstrip("t")) % 2 == 0 else "rank"
+
+
+def _fleet_lane(req):
+    """Every 4th request rides the batch lane; the rest are
+    interactive — enough traffic on both lanes to exercise the
+    router's lane accounting without starving either."""
+    return "batch" if req["i"] % 4 == 3 else "interactive"
+
+
+def run_fleet(args, spec, trace, ring):
+    """Replay the schedule through a FleetRouter (open loop only),
+    hot-swapping "chat" to v2 weights halfway through; returns the
+    fleet result block with per-model typed partitions."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import deploy, serving
+    from mxnet_tpu.resilience.checkpoint import write_checkpoint
+    from mxnet_tpu.serving.llm import (TinyDecoder, DecoderConfig,
+                                       LLMServer)
+
+    dim = args.feature_dim
+    model = TinyDecoder(DecoderConfig(
+        vocab_size=32, d_model=32, num_layers=2, num_heads=2,
+        d_ff=64, max_context=args.max_context))
+    block_size = 16
+
+    def chat_builder(arrays):
+        # same decoder object every build: the engine's programs are
+        # cached ON the model, so the v2 replica warms compile-free
+        return LLMServer(model, deploy.unflatten_params(arrays),
+                         name="replay_fleet_chat",
+                         max_seqs=args.max_seqs, block_size=block_size,
+                         max_context=args.max_context,
+                         max_queue=args.max_queue, prefix_cache=True)
+
+    rank_jit = jax.jit(lambda w, b: jnp.tanh(b @ w))
+
+    def rank_builder(arrays):
+        w = arrays["w"]
+        return serving.ModelServer(
+            lambda batch: np.asarray(rank_jit(w, batch)),
+            buckets=[1, 2, 4, 8], max_delay_ms=1.0, item_shape=(dim,),
+            dtype="float32", name="replay_fleet_rank",
+            max_queue=args.max_queue)
+
+    # v2 weights go through the PR 7 sharded-manifest path BEFORE the
+    # clock starts: publish() must find a committed checkpoint, and
+    # writing it is not part of the serving window being measured
+    ckpt_run = tempfile.mkdtemp(prefix="fleet_ckpt_")
+    write_checkpoint(ckpt_run,
+                     deploy.flatten_params(model.init_params(1)),
+                     step=2, num_shards=2)
+
+    router = serving.FleetRouter(name="replay_fleet")
+    for name, builder, arrays in (
+            ("chat", chat_builder,
+             deploy.flatten_params(model.init_params(0))),
+            ("rank", rank_builder,
+             {"w": np.random.RandomState(7).randn(dim, dim)
+              .astype(np.float32)})):
+        srv = builder(arrays)
+        srv.warmup()
+        srv.start()
+        router.add_model(name, srv, version=1, builder=builder)
+
+    max_prompt = max(2, args.max_context // 2)
+    prefixes = {f"t{k:02d}": tenant_prefix_tokens(
+        spec, f"t{k:02d}", model.vocab_size, block_size)
+        for k in range(spec.tenants)}
+
+    def submit(req):
+        lane = _fleet_lane(req)
+        if _fleet_target(req) == "chat":
+            body = prompt_tokens(spec, req, model.vocab_size)
+            toks = (prefixes[req["tenant"]] + body)[:max_prompt]
+            return router.submit("chat", toks, req["new_tokens"],
+                                 deadline_ms=spec.deadline_ms,
+                                 tenant=req["tenant"], lane=lane)
+        x = request_rng(spec, req).randn(dim).astype(np.float32)
+        return router.submit("rank", x, deadline_ms=spec.deadline_ms,
+                             tenant=req["tenant"], lane=lane)
+
+    outcomes = {m: {k: 0 for k in OUTCOMES} for m in FLEET_MODELS}
+    submitted = dict.fromkeys(FLEET_MODELS, 0)
+    swap = {"published": None, "error": None}
+
+    def publisher():
+        try:
+            swap["published"] = router.publish("chat", 2,
+                                               run_dir=ckpt_run)
+        except Exception as exc:          # surfaced as a refusal gate
+            swap["error"] = repr(exc)
+
+    ring.record()
+    ring.start(max(0.05, spec.duration_s / 40.0))
+    with serving.CompileCounter() as cc:
+        # the swap fires mid-replay, while both models carry live
+        # traffic — that concurrency IS the thing being proven
+        timer = threading.Timer(spec.duration_s / args.speed / 2.0,
+                                publisher)
+        timer.daemon = True
+        timer.start()
+        t0 = time.monotonic()
+        futs, ttfts = [], []
+        for req in trace:
+            m = _fleet_target(req)
+            submitted[m] += 1
+            lag = t0 + req["at_us"] / 1e6 / args.speed \
+                - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            try:
+                futs.append((m, submit(req)))
+            except Exception as exc:
+                outcomes[m][_classify(exc)] += 1
+        for m, fut in futs:
+            try:
+                res = fut.result(timeout=600)
+                outcomes[m]["served"] += 1
+                ttft = getattr(res, "ttft_s", None)
+                if ttft is not None:
+                    ttfts.append(ttft)
+            except Exception as exc:
+                outcomes[m][_classify(exc)] += 1
+        elapsed = time.monotonic() - t0
+        timer.join(timeout=600)
+    ring.stop()
+    ring.record()
+
+    # chat's decode-token total spans BOTH replicas (the v1 server
+    # retired mid-window and its v2 replacement), so read it from the
+    # registry summed across their server labels, not from one
+    # server's stats()
+    from mxnet_tpu.observability import get_registry
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from metrics_dump import parse_exposition
+    finally:
+        sys.path.pop(0)
+    samples = parse_exposition(get_registry().expose())
+    chat_tokens = sum(
+        v for (n, lbls), v in samples.items()
+        if n == "mxtpu_llm_tokens_generated_total"
+        and dict(lbls).get("server", "").startswith("replay_fleet_chat"))
+    chat_servers = sorted(
+        dict(lbls)["server"] for (n, lbls), v in samples.items()
+        if n == "mxtpu_llm_requests_submitted_total"
+        and dict(lbls).get("server", "").startswith("replay_fleet_chat"))
+    routed = {
+        lane: int(sum(v for (n, lbls), v in samples.items()
+                      if n == "mxtpu_fleet_routed_total"
+                      and dict(lbls).get("lane") == lane))
+        for lane in ("interactive", "batch")}
+
+    # per-tenant attribution likewise spans the swap: sum the tenant
+    # outcome counters across every server label the model used
+    def _tenant_counts(metric, prefix):
+        out = {}
+        for (n, lbls), v in samples.items():
+            if n != metric:
+                continue
+            d = dict(lbls)
+            if not d.get("server", "").startswith(prefix) \
+                    or d.get("outcome") not in ("submitted", "served"):
+                continue
+            t = out.setdefault(d["tenant"],
+                               {"submitted": 0, "served": 0})
+            t[d["outcome"]] += int(v)
+        return out
+
+    tenants = {
+        "chat": _tenant_counts("mxtpu_llm_tenant_requests_total",
+                               "replay_fleet_chat"),
+        "rank": _tenant_counts("mxtpu_serving_tenant_requests_total",
+                               "replay_fleet_rank"),
+    }
+    final_version = router.active_version("chat")
+    router.shutdown()
+    ttfts.sort()
+
+    def pct(p):
+        if not ttfts:
+            return None
+        return ttfts[min(len(ttfts) - 1,
+                         int(round(p / 100.0 * (len(ttfts) - 1))))]
+
+    return {
+        "frontend": "fleet",
+        "fleet": "replay_fleet",
+        "models": {
+            "chat": {"kind": "llm", "servers": chat_servers,
+                     "submitted": submitted["chat"],
+                     "outcomes": outcomes["chat"],
+                     "tokens_generated": int(chat_tokens)},
+            "rank": {"kind": "serving",
+                     "servers": ["replay_fleet_rank"],
+                     "submitted": submitted["rank"],
+                     "outcomes": outcomes["rank"]},
+        },
+        "submitted": len(trace),
+        "elapsed_s": round(elapsed, 3),
+        "compiles_during_replay": cc.count,
+        "swap": {"model": "chat", "to_version": 2,
+                 "published": swap["published"],
+                 "error": swap["error"],
+                 "final_active_version": final_version,
+                 "sharded_checkpoint": True},
+        "lanes_routed": routed,
+        "tenants": tenants,
+        "ttft_ms": {"p50": round((pct(50) or 0) * 1e3, 3),
+                    "p99": round((pct(99) or 0) * 1e3, 3)},
+    }
+
+
+def evaluate_and_report_fleet(args, spec, trace, blk, out_dir):
+    """Fleet capacity derivation + committed artifact.
+
+    Per-model chips-per-M-users from the model's own typed partition
+    over the replay window (chat is token-based like the llm front
+    end, rank request-based like serving), summed into the fleet
+    headline. ``build_report`` is deliberately NOT reused here: its
+    per-server registry rates would split chat's traffic across the
+    v1/v2 server labels the hot-swap creates — the per-model outcome
+    partition is the accounting that stays whole across a swap."""
+    from mxnet_tpu.observability import get_registry
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import perf_capture
+    finally:
+        sys.path.pop(0)
+
+    chips = 1
+    try:
+        import jax
+        chips = max(1, jax.local_device_count())
+    except Exception:
+        pass
+    user_model = {"requests_per_user_per_s": args.rpu,
+                  "tokens_per_user_per_s": args.tpu}
+    elapsed = blk["elapsed_s"] or 1.0
+
+    frontends, total = [], 0.0
+    for name in FLEET_MODELS:
+        m = blk["models"][name]
+        oc = m["outcomes"]
+        fe = {"kind": m["kind"], "model": name,
+              "server": ",".join(m["servers"]),
+              "window_s": elapsed,
+              "submitted_qps": round(m["submitted"] / elapsed, 3),
+              "served_qps": round(oc["served"] / elapsed, 3)}
+        denom = oc["served"] + oc["shed"] + oc["expired"]
+        fe["availability"] = round(oc["served"] / denom, 5) \
+            if denom else None
+        if m["kind"] == "llm":
+            tps = m["tokens_generated"] / elapsed
+            fe["tokens_per_sec"] = round(tps, 3)
+            fe["tokens_per_sec_per_chip"] = round(tps / chips, 3)
+            per_chip, demand = tps / chips, args.tpu
+        else:
+            fe["qps_per_chip"] = round(oc["served"] / elapsed / chips,
+                                       3)
+            per_chip, demand = oc["served"] / elapsed / chips, args.rpu
+        if per_chip > 0:
+            fe["chips_per_m_users"] = round(1e6 * demand / per_chip, 3)
+            total += fe["chips_per_m_users"]
+        frontends.append(fe)
+
+    avails = [fe["availability"] for fe in frontends
+              if fe["availability"] is not None]
+    rec = {
+        "metric": "fleet_chips_per_m_users",
+        "unit": "chips / 1M users",
+        "value": round(total, 3) if total > 0 else None,
+        "frontends": frontends,
+        "chips": chips,
+        "user_model": user_model,
+        "window_s": elapsed,
+        "trace": {"spec": spec.to_dict(), "requests": len(trace),
+                  "schedule_sha256": schedule_digest(trace)},
+        "tenants": blk["tenants"],
+        "outcomes": {m: blk["models"][m]["outcomes"]
+                     for m in FLEET_MODELS},
+        "compiles_during_replay": blk["compiles_during_replay"],
+        "slo_attained": bool(avails) and all(
+            a >= args.availability_target for a in avails),
+        "detail": {"fleet": blk["fleet"], "swap": blk["swap"],
+                   "lanes_routed": blk["lanes_routed"],
+                   "ttft_ms": blk["ttft_ms"]},
+    }
+
+    # refusal gates: a swap that recompiled, dropped accounting, threw
+    # untyped, or never landed cannot headline fleet capacity
+    reasons = []
+    if blk["compiles_during_replay"]:
+        reasons.append(f"{blk['compiles_during_replay']} XLA "
+                       "recompiles during the measured window "
+                       "(hot-swap included)")
+    for name in FLEET_MODELS:
+        m = blk["models"][name]
+        if sum(m["outcomes"].values()) != m["submitted"]:
+            reasons.append(
+                f"{name}: accounting drift — "
+                f"{sum(m['outcomes'].values())} outcomes for "
+                f"{m['submitted']} submissions")
+        if m["outcomes"]["failed"]:
+            reasons.append(f"{name}: {m['outcomes']['failed']} "
+                           "untyped/unexpected failures")
+    if blk["swap"]["error"]:
+        reasons.append(f"hot-swap failed: {blk['swap']['error']}")
+    elif blk["swap"]["published"] != blk["swap"]["to_version"] \
+            or blk["swap"]["final_active_version"] \
+            != blk["swap"]["to_version"]:
+        reasons.append("hot-swap did not commit within the window")
+    if reasons:
+        rec["skipped"] = "; ".join(reasons)
+
+    os.makedirs(out_dir, exist_ok=True)
+    metrics_log = os.path.join(out_dir, "load_replay_metrics.jsonl")
+    get_registry().write_snapshot(metrics_log)
+    rec["_capture"] = {
+        "tag": f"load_replay_fleet_seed{spec.seed}",
+        "metrics_log": metrics_log,
+        "captured_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+    }
+    path = perf_capture.emit_capacity_snapshot(rec, out_dir=out_dir)
+    return rec, path
+
+
 # ------------------------------------------------- SLO + capacity ----
 
 def _replay_windows(duration_s):
@@ -660,6 +1021,13 @@ def main():
                     help="per-request end-to-end deadline (0 = none)")
     ap.add_argument("--frontend", choices=("serving", "llm", "both"),
                     default="both")
+    ap.add_argument("--fleet", action="store_true",
+                    help="replay through a FleetRouter (chat=LLM + "
+                         "rank=single-shot, tenant-parity target map, "
+                         "lanes) with a chat weight hot-swap from a "
+                         "sharded checkpoint fired mid-replay; emits "
+                         "an aggregated fleet capacity report and "
+                         "exits nonzero if it refused itself")
     ap.add_argument("--closed", type=int, default=0,
                     help="closed-loop client count (0 = open loop at "
                          "scheduled arrival times)")
@@ -723,6 +1091,27 @@ def main():
         return 0
 
     from mxnet_tpu.observability import TimeSeriesRing, get_registry
+    if args.fleet:
+        if args.closed:
+            print("--fleet is open-loop only (the swap must land "
+                  "against scheduled arrivals)", file=sys.stderr)
+            return 2
+        ring = TimeSeriesRing(get_registry())
+        blk = run_fleet(args, spec, trace, ring)
+        print(json.dumps(blk, indent=1))
+        out_dir = args.out or tempfile.mkdtemp(prefix="load_replay_")
+        rec, cap_path = evaluate_and_report_fleet(args, spec, trace,
+                                                  blk, out_dir)
+        print(f"CAPACITY json -> {cap_path}")
+        print(json.dumps({k: rec[k] for k in
+                          ("value", "unit", "slo_attained", "chips",
+                           "window_s") if k in rec}, indent=1))
+        if rec.get("skipped"):
+            print(f"FLEET REFUSED: {rec['skipped']}")
+            return 1
+        print("FLEET OK")
+        return 0
+
     results, rings = [], {}
     if args.frontend in ("serving", "both"):
         rings["serving"] = TimeSeriesRing(get_registry())

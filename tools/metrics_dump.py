@@ -425,13 +425,54 @@ def smoke():
         f.result(timeout=60)
     lsrv.shutdown()
 
+    # fleet routing: interactive + batch lanes, a per-tenant quota
+    # shed, and one weight hot-swap, so the mxtpu_fleet_* series
+    # (routed/swap/quota/lane-depth/active-version) land in the same
+    # exposition
+    import jax
+    import jax.numpy as jnp
+    _fleet_jit = jax.jit(lambda w, x: jnp.tanh(x @ w))
+
+    def _fleet_server(arrays, tag):
+        w = jnp.asarray(np.asarray(arrays["w"], dtype=np.float32))
+        return serving.ModelServer(
+            lambda batch: np.asarray(_fleet_jit(w, batch)),
+            buckets=[1, 2], max_delay_ms=0.1, item_shape=(3,),
+            dtype="float32", name=f"smoke_fleet_{tag}")
+
+    fsrv = _fleet_server({"w": np.eye(3, dtype=np.float32)}, "v0")
+    fsrv.warmup()
+    fsrv.start()
+    router = serving.FleetRouter(name="smoke_fleet", quota_rps=0.001,
+                                 quota_burst=3)
+    router.add_model("m", fsrv, version=0,
+                     builder=lambda arrays: _fleet_server(arrays, "v1"))
+    router.generate("m", np.ones(3, np.float32), tenant="good",
+                    timeout=60)
+    router.generate("m", np.ones(3, np.float32), lane="batch",
+                    tenant="good", timeout=60)
+    quota_ok = False
+    try:
+        for _ in range(4):      # burst 3 -> the fourth submit sheds
+            router.submit("m", np.ones(3, np.float32), tenant="greedy")
+    except serving.Overloaded as exc:
+        quota_ok = exc.reason == "quota"
+    router.publish("m", 1,
+                   arrays={"w": 2 * np.eye(3, dtype=np.float32)})
+    router.generate("m", np.ones(3, np.float32), tenant="good",
+                    timeout=60)
+    router.shutdown()
+    if not quota_ok:
+        print("SMOKE FAIL: fleet quota shed not raised typed")
+        return 1
+
     reg = get_registry()
     text = reg.expose()
     samples = parse_exposition(text)          # must be valid exposition
     for subsystem in ("mxtpu_training_", "mxtpu_serving_",
                       "mxtpu_resilience_checkpoint_",
                       "mxtpu_xla_compile_", "mxtpu_ckpt_async_",
-                      "mxtpu_llm_"):
+                      "mxtpu_llm_", "mxtpu_fleet_"):
         if not any(name.startswith(subsystem)
                    for name, _ in samples):
             print(f"SMOKE FAIL: no {subsystem}* metric in exposition")
@@ -515,6 +556,41 @@ def smoke():
             return 1
     if ("mxtpu_llm_prefix_evict_total", lbl) not in samples:
         print("SMOKE FAIL: no prefix-evict counter in exposition")
+        return 1
+    # fleet: routing by lane, the quota shed, the hot-swap commit and
+    # the moved version gauge — all under the router's fleet label
+    flbl = (("fleet", "smoke_fleet"),)
+    if samples.get(("mxtpu_fleet_routed_total",
+                    flbl + (("lane", "interactive"),
+                            ("model", "m")))) != 5:
+        print("SMOKE FAIL: fleet interactive routing not counted "
+              "(2 good + 3 greedy admits expected)")
+        return 1
+    if samples.get(("mxtpu_fleet_routed_total",
+                    flbl + (("lane", "batch"), ("model", "m")))) != 1:
+        print("SMOKE FAIL: fleet batch-lane routing not counted once")
+        return 1
+    if samples.get(("mxtpu_fleet_quota_shed_total",
+                    flbl + (("tenant", "greedy"),))) != 1:
+        print("SMOKE FAIL: greedy-tenant quota shed not counted once")
+        return 1
+    if samples.get(("mxtpu_fleet_swap_total",
+                    flbl + (("model", "m"), ("outcome", "ok"),
+                            ("phase", "handover")))) != 1:
+        print("SMOKE FAIL: hot-swap handover commit not counted once")
+        return 1
+    if samples.get(("mxtpu_fleet_active_version",
+                    flbl + (("model", "m"),))) != 1:
+        print("SMOKE FAIL: active-version gauge did not move to 1")
+        return 1
+    if ("mxtpu_fleet_lane_depth",
+            flbl + (("lane", "interactive"),)) not in samples:
+        print("SMOKE FAIL: no fleet lane-depth gauge in exposition")
+        return 1
+    if not any(n.startswith("mxtpu_fleet_swap_seconds")
+               for n, _ in samples):
+        print("SMOKE FAIL: no fleet swap-seconds histogram in "
+              "exposition")
         return 1
     if samples[("mxtpu_training_steps_total", ())] < 2:
         print("SMOKE FAIL: step timer did not count 2 steps")
