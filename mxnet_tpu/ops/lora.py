@@ -1,0 +1,71 @@
+"""Paged LoRA delta: the adapter-augmented projection for the flat step.
+
+Multi-LoRA serving (ISSUE 17) keeps the PR 12 contract — everything
+request-specific rides the batch as traced data, never as program
+structure. An adapter's low-rank factors live in a fixed paged pool
+(``serving/adapters/bank.py``): ``a_pages [P, L, 4, d, r]`` and
+``b_pages [P, L, 4, r, d]``, where axis 2 indexes the four attention
+projections ``(wq, wk, wv, wo)`` and ``r`` is the page rank. A request
+using adapter rank ``R`` owns ``ceil(R / r)`` pages (the tail page is
+zero-padded — zero factor columns contribute an exactly-zero delta).
+Per-row page tables and scales ride the batch like the PR 12 sampling
+vectors, so a mixed-adapter batch — including adapter-less rows, whose
+table points at the all-zero reserved null page 0 with scale 0 — runs
+in ONE fixed-shape program and adapter switch never recompiles.
+
+``paged_lora_delta`` is the single delta expression shared by the flat
+step (``model.decode_flat``), the dense oracle (``model.forward``) and
+the incremental oracle, so parity between them exercises identical
+einsum structure; ``lora_delta`` registers the dense one-adapter form
+in the op registry (the paper's one-registry thesis: the same op backs
+eager fine-tuning and compiled serving).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+# index of each projection along the factor-pool axis 2
+PROJ_Q, PROJ_K, PROJ_V, PROJ_O = 0, 1, 2, 3
+NUM_PROJ = 4
+
+
+def paged_lora_delta(x, a_sel, b_sel, scale):
+    """Per-token paged low-rank delta ``scale * (x @ A) @ B``.
+
+    x      [T, d]        activations entering one projection
+    a_sel  [T, P, d, r]  per-token gathered A factor pages
+    b_sel  [T, P, r, d]  per-token gathered B factor pages
+    scale  [T]           per-token LoRA scaling (alpha / rank; 0 = off)
+
+    Pages are rank slices of one factor: summing page contributions
+    equals the full-rank product because ``x @ [A1|A2] @ [[B1],[B2]]``
+    ``= x@A1@B1 + x@A2@B2``. Null/padded pages are all-zero, so their
+    contribution is exactly zero and adapter-less rows return an exact
+    zero delta (value-identical to no LoRA at all).
+    """
+    xa = jnp.einsum("td,tpdr->tpr", x, a_sel)
+    delta = jnp.einsum("tpr,tprd->td", xa, b_sel)
+    return delta * scale[:, None]
+
+
+def gather_adapter(a_pages, b_pages, pages_tok, layer, proj):
+    """Gather one (layer, projection)'s factor pages for every token.
+
+    a_pages [P_pool, L, 4, d, r], b_pages [P_pool, L, 4, r, d],
+    pages_tok [T, P] int32 page ids (0 = null). Returns
+    (a_sel [T, P, d, r], b_sel [T, P, r, d]) for ``paged_lora_delta``.
+    The gather is traced — page ids are data, so installing, evicting
+    or switching adapters never changes program structure.
+    """
+    return a_pages[pages_tok, layer, proj], b_pages[pages_tok, layer, proj]
+
+
+@register("lora_delta")
+def lora_delta(x, a, b, alpha=1.0):
+    """Dense single-adapter LoRA delta ``(alpha / rank) * x @ a @ b``
+    (a ``[d, R]``, b ``[R, d]``, x ``[..., d]``): the eager/registry
+    form of the serving-side :func:`paged_lora_delta`."""
+    rank = a.shape[-1]
+    return (x @ a) @ b * (float(alpha) / float(rank))

@@ -53,6 +53,9 @@ from .telemetry import (CompileCounter, EventLog, ServingStats,
                         compile_count)
 from . import llm
 from .llm import LLMServer, LLMEngine, GenerationResult
+from . import adapters
+from .adapters import (AdapterBank, AdapterRegistry, LoRAFineTuneJob,
+                       AdapterFineTunePublisher)
 from . import fleet
 from .fleet import FleetRouter, FleetStats, FineTunePublisher
 
@@ -64,4 +67,6 @@ __all__ = ["ModelServer", "MicroBatchQueue", "Request",
            "pad_to_bucket", "waste_fraction",
            "CompileCounter", "EventLog", "ServingStats", "compile_count",
            "llm", "LLMServer", "LLMEngine", "GenerationResult",
+           "adapters", "AdapterBank", "AdapterRegistry",
+           "LoRAFineTuneJob", "AdapterFineTunePublisher",
            "fleet", "FleetRouter", "FleetStats", "FineTunePublisher"]

@@ -1,0 +1,39 @@
+"""Multi-LoRA serving: thousands of fine-tuned variants, one program set.
+
+The mass-personalization subsystem (ROADMAP item 3, the fine-tune-and-
+serve economics of the Gemma paper in PAPERS.md): per-tenant LoRA
+adapters are only viable if serving N adapters costs ~1 base model.
+Three pieces make that true here:
+
+- :class:`AdapterBank` (``bank.py``) — a fixed paged pool of LoRA A/B
+  factor pages, accounted by the SAME strict refcounted
+  ``BlockAllocator`` that backs the KV cache: all-or-nothing alloc,
+  refcounted sharing across in-flight requests, LRU reclaim of cold
+  adapters, typed accounting errors and a ``check()`` invariant.
+  Adapters are installed into the pool by one warmed fixed-shape
+  program (page id traced), so publish/evict/switch never compiles.
+- :class:`AdapterRegistry` (``registry.py``) — the host-side on-disk
+  tier: sharded checkpoint manifests (PR 7) per adapter, larger than
+  the resident bank; the bank faults cold adapters in from it,
+  evicting LRU residents.
+- :class:`LoRAFineTuneJob` / :class:`AdapterFineTunePublisher`
+  (``training.py``) — the fine-tune→publish loop: base weights frozen
+  (``grad_req='null'``, riding the PR 5 frozen-param promotion), only
+  A/B trained by a ``CompiledTrainStep``, hot-published into the live
+  bank through the registry, mirroring PR 16's ``FineTunePublisher``.
+
+Per-request dispatch rides the batch as traced data
+(``ops/lora.py``): see ``LLMServer.submit(adapter=...)``.
+"""
+from .bank import (AdapterBank, AdapterHandle, AdapterError,
+                   UnknownAdapterError, NoFreeAdapterPagesError,
+                   AdapterAccountingError, NULL_ADAPTER_PAGE)
+from .registry import AdapterRegistry
+from .training import LoRAFineTuneJob, AdapterFineTunePublisher
+
+__all__ = [
+    "AdapterBank", "AdapterHandle", "AdapterRegistry",
+    "AdapterError", "UnknownAdapterError", "NoFreeAdapterPagesError",
+    "AdapterAccountingError", "NULL_ADAPTER_PAGE",
+    "LoRAFineTuneJob", "AdapterFineTunePublisher",
+]

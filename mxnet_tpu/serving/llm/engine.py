@@ -66,6 +66,7 @@ import time
 import numpy as np
 
 from ..envutil import env_int as _env_int, env_str as _env_str
+from ..adapters.bank import AdapterError, NULL_ADAPTER_PAGE
 from .kv_cache import (PagedKVCache, KVCacheError, NULL_BLOCK,
                        prefix_block_hashes)
 from .scheduler import Scheduler, Sequence, RUNNING, FINISHED, EVICTED
@@ -78,7 +79,7 @@ from ...resilience import faults
 __all__ = ["LLMEngine"]
 
 
-def _make_step_fn(model, spec_k, sampled, quantized=False):
+def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False):
     """Build the target step program body for (model, spec_k): ONE
     program covering chunked prefill + decode + speculative verify
     over the FLAT ragged layout — a packed ``[total_q_tokens]`` batch
@@ -102,7 +103,17 @@ def _make_step_fn(model, spec_k, sampled, quantized=False):
     ``quantized`` selects the int8-KV variant: the f32 scale pools
     ride the program right after the pages (donated with them) and
     :meth:`model.decode_flat` quantizes on write / the ragged kernel
-    dequantizes on read."""
+    dequantizes on read.
+
+    ``lora`` selects the multi-adapter variant: the AdapterBank's A/B
+    factor pools enter right after the KV pools (NOT donated — they
+    are shared with concurrently publishing threads) and the batch
+    grows two trailing per-row vectors, a_tables int32 [S, P] (each
+    row's adapter page list, NULL_ADAPTER_PAGE-padded) and a_scales
+    f32 [S] (alpha/rank; 0.0 on adapter-less rows). Adapter selection
+    is traced data: a mixed-adapter batch — including adapter-less
+    rows through the all-zero null page — runs in this ONE fixed-shape
+    program, so publish/evict/switch never compiles."""
     import jax.numpy as jnp
 
     def _accept(logits, win_idx, draft_tokens, draft_probs, n_draft,
@@ -120,6 +131,23 @@ def _make_step_fn(model, spec_k, sampled, quantized=False):
             win, draft_tokens, draft_probs, n_draft, temperature,
             top_k, top_p, accept_keys, sample_keys)
 
+    if quantized and lora:
+        def step(params, k_pages, v_pages, k_scales, v_scales,
+                 a_pages, b_pages, tokens, positions, seq_ids, valid,
+                 block_tables, win_idx, draft_tokens, draft_probs,
+                 n_draft, temperature, top_k, top_p, seeds, counters,
+                 a_tables, a_scales):
+            logits, kp2, vp2, ks2, vs2 = model.decode_flat(
+                params, tokens, positions, seq_ids, valid, k_pages,
+                v_pages, block_tables, k_scales=k_scales,
+                v_scales=v_scales,
+                adapter=(a_pages, b_pages, a_tables, a_scales))
+            toks, n_acc = _accept(logits, win_idx, draft_tokens,
+                                  draft_probs, n_draft, temperature,
+                                  top_k, top_p, seeds, counters)
+            return toks, n_acc, kp2, vp2, ks2, vs2
+        return step
+
     if quantized:
         def step(params, k_pages, v_pages, k_scales, v_scales, tokens,
                  positions, seq_ids, valid, block_tables, win_idx,
@@ -133,6 +161,21 @@ def _make_step_fn(model, spec_k, sampled, quantized=False):
                                   draft_probs, n_draft, temperature,
                                   top_k, top_p, seeds, counters)
             return toks, n_acc, kp2, vp2, ks2, vs2
+        return step
+
+    if lora:
+        def step(params, k_pages, v_pages, a_pages, b_pages, tokens,
+                 positions, seq_ids, valid, block_tables, win_idx,
+                 draft_tokens, draft_probs, n_draft, temperature,
+                 top_k, top_p, seeds, counters, a_tables, a_scales):
+            logits, k_pages2, v_pages2 = model.decode_flat(
+                params, tokens, positions, seq_ids, valid, k_pages,
+                v_pages, block_tables,
+                adapter=(a_pages, b_pages, a_tables, a_scales))
+            toks, n_acc = _accept(logits, win_idx, draft_tokens,
+                                  draft_probs, n_draft, temperature,
+                                  top_k, top_p, seeds, counters)
+            return toks, n_acc, k_pages2, v_pages2
         return step
 
     def step(params, k_pages, v_pages, tokens, positions, seq_ids,
@@ -245,10 +288,19 @@ class LLMEngine:
                  num_blocks=None, max_context=None, prefill_chunk=None,
                  draft_model=None, draft_params=None, spec_k=None,
                  stats=None, dtype="float32", breaker=None,
-                 prefix_cache=None, kv_dtype=None):
+                 prefix_cache=None, kv_dtype=None, adapter_bank=None):
         import jax
         import jax.numpy as jnp
         self.model = model
+        d_model = model.num_heads * model.head_dim
+        if adapter_bank is not None:
+            if (adapter_bank.num_layers != model.num_layers
+                    or adapter_bank.d_model != d_model):
+                raise ValueError(
+                    f"adapter bank shaped for {adapter_bank.num_layers}"
+                    f" layers x d_model {adapter_bank.d_model}, model "
+                    f"has {model.num_layers} x {d_model}")
+        self.bank = adapter_bank
         if max_seqs is None:
             max_seqs = _env_int("MXNET_TPU_LLM_MAX_SEQS", 8)
         if block_size is None:
@@ -336,6 +388,8 @@ class LLMEngine:
         self.quantized = self.cache.quantized
         self.scheduler = Scheduler(self.max_seqs)
         self._stats = stats
+        if adapter_bank is not None and stats is not None:
+            adapter_bank.attach_stats(stats)
         if stats is not None and self.prefix_enabled:
             self.cache.on_prefix_evict = stats.record_prefix_evict
         # engine-local prefix counters (mirrored onto mxtpu_llm_* when
@@ -352,14 +406,26 @@ class LLMEngine:
         # two VARIANTS (greedy / sampled) x two widths of the one
         # step program — all warmed, so variant+width selection at
         # dispatch time is recompile-free. Cached on the model object
-        # so engines sharing a model reuse compiled programs.
+        # so engines sharing a model reuse compiled programs. The
+        # adapter-bank variant keys on the bank's pool geometry, so a
+        # bank-less engine shares nothing with (and costs nothing of)
+        # the multi-LoRA program set. The A/B pools themselves are
+        # NEVER donated: publisher threads install into them while
+        # steps are in flight, and donation positions (1..n_pools)
+        # stay untouched because the factor pools enter after the KV
+        # pools.
+        lora = self.bank is not None
+        lora_key = None if not lora else (
+            self.bank.num_pages, self.bank.max_pages_per_adapter,
+            self.bank.page_rank)
         self._step_jits = {
             sampled: _cached_program(
                 model, "step",
-                (self.spec_k, sampled, self.quantized, donate),
+                (self.spec_k, sampled, self.quantized, donate,
+                 lora_key),
                 lambda s=sampled: jax.jit(
                     _make_step_fn(model, self.spec_k, s,
-                                  self.quantized),
+                                  self.quantized, lora=lora),
                     donate_argnums=donate))
             for sampled in (False, True)}
         if self.draft_model is not None:
@@ -460,17 +526,22 @@ class LLMEngine:
 
     def _call_step(self, sampled, batch):
         """Dispatch one step program against the target pool, swapping
-        the donated page (and scale) buffers back in."""
+        the donated page (and scale) buffers back in. With an adapter
+        bank attached, the current A/B factor pool snapshot rides
+        after the KV pools — reading it here (not caching it) is what
+        makes a concurrent publish visible to the very next step."""
         jit = self._step_jits[sampled]
+        lora = () if self.bank is None else self.bank.pools()
         if self.quantized:
             toks, n_acc, kp, vp, ks, vs = jit(
                 self._params, self.cache.k_pages, self.cache.v_pages,
-                self.cache.k_scales, self.cache.v_scales, *batch)
+                self.cache.k_scales, self.cache.v_scales, *lora,
+                *batch)
             self.cache.swap(kp, vp, ks, vs)
         else:
             toks, n_acc, kp, vp = jit(
                 self._params, self.cache.k_pages, self.cache.v_pages,
-                *batch)
+                *lora, *batch)
             self.cache.swap(kp, vp)
         return toks, n_acc
 
@@ -490,6 +561,15 @@ class LLMEngine:
         return tok, probs
 
     # ------------------------------------------------ prefix caching --
+    def _prefix_salt(self, seq):
+        """The sequence's prefix-cache namespace. Adapter KV is NOT
+        base-model KV (the LoRA delta rides the K/V projections), so
+        cached blocks are only reusable under the same adapter name
+        AND version — the pinned handle's identity seeds the hash
+        chain. Base-model sequences share the unsalted namespace."""
+        h = seq.adapter_handle
+        return b"" if h is None else f"{h.name}@{h.version}".encode()
+
     def _prefix_lookup(self, seq):
         """Longest chain of registered blocks matching the prompt's
         full-block prefix. Pure read — no refcounts move until the
@@ -503,7 +583,8 @@ class LLMEngine:
         T = len(seq.prompt)
         bs = self.cache.block_size
         if seq.prefix_hashes is None:
-            seq.prefix_hashes = prefix_block_hashes(seq.prompt, bs)
+            seq.prefix_hashes = prefix_block_hashes(
+                seq.prompt, bs, salt=self._prefix_salt(seq))
         hit = []
         for h in seq.prefix_hashes:
             bid = self.cache.prefix_get(h)
@@ -530,7 +611,8 @@ class LLMEngine:
             return
         hashes = seq.prefix_hashes or []
         if len(hashes) < n_full:
-            hashes = prefix_block_hashes(tokens[:n_full * bs], bs)
+            hashes = prefix_block_hashes(tokens[:n_full * bs], bs,
+                                         salt=self._prefix_salt(seq))
             seq.prefix_hashes = hashes
         for k in range(n_full):
             self.cache.register(hashes[k], seq.block_ids[k])
@@ -573,6 +655,17 @@ class LLMEngine:
         top_p = np.ones(S, np.float32)
         seeds = np.zeros(S, np.int32)
         counters = np.zeros(S, np.int32)
+        lora_tail = ()
+        if self.bank is not None:
+            # install the all-zero null adapter page (warms the
+            # fixed-shape install program every later publish reuses)
+            t0 = time.monotonic()
+            self.bank.warmup()
+            timings["adapter_install"] = time.monotonic() - t0
+            lora_tail = (
+                np.full((S, self.bank.max_pages_per_adapter),
+                        NULL_ADAPTER_PAGE, np.int32),
+                np.zeros(S, np.float32))
         if self.draft_model is not None:
             for T in self._draft_t_buckets:
                 for MB in self._mb_widths:
@@ -604,7 +697,7 @@ class LLMEngine:
                         np.zeros((S, K), np.int32),
                         np.zeros((S, K, V), np.float32),
                         np.zeros(S, np.int32), temp, top_k, top_p,
-                        seeds, counters))
+                        seeds, counters, *lora_tail))
                     np.asarray(toks)
                     tag = "sampled" if sampled else "greedy"
                     timings[f"step_t{T}mb{MB}_{tag}"] = \
@@ -669,6 +762,24 @@ class LLMEngine:
             if slot is None:
                 break
             seq = self.scheduler.peek_waiting()
+            if (self.bank is not None and seq.adapter is not None
+                    and seq.adapter_handle is None):
+                # pin the adapter version BEFORE the prefix lookup —
+                # the pinned (name, version) namespaces the hash
+                # chain, so adapter KV never aliases base-model or
+                # other-version KV. A failed fault-in (unknown name,
+                # bank full of in-use adapters) poisons the sequence
+                # without touching cache state. A later KV gate break
+                # leaves the pin on the waiting sequence — it is
+                # reused on the next admission attempt and released
+                # on terminal states like any other.
+                try:
+                    seq.adapter_handle = self.bank.acquire(
+                        seq.adapter, tenant=seq.tenant)
+                except AdapterError as exc:
+                    self.scheduler.waiting.popleft()
+                    self._poison(seq, exc, events)
+                    continue
             T = len(seq.prompt)
             hit, hit_tokens = ([], 0)
             if self.prefix_enabled:
@@ -712,10 +823,20 @@ class LLMEngine:
                         hit_tokens, tenant=seq.tenant)
             events.append(("admitted", seq))
 
+    def _release_adapter(self, seq):
+        """Drop the sequence's adapter pin on any TERMINAL release.
+        Preemption deliberately keeps it: the pinned version is what
+        makes a preempted sequence's re-prefill bit-identical even if
+        the adapter was republished in between."""
+        if seq.adapter_handle is not None and self.bank is not None:
+            self.bank.release(seq.adapter_handle)
+            seq.adapter_handle = None
+
     def _finish(self, seq, events):
         self._register_blocks(seq)
         self.cache.allocator.free(seq.block_ids)
         seq.block_ids = []
+        self._release_adapter(seq)
         reason = ("stop_token" if (seq.stop_token is not None
                                    and seq.generated
                                    and seq.generated[-1]
@@ -739,6 +860,7 @@ class LLMEngine:
         if seq.block_ids:
             self.cache.allocator.free(seq.block_ids)
             seq.block_ids = []
+        self._release_adapter(seq)
         self.scheduler.release(seq, EVICTED, "poison")
         self._poison_pending.append((seq, exc))
         if self._stats:
@@ -765,6 +887,7 @@ class LLMEngine:
                 if seq.block_ids:       # defensive: waiting seqs
                     self.cache.allocator.free(seq.block_ids)
                     seq.block_ids = []  # normally hold no blocks
+                self._release_adapter(seq)
                 self.scheduler.release(seq, EVICTED, reason)
                 self._dead_pending.append((seq, reason))
                 events.append(("expired", seq))
@@ -776,6 +899,7 @@ class LLMEngine:
                 continue
             self.cache.allocator.free(seq.block_ids)
             seq.block_ids = []
+            self._release_adapter(seq)
             self.scheduler.release(seq, EVICTED, reason)
             self._dead_pending.append((seq, reason))
             events.append(("expired", seq))
@@ -1058,6 +1182,10 @@ class LLMEngine:
                     np.ones(S, np.float32),           # top_p
                     np.zeros(S, np.int32),            # seeds
                     np.zeros(S, np.int32))            # counters
+            if self.bank is not None:
+                bufs += (np.full((S, self.bank.max_pages_per_adapter),
+                                 NULL_ADAPTER_PAGE, np.int32),
+                         np.zeros(S, np.float32))     # a_tables/scales
             self._bufs[(t, mb)] = bufs
         return bufs
 
@@ -1065,7 +1193,7 @@ class LLMEngine:
         bufs = self._batch_buffers(t, mb)
         (tokens, positions, seq_ids, valid, tables, win_idx, d_toks,
          d_probs, n_draft, temp, top_k, top_p, seeds,
-         counters) = bufs
+         counters) = bufs[:14]
         valid.fill(0)
         n_draft.fill(0)
         off = 0
@@ -1096,6 +1224,20 @@ class LLMEngine:
             top_p[i] = sp.top_p
             seeds[i] = sp.seed
             counters[i] = plan["cl"]
+            if self.bank is not None:
+                # per-row adapter dispatch rides the batch exactly
+                # like the sampling vectors: page list + scale for
+                # adapted rows, the all-zero null page + scale 0.0
+                # for plain rows (exact-zero delta — bit-identical
+                # to a bank-less engine)
+                a_tables, a_scales = bufs[14], bufs[15]
+                h = seq.adapter_handle
+                if h is None:
+                    a_tables[i] = NULL_ADAPTER_PAGE
+                    a_scales[i] = 0.0
+                else:
+                    a_tables[i] = h.pages_padded
+                    a_scales[i] = h.scale
             off += n
         return bufs
 
@@ -1353,6 +1495,7 @@ class LLMEngine:
         for seq in self.scheduler.running():
             self.cache.allocator.free(seq.block_ids)
             seq.block_ids = []
+            self._release_adapter(seq)
             self.scheduler.release(seq, EVICTED, reason)
             out.append(seq)
         while self.scheduler.waiting:
@@ -1360,6 +1503,7 @@ class LLMEngine:
             if seq.block_ids:       # defensive: waiting seqs normally
                 self.cache.allocator.free(seq.block_ids)
                 seq.block_ids = []  # hold no blocks
+            self._release_adapter(seq)
             self.scheduler.release(seq, EVICTED, reason)
             out.append(seq)
         self._record_block_gauges()

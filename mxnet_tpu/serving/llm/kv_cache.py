@@ -94,14 +94,18 @@ class BlockAccountingError(KVCacheError):
     table), or a refcount/partition drift — always a caller bug."""
 
 
-def prefix_block_hashes(tokens, block_size):
+def prefix_block_hashes(tokens, block_size, salt=b""):
     """Chained content hashes of the FULL blocks of ``tokens``: hash k
     covers tokens ``[0, (k+1)*block_size)`` — block k's content chained
     onto hash k-1 — so equal hashes imply equal whole prefixes, not
     just equal blocks. The partial tail block is never hashed (it is
-    mutable). Returns a list of hex digests, one per full block."""
+    mutable). ``salt`` seeds the chain: KV written under a LoRA
+    adapter embeds that adapter's K/V deltas, so the engine namespaces
+    the whole chain by the pinned adapter identity — equal tokens
+    under different adapters (or versions) never share blocks.
+    Returns a list of hex digests, one per full block."""
     out = []
-    h = b""
+    h = bytes(salt)
     n_full = len(tokens) // block_size
     for k in range(n_full):
         m = hashlib.blake2b(digest_size=16)

@@ -161,6 +161,31 @@ class LLMStats:
             "mxtpu_llm_decode_step_seconds",
             "Wall time of one decode batch launch.", lbl,
             buckets=_LATENCY_BUCKETS).labels(**s)
+        self._adapters_resident = r.gauge(
+            "mxtpu_llm_adapters_resident",
+            "LoRA adapters currently installed in the device-resident "
+            "AdapterBank (in use + cold).", lbl).labels(**s)
+        self._adapter_evictions = r.counter(
+            "mxtpu_llm_adapter_evictions_total",
+            "Adapters retired from the bank, by reason (capacity = "
+            "LRU reclaim for a fault-in, republish = replaced by a "
+            "newer version, explicit = operator evict).",
+            ("server", "reason"))
+        self._adapter_evict_children = {}
+        self._adapter_requests = r.counter(
+            "mxtpu_llm_adapter_requests_total",
+            "Generations admitted under each LoRA adapter (base-model "
+            "requests create no series).", ("server", "adapter"))
+        self._adapter_req_children = {}
+        self._adapter_publishes = r.counter(
+            "mxtpu_llm_adapter_publish_total",
+            "Adapter versions hot-published into the bank (fine-tune "
+            "loop or direct publish).", lbl).labels(**s)
+        self._tenant_adapter_requests = r.counter(
+            "mxtpu_llm_tenant_adapter_requests_total",
+            "Adapter-tagged generations attributed per tenant (tagged "
+            "requests only).", ("server", "tenant", "adapter"))
+        self._tenant_adapter_children = {}
         # the overload/failure series share the single-shot server's
         # mxtpu_serving_* catalog (one dashboard for both front ends)
         self._overload = OverloadStats(r, self._server)
@@ -280,6 +305,30 @@ class LLMStats:
     def record_failure(self, n=1):
         self._failed.inc(n)
 
+    # ------------------------------------------------ adapter series --
+    def record_adapters_resident(self, n):
+        self._adapters_resident.set(n)
+
+    def record_adapter_evicted(self, reason, n=1):
+        self._labeled_child(self._adapter_evictions,
+                            self._adapter_evict_children,
+                            reason=str(reason)).inc(n)
+
+    def record_adapter_request(self, adapter, tenant=None):
+        """One generation admitted under ``adapter`` — attributed per
+        tenant too when the request is tenant-tagged."""
+        self._labeled_child(self._adapter_requests,
+                            self._adapter_req_children,
+                            adapter=str(adapter)).inc()
+        if tenant is not None:
+            self._labeled_child(self._tenant_adapter_requests,
+                                self._tenant_adapter_children,
+                                tenant=str(tenant),
+                                adapter=str(adapter)).inc()
+
+    def record_adapter_publish(self, n=1):
+        self._adapter_publishes.inc(n)
+
     # ------------------------------------------------- tenant series --
     def record_tenant(self, tenant, outcome, n=1):
         """Per-tenant outcome attribution (no-op for tenant None)."""
@@ -343,5 +392,15 @@ class LLMStats:
                     "p50": self._latency.percentile(50) * 1e3,
                     "p99": self._latency.percentile(99) * 1e3,
                 },
+                "adapters_resident": int(
+                    self._adapters_resident.value),
+                "adapter_publishes": int(
+                    self._adapter_publishes.value),
+                "adapter_evictions": {
+                    k[0][1]: int(c.value) for k, c in
+                    self._adapter_evict_children.items()},
+                "adapter_requests": {
+                    k[0][1]: int(c.value) for k, c in
+                    self._adapter_req_children.items()},
                 "tenants": self._tenants.snapshot(),
             })

@@ -30,8 +30,28 @@ import numpy as np
 from ...ops.ragged_attention import (ragged_paged_attention,
                                      ragged_flat_attention)
 from ...ops.flash_attention import attention_reference
+from ...ops.lora import (paged_lora_delta, gather_adapter,
+                         PROJ_Q, PROJ_K, PROJ_V, PROJ_O)
 
 __all__ = ["DecoderConfig", "TinyDecoder", "greedy_decode_reference"]
+
+
+def _lora_all_rows(x2d, a_sel, b_sel, li, proj, scale):
+    """Single-adapter LoRA delta for every row of ``x2d [N, d]`` —
+    the oracle-side twin of the flat step's per-token gather:
+    ``a_sel/b_sel [P, L, 4, d|r, r|d]`` are one adapter's padded
+    factor pages (:meth:`AdapterBank.adapter_arrays`), broadcast to
+    every row so the einsum structure matches
+    :func:`~...ops.lora.paged_lora_delta` exactly."""
+    import jax.numpy as jnp
+    n = x2d.shape[0]
+    a = a_sel[:, li, proj]                       # [P, d, r]
+    b = b_sel[:, li, proj]                       # [P, r, d]
+    return paged_lora_delta(
+        x2d,
+        jnp.broadcast_to(a[None], (n,) + a.shape),
+        jnp.broadcast_to(b[None], (n,) + b.shape),
+        jnp.full((n,), scale, x2d.dtype))
 
 
 class DecoderConfig:
@@ -138,29 +158,53 @@ class TinyDecoder:
         }
 
     # ------------------------------------------------------ prefill --
-    def forward(self, params, tokens):
+    def forward(self, params, tokens, lora=None):
         """Dense causal forward. tokens: int32 [B, T] (T <=
         max_context). Returns (logits [B, T, V], k, v) with k/v
         [L, B, T, H, Dh] — the KV the prefill path writes into pages.
+
+        ``lora``: optional single-adapter factors ``(a_sel, b_sel,
+        scale)`` as returned by ``AdapterBank.adapter_arrays`` —
+        applied to every row (the per-adapter oracle of the flat
+        step's per-token dispatch).
         """
         import jax
         import jax.numpy as jnp
         c = self.config
         B, T = tokens.shape
         h = params["embed"][tokens] + params["pos"][:T][None, :, :]
+        if lora is not None:
+            la, lb, lscale = (jnp.asarray(lora[0]), jnp.asarray(lora[1]),
+                              lora[2])
         ks, vs = [], []
-        for lp in params["layers"]:
+        for li, lp in enumerate(params["layers"]):
             x = _layer_norm(h, lp["ln1_g"], lp["ln1_b"])
-            q = (x @ lp["wq"]).reshape(B, T, c.num_heads, c.head_dim)
-            k = (x @ lp["wk"]).reshape(B, T, c.num_heads, c.head_dim)
-            v = (x @ lp["wv"]).reshape(B, T, c.num_heads, c.head_dim)
+            q = x @ lp["wq"]
+            k = x @ lp["wk"]
+            v = x @ lp["wv"]
+            if lora is not None:
+                x2d = x.reshape(B * T, c.d_model)
+                q = q + _lora_all_rows(x2d, la, lb, li, PROJ_Q,
+                                       lscale).reshape(B, T, c.d_model)
+                k = k + _lora_all_rows(x2d, la, lb, li, PROJ_K,
+                                       lscale).reshape(B, T, c.d_model)
+                v = v + _lora_all_rows(x2d, la, lb, li, PROJ_V,
+                                       lscale).reshape(B, T, c.d_model)
+            q = q.reshape(B, T, c.num_heads, c.head_dim)
+            k = k.reshape(B, T, c.num_heads, c.head_dim)
+            v = v.reshape(B, T, c.num_heads, c.head_dim)
             ks.append(k)
             vs.append(v)
             att = attention_reference(
                 q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                 v.transpose(0, 2, 1, 3), causal=True)
             att = att.transpose(0, 2, 1, 3).reshape(B, T, c.d_model)
-            h = h + att @ lp["wo"]
+            o = att @ lp["wo"]
+            if lora is not None:
+                o = o + _lora_all_rows(att.reshape(B * T, c.d_model),
+                                       la, lb, li, PROJ_O,
+                                       lscale).reshape(B, T, c.d_model)
+            h = h + o
             x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
             h = h + jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"] \
                 + lp["b2"]
@@ -226,7 +270,7 @@ class TinyDecoder:
 
     def decode_flat(self, params, tokens, positions, seq_ids, valid,
                     k_pages, v_pages, block_tables, k_scales=None,
-                    v_scales=None):
+                    v_scales=None, adapter=None):
         """The FLAT ragged step: a packed ``[T]`` batch of query
         tokens from many sequences — no per-sequence padding, so a
         mixed prefill/decode/verify step computes exactly the tokens
@@ -251,6 +295,15 @@ class TinyDecoder:
         written value, so a cached (prefix-shared) block holds exactly
         the bytes a recomputing sequence would produce. Returns
         (logits, k_pages, v_pages, k_scales, v_scales).
+
+        Multi-LoRA (ISSUE 17): ``adapter = (a_pages, b_pages,
+        a_tables, a_scales)`` — the bank's factor pools plus a
+        per-SEQUENCE page-table row ``a_tables [S, P]`` int32 and
+        scale ``a_scales [S]`` f32, all traced. Each token gathers
+        its row's factor pages (``a_tables[seq_ids]``) and adds the
+        low-rank delta to the four attention projections; rows whose
+        table is all null page 0 (scale 0) get an exact-zero delta —
+        one program serves any adapter mix.
         """
         import jax
         import jax.numpy as jnp
@@ -263,12 +316,28 @@ class TinyDecoder:
             vmask,
             block_tables[seq_ids, positions // bs], 0)  # null block
         slot = jnp.where(vmask, positions % bs, 0)
+        if adapter is not None:
+            la_pages, lb_pages, a_tables, a_scales = adapter
+            pages_tok = a_tables[seq_ids]               # [T, P]
+            scale_tok = a_scales[seq_ids]               # [T]
+
+            def _delta(x2d, li, proj):
+                return paged_lora_delta(
+                    x2d, *gather_adapter(la_pages, lb_pages, pages_tok,
+                                         li, proj), scale_tok)
         h = params["embed"][tokens] + params["pos"][positions]
         for li, lp in enumerate(params["layers"]):
             x = _layer_norm(h, lp["ln1_g"], lp["ln1_b"])
-            q = (x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
-            k = (x @ lp["wk"]).reshape(T, c.num_heads, c.head_dim)
-            v = (x @ lp["wv"]).reshape(T, c.num_heads, c.head_dim)
+            q = x @ lp["wq"]
+            k = x @ lp["wk"]
+            v = x @ lp["wv"]
+            if adapter is not None:
+                q = q + _delta(x, li, PROJ_Q)
+                k = k + _delta(x, li, PROJ_K)
+                v = v + _delta(x, li, PROJ_V)
+            q = q.reshape(T, c.num_heads, c.head_dim)
+            k = k.reshape(T, c.num_heads, c.head_dim)
+            v = v.reshape(T, c.num_heads, c.head_dim)
             if quantized:
                 ksc = jnp.maximum(
                     jnp.max(jnp.abs(k), axis=-1) / 127.0, 1e-8)
@@ -295,7 +364,11 @@ class TinyDecoder:
                                             v_pages[li],
                                             block_tables, seq_ids,
                                             positions)
-            h = h + att.reshape(T, c.d_model) @ lp["wo"]
+            att2d = att.reshape(T, c.d_model)
+            o = att2d @ lp["wo"]
+            if adapter is not None:
+                o = o + _delta(att2d, li, PROJ_O)
+            h = h + o
             x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
             h = h + jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"] \
                 + lp["b2"]
@@ -320,24 +393,40 @@ class TinyDecoder:
         return logits[:, 0], k_pages, v_pages
 
 
-def _incremental_step(model, params, token, pos, k_cache, v_cache):
+def _incremental_step(model, params, token, pos, k_cache, v_cache,
+                      lora=None):
     """One appended token against a dense (non-paged) KV cache —
     the eager oracle's decode step. token/pos: int32 scalars; caches:
     [L, max_context, H, Dh]. Writes the token's K/V at ``pos``, then
-    attends over positions ``<= pos``. Returns (logits [V], k_cache,
+    attends over positions ``<= pos``. ``lora``: optional
+    ``(a_sel, b_sel, scale)`` single-adapter factors (same layout as
+    :meth:`TinyDecoder.forward`). Returns (logits [V], k_cache,
     v_cache). Pure function of its inputs (jitted once per model)."""
     import jax
     import jax.numpy as jnp
     from ...ops.flash_attention import _NEG_INF
     c = model.config
-    scale = float(1.0 / (c.head_dim ** 0.5))
+    scale = 1.0 / (c.head_dim ** 0.5)      # python float: config-time
     mask = jnp.arange(c.max_context, dtype=jnp.int32) <= pos
+    if lora is not None:
+        la, lb, lscale = lora
+
+        def _ldelta(x1d, li, proj):
+            return _lora_all_rows(x1d[None], la, lb, li, proj,
+                                  lscale)[0]
     h = params["embed"][token] + params["pos"][pos]
     for li, lp in enumerate(params["layers"]):
         x = _layer_norm(h, lp["ln1_g"], lp["ln1_b"])
-        q = (x @ lp["wq"]).reshape(c.num_heads, c.head_dim)
-        k = (x @ lp["wk"]).reshape(c.num_heads, c.head_dim)
-        v = (x @ lp["wv"]).reshape(c.num_heads, c.head_dim)
+        q = x @ lp["wq"]
+        k = x @ lp["wk"]
+        v = x @ lp["wv"]
+        if lora is not None:
+            q = q + _ldelta(x, li, PROJ_Q)
+            k = k + _ldelta(x, li, PROJ_K)
+            v = v + _ldelta(x, li, PROJ_V)
+        q = q.reshape(c.num_heads, c.head_dim)
+        k = k.reshape(c.num_heads, c.head_dim)
+        v = v.reshape(c.num_heads, c.head_dim)
         k_cache = k_cache.at[li, pos].set(k)
         v_cache = v_cache.at[li, pos].set(v)
         s = jnp.einsum("hd,thd->ht", q.astype(jnp.float32),
@@ -346,7 +435,11 @@ def _incremental_step(model, params, token, pos, k_cache, v_cache):
         p = jax.nn.softmax(s, axis=-1)
         att = jnp.einsum("ht,thd->hd", p,
                          v_cache[li].astype(jnp.float32)).astype(h.dtype)
-        h = h + att.reshape(c.d_model) @ lp["wo"]
+        att1d = att.reshape(c.d_model)
+        o = att1d @ lp["wo"]
+        if lora is not None:
+            o = o + _ldelta(att1d, li, PROJ_O)
+        h = h + o
         x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
         h = h + jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"] \
             + lp["b2"]
@@ -356,7 +449,8 @@ def _incremental_step(model, params, token, pos, k_cache, v_cache):
 
 
 def greedy_decode_reference(model, params, prompt_tokens,
-                            max_new_tokens, stop_token=None):
+                            max_new_tokens, stop_token=None,
+                            lora=None):
     """Per-sequence eager greedy decoding — the oracle continuous
     batching must match token for token.
 
@@ -370,19 +464,42 @@ def greedy_decode_reference(model, params, prompt_tokens,
     fraction of the work — parity suites stop paying a full padded
     forward per emitted token. Returns the generated tokens (prompt
     excluded) as a list.
+
+    ``lora``: optional single-adapter ``(a_sel, b_sel, scale)`` from
+    ``AdapterBank.adapter_arrays`` — the per-adapter oracle for
+    mixed-adapter engine batches (the factors ride the separately
+    cached ``_incr_jit_lora`` as traced arguments, so sweeping
+    adapters never recompiles either oracle).
     """
     import jax
     import jax.numpy as jnp
     toks = [int(t) for t in prompt_tokens]
     out = []
     ctx = model.max_context
-    step = getattr(model, "_incr_jit", None)
-    if step is None:
-        step = jax.jit(functools.partial(_incremental_step, model))
-        model._incr_jit = step
+    if lora is None:
+        step = getattr(model, "_incr_jit", None)
+        if step is None:
+            step = jax.jit(functools.partial(_incremental_step, model))
+            model._incr_jit = step
+    else:
+        la, lb, lscale = (jnp.asarray(lora[0]), jnp.asarray(lora[1]),
+                          np.float32(lora[2]))
+        lstep = getattr(model, "_incr_jit_lora", None)
+        if lstep is None:
+            def _lora_step(params, token, pos, kc, vc, a, b, s,
+                           _model=model):
+                return _incremental_step(_model, params, token, pos,
+                                         kc, vc, lora=(a, b, s))
+            lstep = jax.jit(_lora_step)
+            model._incr_jit_lora = lstep
+
+        def step(params, token, pos, kc, vc):
+            return lstep(params, token, pos, kc, vc, la, lb, lscale)
     padded = np.zeros(ctx, np.int32)
     padded[:len(toks)] = toks
-    logits, k, v = model.forward(params, jnp.asarray(padded[None]))
+    logits, k, v = model.forward(
+        params, jnp.asarray(padded[None]),
+        lora=None if lora is None else (la, lb, lscale))
     # positions past the prompt hold pad garbage; each is overwritten
     # by the incremental step that lands there before any mask
     # exposes it

@@ -51,10 +51,11 @@ class Sequence:
                  "future", "span", "finish_reason", "deadline",
                  "cancelled", "tenant", "sampling", "draft_len",
                  "prefill_started", "prefix_hashes",
-                 "cache_hit_tokens")
+                 "cache_hit_tokens", "adapter", "adapter_handle")
 
     def __init__(self, prompt_tokens, max_new_tokens, stop_token=None,
-                 deadline=None, tenant=None, sampling=None):
+                 deadline=None, tenant=None, sampling=None,
+                 adapter=None):
         self.seq_id = next(_seq_ids)
         self.prompt = [int(t) for t in prompt_tokens]
         if not self.prompt:
@@ -114,6 +115,13 @@ class Sequence:
         # prefill work the sequence never paid (credited on
         # mxtpu_llm_prefill_tokens_saved_total)
         self.cache_hit_tokens = 0
+        # LoRA adapter name this request decodes under (None = base
+        # model); the engine resolves it to an AdapterHandle at
+        # admission, pinning one published version for the sequence's
+        # whole life — preemption deliberately KEEPS the handle, so
+        # re-prefill after a mid-flight republish stays bit-identical
+        self.adapter = adapter
+        self.adapter_handle = None
 
     def expired(self, now=None):
         if self.deadline is None:

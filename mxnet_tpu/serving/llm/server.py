@@ -54,6 +54,7 @@ import numpy as np
 
 from ..errors import (DeadlineExceededError, Overloaded,
                       SequenceEvictedError, ServerClosed)
+from ..adapters.bank import UnknownAdapterError
 from ..envutil import env_float as _env_float
 from ..overload import (CircuitBreaker, resolve_deadline,
                         resolve_overload_knobs, shed_if_breaker_open)
@@ -181,7 +182,8 @@ class LLMServer:
         return len(self._pending) + self._engine.scheduler.num_waiting
 
     def submit(self, prompt_tokens, max_new_tokens, stop_token=None,
-               deadline_ms=None, tenant=None, sampling=None):
+               deadline_ms=None, tenant=None, sampling=None,
+               adapter=None):
         """Enqueue one prompt; returns a Future resolving to a
         :class:`GenerationResult` (or raising a typed
         :class:`~..errors.ServingError` subclass:
@@ -200,11 +202,30 @@ class LLMServer:
         and its generated tokens — on the per-tenant series
         ``mxtpu_llm_tenant_requests_total`` /
         ``mxtpu_llm_tenant_tokens_total``; untagged requests create
-        no tenant series."""
+        no tenant series.
+
+        ``adapter`` (optional): the name of a published LoRA adapter
+        to decode under (``None`` = base model). Requires an
+        :class:`~..adapters.AdapterBank` on the engine
+        (``adapter_bank=`` engine kwarg); the name must be resident or
+        in the bank's registry — checked HERE on the caller's thread,
+        so a typo raises at submit, not mid-batch. Adapter selection
+        is traced batch data: mixed-adapter batches (and base-model
+        rows) run in the one warmed program, never recompiling."""
         if isinstance(sampling, dict):
             sampling = SamplingParams(**sampling)
         if not self._started:
             raise RuntimeError("server not started; call start()")
+        if adapter is not None:
+            bank = self._engine.bank
+            if bank is None:
+                raise ValueError(
+                    f"adapter={adapter!r} but the engine has no "
+                    "AdapterBank (pass adapter_bank= at construction)")
+            if not bank.known(adapter):
+                raise UnknownAdapterError(
+                    f"adapter {adapter!r} is neither resident nor in "
+                    "the registry")
         try:
             shed_if_breaker_open(self._breaker, self._stats)
             deadline = resolve_deadline(deadline_ms,
@@ -219,7 +240,7 @@ class LLMServer:
         prompt = [int(t) for t in np.asarray(prompt_tokens).ravel()]
         seq = Sequence(prompt, max_new_tokens, stop_token=stop_token,
                        deadline=deadline, tenant=tenant,
-                       sampling=sampling)
+                       sampling=sampling, adapter=adapter)
         # validate shape/vocab NOW, on the caller's thread
         self._engine.add_validate(seq)
         from concurrent.futures import Future
@@ -282,7 +303,7 @@ class LLMServer:
 
     def generate(self, prompt_tokens, max_new_tokens, stop_token=None,
                  timeout=None, deadline_ms=None, reap_timeout=5.0,
-                 tenant=None, sampling=None):
+                 tenant=None, sampling=None, adapter=None):
         """Blocking single-prompt decode through the batcher.
 
         On ``timeout`` the underlying sequence is CANCELLED — its KV
@@ -295,7 +316,8 @@ class LLMServer:
         the typed error after this window instead)."""
         fut = self.submit(prompt_tokens, max_new_tokens,
                           stop_token=stop_token, deadline_ms=deadline_ms,
-                          tenant=tenant, sampling=sampling)
+                          tenant=tenant, sampling=sampling,
+                          adapter=adapter)
         from concurrent.futures import TimeoutError as FuturesTimeout
         try:
             return fut.result(timeout=timeout)
@@ -332,6 +354,8 @@ class LLMServer:
         lookups = snap.get("prefix_lookups", 0)
         snap["prefix_hit_rate"] = (snap.get("prefix_hits", 0) / lookups
                                    if lookups else 0.0)
+        if self._engine.bank is not None:
+            snap["adapters"] = self._engine.bank.stats()
         return snap
 
     # --------------------------------------------------------- drain --

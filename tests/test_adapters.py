@@ -1,0 +1,512 @@
+"""mxnet_tpu.serving.adapters: multi-LoRA serving (ISSUE 17).
+
+The multi-adapter contract pinned here:
+
+- a MIXED-adapter continuous batch (different adapters per row,
+  including adapter-less base-model rows) is BIT-IDENTICAL, token for
+  token, to per-adapter eager decoding with the same factors — one
+  fixed-shape program serves every combination;
+- the prefix cache is adapter-NAMESPACED: the same prompt under the
+  same adapter hits, under a different adapter (or the base model)
+  never cross-hits, and hits stay bit-exact;
+- adapter churn — publish, serve, evict, registry fault-in, republish
+  — compiles NOTHING after warmup (the backend_compile counter must
+  not move);
+- the AdapterBank survives a 1k-step randomized publish/acquire/
+  release/evict storm against a shadow refcount model with its
+  ``check()`` partition invariant intact throughout;
+- a worker death with live shared adapters resolves every Future,
+  settles every refcount to zero users and leaks no pages or blocks;
+- ``FleetRouter.submit(..., adapter=...)`` plumbs through to the
+  backing ``LLMServer`` untouched.
+
+Tier-1 budget: ONE module-scoped warmed engine carries the parity,
+prefix and churn tests; the chaos/fleet servers reuse the same model
+object + geometry, so their warmups hit the model's program cache and
+compile nothing. The speculative-decode parity sweep compiles a fresh
+lora+spec program set and is marked slow.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mxnet_tpu import serving  # noqa: E402
+from mxnet_tpu.serving import ServerClosed  # noqa: E402
+from mxnet_tpu.serving.llm import (  # noqa: E402
+    TinyDecoder, DecoderConfig, LLMEngine, LLMServer, Sequence,
+    greedy_decode_reference)
+from mxnet_tpu.serving.adapters import (  # noqa: E402
+    AdapterBank, AdapterRegistry, UnknownAdapterError,
+    NoFreeAdapterPagesError, AdapterAccountingError)
+from mxnet_tpu.resilience import faults  # noqa: E402
+
+VOCAB = 17
+BS = 8          # KV block size
+CTX = 32   # small shapes: the module's one lora program set compiles fast
+L = 2           # num_layers
+D = 16          # d_model
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TinyDecoder(DecoderConfig(
+        vocab_size=VOCAB, d_model=D, num_layers=L, num_heads=2,
+        d_ff=32, max_context=CTX))
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init_params(seed=0)
+
+
+def _factors(seed, rank, layers=L, d_model=D, scale=0.05):
+    rng = np.random.RandomState(seed)
+    a = (rng.randn(layers, 4, d_model, rank) * scale).astype(np.float32)
+    b = (rng.randn(layers, 4, rank, d_model) * scale).astype(np.float32)
+    return a, b
+
+
+@pytest.fixture(scope="module")
+def bank(tmp_path_factory):
+    """Module bank: 'ada' (rank 4, one page), 'bob' (rank 8, two
+    pages, explicit alpha), backed by an on-disk registry so capacity
+    evictions can always fault adapters back in."""
+    reg = AdapterRegistry(tmp_path_factory.mktemp("adapter_reg"),
+                          num_shards=2)
+    bk = AdapterBank(L, D, max_adapters=4, page_rank=4, registry=reg)
+    a, b = _factors(1, 4)
+    bk.publish("ada", a, b)
+    a, b = _factors(2, 8)
+    bk.publish("bob", a, b, alpha=4.0)
+    return bk
+
+
+@pytest.fixture(scope="module")
+def engine(model, params, bank):
+    """THE warmed engine every in-process test shares (tier-1 budget:
+    one lora program set for the module)."""
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefix_cache=True,
+                    adapter_bank=bank)
+    eng.warmup()
+    return eng
+
+
+def _run_all(eng, seqs, stagger_from=None):
+    """Drive seqs to completion; optionally inject the tail mid-run
+    (staggered admission churns mixed-adapter batch composition)."""
+    cut = len(seqs) if stagger_from is None else stagger_from
+    for s in seqs[:cut]:
+        eng.add(s)
+    injected = cut
+    steps = 0
+    while eng.has_work() or injected < len(seqs):
+        if injected < len(seqs) and (steps % 2 == 0
+                                     or not eng.has_work()):
+            eng.add(seqs[injected])
+            injected += 1
+        eng.step()
+        steps += 1
+        assert steps < 2000
+    return steps
+
+
+def _oracle(model, params, bank, prompt, n, adapter):
+    lora = None if adapter is None else bank.adapter_arrays(adapter)
+    return greedy_decode_reference(model, params, prompt, n, lora=lora)
+
+
+# --------------------------------------- mixed-adapter bit-exactness --
+def test_mixed_adapter_batch_bit_identical(model, params, bank, engine):
+    """>= 8 sequences under 3 different adapters AND base-model rows
+    (null page), ragged prompts, staggered admission: every token
+    stream equals per-adapter eager decoding exactly, and the bank's
+    accounting drains to zero users."""
+    before = bank.stats()
+    rng = np.random.RandomState(11)
+    adapters = [None, "ada", "bob", None, "ada", "bob", "ada", None]
+    cases = []
+    for i, ad in enumerate(adapters):
+        plen = (BS - 1, BS, BS + 1)[i] if i < 3 else int(
+            rng.randint(1, 21))
+        prompt = rng.randint(0, VOCAB, size=plen).tolist()
+        cases.append((prompt, int(rng.randint(2, 9)), ad))
+    seqs = [Sequence(p, n, adapter=ad) for p, n, ad in cases]
+    _run_all(engine, seqs, stagger_from=4)
+    for (prompt, n, ad), s in zip(cases, seqs):
+        assert s.state == "finished"
+        ref = _oracle(model, params, bank, prompt, n, ad)
+        assert s.output_tokens() == ref, \
+            f"seq {s.seq_id} (adapter {ad!r}) diverged"
+    after = bank.stats()
+    assert after["in_use"] == 0
+    # one acquire per adapter-carrying admission, all from residency
+    n_ad = sum(1 for ad in adapters if ad is not None)
+    assert after["acquires"] - before["acquires"] == n_ad
+    assert after["registry_loads"] == before["registry_loads"]
+    assert bank.check()
+    assert engine.cache.allocator.num_used == 0
+
+
+# ------------------------------------ adapter-namespaced prefix cache --
+def test_prefix_cache_is_adapter_namespaced(model, params, bank,
+                                            engine):
+    """Same prompt, four namespaces: a repeat under the SAME adapter
+    hits the cache (bit-exact), the same prompt under a DIFFERENT
+    adapter or the base model never cross-hits — the pinned
+    (name, version) salts the block hash chain."""
+    rng = np.random.RandomState(23)
+    prompt = rng.randint(0, VOCAB, size=2 * BS + 1).tolist()
+
+    # wave 1 seeds two namespaces (cold: zero hits)
+    lk0, h0 = engine.prefix_lookups, engine.prefix_hits
+    w1 = [Sequence(prompt, 5, adapter="ada"), Sequence(prompt, 5)]
+    _run_all(engine, w1)
+    assert engine.prefix_lookups == lk0 + 2
+    assert engine.prefix_hits == h0
+
+    # wave 2: same-namespace repeats hit, "bob" must not cross-hit
+    w2 = [Sequence(prompt, 5, adapter="ada"),
+          Sequence(prompt, 5, adapter="bob"),
+          Sequence(prompt, 5)]
+    _run_all(engine, w2)
+    assert engine.prefix_lookups == lk0 + 5
+    assert engine.prefix_hits == h0 + 2
+    assert w2[0].cache_hit_tokens == 2 * BS      # ada @ ada: hit
+    assert w2[1].cache_hit_tokens == 0           # bob: own namespace
+    assert w2[2].cache_hit_tokens == 2 * BS      # base @ base: hit
+
+    for s, ad in zip(w1 + w2, ["ada", None, "ada", "bob", None]):
+        assert s.output_tokens() == _oracle(model, params, bank,
+                                            prompt, 5, ad), \
+            f"adapter {ad!r} (hit={s.cache_hit_tokens}) diverged"
+    assert bank.stats()["in_use"] == 0 and bank.check()
+
+
+# ----------------------------------------- zero-recompile churn pin ---
+def test_adapter_churn_never_recompiles(model, params, bank, engine):
+    """Publish a NEW adapter, serve it, evict it cold, fault it back
+    in from the registry, republish a live name mid-flight — the
+    backend_compile counter must not move once."""
+    rng = np.random.RandomState(31)
+    prompt = rng.randint(0, VOCAB, size=9).tolist()
+    with serving.CompileCounter() as cc:
+        bank.publish("cal", *_factors(3, 2))        # rank 2: tail-pad
+        s = Sequence(prompt, 4, adapter="cal")
+        _run_all(engine, [s])
+        assert s.output_tokens() == _oracle(model, params, bank,
+                                            prompt, 4, "cal")
+        bank.evict("cal")                           # cold: evictable
+        assert "cal" not in bank.names()
+        loads0 = bank.stats()["registry_loads"]
+        s2 = Sequence(prompt, 4, adapter="cal")     # registry fault-in
+        _run_all(engine, [s2])
+        assert bank.stats()["registry_loads"] == loads0 + 1
+        v2 = bank.publish("ada", *_factors(41, 4))  # republish live name
+        assert bank.resident_version("ada") == v2
+        s3 = Sequence(prompt, 4, adapter="ada")     # serves v2
+        _run_all(engine, [s3])
+        assert s3.output_tokens() == _oracle(model, params, bank,
+                                             prompt, 4, "ada")
+    assert cc.count == 0, \
+        f"{cc.count} XLA recompiles during adapter churn"
+    assert bank.check()
+
+
+# ------------------------------------------- engine-level poison path --
+def test_unknown_adapter_poisons_without_leaking(model, params, bank,
+                                                engine):
+    """Server.submit validates names up front; a sequence that still
+    reaches admission with an unknown adapter is poison-isolated —
+    released typed, no KV blocks or adapter pins left behind."""
+    st0 = bank.stats()
+    s = Sequence([1, 2, 3], 4, adapter="ghost")
+    engine.add(s)
+    steps = 0
+    while engine.has_work():
+        engine.step()
+        steps += 1
+        assert steps < 50
+    assert s.state == "evicted" and s.finish_reason == "poison"
+    seq, exc = engine._poison_pending.pop()
+    assert seq is s and isinstance(exc, UnknownAdapterError)
+    st1 = bank.stats()
+    assert st1["in_use"] == 0
+    assert st1["pages_used"] == st0["pages_used"]
+    assert engine.cache.allocator.num_used == 0
+
+
+def test_submit_adapter_requires_bank(model, params):
+    """adapter= against a bank-less server is a caller-thread
+    ValueError before any engine work (the idle worker compiles
+    nothing)."""
+    srv = LLMServer(model, params, name="adapters_nobank", max_seqs=4,
+                    block_size=BS, max_context=CTX, prefix_cache=True)
+    srv.start()
+    try:
+        with pytest.raises(ValueError, match="no AdapterBank"):
+            srv.submit([1, 2], 2, adapter="ada")
+    finally:
+        srv.shutdown(drain=False)
+
+
+# --------------------------------------------- 1k-step bank fuzzing ---
+class _ShadowFull(Exception):
+    pass
+
+
+class _ShadowBank:
+    """Host-side replica of the AdapterBank's accounting — refcounts,
+    page ownership AND the cold-LRU order (a publish under pressure
+    capacity-evicts oldest-idle residents, so predicting exactly which
+    names survive requires mirroring the LRU, not just counting)."""
+
+    def __init__(self, pages_total):
+        import collections
+        self.pages_total = pages_total
+        self.resident = {}     # name -> current version
+        self.users = {}        # (name, version) -> in-flight pins
+        self.npages = {}       # (name, version) -> pages (held while
+        #                        current, or detached with users > 0)
+        self.cold = collections.OrderedDict()   # oldest-idle first
+
+    def free_pages(self):
+        return self.pages_total - sum(self.npages.values())
+
+    def retire(self, name):
+        v = self.resident.pop(name)
+        self.cold.pop(name, None)
+        if self.users.get((name, v), 0) == 0:   # fully idle: pages back
+            self.users.pop((name, v), None)
+            self.npages.pop((name, v), None)
+        # else: detached — pages drain with its last release
+
+    def publish(self, name, need, version):
+        old = self.resident.get(name)
+        if old is not None and self.users.get((name, old), 0) == 0:
+            self.retire(name)       # cold old version retires up front
+            old = None
+        while self.free_pages() < need:
+            victim = next(iter(self.cold), None)
+            if victim is None:      # NB: the cold LRU is already
+                raise _ShadowFull   # drained at this point
+            self.retire(victim)
+        if old is not None:
+            self.retire(name)       # live old version: detach
+        self.resident[name] = version
+        self.npages[(name, version)] = need
+        self.users.setdefault((name, version), 0)
+        self.cold[name] = None
+
+    def acquire(self, name):
+        v = self.resident[name]
+        self.users[(name, v)] += 1
+        self.cold.pop(name, None)
+        return v
+
+    def release(self, name, v):
+        self.users[(name, v)] -= 1
+        if self.users[(name, v)] == 0:
+            if self.resident.get(name) == v:
+                self.cold[name] = None          # most-recently idle
+            else:                               # detached: drained
+                self.users.pop((name, v))
+                self.npages.pop((name, v))
+
+
+def test_adapter_bank_fuzz_shadow_refcounts():
+    """1000 randomized publish/acquire/release/evict steps against the
+    shadow model on a deliberately tiny pool (3 adapters x 2 pages of
+    rank 2): every typed error fires exactly when the shadow says it
+    must, capacity evictions hit exactly the adapters the shadow LRU
+    predicts, ``check()`` holds throughout, and the final drain
+    returns every page."""
+    rng = np.random.RandomState(7)
+    dL, dD = 2, 8
+    bk = AdapterBank(dL, dD, max_adapters=3, page_rank=2,
+                     max_pages_per_adapter=2)
+    sh = _ShadowBank(bk.stats()["pages_total"])
+    names = [f"f{i}" for i in range(6)]
+    live = []                        # (name, version, handle)
+
+    for step in range(1000):
+        op = int(rng.randint(4))
+        if op == 0:                  # publish / republish
+            name = names[int(rng.randint(len(names)))]
+            rank = int(rng.randint(1, 5))
+            a = (rng.randn(dL, 4, dD, rank) * 0.01).astype(np.float32)
+            b = (rng.randn(dL, 4, rank, dD) * 0.01).astype(np.float32)
+            need = -(-rank // 2)
+            try:
+                v = bk.publish(name, a, b, persist=False)
+            except NoFreeAdapterPagesError:
+                v = None
+            # replay on the shadow: same evictions, same outcome —
+            # a FAILED publish still drains the whole cold LRU (and a
+            # cold old version of the name itself), a successful one
+            # evicts exactly the oldest-idle residents it needed
+            try:
+                sh.publish(name, need, v)
+                assert v is not None, \
+                    f"step {step}: bank pool-full, shadow fits {need}"
+            except _ShadowFull:
+                assert v is None, \
+                    f"step {step}: shadow pool-full, bank fit {need}"
+        elif op == 1:                # acquire
+            res = bk.names()
+            if res:
+                name = res[int(rng.randint(len(res)))]
+                h = bk.acquire(name)
+                assert h.version == sh.acquire(name)
+                live.append((name, h.version, h))
+            elif step % 7 == 0:      # no registry: typed unknown
+                with pytest.raises(UnknownAdapterError):
+                    bk.acquire("nope")
+        elif op == 2:                # release a random pin
+            if live:
+                name, v, h = live.pop(int(rng.randint(len(live))))
+                bk.release(h)
+                sh.release(name, v)
+        else:                        # evict
+            res = bk.names()
+            if res:
+                name = res[int(rng.randint(len(res)))]
+                v = sh.resident[name]
+                if sh.users.get((name, v), 0) > 0:
+                    with pytest.raises(AdapterAccountingError):
+                        bk.evict(name)
+                else:
+                    bk.evict(name)
+                    sh.retire(name)
+            else:
+                with pytest.raises(UnknownAdapterError):
+                    bk.evict("f0")
+        assert sorted(sh.resident) == bk.names(), f"step {step}"
+        if step % 50 == 0:
+            assert bk.check()
+            st = bk.stats()
+            assert st["resident"] == len(sh.resident)
+            assert st["cold"] == len(sh.cold)
+            assert st["in_use"] == sum(
+                1 for n, v in sh.resident.items()
+                if sh.users[(n, v)] > 0)
+            assert st["detached"] == sum(
+                1 for (n, v), u in sh.users.items()
+                if u > 0 and sh.resident.get(n) != v)
+            assert st["pages_used"] == sum(sh.npages.values())
+
+    for name, v, h in live:          # drain: every pin released
+        bk.release(h)
+        sh.release(name, v)
+    for name in bk.names():
+        bk.evict(name)
+    st = bk.stats()
+    assert st["pages_used"] == 0 and st["resident"] == 0 \
+        and st["detached"] == 0
+    assert bk.check()
+
+
+# ------------------------------------------------ chaos: worker death --
+def test_worker_death_with_live_adapters_settles_refcounts(
+        model, params, bank):
+    """InjectedCrash mid-loop while adapter-carrying requests are in
+    flight: every Future resolves typed, the shared bank's refcounts
+    settle to zero users (no leaked pins, partition invariant holds)
+    and the KV pool is clean. Same model + geometry as the module
+    engine, so warmup compiles nothing."""
+    srv = LLMServer(model, params, name="adapters_chaos", max_seqs=4,
+                    block_size=BS, max_context=CTX, prefix_cache=True,
+                    adapter_bank=bank)
+    srv.warmup()
+    srv.start()
+    try:
+        faults.crash_at_point("llm.worker", nth=2)
+        futs = [srv.submit([1 + i, 2, 3], 10, adapter=ad)
+                for i, ad in enumerate(["ada", "bob", None, "ada"])]
+        for f in futs:
+            try:
+                f.result(timeout=30)
+            except BaseException:
+                pass                     # typed resolution is enough
+    finally:
+        faults.reset()
+    deadline = time.monotonic() + 10
+    while srv.running and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(ServerClosed):
+        srv.submit([1], 1, adapter="ada")
+    st = bank.stats()
+    assert st["in_use"] == 0, "crash leaked adapter pins"
+    assert st["detached"] == 0
+    assert bank.check()
+    assert srv.engine.cache.allocator.num_used == 0
+    assert srv.engine.cache.check(live_block_ids=[])
+
+
+# ------------------------------------------------ fleet plumb-through --
+def test_fleet_router_plumbs_adapter_through(model, params, bank):
+    """FleetRouter.submit(..., adapter=...) reaches the backing
+    LLMServer untouched: routed generation matches the per-adapter
+    oracle; unknown names fail typed at the router's front door."""
+    srv = LLMServer(model, params, name="adapters_fleet", max_seqs=4,
+                    block_size=BS, max_context=CTX, prefix_cache=True,
+                    adapter_bank=bank)
+    srv.warmup()
+    srv.start()
+    router = serving.FleetRouter(name="fleet_adapters")
+    router.add_model("chat", srv, version=1)
+    try:
+        prompt = [3, 1, 4, 1, 5]
+        out = router.generate("chat", prompt, 6, adapter="bob",
+                              timeout=60, tenant="acme")
+        assert out.tokens == _oracle(model, params, bank, prompt, 6,
+                                     "bob")
+        base = router.generate("chat", prompt, 6, timeout=60)
+        assert base.tokens == _oracle(model, params, bank, prompt, 6,
+                                      None)
+        with pytest.raises(UnknownAdapterError):
+            router.submit("chat", prompt, 2, adapter="ghost")
+    finally:
+        router.shutdown()
+    assert bank.stats()["in_use"] == 0 and bank.check()
+
+
+# ------------------------------------- speculative decoding (slow) ----
+@pytest.mark.slow
+def test_spec_decode_mixed_adapter_parity(model, params, bank):
+    """Speculative decoding with a layer-truncated draft under a
+    MIXED-adapter batch: the base-model draft proposes, the
+    adapter-bearing target verifies, greedy acceptance keeps every
+    stream identical to target-only decoding — so the per-adapter
+    oracle still holds bit-exactly. (Fresh lora+spec program set:
+    the module's one heavyweight compile, hence slow.)"""
+    draft = TinyDecoder(DecoderConfig(
+        vocab_size=VOCAB, d_model=D, num_layers=1, num_heads=2,
+        d_ff=32, max_context=CTX))
+    draft_params = {k: (v if k != "layers" else list(v[:1]))
+                    for k, v in params.items()}
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefix_cache=True,
+                    adapter_bank=bank, draft_model=draft,
+                    draft_params=draft_params, spec_k=2)
+    eng.warmup()
+    rng = np.random.RandomState(47)
+    cases = []
+    for ad in ["ada", "bob", None, "ada"]:
+        prompt = rng.randint(0, VOCAB,
+                             size=int(rng.randint(3, 20))).tolist()
+        cases.append((prompt, int(rng.randint(3, 9)), ad))
+    seqs = [Sequence(p, n, adapter=ad) for p, n, ad in cases]
+    _run_all(eng, seqs, stagger_from=2)
+    for (prompt, n, ad), s in zip(cases, seqs):
+        assert s.state == "finished"
+        assert s.output_tokens() == _oracle(model, params, bank,
+                                            prompt, n, ad), \
+            f"spec-decode diverged under adapter {ad!r}"
+    assert bank.stats()["in_use"] == 0 and bank.check()
+    assert eng.cache.allocator.num_used == 0

@@ -90,6 +90,10 @@ case("broadcast_mod", [_pos(3, 4, shift=5.0), _pos(1, 4, seed=1,
 case("_grad_add", [_r(3, 4), _r(3, 4, seed=1)])
 case("_scatter_elemwise_div", [_r(3, 4), _pos(3, 4, seed=1)])
 case("_npi_powerd", [_pos(3, 4), _pos(3, 4, seed=1, shift=0.5)])
+# LoRA delta (serving/adapters fine-tune path): grads flow into x AND
+# both low-rank factors
+case("lora_delta", [_r(3, 4), _r(4, 2, seed=1, scale=0.5),
+                    _r(2, 4, seed=2, scale=0.5)], {"alpha": 2.0})
 
 # zero-slope-almost-everywhere rounders: both sides are 0 away from the
 # jumps, so the check is meaningful (inputs kept off half-integers)

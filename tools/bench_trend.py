@@ -193,7 +193,8 @@ def scan_llm(repo=REPO):
         rnd = int(m.group(1)) if m else 0
         row = {"round": rnd, "status": "valid", "tokens_s": None,
                "ttft_p50": None, "ttft_p99": None, "accept": None,
-               "hit_rate": None, "tag": "", "note": ""}
+               "hit_rate": None, "adapters": None, "tag": "",
+               "note": ""}
         try:
             with open(path) as f:
                 rec = json.load(f)
@@ -220,6 +221,18 @@ def scan_llm(repo=REPO):
         # rounds and runs without shared-prefix traffic
         pf = rec.get("prefix") or {}
         row["hit_rate"] = pf.get("hit_rate")
+        # multi-LoRA sweep (ISSUE 17): adapter count of the headline
+        # pass, absent on pre-adapter rounds; the full curve stays in
+        # the artifact's adapters_curve
+        ad = rec.get("adapters") or {}
+        row["adapters"] = ad.get("count")
+        curve = rec.get("adapters_curve") or []
+        if len(curve) > 1:
+            pts = ", ".join(
+                f"{c['adapters']}→{c['tokens_per_sec']}"
+                for c in curve)
+            row["note"] = (row["note"] + " " if row["note"] else "") \
+                + f"lora curve tok/s: {pts}"
         if pf.get("ttft_ms_control"):
             row["note"] = (row["note"] + " " if row["note"] else "") \
                 + (f"saved={pf.get('prefill_tokens_saved')}tok "
@@ -243,8 +256,8 @@ def render_llm(rows):
         return pat % v if v is not None else "—"
     lines = [
         "| round | status | tokens/s | TTFT p50 (ms) | TTFT p99 (ms) "
-        "| accept rate | hit rate | config | note |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "| accept rate | hit rate | adapters | config | note |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         lines.append(
@@ -254,6 +267,7 @@ def render_llm(rows):
             f"| {fmt(r['ttft_p99'], '%.2f')} "
             f"| {fmt(r.get('accept'), '%.3f')} "
             f"| {fmt(r.get('hit_rate'), '%.3f')} "
+            f"| {fmt(r.get('adapters'), '%d')} "
             f"| {r['tag']} | {r['note']} |")
     valid = [r for r in rows if r["status"] == "valid"
              and r["tokens_s"] is not None]

@@ -97,7 +97,8 @@ def _truncated_draft(model, params):
     return draft, dparams
 
 
-def _engine_kw(args, model, params, prefix_cache=None):
+def _engine_kw(args, model, params, prefix_cache=None,
+               adapter_bank=None):
     """Engine sizing + speed knobs shared by both run modes: chunked
     prefill size, KV storage dtype (--kv-dtype int8 = quantized
     pages), prefix caching, and, with --spec-k > 0, the built-in
@@ -107,6 +108,8 @@ def _engine_kw(args, model, params, prefix_cache=None):
               kv_dtype=args.kv_dtype)
     if prefix_cache is not None:
         kw["prefix_cache"] = prefix_cache
+    if adapter_bank is not None:
+        kw["adapter_bank"] = adapter_bank
     if args.prefill_chunk > 0:
         kw["prefill_chunk"] = args.prefill_chunk
     if args.spec_k > 0:
@@ -114,6 +117,43 @@ def _engine_kw(args, model, params, prefix_cache=None):
         kw.update(draft_model=draft, draft_params=dparams,
                   spec_k=args.spec_k)
     return kw
+
+
+def _adapter_counts(args):
+    """Parse --adapters "0,1,8,64" into the sweep's count list."""
+    if not args.adapters:
+        return []
+    return [int(x) for x in str(args.adapters).split(",")]
+
+
+def _bench_bank(model, pool_size):
+    """ONE AdapterBank (cached on the model) shared by every sweep
+    pass: the bank's pool geometry keys the step-program cache, so
+    one bank == one program set across the whole N=0..max curve —
+    which is exactly the claim the sweep exists to measure."""
+    cached = getattr(model, "_llm_bench_bank", None)
+    if cached is not None and cached.max_adapters >= pool_size:
+        return cached
+    from mxnet_tpu.serving.adapters import AdapterBank
+    bank = AdapterBank(model.num_layers, model.config.d_model,
+                       max_adapters=pool_size, page_rank=4)
+    rng = np.random.RandomState(1234)
+    L, d = model.num_layers, model.config.d_model
+    for i in range(pool_size):
+        a = (rng.randn(L, 4, d, 4) * 0.05).astype(np.float32)
+        b = (rng.randn(L, 4, 4, d) * 0.05).astype(np.float32)
+        bank.publish(f"bench-{i}", a, b)
+    model._llm_bench_bank = bank
+    return bank
+
+
+def _adapter_for(i, n_adapters):
+    """Request i's adapter: cycle the N published adapters plus one
+    base-model share (every (N+1)th request rides the null adapter)."""
+    if n_adapters <= 0:
+        return None
+    idx = i % (n_adapters + 1)
+    return None if idx == 0 else f"bench-{idx - 1}"
 
 
 def _shared_prompts(args, model, rng, max_prompt):
@@ -268,11 +308,13 @@ def run_overload(args):
     return report
 
 
-def run(args, prefix_cache=None, name="llm_bench"):
+def run(args, prefix_cache=None, name="llm_bench", adapter_bank=None,
+        n_adapters=0):
     model, params = _load_model(args)
     srv = LLMServer(model, params, name=name,
                     **_engine_kw(args, model, params,
-                                 prefix_cache=prefix_cache))
+                                 prefix_cache=prefix_cache,
+                                 adapter_bank=adapter_bank))
     warm = srv.warmup()
     srv.start()
 
@@ -295,7 +337,8 @@ def run(args, prefix_cache=None, name="llm_bench"):
                 n = 1 + (tid + i) % args.max_new_tokens
                 res = srv.generate(
                     prompt, n, timeout=600,
-                    sampling=_sampling_for(tid * 997 + i, args))
+                    sampling=_sampling_for(tid * 997 + i, args),
+                    adapter=_adapter_for(tid * 997 + i, n_adapters))
                 # a generation may legally end early at the context
                 # cap (finish_reason "length"), not only at n
                 want = min(n, srv.max_context - len(prompt))
@@ -373,6 +416,16 @@ def run(args, prefix_cache=None, name="llm_bench"):
             "evictions": stats["prefix_evictions"],
         },
     }
+    if adapter_bank is not None:
+        report["adapters"] = {
+            "count": n_adapters,
+            "requests_with_adapter": sum(
+                1 for tid in range(args.concurrency)
+                for i in range(quota[tid])
+                if _adapter_for(tid * 997 + i, n_adapters)
+                is not None),
+            "bank": stats.get("adapters"),
+        }
     print(json.dumps(report, indent=1))
     return report
 
@@ -415,6 +468,11 @@ def emit_bench(report, out_dir):
             # prefix-cache economics: hit rate, prefill work saved and
             # the cache-off TTFT control from the same config
             "prefix": report.get("prefix"),
+            # multi-LoRA sweep: per-pass bank economics + the
+            # tokens/sec-vs-adapter-count curve, all passes from ONE
+            # warmed program set
+            "adapters": report.get("adapters"),
+            "adapters_curve": report.get("adapters_curve"),
         },
         "_capture": {
             "tag": "llm_bench",
@@ -471,6 +529,13 @@ def main():
                          "cross-request prefix cache); > 0 also runs "
                          "a cache-OFF control pass so the TTFT win "
                          "is measured against the same workload")
+    ap.add_argument("--adapters", default="",
+                    help="comma-separated LoRA adapter counts to sweep "
+                         "(e.g. 0,1,8,64): each pass serves mixed "
+                         "traffic cycling N published adapters plus "
+                         "the base model, ALL passes from one "
+                         "AdapterBank — i.e. one warmed program set; "
+                         "the curve lands in the BENCH json")
     ap.add_argument("--kv-dtype", choices=("float32", "int8"),
                     default="float32",
                     help="KV page storage dtype: int8 = per-slot-"
@@ -513,11 +578,36 @@ def main():
             args.prefill_chunk = args.prefill_chunk or 16
             args.spec_k = args.spec_k or 2
             args.temperature = args.temperature or 0.8
-            if args.prefix_share == 0:
+            # the adapter sweep replaces the prefix control pass (the
+            # sweep's passes must all share ONE configuration)
+            if args.prefix_share == 0 and not args.adapters:
                 args.prefix_share = 0.5
 
+    counts = _adapter_counts(args)
     if args.overload:
         report = run_overload(args)
+    elif counts:
+        # the multi-LoRA sweep: one pass per adapter count, every
+        # pass against the SAME AdapterBank (same pool geometry ->
+        # same program-cache key -> one warmed program set); pass 2+
+        # pays zero warmup compiles, which the curve's
+        # compiles_during_load column proves
+        model, params = _load_model(args)
+        bank = _bench_bank(model, max(max(counts), 1))
+        curve, report = [], None
+        for n in counts:
+            rep = run(args, name=f"llm_bench_a{n}",
+                      adapter_bank=bank, n_adapters=n)
+            curve.append({
+                "adapters": n,
+                "tokens_per_sec": rep["tokens_per_sec"],
+                "ttft_ms": rep["ttft_ms"],
+                "compiles_during_load": rep["compiles_during_load"],
+                "adapter_requests":
+                    rep["adapters"]["requests_with_adapter"],
+            })
+            report = rep
+        report["adapters_curve"] = curve
     else:
         control = None
         if args.prefix_share > 0:
@@ -592,6 +682,18 @@ def main():
                       == pf["prefill_tokens_saved"]
                       and bench.get("prefix", {}).get(
                           "ttft_ms_control") is not None)
+            if counts:
+                # the multi-LoRA path really ran: every pass of the
+                # sweep was recompile-free (one program set serves
+                # all counts), adapter-carrying requests were served,
+                # and the committed snapshot carries the full curve
+                curve = report.get("adapters_curve") or []
+                ok = (ok and len(curve) == len(counts)
+                      and all(c["compiles_during_load"] == 0
+                              for c in curve)
+                      and any(c["adapter_requests"] > 0
+                              for c in curve)
+                      and bench.get("adapters_curve") == curve)
         print("SMOKE", "PASS" if ok else "FAIL")
         sys.exit(0 if ok else 1)
 

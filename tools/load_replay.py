@@ -241,6 +241,31 @@ def tenant_prefix_tokens(spec, tenant, vocab, block_size):
     return rng.randint(0, vocab, size=length).tolist()
 
 
+def tenant_adapter(tenant):
+    """Tenant ``t``'s deterministic LoRA adapter id (ISSUE 17): every
+    third tenant — including the dominant Zipf head t00 — rides the
+    base model (so replay batches mix adapter and adapter-less rows),
+    the rest each get a per-tenant fine-tuned variant. A pure function
+    of the tenant index, so the assignment is part of the trace's
+    bit-identity."""
+    idx = int(str(tenant).lstrip("t"))
+    return None if idx % 3 == 0 else f"lora-t{idx:02d}"
+
+
+def tenant_adapter_factors(spec, name, num_layers, d_model, rank=4):
+    """Adapter ``name``'s deterministic A/B factors, seeded from
+    (trace seed, name) — what the replay writes into the adapter
+    registry so fault-in serves reproducible weights."""
+    h = int(hashlib.sha256(f"{spec.seed}:{name}".encode())
+            .hexdigest()[:8], 16)
+    rng = np.random.RandomState(h % (2 ** 31 - 1))
+    a = (rng.randn(num_layers, 4, d_model, rank) * 0.05
+         ).astype(np.float32)
+    b = (rng.randn(num_layers, 4, rank, d_model) * 0.05
+         ).astype(np.float32)
+    return a, b
+
+
 # ------------------------------------------------------------ replay --
 
 OUTCOMES = ("served", "shed", "expired", "evicted", "failed")
@@ -404,17 +429,45 @@ def run_llm(args, spec, trace, ring):
     from mxnet_tpu import serving
     from mxnet_tpu.serving.llm import (TinyDecoder, DecoderConfig,
                                        LLMServer)
+    from mxnet_tpu.serving.adapters import AdapterBank, AdapterRegistry
     model = TinyDecoder(DecoderConfig(
         vocab_size=32, d_model=32, num_layers=2, num_heads=2,
         d_ff=64, max_context=args.max_context))
     block_size = 16
+    # per-Zipf-tenant LoRA adapters (ISSUE 17): traffic adapters live
+    # in a registry only — the replay's acquires FAULT them in — and
+    # never-acquired decoys pre-fill every pool page so each fault-in
+    # must run the cold-LRU capacity eviction path. Sized so every
+    # traffic adapter fits once the decoys are gone: no acquire can
+    # ever fail.
+    adapters = {f"t{k:02d}": tenant_adapter(f"t{k:02d}")
+                for k in range(spec.tenants)}
+    names = sorted({a for a in adapters.values() if a})
+    bank = None
+    if names:
+        reg = AdapterRegistry(
+            tempfile.mkdtemp(prefix="replay_adapters_"), num_shards=2)
+        for nm in names:
+            a, b = tenant_adapter_factors(spec, nm, model.num_layers,
+                                          32)
+            reg.save(nm, a, b, version=1)
+        bank = AdapterBank(model.num_layers, 32,
+                           max_adapters=len(names), page_rank=4,
+                           registry=reg)
+        j = 0
+        while bank.stats()["pages_free"] > 0:
+            da, db = tenant_adapter_factors(
+                spec, f"replay-decoy-{j}", model.num_layers, 32)
+            bank.publish(f"replay-decoy-{j}", da, db, persist=False)
+            j += 1
     # prefix_cache pinned ON: the tenant system-prompt workload (and
     # the smoke's hit-rate gate) exists to exercise it, regardless of
     # the ambient MXNET_TPU_LLM_PREFIX_CACHE value
     srv = LLMServer(model, model.init_params(0), name="replay_llm",
                     max_seqs=args.max_seqs, block_size=block_size,
                     max_context=args.max_context,
-                    max_queue=args.max_queue, prefix_cache=True)
+                    max_queue=args.max_queue, prefix_cache=True,
+                    adapter_bank=bank)
     srv.warmup()
     srv.start()
     max_prompt = max(2, args.max_context // 2)
@@ -429,7 +482,9 @@ def run_llm(args, spec, trace, ring):
         toks = (prefixes[req["tenant"]] + body)[:max_prompt]
         return srv.submit(toks, req["new_tokens"],
                           deadline_ms=spec.deadline_ms,
-                          tenant=req["tenant"])
+                          tenant=req["tenant"],
+                          adapter=adapters[req["tenant"]]
+                          if bank is not None else None)
 
     ring.record()
     interval = max(0.05, spec.duration_s / 40.0)
@@ -470,6 +525,16 @@ def run_llm(args, spec, trace, ring):
             "hit_rate": round(stats["prefix_hit_rate"], 4),
             "prefill_tokens_saved": stats["prefill_tokens_saved"],
             "evictions": stats["prefix_evictions"],
+        },
+        # per-tenant LoRA economics: residency hits vs registry
+        # fault-ins and the capacity evictions the fault-ins forced —
+        # saved fault-ins are saved publish bandwidth, like saved
+        # prefill is saved chip time
+        "adapters": None if bank is None else {
+            "per_tenant": adapters,
+            "names": names,
+            "pool": len(names),
+            "bank": stats.get("adapters"),
         },
     }
 
@@ -903,6 +968,8 @@ def evaluate_and_report(args, spec, trace, results, rings, out_dir):
     for blk in results:
         if blk["frontend"] == "llm" and "prefix" in blk:
             rec["llm_prefix"] = blk["prefix"]
+        if blk["frontend"] == "llm" and blk.get("adapters"):
+            rec["llm_adapters"] = blk["adapters"]
 
     # refusal gates: an unhealthy replay cannot headline capacity
     reasons = []
@@ -970,6 +1037,22 @@ def _smoke_check(args, spec, trace, results, rec, cap_path):
                     or rec["llm_prefix"].get("hit_rate") is None):
                 probs.append("capacity report carries no llm_prefix "
                              "hit-rate block")
+            ad = (blk.get("adapters") or {}).get("bank") or {}
+            if not ad.get("acquire_hits"):
+                probs.append("llm: tenant adapters produced no "
+                             "residency hits")
+            if ad.get("registry_loads", 0) \
+                    < len((blk.get("adapters") or {}).get("names", [])):
+                probs.append("llm: not every tenant adapter was "
+                             "faulted in from the registry")
+            if not (ad.get("evictions") or {}).get("capacity"):
+                probs.append("llm: fault-ins forced no cold-LRU "
+                             "capacity eviction (decoy survived)")
+            if ("llm_adapters" not in rec
+                    or (rec["llm_adapters"].get("bank") or {})
+                    .get("acquires") is None):
+                probs.append("capacity report carries no llm_adapters "
+                             "hit/evict block")
     with open(cap_path) as f:
         cap = json.load(f)
     if cap.get("skipped"):

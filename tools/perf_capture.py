@@ -394,6 +394,13 @@ def emit_llm_snapshot(rec, out_dir=None):
         # so the trend table can attribute a TTFT win to the cache
         if extra.get("prefix") is not None:
             out["prefix"] = extra["prefix"]
+        # multi-LoRA runs (llm_bench --adapters, ISSUE 17) carry the
+        # bank economics and the tokens/sec + TTFT vs adapter-count
+        # curve — the "N adapters from one program set" evidence
+        if extra.get("adapters") is not None:
+            out["adapters"] = extra["adapters"]
+        if extra.get("adapters_curve") is not None:
+            out["adapters_curve"] = extra["adapters_curve"]
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
         f.write("\n")
@@ -454,6 +461,10 @@ def emit_capacity_snapshot(rec, out_dir=None):
             # (ISSUE 13): saved prefill is saved chip time, so the
             # reuse economics belong next to the capacity headline
             "llm_prefix": rec.get("llm_prefix"),
+            # multi-LoRA economics (ISSUE 17): per-tenant adapter map
+            # + bank hit/evict counters — how many variants the same
+            # chip count actually served
+            "llm_adapters": rec.get("llm_adapters"),
             "metrics_log": cap.get("metrics_log"),
             "detail": rec.get("detail"),
         })
