@@ -43,6 +43,15 @@ burn-rate status off that ring (``mxtpu_slo_*``); :mod:`.capacity`
 turns a replay window into the committed chips-per-M-users report
 (``tools/load_replay.py`` drives all three).
 
+Incident capture: :mod:`.flightrecorder` (:func:`get_flightrecorder`)
+keeps a bounded black-box ring of per-request lifecycle events and
+control-plane decisions, dumps atomic post-mortem bundles on SLO
+page/breach transitions, worker crashes, or manual request
+(``mxtpu_flight_*``; rendered by ``tools/flight_inspect.py``), and
+serves ``debug_status()`` snapshots of registered servers;
+:mod:`.exemplars` attaches opt-in (req id, span id) exemplars to
+histogram buckets so a breach names its offending requests.
+
 Causality lives next door: :mod:`.tracing` (:func:`get_tracer`) records
 nested host spans across the same subsystems — one step / one serving
 request readable end to end, exported as Chrome-trace/Perfetto JSON and
@@ -59,10 +68,16 @@ from .tracing import Span, Tracer, get_tracer, validate_chrome_trace
 from .timeseries import TimeSeriesRing
 from .slo import (SLO, SLOEngine, STATUS_OK, STATUS_WARN, STATUS_PAGE,
                   STATUS_BREACH)
+from .flightrecorder import (FlightRecorder, get_flightrecorder,
+                             flight_ring_capacity, flight_triggers)
+from .exemplars import EXEMPLARS_PER_BUCKET
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_TIME_BUCKETS", "get_registry", "StepTimer",
            "compile_count", "install_jax_monitoring_bridge",
            "Span", "Tracer", "get_tracer", "validate_chrome_trace",
            "TimeSeriesRing", "SLO", "SLOEngine", "STATUS_OK",
-           "STATUS_WARN", "STATUS_PAGE", "STATUS_BREACH"]
+           "STATUS_WARN", "STATUS_PAGE", "STATUS_BREACH",
+           "FlightRecorder", "get_flightrecorder",
+           "flight_ring_capacity", "flight_triggers",
+           "EXEMPLARS_PER_BUCKET"]

@@ -25,6 +25,7 @@ Design rules:
 from __future__ import annotations
 
 import bisect
+import collections
 import json
 import os
 import threading
@@ -139,7 +140,8 @@ class GaugeChild(_Child):
 
 class HistogramChild(_Child):
     """Fixed-edge histogram. Memory is O(len(edges)) forever — the
-    bounded replacement for raw sample reservoirs."""
+    bounded replacement for raw sample reservoirs (opt-in bucket
+    exemplars are capped per bucket, see :mod:`.exemplars`)."""
 
     def __init__(self, parent, labelvalues):
         super().__init__(parent, labelvalues)
@@ -149,8 +151,14 @@ class HistogramChild(_Child):
         self._count = 0
         self._min = None
         self._max = None
+        self._exemplars = None         # {bucket_i: deque} once seen
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
+        """Record one observation. ``exemplar`` (optional) is a
+        ``(req, span_id)`` pair kept in the landing bucket's bounded
+        last-K reservoir — the flight-recorder join from a latency
+        bucket to the request that filled it. ``None`` (the default)
+        costs one test: no allocation rides the unexemplared path."""
         value = float(value)
         i = bisect.bisect_left(self._parent.buckets, value)
         with self._lock:
@@ -161,6 +169,17 @@ class HistogramChild(_Child):
                 self._min = value
             if self._max is None or value > self._max:
                 self._max = value
+            if exemplar is not None:
+                ex = self._exemplars
+                if ex is None:
+                    ex = self._exemplars = {}
+                lst = ex.get(i)
+                if lst is None:
+                    from .exemplars import EXEMPLARS_PER_BUCKET
+                    lst = ex[i] = collections.deque(
+                        maxlen=EXEMPLARS_PER_BUCKET)
+                lst.append((value, exemplar[0], exemplar[1],
+                            time.time()))
 
     @property
     def count(self):
@@ -232,6 +251,7 @@ class HistogramChild(_Child):
             self._count = 0
             self._min = None
             self._max = None
+            self._exemplars = None
 
 
 class _Metric:
@@ -338,8 +358,8 @@ class Histogram(_Metric):
         self.buckets = buckets
         super().__init__(name, help, labelnames, lock)
 
-    def observe(self, value):
-        self._need_default().observe(value)
+    def observe(self, value, exemplar=None):
+        self._need_default().observe(value, exemplar=exemplar)
 
     def percentile(self, p):
         return self._need_default().percentile(p)

@@ -300,6 +300,9 @@ class SLOEngine:
         self._evals = registry.counter(
             "mxtpu_slo_evaluations_total",
             "SLOEngine.evaluate() passes.")
+        # previous status per SLO: the flight recorder dumps on the
+        # TRANSITION into page/breach, not on every hot evaluation
+        self._prev_status = {}
 
     def evaluate(self, metrics=None):
         """One evaluation pass over every SLO. ``metrics`` defaults to
@@ -361,4 +364,24 @@ class SLOEngine:
                     self._burn.labels(slo=slo.name,
                                       window=win).set(b or 0.0)
         self._evals.inc()
+        # flight-recorder trigger: an SLO whose status ENTERED
+        # page/breach this pass dumps one post-mortem bundle carrying
+        # these reports (burn windows included). Edge-triggered on the
+        # transition — a breach that stays breached across evaluations
+        # fires once, not per pass.
+        fired = [name for name, rep in reports.items()
+                 if rep["status"] >= STATUS_PAGE
+                 and self._prev_status.get(name,
+                                           STATUS_OK) < STATUS_PAGE]
+        self._prev_status = {name: rep["status"]
+                             for name, rep in reports.items()}
+        if fired:
+            from .flightrecorder import get_flightrecorder
+            recorder = get_flightrecorder()
+            if recorder.enabled:
+                for name in fired:
+                    recorder.event("slo.trigger", attrs={
+                        "slo": name,
+                        "status": reports[name]["status_name"]})
+                recorder.slo_dump(fired, reports)
         return reports

@@ -49,6 +49,7 @@ import numpy as np
 
 from ..llm.kv_cache import BlockAllocator, NoFreeBlocksError
 from ..envutil import env_int
+from ...observability.flightrecorder import get_flightrecorder
 
 __all__ = ["AdapterBank", "AdapterHandle", "AdapterError",
            "UnknownAdapterError", "NoFreeAdapterPagesError",
@@ -183,6 +184,7 @@ class AdapterBank:
                            "republish": 0}             # guarded-by: _lock
         self._stats = stats                            # guarded-by: _lock
         self._warmed = False                           # guarded-by: _lock
+        self._flight = get_flightrecorder()
 
         # ONE fixed-shape install program per bank: a/b page sources
         # and the destination page id are traced, so every later
@@ -349,6 +351,11 @@ class AdapterBank:
         self._evictions[reason] += 1
         if self._stats is not None:
             self._stats.record_adapter_evicted(reason)
+        if self._flight.enabled:
+            self._flight.event(
+                "adapter.evict",
+                attrs={"adapter": rec.name, "version": rec.version,
+                       "reason": reason, "users": rec.users})
         self._gauge_locked()
 
     def evict(self, name, reason="explicit"):
@@ -412,7 +419,13 @@ class AdapterBank:
         self._publish_locked(name, np.asarray(a, self.dtype),
                              np.asarray(b, self.dtype), rank, scale,
                              max(version, self._versions.get(name, 0)))
-        return self._resident[name]
+        rec = self._resident[name]
+        if self._flight.enabled:
+            self._flight.event(
+                "adapter.fault_in",
+                attrs={"adapter": name, "version": rec.version,
+                       "rank": rank, "pages": len(rec.pages)})
+        return rec
 
     def release(self, handle):
         """Drop one request's pin. The last release of a CURRENT

@@ -48,6 +48,7 @@ import time
 
 from ..envutil import env_float as _env_float, env_int as _env_int
 from ..errors import Overloaded, ServerClosed
+from ...observability.flightrecorder import get_flightrecorder
 from ...resilience import faults
 from .metrics import FleetStats
 from .quota import LANES, TenantQuota
@@ -120,6 +121,18 @@ class FleetRouter:
         self._lane_live = dict.fromkeys(LANES, 0)   # guarded-by: _lock
         self._closed = False    # guarded-by: _lock
         self._publishing = set()    # guarded-by: _lock
+        self._flight = get_flightrecorder()
+        self._flight.register(f"fleet:{name}", self)
+
+    def _swap_event(self, model, phase, outcome, version=None):
+        """Swap phases are control-plane decisions: mirror every
+        ``record_swap`` onto the flight decision log."""
+        if self._flight.enabled:
+            attrs = {"fleet": self.name, "model": model,
+                     "phase": phase, "outcome": outcome}
+            if version is not None:
+                attrs["version"] = version
+            self._flight.event("fleet.swap", attrs=attrs)
 
     # ----------------------------------------------------- registry --
     def add_model(self, name, server, *, version=0, builder=None):
@@ -153,6 +166,36 @@ class FleetRouter:
     def stats(self):
         return self._stats
 
+    def debug_status(self):
+        """Structured routing-table snapshot for the flight recorder's
+        statusz surface: per-model active/route versions (a mismatch
+        means a swap is mid-drain), lane occupancy, in-flight
+        publishes, and each backing server's own ``debug_status()``
+        (best-effort — a server mid-teardown reports its error)."""
+        with self._lock:
+            models = {
+                name: {"kind": e.kind,
+                       "active_version": e.active.version,
+                       "route_version": e.route.version,
+                       "swapping": e.route is not e.active}
+                for name, e in self._models.items()}
+            lanes = dict(self._lane_live)
+            closed = self._closed
+            publishing = sorted(self._publishing)
+            servers = {name: e.active.server
+                       for name, e in self._models.items()}
+        for name, srv in servers.items():
+            ds = getattr(srv, "debug_status", None)
+            if ds is None:
+                continue
+            try:
+                models[name]["server"] = ds()
+            except Exception as exc:   # pragma: no cover - defensive
+                models[name]["server"] = {"error": repr(exc)}
+        return {"kind": "fleet", "fleet": self.name, "closed": closed,
+                "publishing": publishing, "lanes": lanes,
+                "models": models}
+
     # ------------------------------------------------------- submit --
     def _admit(self, model, tenant, lane):
         """Shared admission: chaos site, lane check, quota gate, entry
@@ -171,12 +214,23 @@ class FleetRouter:
                            f"{known}")
         if not self._quota.allow(tenant):
             self._stats.record_quota_shed(tenant)
+            if self._flight.enabled:
+                self._flight.event(
+                    "fleet.shed", tenant=tenant,
+                    attrs={"fleet": self.name, "model": model,
+                           "reason": "quota"})
             raise Overloaded(
                 f"tenant {tenant!r} over fleet quota "
                 f"({self._quota.rate:g} req/s, burst "
                 f"{self._quota.burst:g}); request shed", reason="quota")
         if (lane == "batch" and self.batch_lane_depth > 0
                 and batch_live >= self.batch_lane_depth):
+            if self._flight.enabled:
+                self._flight.event(
+                    "fleet.shed", tenant=tenant,
+                    attrs={"fleet": self.name, "model": model,
+                           "reason": "lane_full",
+                           "depth": batch_live})
             raise Overloaded(
                 f"batch lane full ({batch_live} >= "
                 f"{self.batch_lane_depth}); request shed",
@@ -297,6 +351,7 @@ class FleetRouter:
                 arrays = self._load_arrays(run_dir, ckpt_dir, manifest,
                                            verify)
             self._stats.record_swap(model, "load", "ok")
+            self._swap_event(model, "load", "ok", version)
 
             # warm: build + pre-compile the new replica OFF the
             # serving path — the old version serves undisturbed while
@@ -312,6 +367,7 @@ class FleetRouter:
             srv.start()
             new = _Handle(version, srv, entry.kind)
             self._stats.record_swap(model, "warm", "ok")
+            self._swap_event(model, "warm", "ok", version)
 
             # drain: flip NEW traffic to the new replica first (a
             # caller must never see a closed fleet), then quiesce the
@@ -324,6 +380,7 @@ class FleetRouter:
             quiesced = True
             old.server.quiesce(timeout=drain_timeout)
             self._stats.record_swap(model, "drain", "ok")
+            self._swap_event(model, "drain", "ok", version)
 
             # handover: THE commit point — active moves, the version
             # gauge moves, and from here failure rolls forward.
@@ -334,6 +391,7 @@ class FleetRouter:
             committed = True
             self._stats.set_active_version(model, version)
             self._stats.record_swap(model, "handover", "ok")
+            self._swap_event(model, "handover", "ok", version)
 
             # prune: retire the old replica. Anything that outlived a
             # bounded drain resolves TYPED here (evicted with partial
@@ -342,6 +400,7 @@ class FleetRouter:
             faults.point("fleet.publish:prune")
             self._retire(old)
             self._stats.record_swap(model, "prune", "ok")
+            self._swap_event(model, "prune", "ok", version)
             self._stats.record_swap_seconds(model,
                                             time.monotonic() - t0)
             return version
@@ -350,12 +409,14 @@ class FleetRouter:
             # matrix exercises exactly this handler.
             if committed:
                 self._stats.record_swap(model, phase, "failed")
+                self._swap_event(model, phase, "failed", version)
                 try:
                     self._retire(old)
                 except Exception:
                     pass
                 raise
             self._stats.record_swap(model, phase, "rolled_back")
+            self._swap_event(model, phase, "rolled_back", version)
             if quiesced:
                 old.server.resume()
             with self._lock:

@@ -74,6 +74,7 @@ from .sampling import (TAG_SAMPLE, TAG_ACCEPT, TAG_DRAFT, row_keys,
                        sample_and_probs, spec_accept,
                        spec_accept_greedy)
 from ...observability.tracing import get_tracer
+from ...observability.flightrecorder import get_flightrecorder
 from ...resilience import faults
 
 __all__ = ["LLMEngine"]
@@ -388,10 +389,11 @@ class LLMEngine:
         self.quantized = self.cache.quantized
         self.scheduler = Scheduler(self.max_seqs)
         self._stats = stats
+        self._flight = get_flightrecorder()
         if adapter_bank is not None and stats is not None:
             adapter_bank.attach_stats(stats)
-        if stats is not None and self.prefix_enabled:
-            self.cache.on_prefix_evict = stats.record_prefix_evict
+        if self.prefix_enabled:
+            self.cache.on_prefix_evict = self._on_prefix_evict
         # engine-local prefix counters (mirrored onto mxtpu_llm_* when
         # stats is attached; always available to tests/tools)
         self.prefix_lookups = 0
@@ -639,6 +641,11 @@ class LLMEngine:
             raise
         self.cache.allocator.free([old])
         self.cache.cow_count += 1
+        fl = self._flight
+        if fl.enabled:
+            fl.event("kv.cow", req=f"llm:{seq.seq_id}",
+                     tenant=seq.tenant,
+                     attrs={"old": old, "new": new})
 
     # ------------------------------------------------------- warmup --
     def warmup(self):
@@ -749,6 +756,17 @@ class LLMEngine:
             self._stats.record_admission_state(
                 self.scheduler.num_waiting, self.scheduler.num_running)
 
+    def _on_prefix_evict(self, n=1):
+        """Prefix-cache LRU reclaim observer: mirrors onto the metrics
+        registry and drops a ``kv.reclaim`` decision into the flight
+        ring (control-plane event — which cached blocks the allocator
+        gave back under pressure)."""
+        if self._stats:
+            self._stats.record_prefix_evict(n)
+        fl = self._flight
+        if fl.enabled:
+            fl.event("kv.reclaim", attrs={"blocks": n})
+
     def _admit(self, events):
         """Place waiting sequences into free slots. Conservative KV
         gate (the full prompt + one decode block must fit, prefix-hit
@@ -822,6 +840,13 @@ class LLMEngine:
                     self._stats.record_prefix_lookup(
                         hit_tokens, tenant=seq.tenant)
             events.append(("admitted", seq))
+            fl = self._flight
+            if fl.enabled:
+                fl.event("llm.admit", req=f"llm:{seq.seq_id}",
+                         tenant=seq.tenant,
+                         attrs={"slot": slot, "prompt": T,
+                                "cache_hit": hit_tokens,
+                                "adapter": seq.adapter})
 
     def _release_adapter(self, seq):
         """Drop the sequence's adapter pin on any TERMINAL release.
@@ -853,6 +878,12 @@ class LLMEngine:
         self.scheduler.preempt(seq)
         if self._stats:
             self._stats.record_preemption()
+        fl = self._flight
+        if fl.enabled:
+            fl.event("llm.preempt", req=f"llm:{seq.seq_id}",
+                     tenant=seq.tenant,
+                     attrs={"preemptions": seq.preemptions,
+                            "seq_len": seq.seq_len})
 
     def _poison(self, seq, exc, events):
         """Release ``seq`` as poison-isolated: blocks freed, slot
@@ -1290,6 +1321,13 @@ class LLMEngine:
                     seq.draft_len += plan["draft_fed"]
                 if self._stats:
                     self._stats.record_prefill_chunk(plan["ntok"])
+                fl = self._flight
+                if fl.enabled:
+                    fl.event("llm.prefill", req=f"llm:{seq.seq_id}",
+                             tenant=seq.tenant,
+                             attrs={"ntok": plan["ntok"],
+                                    "seq_len": seq.seq_len,
+                                    "emit": plan["emit"]})
                 if not plan["emit"]:
                     continue
                 # the prompt completed: register its full immutable
@@ -1310,8 +1348,16 @@ class LLMEngine:
                 if seq.t_first_token is None:
                     seq.t_first_token = time.monotonic()
                     if self._stats:
+                        # exemplar joins this TTFT observation back to
+                        # the request's flight timeline / trace span
+                        ex = None
+                        if self._flight.enabled:
+                            ex = (f"llm:{seq.seq_id}",
+                                  seq.span.span_id
+                                  if seq.span is not None else None)
                         self._stats.record_first_token(
-                            seq.t_first_token - seq.t_submit)
+                            seq.t_first_token - seq.t_submit,
+                            exemplar=ex)
                 if seq.done or seq.seq_len + 1 >= self.max_context:
                     self._finish(seq, events)
                 continue
@@ -1459,6 +1505,15 @@ class LLMEngine:
         if self._stats and any(plans[s]["kind"] == "decode"
                                for s in rows if s in plans):
             self._stats.record_decode_step(decoded, step_s)
+        fl = self._flight
+        if fl.enabled:
+            fl.event("llm.step",
+                     attrs={"running": len(rows),
+                            "prefilling": sum(
+                                1 for s in rows
+                                if plans[s]["kind"] == "prefill"),
+                            "decoded": decoded,
+                            "step_ms": round(step_s * 1e3, 3)})
         self._record_block_gauges()
         return events
 
@@ -1508,3 +1563,47 @@ class LLMEngine:
             out.append(seq)
         self._record_block_gauges()
         return out
+
+    # ------------------------------------------------------ statusz --
+    def debug_status(self):
+        """Structured point-in-time engine state for the flight
+        recorder's statusz surface (bundled into every post-mortem
+        dump). Advisory read — called from the worker thread by the
+        servers' ``debug_status()`` and best-effort from dump paths;
+        every field is plain host state, so a torn read can misreport
+        a count but never touch device state or recompile."""
+        a = self.cache.allocator
+        now = time.monotonic()
+        seqs = []
+        for seq in list(self.scheduler.running()) + \
+                list(self.scheduler.waiting):
+            seqs.append({
+                "seq_id": seq.seq_id, "state": seq.state,
+                "tenant": seq.tenant, "adapter": seq.adapter,
+                "slot": seq.slot, "seq_len": seq.seq_len,
+                "generated": len(seq.generated),
+                "preemptions": seq.preemptions,
+                "cache_hit_tokens": seq.cache_hit_tokens,
+                "age_s": round(now - seq.t_submit, 3)})
+        return {
+            "waiting": self.scheduler.num_waiting,
+            "running": self.scheduler.num_running,
+            "kv_blocks": {"used": a.num_used, "usable": a.num_usable,
+                          "cached": a.num_cached,
+                          "shared": a.num_shared,
+                          "free": a.num_free - a.num_cached,
+                          "cow_count": self.cache.cow_count},
+            "programs": {"t_buckets": list(self._t_buckets),
+                         "mb_widths": list(self._mb_widths),
+                         "warmed": self._warmed,
+                         "step_variants": len(self._step_jits),
+                         "spec_k": self.spec_k,
+                         "prefill_chunk": self.prefill_chunk},
+            "prefix_cache": {"enabled": self.prefix_enabled,
+                             "lookups": self.prefix_lookups,
+                             "hits": self.prefix_hits,
+                             "tokens_saved": self.prefill_tokens_saved},
+            "adapters": self.bank.stats() if self.bank is not None
+            else None,
+            "sequences": seqs,
+        }

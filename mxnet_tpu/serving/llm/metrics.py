@@ -248,8 +248,11 @@ class LLMStats:
         self._prefills.inc()
         self._prefill_tokens.inc(prompt_tokens)
 
-    def record_first_token(self, ttft_s):
-        self._ttft.observe(ttft_s)
+    def record_first_token(self, ttft_s, exemplar=None):
+        """``exemplar`` (optional ``(req, span_id)``): keep this
+        observation in its TTFT bucket's bounded reservoir — built by
+        call sites only while the flight recorder is on."""
+        self._ttft.observe(ttft_s, exemplar=exemplar)
 
     # smoothing factor for the per-step throughput EMA: heavy enough
     # to damp single-launch jitter, light enough that the gauge tracks
@@ -294,9 +297,9 @@ class LLMStats:
     def record_preemption(self):
         self._preemptions.inc()
 
-    def record_completed(self, latency_s):
+    def record_completed(self, latency_s, exemplar=None):
         self._completed.inc()
-        self._latency.observe(latency_s)
+        self._latency.observe(latency_s, exemplar=exemplar)
 
     def record_evicted(self, reason):
         self._labeled_child(self._evicted, self._evict_children,

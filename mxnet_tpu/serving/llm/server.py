@@ -64,6 +64,7 @@ from .sampling import SamplingParams
 from .scheduler import Sequence
 from ..telemetry import compile_count
 from ...observability.tracing import get_tracer
+from ...observability.flightrecorder import get_flightrecorder
 from ...resilience import faults
 
 __all__ = ["LLMServer", "SequenceEvictedError", "GenerationResult"]
@@ -108,10 +109,11 @@ class LLMServer:
                  breaker_cooldown_ms=None, **engine_kw):
         self.name = name
         self._stats = LLMStats(server=name)
+        self._flight = get_flightrecorder()
         self._breaker = CircuitBreaker(
             threshold=breaker_threshold,
             cooldown_ms=breaker_cooldown_ms,
-            on_state=self._stats.record_breaker_state)
+            on_state=self._on_breaker_state)
         self._engine = LLMEngine(model, params, stats=self._stats,
                                  breaker=self._breaker, **engine_kw)
         self.max_queue, self.default_deadline_ms = \
@@ -131,6 +133,17 @@ class LLMServer:
         self._started = False
         self._guard_watcher = None
         self._guard_stop = threading.Event()
+        self._flight.register(f"llm:{name}", self)
+
+    def _on_breaker_state(self, state):
+        """Breaker transition observer: the gauge plus one flight
+        control-plane event (the recorder names the moment the fleet
+        degraded to rejection)."""
+        self._stats.record_breaker_state(state)
+        fl = self._flight
+        if fl.enabled:
+            fl.event("breaker", attrs={"server": self.name,
+                                       "state": state})
 
     # -------------------------------------------------------- sizing --
     @property
@@ -226,6 +239,7 @@ class LLMServer:
                 raise UnknownAdapterError(
                     f"adapter {adapter!r} is neither resident nor in "
                     "the registry")
+        fl = self._flight
         try:
             shed_if_breaker_open(self._breaker, self._stats)
             deadline = resolve_deadline(deadline_ms,
@@ -233,9 +247,17 @@ class LLMServer:
                                         self._stats)
         except Overloaded:              # breaker_open shed
             self._stats.record_tenant(tenant, "shed")
+            if fl.enabled:
+                fl.event("llm.shed", tenant=tenant,
+                         attrs={"server": self.name,
+                                "reason": "breaker_open"})
             raise
         except DeadlineExceededError:   # budget spent at submit
             self._stats.record_tenant(tenant, "expired")
+            if fl.enabled:
+                fl.event("llm.shed", tenant=tenant,
+                         attrs={"server": self.name,
+                                "reason": "deadline_at_submit"})
             raise
         prompt = [int(t) for t in np.asarray(prompt_tokens).ravel()]
         seq = Sequence(prompt, max_new_tokens, stop_token=stop_token,
@@ -257,12 +279,20 @@ class LLMServer:
                 if seq.span is not None:
                     seq.span.set("error", "ServerClosed")
                     seq.span.finish()
+                if fl.enabled:
+                    fl.event("llm.shed", tenant=tenant,
+                             attrs={"server": self.name,
+                                    "reason": "closed"})
                 raise ServerClosed(
                     "server is draining; no new sequences admitted")
             if self._quiesced:
                 if seq.span is not None:
                     seq.span.set("error", "ServerClosed")
                     seq.span.finish()
+                if fl.enabled:
+                    fl.event("llm.shed", tenant=tenant,
+                             attrs={"server": self.name,
+                                    "reason": "quiesced"})
                 raise ServerClosed(
                     "server is quiesced; admission paused "
                     "(resume() re-opens)")
@@ -274,6 +304,11 @@ class LLMServer:
                 if seq.span is not None:
                     seq.span.set("error", "Overloaded")
                     seq.span.finish()
+                if fl.enabled:
+                    fl.event("llm.shed", tenant=tenant,
+                             attrs={"server": self.name,
+                                    "reason": "queue_full",
+                                    "depth": depth})
                 raise Overloaded(
                     f"admission queue full ({depth} >= max_queue "
                     f"{self.max_queue}); request shed",
@@ -284,6 +319,13 @@ class LLMServer:
         seq.future.add_done_callback(self._live_dec)
         self._stats.record_submit()
         self._stats.record_tenant(tenant, "submitted")
+        if fl.enabled:
+            fl.event("llm.submit", req=f"llm:{seq.seq_id}",
+                     tenant=tenant,
+                     attrs={"server": self.name, "prompt": len(prompt),
+                            "adapter": adapter,
+                            "span_id": seq.span.span_id
+                            if seq.span is not None else None})
         return seq.future
 
     def cancel(self, future):
@@ -357,6 +399,32 @@ class LLMServer:
         if self._engine.bank is not None:
             snap["adapters"] = self._engine.bank.stats()
         return snap
+
+    def debug_status(self):
+        """Structured live-state snapshot for the flight recorder's
+        statusz surface: admission/lifecycle flags read under the
+        server lock, plus the engine's advisory state (queue depths,
+        KV partition, program warmth, in-flight sequences). JSON-ready
+        and side-effect free — safe to call from a dump while the
+        worker is dying."""
+        with self._cv:
+            pending = len(self._pending)
+            closed, quiesced = self._closed, self._quiesced
+            live = self._live
+        return {
+            "kind": "llm",
+            "server": self.name,
+            "started": self._started,
+            "closed": closed,
+            "quiesced": quiesced,
+            "live_futures": live,
+            "pending": pending,
+            "queue_depth": pending
+            + self._engine.scheduler.num_waiting,
+            "max_queue": self.max_queue,
+            "breaker_state": self._breaker.state,
+            "engine": self._engine.debug_status(),
+        }
 
     # --------------------------------------------------------- drain --
     def shutdown(self, drain=True, deadline_ms=None):
@@ -468,7 +536,21 @@ class LLMServer:
                 if seq.t_first_token else None)
         res = GenerationResult(seq.output_tokens(), seq.seq_id, ttft,
                                seq.finish_reason)
-        self._stats.record_completed(time.monotonic() - seq.t_submit)
+        latency = time.monotonic() - seq.t_submit
+        ex = None
+        fl = self._flight
+        if fl.enabled:
+            key = f"llm:{seq.seq_id}"
+            ex = (key, seq.span.span_id
+                  if seq.span is not None else None)
+            fl.event("llm.served", req=key, tenant=seq.tenant,
+                     attrs={"server": self.name,
+                            "tokens": len(res.tokens),
+                            "finish": seq.finish_reason,
+                            "latency_ms": round(latency * 1e3, 3),
+                            "ttft_ms": round(ttft * 1e3, 3)
+                            if ttft is not None else None})
+        self._stats.record_completed(latency, exemplar=ex)
         self._stats.record_tenant(seq.tenant, "served")
         self._stats.record_tenant_tokens(seq.tenant, len(res.tokens))
         if seq.span is not None:
@@ -489,6 +571,12 @@ class LLMServer:
         self._stats.record_evicted(reason)
         self._stats.record_tenant(seq.tenant, "evicted")
         self._stats.record_tenant_tokens(seq.tenant, len(toks))
+        fl = self._flight
+        if fl.enabled:
+            fl.event("llm.evicted", req=f"llm:{seq.seq_id}",
+                     tenant=seq.tenant,
+                     attrs={"server": self.name, "reason": reason,
+                            "tokens": len(toks)})
         self._close_span(seq, error=reason, tokens=len(toks))
         seq.future.set_exception(err)
 
@@ -508,6 +596,12 @@ class LLMServer:
             self._stats.record_evicted(reason)
         self._stats.record_tenant(seq.tenant, "expired")
         self._stats.record_tenant_tokens(seq.tenant, len(toks))
+        fl = self._flight
+        if fl.enabled:
+            fl.event("llm.expired", req=f"llm:{seq.seq_id}",
+                     tenant=seq.tenant,
+                     attrs={"server": self.name, "reason": reason,
+                            "tokens": len(toks)})
         self._close_span(seq, error=reason, tokens=len(toks))
         seq.future.set_exception(err)
 
@@ -516,6 +610,11 @@ class LLMServer:
         exception (the serving layer isolates, it does not mask)."""
         self._stats.record_failure()
         self._stats.record_tenant(seq.tenant, "failed")
+        fl = self._flight
+        if fl.enabled:
+            fl.event("llm.poisoned", req=f"llm:{seq.seq_id}",
+                     tenant=seq.tenant,
+                     attrs={"server": self.name, "error": repr(exc)})
         self._close_span(seq, error=repr(exc))
         seq.future.set_exception(exc)
 
@@ -556,6 +655,11 @@ class LLMServer:
         try:
             self._run_loop_inner()
         except BaseException as exc:
+            # flight bundle FIRST, while the dying state is still
+            # visible (queue depths, in-flight sequences, KV
+            # partition); crash_dump never raises, so cleanup and the
+            # re-raise below are unconditional
+            self._flight.crash_dump(exc, server=self.name)
             # InjectedCrash (chaos harness) or an engine bug the
             # isolation layer could not contain: close admission FIRST
             # so no future submit can enqueue onto a dead loop, then

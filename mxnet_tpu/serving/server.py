@@ -68,6 +68,7 @@ from .overload import (CircuitBreaker, resolve_deadline,
                        resolve_overload_knobs, shed_if_breaker_open)
 from .telemetry import ServingStats, EventLog, compile_count
 from ..observability.tracing import get_tracer
+from ..observability.flightrecorder import get_flightrecorder
 from ..resilience import faults
 
 __all__ = ["ModelServer", "ServerClosed"]
@@ -159,10 +160,13 @@ class ModelServer:
         self._fn = self._build_fn(model)
         self._queue = MicroBatchQueue(max_depth=self.max_queue)
         self._stats = ServingStats(server=name)
+        # flight recorder BEFORE the breaker: CircuitBreaker invokes
+        # on_state(CLOSED) during its own __init__
+        self._flight = get_flightrecorder()
         self._breaker = CircuitBreaker(
             threshold=breaker_threshold,
             cooldown_ms=breaker_cooldown_ms,
-            on_state=self._stats.record_breaker_state)
+            on_state=self._on_breaker_state)
         self._events = (EventLog(event_log) if event_log is not None
                         else EventLog.from_env())
         self._worker = None
@@ -180,6 +184,15 @@ class ModelServer:
         self._drained = threading.Event()
         self._guard_watcher = None
         self._guard_stop = threading.Event()
+        self._flight.register(f"serving:{name}", self)
+
+    def _on_breaker_state(self, state):
+        """Breaker transition observer: gauge + flight decision log."""
+        self._stats.record_breaker_state(state)
+        fl = self._flight
+        if fl.enabled:
+            fl.event("breaker", attrs={"server": self.name,
+                                       "state": state})
 
     # ---------------------------------------------------------- backend --
     def _build_fn(self, model):
@@ -316,6 +329,7 @@ class ModelServer:
                 "server owns the batch dimension)")
         if not self._started:
             raise RuntimeError("server not started; call start()")
+        fl = self._flight
         try:
             shed_if_breaker_open(self._breaker, self._stats,
                                  self._events)
@@ -324,9 +338,17 @@ class ModelServer:
                                         self._stats, self._events)
         except Overloaded:              # breaker_open shed
             self._stats.record_tenant(tenant, "shed")
+            if fl.enabled:
+                fl.event("serving.shed", tenant=tenant,
+                         attrs={"server": self.name,
+                                "reason": "breaker_open"})
             raise
         except DeadlineExceededError:   # budget spent at submit
             self._stats.record_tenant(tenant, "expired")
+            if fl.enabled:
+                fl.event("serving.shed", tenant=tenant,
+                         attrs={"server": self.name,
+                                "reason": "deadline_at_submit"})
             raise
         if deadline is not None:
             budget_s = deadline - time.monotonic()
@@ -336,6 +358,11 @@ class ModelServer:
                 self._stats.record_tenant(tenant, "shed")
                 self._events.emit("shed", reason="deadline_unmeetable",
                                   est_wait_ms=round(est * 1e3, 3))
+                if fl.enabled:
+                    fl.event("serving.shed", tenant=tenant,
+                             attrs={"server": self.name,
+                                    "reason": "deadline_unmeetable",
+                                    "est_wait_ms": round(est * 1e3, 3)})
                 raise Overloaded(
                     f"estimated queue wait {est * 1e3:.1f}ms exceeds "
                     f"the request's {budget_s * 1e3:.1f}ms deadline "
@@ -360,6 +387,10 @@ class ModelServer:
                     req.span.set("error", "ServerClosed")
                     req.span.finish()
                     req.span = None
+                if fl.enabled:
+                    fl.event("serving.shed", tenant=tenant,
+                             attrs={"server": self.name,
+                                    "reason": "quiesced"})
                 raise ServerClosed(
                     "server is quiesced; admission paused "
                     "(resume() re-opens)")
@@ -379,6 +410,11 @@ class ModelServer:
             self._stats.record_tenant(tenant, "shed")
             self._events.emit("shed", reason="queue_full",
                               depth=exc.depth)
+            if fl.enabled:
+                fl.event("serving.shed", tenant=tenant,
+                         attrs={"server": self.name,
+                                "reason": "queue_full",
+                                "depth": exc.depth})
             if req.span is not None:
                 req.span.set("error", "Overloaded")
                 req.span.finish()
@@ -388,6 +424,13 @@ class ModelServer:
         self._stats.record_submit()
         self._stats.record_tenant(tenant, "submitted")
         self._stats.record_queue_depth(self._queue.depth())
+        if fl.enabled:
+            fl.event("serving.submit", req=f"srv:{req.rid}",
+                     tenant=tenant,
+                     attrs={"server": self.name,
+                            "depth": self._queue.depth(),
+                            "span_id": req.span.span_id
+                            if req.span is not None else None})
         return fut
 
     def predict(self, x, timeout=None, deadline_ms=None, tenant=None):
@@ -403,6 +446,31 @@ class ModelServer:
         snap["compiles"] = compile_count()
         snap["buckets"] = list(self.buckets)
         return snap
+
+    def debug_status(self):
+        """Structured point-in-time server state for the flight
+        recorder's statusz surface. ``_admitting``/``_live`` are read
+        under ``_lifecycle`` (their guard); the in-flight batch is the
+        worker's private list — a torn read can misreport a row but
+        only plain host state is touched."""
+        with self._lifecycle:
+            admitting = self._admitting
+            live = self._live
+        now = time.monotonic()
+        inflight = [{"rid": r.rid, "tenant": r.tenant,
+                     "age_s": round(now - r.t_enqueue, 3)}
+                    for r in list(self._inflight)]
+        return {
+            "kind": "serving", "server": self.name,
+            "started": self._started, "abort": self._abort,
+            "admitting": admitting, "live_futures": live,
+            "queue_depth": self._queue.depth(),
+            "max_queue": self.max_queue,
+            "buckets": list(self.buckets),
+            "max_batch": self.max_batch_size,
+            "breaker_state": self._breaker.state,
+            "inflight": inflight,
+        }
 
     # ------------------------------------------------------------ drain --
     def shutdown(self, drain=True, timeout=None):
@@ -537,15 +605,32 @@ class ModelServer:
 
     def _reply(self, batch, out, bucket, pad_s, service_s, tracer):
         """Resolve every Future in ``batch`` with its row + account."""
+        fl = self._flight
+        # exemplars captured BEFORE _finish_request_spans nulls spans
+        exs = None
+        if fl.enabled:
+            exs = [(f"srv:{r.rid}",
+                    r.span.span_id if r.span is not None else None)
+                   for r in batch]
         with tracer.span("mxtpu.serving.reply", "serving"):
             for i, req in enumerate(batch):
                 req.future.set_result(out[i])
                 self._stats.record_tenant(req.tenant, "served")
+                if fl.enabled:
+                    fl.event("serving.served", req=f"srv:{req.rid}",
+                             tenant=req.tenant,
+                             attrs={"server": self.name,
+                                    "bucket": bucket,
+                                    "wait_ms": round(
+                                        req.wait_s * 1e3, 3),
+                                    "service_ms": round(
+                                        service_s * 1e3, 3)})
             _finish_request_spans(batch, bucket=bucket, pad_s=pad_s,
                                   service_s=service_s)
         n = len(batch)
         self._stats.record_batch(
-            n, bucket, [r.wait_s for r in batch], service_s)
+            n, bucket, [r.wait_s for r in batch], service_s,
+            exemplars=exs)
         self._events.emit(
             "batch", n=n, bucket=bucket,
             waste=waste_fraction(n, bucket),
@@ -571,6 +656,12 @@ class ModelServer:
                 self._stats.record_tenant(req.tenant, "failed")
                 self._events.emit("poison", rid=req.rid,
                                   error=repr(exc))
+                if self._flight.enabled:
+                    self._flight.event(
+                        "serving.poisoned", req=f"srv:{req.rid}",
+                        tenant=req.tenant,
+                        attrs={"server": self.name,
+                               "error": repr(exc)})
                 return
             # a successful sub-dispatch proves the BACKEND is healthy:
             # recurring poison rows must isolate forever without ever
@@ -616,7 +707,9 @@ class ModelServer:
             self._serve_loop_inner()
         except BaseException as exc:
             # InjectedCrash (chaos harness) or any unexpected loop bug:
-            # never strand a Future behind a dead worker
+            # black-box dump FIRST (captures the dying queue/in-flight
+            # state), then never strand a Future behind a dead worker
+            self._flight.crash_dump(exc, server=self.name)
             self._fail_remaining(exc)
             raise
 
@@ -664,6 +757,12 @@ class ModelServer:
                 self._stats.record_failure(len(dead))
                 self._events.emit("deadline_expired", n=len(dead),
                                   at="queue")
+                if self._flight.enabled:
+                    for req in dead:
+                        self._flight.event(
+                            "serving.expired", req=f"srv:{req.rid}",
+                            tenant=req.tenant,
+                            attrs={"server": self.name, "at": "queue"})
                 batch = [r for r in batch if not r.expired(now)]
                 if not batch:
                     self._inflight = []
@@ -681,6 +780,10 @@ class ModelServer:
                 _finish_request_spans(batch, error="breaker_open")
                 self._stats.record_failure(len(batch))
                 self._events.emit("breaker_reject", n=len(batch))
+                if self._flight.enabled:
+                    self._flight.event(
+                        "serving.breaker_reject",
+                        attrs={"server": self.name, "n": len(batch)})
                 self._inflight = []
                 continue
             with tracer.span("mxtpu.serving.batch", "serving") as bsp:

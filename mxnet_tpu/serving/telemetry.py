@@ -342,17 +342,24 @@ class ServingStats:
     def record_queue_depth(self, depth):
         self._queue_depth.set(depth)
 
-    def record_batch(self, n, bucket, wait_s_each, service_s):
-        """One executed micro-batch: n real rows padded to ``bucket``."""
+    def record_batch(self, n, bucket, wait_s_each, service_s,
+                     exemplars=None):
+        """One executed micro-batch: n real rows padded to ``bucket``.
+        ``exemplars`` (optional, aligned with ``wait_s_each``): one
+        ``(req, span_id)`` per row, attached to each row's latency
+        bucket — built by the server only while the flight recorder
+        is on."""
         with self._lock:
             self._batches.inc()
             self._rows.inc(n)
             self._padded.inc(bucket - n)
             self._hit_child(bucket).inc()
             self._service.observe(service_s)
-            for w in wait_s_each:
+            for i, w in enumerate(wait_s_each):
                 self._wait.observe(w)
-                self._latency.observe(w + service_s)
+                self._latency.observe(
+                    w + service_s,
+                    exemplar=exemplars[i] if exemplars else None)
             self._completed.inc(n)
 
     def record_failure(self, n):

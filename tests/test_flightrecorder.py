@@ -1,0 +1,396 @@
+"""Flight recorder: incident black box + exemplars + statusz (ISSUE 18).
+
+Tier-1 coverage of the always-on bounded black box:
+
+- recorder OFF is the shared no-op singleton: real served traffic
+  records ZERO events (counter-asserted — the same discipline as the
+  tracer's zero-per-step-allocation pin);
+- recorder ON: the ring is bounded at its configured capacity under a
+  10k-event burst, with every eviction counted as a drop;
+- SLO page transition -> one post-mortem bundle, edge-triggered (a
+  breach that stays breached fires once), carrying the burn-window
+  reports in ``slo.json``;
+- worker death -> crash-triggered bundle, while every submitted
+  Future still resolves typed (the dump must not eat the chaos
+  contract);
+- a torn dump (InjectedCrash at the ``flight.dump`` site) leaves data
+  files with NO manifest, and ``flight_inspect.check`` says so;
+- exemplars on the hot-path latency histograms join back to the
+  offending request's event timeline inside the same bundle;
+- two bundles diff (the metrics pair chains "then" <- previous dump).
+
+The LLM-engine end-to-end (admit/prefill/step events, TTFT exemplar,
+engine statusz) needs a warmed decoder and is slow-marked; everything
+tier-1 here runs against pure-Python ``ModelServer`` backends — no XLA
+compiles at all.
+"""
+import glob
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu import serving  # noqa: E402
+from mxnet_tpu.serving import ServerClosed  # noqa: E402
+from mxnet_tpu.observability import (  # noqa: E402
+    get_flightrecorder, get_registry)
+from mxnet_tpu.observability.flightrecorder import (  # noqa: E402
+    flight_ring_capacity)
+from mxnet_tpu.observability.exemplars import collect  # noqa: E402
+from mxnet_tpu.observability.registry import MetricsRegistry  # noqa: E402
+from mxnet_tpu.observability.timeseries import TimeSeriesRing  # noqa: E402
+from mxnet_tpu.observability.slo import (  # noqa: E402
+    SLO, SLOEngine, STATUS_PAGE)
+from mxnet_tpu.resilience import InjectedCrash, faults  # noqa: E402
+
+ITEM = (2,)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _flight_state():
+    """Every test leaves the process-wide singleton OFF, empty, and at
+    the default ring capacity (tests share one interpreter)."""
+    fl = get_flightrecorder()
+    fl.disable()
+    fl.clear()
+    faults.reset()
+    yield fl
+    fl.enable(ring=flight_ring_capacity())
+    fl.disable()
+    fl.clear()
+    faults.reset()
+
+
+def _echo_server(name, **kw):
+    kw.setdefault("buckets", [1, 2, 4])
+    kw.setdefault("max_delay_ms", 5.0)
+    return serving.ModelServer(lambda b: b * 2.0, item_shape=ITEM,
+                               dtype="float32", name=name,
+                               **kw).start()
+
+
+def _serve_burst(srv, n=4):
+    for f in [srv.submit(np.zeros(ITEM, np.float32))
+              for _ in range(n)]:
+        f.result(timeout=30)
+
+
+def _bundles(tmp, trigger):
+    return sorted(glob.glob(os.path.join(tmp, f"flight_*_{trigger}")))
+
+
+def _read(bundle, fname):
+    with open(os.path.join(bundle, fname)) as f:
+        return json.load(f)
+
+
+# ------------------------------------------------------ off = no-op --
+
+def test_off_mode_records_nothing_counter_asserted(_flight_state):
+    """The zero-overhead pin: with the recorder off, real served
+    traffic moves NEITHER the ring nor the events counter — the same
+    shared-no-op discipline as the tracer."""
+    fl = _flight_state
+    assert not fl.enabled
+    before = fl.stats()
+    srv = _echo_server("flight_off")
+    _serve_burst(srv, n=6)
+    srv.shutdown()
+    after = fl.stats()
+    assert after["recorded"] == before["recorded"]
+    assert after["buffered"] == 0
+    assert after["dropped"] == before["dropped"]
+    # and event() itself is inert, not queueing anywhere
+    fl.event("serving.submit", req="srv:ghost")
+    assert fl.stats()["recorded"] == before["recorded"]
+
+
+# --------------------------------------------------- bounded ring ----
+
+def test_ring_bounded_with_counted_drops_at_10k_events(_flight_state):
+    fl = _flight_state
+    fl.enable(ring=128)
+    base = fl.stats()
+    for i in range(10_000):
+        fl.event("llm.step", attrs={"i": i})
+    st = fl.stats()
+    assert st["capacity"] == 128
+    assert st["buffered"] == 128                  # flat, not 10k
+    assert st["recorded"] - base["recorded"] == 10_000
+    assert st["dropped"] - base["dropped"] == 10_000 - (128 - base["buffered"])
+    # the ring holds the NEWEST events (black-box semantics: the tail
+    # before the incident, not the takeoff)
+    snap = fl.snapshot()
+    assert snap[-1]["attrs"]["i"] == 9_999
+    assert snap[0]["attrs"]["i"] == 9_999 - 127
+
+
+# ------------------------------------------------ SLO-page trigger ---
+
+def _paging_fixture():
+    """A local registry + ring whose last second burns hot enough that
+    a (1.5s, 1s) window pair pages at threshold 1.0 (borrowed from
+    test_slo_capacity's exact-burn fixtures)."""
+    reg = MetricsRegistry()
+    served = reg.counter("mxtpu_serving_requests_completed_total", "",
+                         ("server",)).labels(server="u")
+    shed = reg.counter("mxtpu_serving_shed_total", "",
+                       ("server", "reason")).labels(server="u",
+                                                    reason="queue_full")
+    reg.counter("mxtpu_serving_deadline_expired_total", "",
+                ("server",)).labels(server="u")
+    ring = TimeSeriesRing(reg, capacity=32)
+    t = 0.0
+    ring.record(now=t)
+    for _ in range(9):
+        t += 1.0
+        served.inc(100)
+        ring.record(now=t)
+    t += 1.0
+    served.inc(100)
+    shed.inc(10)
+    ring.record(now=t)
+    slo = SLO.serving_availability("avail_flight", "u", target=0.95)
+    eng = SLOEngine([slo], ring, registry=reg,
+                    windows=[(1.5, 1.0, 1.0, STATUS_PAGE)])
+    return eng
+
+
+def test_slo_page_transition_dumps_bundle_once(_flight_state,
+                                               tmp_path):
+    fl = _flight_state
+    fl.enable(out_dir=str(tmp_path))
+    eng = _paging_fixture()
+    rep = eng.evaluate()["avail_flight"]
+    assert rep["status"] == STATUS_PAGE
+    bundles = _bundles(str(tmp_path), "slo")
+    assert len(bundles) == 1, "page transition must cut one bundle"
+    man = _read(bundles[0], "MANIFEST.json")
+    assert man["trigger"] == "slo"
+    assert "avail_flight" in man["reason"]
+    # burn windows ride inside the bundle
+    slo_blob = _read(bundles[0], "slo.json")
+    assert slo_blob["avail_flight"]["status"] == STATUS_PAGE
+    assert "burn_rates" in slo_blob["avail_flight"]
+    # the trigger left its own decision event in the ring
+    kinds = [e["kind"] for e in _read(bundles[0], "events.json")]
+    assert "slo.trigger" in kinds
+    # edge-triggered: still paging on the next pass -> NO second bundle
+    eng.evaluate()
+    assert len(_bundles(str(tmp_path), "slo")) == 1
+
+
+def test_slo_trigger_gated_by_trigger_list(_flight_state, tmp_path,
+                                           monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_TRIGGERS", "crash")
+    fl = _flight_state
+    fl.enable(out_dir=str(tmp_path))
+    eng = _paging_fixture()
+    assert eng.evaluate()["avail_flight"]["status"] == STATUS_PAGE
+    assert _bundles(str(tmp_path), "slo") == []
+
+
+# -------------------------------------------------- crash trigger ----
+
+def test_worker_death_dumps_bundle_and_futures_resolve_typed(
+        _flight_state, tmp_path):
+    """The chaos invariant survives the black box: InjectedCrash at
+    the serving.worker point cuts a crash bundle AND every Future
+    still resolves typed — the dump must never add a hang."""
+    fl = _flight_state
+    fl.enable(out_dir=str(tmp_path))
+    faults.crash_at_point("serving.worker", nth=1)
+    srv = _echo_server("flight_crash", max_delay_ms=100.0)
+    futs = [srv.submit(np.zeros(ITEM, np.float32)) for _ in range(5)]
+    resolved, errors = 0, []
+    for f in futs:
+        try:
+            f.result(timeout=30)
+            resolved += 1
+        except BaseException as exc:
+            errors.append(exc)
+    assert resolved + len(errors) == 5            # nothing hangs
+    assert errors and all(isinstance(e, ServerClosed) for e in errors)
+    faults.reset()
+    srv.shutdown()
+    bundles = _bundles(str(tmp_path), "crash")
+    assert len(bundles) == 1
+    man = _read(bundles[0], "MANIFEST.json")
+    assert man["trigger"] == "crash"
+    assert "InjectedCrash" in man["reason"]
+    assert (man.get("extra") or {}).get("server") == "flight_crash"
+    # the ring caught the submits that preceded the death, and the
+    # statusz sweep caught the still-live server
+    events = _read(bundles[0], "events.json")
+    assert any(e["kind"] == "serving.submit"
+               and e["req"].startswith("srv:") for e in events)
+    assert "serving:flight_crash" in _read(bundles[0], "status.json")
+    fi = _load_tool("flight_inspect")
+    assert fi.check(bundles[0]) == []
+
+
+def test_torn_dump_leaves_no_manifest_and_check_reports_it(
+        _flight_state, tmp_path):
+    fl = _flight_state
+    fl.enable(out_dir=str(tmp_path))
+    fl.event("serving.submit", req="srv:1")
+    faults.crash_at_point("flight.dump", nth=1)
+    with pytest.raises(InjectedCrash):
+        fl.dump(trigger="manual", reason="torn")
+    faults.reset()
+    torn = _bundles(str(tmp_path), "manual")
+    assert len(torn) == 1
+    assert not os.path.exists(os.path.join(torn[0], "MANIFEST.json"))
+    assert os.path.exists(os.path.join(torn[0], "events.json"))
+    fi = _load_tool("flight_inspect")
+    probs = fi.check(torn[0])
+    assert probs and any("manifest" in p.lower() for p in probs)
+
+
+# ------------------------------------------- exemplars + statusz -----
+
+def test_exemplar_joins_back_to_request_timeline(_flight_state,
+                                                 tmp_path):
+    """The page-to-cause path: a latency exemplar captured on the hot
+    path carries the SAME ``srv:<rid>`` key as the request's events,
+    so a bundle resolves slow-bucket occupants to full timelines."""
+    fl = _flight_state
+    fl.enable(out_dir=str(tmp_path))
+    srv = _echo_server("flight_exm")
+    _serve_burst(srv, n=4)
+    bundle = fl.dump(trigger="manual", reason="exemplar join")
+    srv.shutdown()
+    exm = _read(bundle, "exemplars.json")
+    rows = [r for r in exm.get("mxtpu_serving_latency_seconds", [])
+            if r["labels"].get("server") == "flight_exm"]
+    assert rows, "served burst must have left latency exemplars"
+    reqs = {e["req"] for bkt in rows[0]["buckets"].values()
+            for e in bkt}
+    assert reqs and all(r.startswith("srv:") for r in reqs)
+    events = _read(bundle, "events.json")
+    by_req = {e["req"] for e in events if e["req"]}
+    assert reqs <= by_req, "every exemplar must join to ring events"
+    # and the inspector renders that join (exemplar -> waterfall)
+    fi = _load_tool("flight_inspect")
+    out = fi.render_exemplars(bundle,
+                              "mxtpu_serving_latency_seconds")
+    assert any(r in out for r in reqs)
+
+
+def test_model_server_statusz_shape(_flight_state):
+    srv = _echo_server("flight_statusz", max_queue=7)
+    _serve_burst(srv, n=2)
+    st = srv.debug_status()
+    srv.shutdown()
+    assert st["kind"] == "serving"
+    assert st["server"] == "flight_statusz"
+    assert st["max_queue"] == 7
+    assert st["queue_depth"] == 0 and st["inflight"] == []
+    assert st["breaker_state"] in (0, 1, 2)
+    json.dumps(st)                      # JSON-safe, whole surface
+
+
+# ------------------------------------------------------ bundle diff --
+
+def test_bundle_diff_pairs_consecutive_dumps(_flight_state, tmp_path):
+    """metrics_then of bundle N+1 == metrics_now of bundle N (the
+    baseline refresh chains bundles), and the inspector's diff
+    renders what moved between them."""
+    fl = _flight_state
+    fl.enable(out_dir=str(tmp_path))
+    srv = _echo_server("flight_diff")
+    _serve_burst(srv, n=2)
+    b1 = fl.dump(trigger="manual", reason="first")
+    _serve_burst(srv, n=3)
+    b2 = fl.dump(trigger="manual", reason="second")
+    srv.shutdown()
+    assert _read(b2, "metrics_then.json") == _read(b1,
+                                                   "metrics_now.json")
+    fi = _load_tool("flight_inspect")
+    assert fi.check(b1) == [] and fi.check(b2) == []
+    out = fi.diff(b1, b2)
+    assert "recorded" in out
+    assert os.path.basename(b1) in out and os.path.basename(b2) in out
+
+
+# ------------------------------------------------- LLM e2e (slow) ----
+
+@pytest.fixture(scope="module")
+def llm_srv():
+    """ONE warmed decoder server for every slow LLM test in this
+    module (warmup is the expensive part on a 1-CPU box)."""
+    from mxnet_tpu.serving.llm import TinyDecoder, DecoderConfig, LLMServer
+    model = TinyDecoder(DecoderConfig(
+        vocab_size=17, d_model=16, num_layers=2, num_heads=2,
+        d_ff=32, max_context=64))
+    srv = LLMServer(model, model.init_params(seed=0),
+                    name="flight_llm", max_seqs=2, block_size=8,
+                    max_context=64, max_queue=32)
+    srv.warmup()
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.mark.slow
+def test_llm_request_timeline_exemplar_and_statusz(
+        _flight_state, tmp_path, llm_srv):
+    """End to end on a real engine: one request's full event timeline
+    (submit -> admit -> prefill -> step -> served) lands in the ring,
+    its TTFT exemplar joins back to it, and the engine's statusz
+    carries KV/program accounting — all with zero recompiles (the
+    recorder is pure host code on warmed programs)."""
+    fl = _flight_state
+    fl.enable(out_dir=str(tmp_path))
+    with serving.CompileCounter() as cc:
+        futs = [llm_srv.submit([1 + i, 2, 3], max_new_tokens=3)
+                for i in range(3)]
+        for f in futs:
+            f.result(timeout=60)
+    assert cc.count == 0, "recording must not recompile warm programs"
+    events = fl.snapshot()
+    by_req = {}
+    for e in events:
+        if e["req"]:
+            by_req.setdefault(e["req"], []).append(e["kind"])
+    llm_reqs = {r for r in by_req if r.startswith("llm:")}
+    assert len(llm_reqs) == 3
+    for r in llm_reqs:
+        assert {"llm.submit", "llm.admit", "llm.prefill",
+                "llm.served"} <= set(by_req[r])
+    assert any(e["kind"] == "llm.step" for e in events)
+    # TTFT exemplars carry the same llm:<seq> keys
+    exm = collect(get_registry(), ("mxtpu_llm_ttft_seconds",))
+    ttft_reqs = {e["req"]
+                 for row in exm.get("mxtpu_llm_ttft_seconds", [])
+                 if row["labels"].get("server") == "flight_llm"
+                 for bkt in row["buckets"].values() for e in bkt}
+    assert ttft_reqs & llm_reqs
+    # statusz: server -> engine sweep, JSON-safe
+    st = llm_srv.debug_status()
+    assert st["kind"] == "llm"
+    eng = st["engine"]
+    assert set(eng["kv_blocks"]) >= {"used", "usable", "free"}
+    assert eng["programs"]["warmed"]
+    json.dumps(st)
+    # and the bundle round-trips through the inspector's request view
+    bundle = fl.dump(trigger="manual", reason="llm e2e")
+    fi = _load_tool("flight_inspect")
+    assert fi.check(bundle) == []
+    req = sorted(llm_reqs)[0]
+    out = fi.render_request(bundle, req)
+    assert "llm.admit" in out and "llm.served" in out

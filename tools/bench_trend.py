@@ -52,6 +52,9 @@ LLM_END = "<!-- LLM_BENCH_TREND:END -->"
 MC_BEGIN = ("<!-- MULTICHIP_TREND:BEGIN "
             "(tools/bench_trend.py — do not edit by hand) -->")
 MC_END = "<!-- MULTICHIP_TREND:END -->"
+CAP_BEGIN = ("<!-- CAPACITY_TREND:BEGIN "
+             "(tools/bench_trend.py — do not edit by hand) -->")
+CAP_END = "<!-- CAPACITY_TREND:END -->"
 HEADING = ("\n## Bench trend (MFU / throughput per round)\n\n"
            "Regenerate with `python tools/bench_trend.py` after "
            "every new `BENCH_rNN.json`; rows the table marks "
@@ -62,6 +65,15 @@ LLM_HEADING = ("\n## LLM decode bench trend (tokens/sec + TTFT per "
                "every new `BENCH_llm_rNN.json` (tools/llm_bench.py); "
                "skipped rows recompiled or lost requests and are not "
                "evidence.\n\n")
+CAP_HEADING = ("\n## Capacity trend (chips per 1M users, per round)\n\n"
+               "Regenerate with `python tools/bench_trend.py` after "
+               "every new `CAPACITY_rNN.json` (tools/load_replay.py). "
+               "The headline is the replay's committed chips-per-"
+               "1M-users under attained SLOs; a round whose SLOs did "
+               "NOT attain is an overload experiment, not a capacity "
+               "claim. CPU-host numbers trend the serving-stack "
+               "economics (admission/batching/KV behavior), not real "
+               "chip counts.\n\n")
 MC_HEADING = ("\n## Multi-chip SPMD scaling trend (devices → step "
               "time / dispatches)\n\n"
               "Regenerate with `python tools/bench_trend.py` after "
@@ -395,6 +407,94 @@ def render_multichip(rows):
     return "\n".join(lines)
 
 
+def scan_capacity(repo=REPO):
+    """Classified rows for the ``CAPACITY_r*.json`` trajectory
+    (tools/load_replay.py reports): {round, status, chips_per_m,
+    served_qps, shed_qps, tokens_s, slo, tag, note}. ``served/shed``
+    sum across frontends — the trend of interest is goodput per chip
+    against refusal behavior, round over round."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "CAPACITY_r*.json"))):
+        m = re.search(r"CAPACITY_r(\d+)\.json$", path)
+        rnd = int(m.group(1)) if m else 0
+        row = {"round": rnd, "status": "valid", "chips_per_m": None,
+               "served_qps": None, "shed_qps": None, "tokens_s": None,
+               "slo": "—", "tag": "", "note": ""}
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            row.update(status="invalid", note=f"unreadable: {e}")
+            rows.append(row)
+            continue
+        if isinstance(rec.get("round"), int):
+            row["round"] = rec["round"]
+        row["tag"] = rec.get("tag") or ""
+        if rec.get("skipped") or rec.get("value") is None:
+            row.update(status="skipped",
+                       note=f"skipped: {rec.get('skipped')}")
+            rows.append(row)
+            continue
+        row["chips_per_m"] = float(rec["value"])
+        attained = rec.get("slo_attained")
+        row["slo"] = ("attained" if attained
+                      else "—" if attained is None else "BREACHED")
+        if attained is False:
+            # an un-attained replay is an overload experiment: its
+            # chips/M figure is not a serving-capacity claim
+            row.update(status="overload",
+                       note="SLOs not attained — refusal-behavior "
+                            "evidence, not capacity")
+        served = shed = 0.0
+        have = False
+        for fe in rec.get("frontends") or []:
+            if fe.get("served_qps") is not None:
+                served += float(fe["served_qps"])
+                have = True
+            shed += float(fe.get("shed_qps") or 0.0) \
+                + float(fe.get("expired_qps") or 0.0) \
+                + float(fe.get("evicted_qps") or 0.0)
+            if fe.get("tokens_per_sec") is not None:
+                row["tokens_s"] = float(fe["tokens_per_sec"])
+        if have:
+            row["served_qps"], row["shed_qps"] = served, shed
+        rows.append(row)
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def render_capacity(rows):
+    def fmt(v, pat):
+        return pat % v if v is not None else "—"
+    lines = [
+        "| round | status | chips / 1M users | served qps | "
+        "shed+expired qps | llm tokens/s | SLOs | config | note |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| r{r['round']:02d} | {r['status']} "
+            f"| {fmt(r['chips_per_m'], '%.0f')} "
+            f"| {fmt(r['served_qps'], '%.2f')} "
+            f"| {fmt(r['shed_qps'], '%.2f')} "
+            f"| {fmt(r['tokens_s'], '%.1f')} "
+            f"| {r['slo']} | {r['tag']} | {r['note']} |")
+    valid = [r for r in rows if r["status"] == "valid"
+             and r["chips_per_m"] is not None]
+    if valid:
+        best = min(valid, key=lambda r: r["chips_per_m"])
+        latest = valid[-1]
+        lines.append(
+            f"\nBest (lowest) attained footprint: "
+            f"**{best['chips_per_m']:.0f} chips/1M users** "
+            f"(r{best['round']:02d}, {best['tag']}); latest "
+            f"r{latest['round']:02d} at {latest['chips_per_m']:.0f}.")
+    else:
+        lines.append("\nNo SLO-attained capacity round yet.")
+    return "\n".join(lines)
+
+
 def splice(doc_path, table, begin=BEGIN, end=END, heading=HEADING):
     block = f"{begin}\n\n{table}\n\n{end}"
     try:
@@ -426,9 +526,10 @@ def main():
     rows = scan(args.repo)
     llm_rows = scan_llm(args.repo)
     mc_rows = scan_multichip(args.repo)
-    if not rows and not llm_rows and not mc_rows:
-        print("no BENCH_r*.json, BENCH_llm_r*.json or "
-              "MULTICHIP_r*.json found", file=sys.stderr)
+    cap_rows = scan_capacity(args.repo)
+    if not rows and not llm_rows and not mc_rows and not cap_rows:
+        print("no BENCH_r*.json, BENCH_llm_r*.json, MULTICHIP_r*.json "
+              "or CAPACITY_r*.json found", file=sys.stderr)
         return 1
     doc = args.doc or os.path.join(args.repo, "docs",
                                    "PERFORMANCE.md")
@@ -449,6 +550,12 @@ def main():
         if not args.dry_run:
             splice(doc, mc_table, begin=MC_BEGIN, end=MC_END,
                    heading=MC_HEADING)
+    if cap_rows:
+        cap_table = render_capacity(cap_rows)
+        print("\n" + cap_table)
+        if not args.dry_run:
+            splice(doc, cap_table, begin=CAP_BEGIN, end=CAP_END,
+                   heading=CAP_HEADING)
     if not args.dry_run:
         print(f"\nwrote {doc}")
     return 0

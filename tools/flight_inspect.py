@@ -1,0 +1,364 @@
+"""Render, verify and diff flight-recorder post-mortem bundles.
+
+A bundle is the directory ``FlightRecorder.dump()`` writes — seven
+JSON data files plus ``MANIFEST.json`` with per-file crc32/bytes (see
+``mxnet_tpu/observability/flightrecorder.py`` for the format table):
+
+    python tools/flight_inspect.py <bundle>             # waterfall
+    python tools/flight_inspect.py <bundle> --check     # rc 0/1
+    python tools/flight_inspect.py <bundle> --request llm:17
+    python tools/flight_inspect.py <bundle> --exemplar  # breach join
+    python tools/flight_inspect.py --diff <A> <B>
+
+Default render: the **decision log** (control-plane events — breaker
+transitions, fleet swap phases, KV reclaim/COW, adapter fault-in/evict,
+sheds) followed by the **per-request waterfall** — every request key in
+the ring, oldest first, each with its lifecycle events at offsets from
+its first recorded event.
+
+``--check`` proves the bundle complete and uncorrupted: MANIFEST.json
+present and parsable, every indexed file present with matching byte
+count and crc32, every data file valid JSON, no stray data files. A
+torn bundle (the ``flight.dump`` chaos site kills the writer after the
+data files but before the manifest) fails with rc 1 — that asymmetry
+is the atomicity contract.
+
+``--request KEY`` renders one request's full joined timeline: its
+flight events plus every trace span (``trace.json``) belonging to the
+request — matched via the ``span_id`` its submit event carries, plus
+all descendants of that span.
+
+``--exemplar [METRIC]`` resolves histogram exemplars back to request
+timelines: for the highest-bucket exemplars of METRIC (default: every
+exemplar metric in the bundle), prints the owning request's waterfall —
+"the SLO page named this latency bucket; these are the requests in it,
+step by step".
+
+``--diff A B`` compares two bundles: manifest/stat movement, event-kind
+counts, request overlap, and the metrics delta between A's and B's
+``metrics_now.json`` (reusing ``tools/metrics_dump.render_delta`` —
+same reset handling as the live timeseries layer).
+"""
+import argparse
+import json
+import os
+import sys
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MANIFEST = "MANIFEST.json"
+
+# control-plane event kinds (no req key, or fleet/adapter/KV scope):
+# everything else with a req key renders in the waterfall
+DECISION_KINDS = ("breaker", "fleet.swap", "fleet.shed", "kv.reclaim",
+                  "kv.cow", "adapter.fault_in", "adapter.evict",
+                  "slo.trigger", "serving.breaker_reject")
+
+
+def _load(bundle, fname):
+    with open(os.path.join(bundle, fname)) as f:
+        return json.load(f)
+
+
+def _fmt_t(t_us):
+    return f"t+{t_us / 1e6:10.6f}s"
+
+
+def _fmt_attrs(attrs):
+    if not attrs:
+        return ""
+    return "  {" + ", ".join(f"{k}={v}" for k, v in
+                             sorted(attrs.items())) + "}"
+
+
+# -------------------------------------------------------------- check --
+
+def check(bundle):
+    """Verify one bundle; returns a list of problems (empty = OK)."""
+    problems = []
+    mpath = os.path.join(bundle, MANIFEST)
+    if not os.path.exists(mpath):
+        return [f"{MANIFEST} missing (torn bundle: the writer died "
+                "before the commit point)"]
+    try:
+        manifest = _load(bundle, MANIFEST)
+    except ValueError as e:
+        return [f"{MANIFEST} unparsable: {e}"]
+    files = manifest.get("files")
+    if not isinstance(files, dict) or not files:
+        return [f"{MANIFEST} carries no file index"]
+    for fname, meta in sorted(files.items()):
+        path = os.path.join(bundle, fname)
+        if not os.path.exists(path):
+            problems.append(f"{fname}: indexed but missing")
+            continue
+        data = open(path, "rb").read()
+        if len(data) != meta.get("bytes"):
+            problems.append(
+                f"{fname}: {len(data)} bytes, manifest says "
+                f"{meta.get('bytes')}")
+        crc = zlib.crc32(data) & 0xFFFFFFFF
+        if crc != meta.get("crc32"):
+            problems.append(
+                f"{fname}: crc32 {crc:#010x}, manifest says "
+                f"{meta.get('crc32'):#010x}")
+        try:
+            json.loads(data)
+        except ValueError as e:
+            problems.append(f"{fname}: invalid JSON: {e}")
+    for fname in sorted(os.listdir(bundle)):
+        if fname != MANIFEST and fname.endswith(".json") \
+                and fname not in files:
+            problems.append(f"{fname}: present but not in manifest")
+    return problems
+
+
+# ------------------------------------------------------------- render --
+
+def _split_events(events):
+    """(decision log, {req: [events]}) — both in ring order."""
+    decisions, requests = [], {}
+    for ev in events:
+        req = ev.get("req")
+        if req is None or ev["kind"] in DECISION_KINDS:
+            decisions.append(ev)
+        else:
+            requests.setdefault(req, []).append(ev)
+    return decisions, requests
+
+
+def render(bundle):
+    manifest = _load(bundle, MANIFEST)
+    events = _load(bundle, "events.json")
+    decisions, requests = _split_events(events)
+    st = manifest.get("stats", {})
+    lines = [f"# bundle {manifest.get('bundle')}  "
+             f"trigger={manifest.get('trigger')}  "
+             f"reason={manifest.get('reason')}",
+             f"# events={len(events)} (recorded={st.get('recorded')} "
+             f"dropped={st.get('dropped')})  requests={len(requests)}  "
+             f"dumps_so_far={st.get('dumps')}"]
+    slo = _load(bundle, "slo.json")
+    fired = [name for name, rep in sorted(slo.items())
+             if isinstance(rep, dict) and rep.get("status", 0) >= 2]
+    if fired:
+        lines.append("# SLO page/breach: " + ", ".join(
+            f"{n} ({slo[n].get('status_name')})" for n in fired))
+    lines.append("")
+    lines.append(f"decision log ({len(decisions)} entries)")
+    lines.append("-" * 72)
+    for ev in decisions:
+        tag = f" req={ev['req']}" if ev.get("req") else ""
+        lines.append(f"  {_fmt_t(ev['t_us'])}  {ev['kind']:<22}"
+                     f"{tag}{_fmt_attrs(ev.get('attrs'))}")
+    lines.append("")
+    lines.append(f"request waterfall ({len(requests)} requests)")
+    lines.append("-" * 72)
+    order = sorted(requests, key=lambda r: requests[r][0]["t_us"])
+    for req in order:
+        evs = requests[req]
+        t0 = evs[0]["t_us"]
+        tenant = next((e["tenant"] for e in evs if e.get("tenant")),
+                      None)
+        span = (evs[0].get("attrs") or {}).get("span_id")
+        lines.append(f"{req}  tenant={tenant}  span={span}  "
+                     f"start={_fmt_t(t0)}  "
+                     f"dur={(evs[-1]['t_us'] - t0) / 1e3:.3f}ms")
+        for ev in evs:
+            lines.append(f"    +{(ev['t_us'] - t0) / 1e3:9.3f}ms  "
+                         f"{ev['kind']:<16}"
+                         f"{_fmt_attrs(ev.get('attrs'))}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------- request span join --
+
+def _span_tree(spans, root_ids):
+    """All spans in ``root_ids`` plus their descendants, by parent_id."""
+    children = {}
+    for sp in spans:
+        children.setdefault(sp.get("parent_id"), []).append(sp)
+    out, stack = [], [sp for sp in spans
+                      if sp.get("span_id") in root_ids]
+    seen = set()
+    while stack:
+        sp = stack.pop()
+        sid = sp.get("span_id")
+        if sid in seen:
+            continue
+        seen.add(sid)
+        out.append(sp)
+        stack.extend(children.get(sid, []))
+    return sorted(out, key=lambda s: s.get("ts_us", 0))
+
+
+def render_request(bundle, req):
+    """One request's joined timeline: flight events + trace spans."""
+    events = [e for e in _load(bundle, "events.json")
+              if e.get("req") == req]
+    if not events:
+        return f"{req}: no flight events in this bundle"
+    t0 = events[0]["t_us"]
+    lines = [f"# {req}: {len(events)} flight events",
+             "flight events", "-" * 72]
+    span_ids = set()
+    for ev in events:
+        sid = (ev.get("attrs") or {}).get("span_id")
+        if sid:
+            span_ids.add(sid)
+        lines.append(f"  +{(ev['t_us'] - t0) / 1e3:9.3f}ms  "
+                     f"{ev['kind']:<16}{_fmt_attrs(ev.get('attrs'))}")
+    spans = _load(bundle, "trace.json")
+    joined = _span_tree(spans, span_ids)
+    lines.append("")
+    lines.append(f"trace spans ({len(joined)} joined via span ids "
+                 f"{sorted(span_ids)})")
+    lines.append("-" * 72)
+    if not joined and span_ids:
+        lines.append("  (span ring rotated past this request — raise "
+                     "MXNET_TPU_TRACE_BUFFER)")
+    base = joined[0]["ts_us"] if joined else 0
+    for sp in joined:
+        lines.append(
+            f"  +{(sp['ts_us'] - base) / 1e3:9.3f}ms  "
+            f"{sp['name']:<28} {sp.get('dur_us', 0) / 1e3:8.3f}ms  "
+            f"span={sp.get('span_id')} parent={sp.get('parent_id')}"
+            f"{_fmt_attrs(sp.get('attrs'))}")
+    return "\n".join(lines)
+
+
+def render_exemplars(bundle, metric=None):
+    """Resolve bucket exemplars to request timelines: the breach-to-
+    request join. For each (metric, labels) family, take the exemplars
+    of the HIGHEST occupied bucket (the slow tail an SLO page points
+    at) and render each owning request's full timeline."""
+    ex = _load(bundle, "exemplars.json")
+    if metric is not None:
+        ex = {metric: ex.get(metric, [])}
+    chunks = []
+    seen = set()
+    for name, fams in sorted(ex.items()):
+        for fam in fams:
+            buckets = fam.get("buckets") or {}
+            if not buckets:
+                continue
+            # highest bucket = slowest observations this family saw
+            def _edge(le):
+                return float("inf") if le == "+Inf" else float(le)
+            top = max(buckets, key=_edge)
+            for x in buckets[top]:
+                chunks.append(
+                    f"# exemplar: {name}{fam.get('labels')} "
+                    f"le={top} value={x['value']:.6g} req={x['req']} "
+                    f"span={x['span_id']}")
+                if x["req"] in seen:
+                    chunks.append(f"  (timeline of {x['req']} "
+                                  "rendered above)")
+                    continue
+                seen.add(x["req"])
+                chunks.append(render_request(bundle, x["req"]))
+            chunks.append("")
+    if not chunks:
+        return "(no exemplars in this bundle — recorder was off on " \
+               "the hot paths, or no traffic)"
+    return "\n".join(chunks)
+
+
+# --------------------------------------------------------------- diff --
+
+def diff(bundle_a, bundle_b):
+    ma, mb = _load(bundle_a, MANIFEST), _load(bundle_b, MANIFEST)
+    ea, eb = _load(bundle_a, "events.json"), _load(bundle_b,
+                                                  "events.json")
+    lines = [f"# diff {ma.get('bundle')} -> {mb.get('bundle')}",
+             f"# triggers: {ma.get('trigger')} -> {mb.get('trigger')}"]
+    sa, sb = ma.get("stats", {}), mb.get("stats", {})
+    for key in ("recorded", "dropped", "dumps"):
+        va, vb = sa.get(key, 0), sb.get(key, 0)
+        lines.append(f"  {key:<10} {va} -> {vb} ({vb - va:+d})")
+
+    def _kinds(evs):
+        out = {}
+        for e in evs:
+            out[e["kind"]] = out.get(e["kind"], 0) + 1
+        return out
+
+    ka, kb = _kinds(ea), _kinds(eb)
+    lines.append("")
+    lines.append(f"{'event kind':<24} {'A':>8} {'B':>8} {'delta':>8}")
+    lines.append("-" * 52)
+    for kind in sorted(set(ka) | set(kb)):
+        a, b = ka.get(kind, 0), kb.get(kind, 0)
+        lines.append(f"{kind:<24} {a:>8} {b:>8} {b - a:>+8}")
+    ra = {e["req"] for e in ea if e.get("req")}
+    rb = {e["req"] for e in eb if e.get("req")}
+    lines.append("")
+    lines.append(f"requests: {len(ra)} in A, {len(rb)} in B, "
+                 f"{len(ra & rb)} in both")
+    # metrics movement between the two dump instants — the same delta
+    # renderer the offline metrics tooling uses
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from metrics_dump import render_delta
+    finally:
+        sys.path.pop(0)
+    lines.append("")
+    lines.append(render_delta(
+        {"ts": ma.get("created_unix"),
+         "metrics": _load(bundle_a, "metrics_now.json")},
+        {"ts": mb.get("created_unix"),
+         "metrics": _load(bundle_b, "metrics_now.json")}))
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------- main --
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Inspect flight-recorder post-mortem bundles.")
+    ap.add_argument("bundle", nargs="?",
+                    help="bundle directory (from FlightRecorder.dump)")
+    ap.add_argument("--check", action="store_true",
+                    help="verify manifest + per-file crc32/bytes; "
+                         "rc 0 iff the bundle is complete")
+    ap.add_argument("--request", metavar="KEY",
+                    help="render one request's joined flight+trace "
+                         "timeline (e.g. llm:17, srv:3)")
+    ap.add_argument("--exemplar", nargs="?", const="", metavar="METRIC",
+                    help="resolve top-bucket histogram exemplars to "
+                         "request timelines (optionally one metric)")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                    help="compare two bundles")
+    args = ap.parse_args()
+
+    if args.diff:
+        print(diff(args.diff[0], args.diff[1]))
+        return 0
+    if not args.bundle:
+        ap.error("bundle directory required (or --diff A B)")
+    if not os.path.isdir(args.bundle):
+        print(f"{args.bundle}: not a bundle directory", file=sys.stderr)
+        return 1
+    if args.check:
+        problems = check(args.bundle)
+        if problems:
+            for p in problems:
+                print(f"FAIL {args.bundle}: {p}")
+            return 1
+        manifest = _load(args.bundle, MANIFEST)
+        print(f"OK {args.bundle}: {len(manifest['files'])} files, "
+              f"trigger={manifest.get('trigger')}")
+        return 0
+    if args.request:
+        print(render_request(args.bundle, args.request))
+        return 0
+    if args.exemplar is not None:
+        print(render_exemplars(args.bundle, args.exemplar or None))
+        return 0
+    print(render(args.bundle))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -775,7 +775,8 @@ def run_fleet(args, spec, trace, ring):
     }
 
 
-def evaluate_and_report_fleet(args, spec, trace, blk, out_dir):
+def evaluate_and_report_fleet(args, spec, trace, blk, out_dir,
+                              rings=None, flight_bundle=None):
     """Fleet capacity derivation + committed artifact.
 
     Per-model chips-per-M-users from the model's own typed partition
@@ -880,14 +881,82 @@ def evaluate_and_report_fleet(args, spec, trace, blk, out_dir):
     os.makedirs(out_dir, exist_ok=True)
     metrics_log = os.path.join(out_dir, "load_replay_metrics.jsonl")
     get_registry().write_snapshot(metrics_log)
+    ts_log = persist_timeseries(
+        rings or {},
+        os.path.join(out_dir, "load_replay_timeseries.jsonl"))
     rec["_capture"] = {
         "tag": f"load_replay_fleet_seed{spec.seed}",
         "metrics_log": metrics_log,
+        "timeseries_log": ts_log,
+        "flight_bundle": flight_bundle,
         "captured_at": datetime.datetime.now(
             datetime.timezone.utc).isoformat(),
     }
     path = perf_capture.emit_capacity_snapshot(rec, out_dir=out_dir)
     return rec, path
+
+
+# -------------------------------------------------- flight probe ----
+#
+# ISSUE 18: the replay doubles as the flight recorder's chaos proof.
+# The recorder runs over the WHOLE window (every submit/admit/step/
+# served event from both front ends lands in the ring), and a timer
+# thread fires ONE InjectedCrash at a probe site mid-replay. The crash
+# is caught in the probe thread and handed to ``crash_dump`` — the
+# replay itself never notices, no future fails, the typed-partition
+# and CompileCounter==0 refusal gates stay exactly as strict — but
+# what lands on disk is a genuine crash-triggered post-mortem bundle
+# captured while both servers carried live traffic.
+
+def arm_flight_probe(args, spec, out_dir):
+    """Enable the recorder and schedule the mid-replay crash probe;
+    returns the probe state dict (``finish_flight_probe`` reaps it)."""
+    from mxnet_tpu.observability import get_flightrecorder
+    from mxnet_tpu.resilience import InjectedCrash, faults
+    fl = get_flightrecorder()
+    fl.enable(out_dir=out_dir)
+    faults.crash_at_point("flight.replay_probe", nth=1)
+    state = {"bundle": None, "timer": None, "recorder": fl}
+
+    def probe():
+        try:
+            faults.point("flight.replay_probe")
+        except InjectedCrash as exc:
+            state["bundle"] = fl.crash_dump(exc, server="replay_probe")
+        finally:
+            # disarm: the injector must not stay hot past the probe
+            # (an armed injector slows every check() in the hot path)
+            faults.reset()
+
+    timer = threading.Timer(spec.duration_s / args.speed / 2.0, probe)
+    timer.daemon = True
+    timer.start()
+    state["timer"] = timer
+    return state
+
+
+def finish_flight_probe(state):
+    """Join the probe timer; returns the bundle path (or None if the
+    dump failed — the smoke treats that as a hard problem)."""
+    if state is None:
+        return None
+    state["timer"].join(timeout=60)
+    return state["bundle"]
+
+
+def persist_timeseries(rings, path):
+    """Write every frontend ring's raw snapshot records as JSONL —
+    the same records the SLO engine and capacity model read, committed
+    alongside the report so the derivation is auditable after the
+    fact (and diffable against a flight bundle's metrics pair)."""
+    with open(path, "w") as f:
+        for frontend in sorted(rings):
+            for rec in rings[frontend].records():
+                f.write(json.dumps(
+                    {"frontend": frontend, "ts": rec["ts"],
+                     "metrics": rec["metrics"]},
+                    sort_keys=True, default=repr) + "\n")
+    return path
 
 
 # ------------------------------------------------- SLO + capacity ----
@@ -912,7 +981,8 @@ def _env_float(name, default):
         return default
 
 
-def evaluate_and_report(args, spec, trace, results, rings, out_dir):
+def evaluate_and_report(args, spec, trace, results, rings, out_dir,
+                        flight_bundle=None):
     """SLO evaluation + capacity derivation + committed artifact."""
     from mxnet_tpu.observability import SLO, SLOEngine, get_registry
     from mxnet_tpu.observability import capacity as cap_mod
@@ -992,9 +1062,13 @@ def evaluate_and_report(args, spec, trace, results, rings, out_dir):
     os.makedirs(out_dir, exist_ok=True)
     metrics_log = os.path.join(out_dir, "load_replay_metrics.jsonl")
     get_registry().write_snapshot(metrics_log)
+    ts_log = persist_timeseries(
+        rings, os.path.join(out_dir, "load_replay_timeseries.jsonl"))
     rec["_capture"] = {
         "tag": f"load_replay_seed{spec.seed}",
         "metrics_log": metrics_log,
+        "timeseries_log": ts_log,
+        "flight_bundle": flight_bundle,
         "captured_at": datetime.datetime.now(
             datetime.timezone.utc).isoformat(),
     }
@@ -1004,14 +1078,17 @@ def evaluate_and_report(args, spec, trace, results, rings, out_dir):
 
 # -------------------------------------------------------------- main --
 
-def _smoke_check(args, spec, trace, results, rec, cap_path):
+def _smoke_check(args, spec, trace, results, rec, cap_path,
+                 flight_bundle=None):
     """The CI gate: determinism, zero recompiles, exact typed
-    partition, a well-formed committed capacity report, and a clean
-    exposition."""
+    partition, a well-formed committed capacity report, a clean
+    exposition, a verified crash-triggered flight bundle, and the
+    persisted time-series records."""
     from mxnet_tpu.observability import get_registry
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     try:
         from metrics_dump import parse_exposition
+        from flight_inspect import check as flight_check
     finally:
         sys.path.pop(0)
     probs = []
@@ -1077,9 +1154,42 @@ def _smoke_check(args, spec, trace, results, rec, cap_path):
     for prefix in ("mxtpu_slo_attainment", "mxtpu_slo_status",
                    "mxtpu_slo_burn_rate", "mxtpu_ts_snapshots_total",
                    "mxtpu_serving_tenant_requests_total",
-                   "mxtpu_llm_tenant_requests_total"):
+                   "mxtpu_llm_tenant_requests_total",
+                   "mxtpu_flight_events_total",
+                   "mxtpu_flight_dumps_total"):
         if not any(n.startswith(prefix) for n, _ in samples):
             probs.append(f"no {prefix}* series in exposition")
+    # flight recorder (ISSUE 18): the mid-replay probe must have
+    # produced a complete, CRC-verified crash bundle
+    if not flight_bundle:
+        probs.append("mid-replay probe produced no flight bundle")
+    else:
+        for p in flight_check(flight_bundle):
+            probs.append(f"flight bundle: {p}")
+        try:
+            with open(os.path.join(flight_bundle,
+                                   "MANIFEST.json")) as f:
+                man = json.load(f)
+            if man.get("trigger") != "crash":
+                probs.append("flight bundle trigger is "
+                             f"{man.get('trigger')!r}, not 'crash'")
+            if not man.get("stats", {}).get("recorded"):
+                probs.append("flight bundle recorded no events")
+        except Exception as exc:
+            probs.append(f"flight manifest unreadable: {exc!r}")
+    ts_log = (rec.get("_capture") or {}).get("timeseries_log")
+    if not ts_log or not os.path.exists(ts_log):
+        probs.append("no persisted time-series snapshots")
+    else:
+        with open(ts_log) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        if len(lines) < 4:
+            probs.append(f"time-series log holds only {len(lines)} "
+                         "snapshots")
+        if any("metrics" not in ln or "frontend" not in ln
+               for ln in lines):
+            probs.append("time-series records missing frontend/"
+                         "metrics fields")
     return probs
 
 
@@ -1174,6 +1284,9 @@ def main():
         return 0
 
     from mxnet_tpu.observability import TimeSeriesRing, get_registry
+    out_dir = args.out or tempfile.mkdtemp(prefix="load_replay_")
+    os.makedirs(out_dir, exist_ok=True)
+    flight = arm_flight_probe(args, spec, out_dir)
     if args.fleet:
         if args.closed:
             print("--fleet is open-loop only (the swap must land "
@@ -1182,9 +1295,12 @@ def main():
         ring = TimeSeriesRing(get_registry())
         blk = run_fleet(args, spec, trace, ring)
         print(json.dumps(blk, indent=1))
-        out_dir = args.out or tempfile.mkdtemp(prefix="load_replay_")
-        rec, cap_path = evaluate_and_report_fleet(args, spec, trace,
-                                                  blk, out_dir)
+        bundle = finish_flight_probe(flight)
+        if bundle:
+            print(f"FLIGHT bundle -> {bundle}")
+        rec, cap_path = evaluate_and_report_fleet(
+            args, spec, trace, blk, out_dir, rings={"fleet": ring},
+            flight_bundle=bundle)
         print(f"CAPACITY json -> {cap_path}")
         print(json.dumps({k: rec[k] for k in
                           ("value", "unit", "slo_attained", "chips",
@@ -1206,16 +1322,20 @@ def main():
         results.append(run_llm(args, spec, trace, rings["llm"]))
         print(json.dumps(results[-1], indent=1))
 
-    out_dir = args.out or tempfile.mkdtemp(prefix="load_replay_")
+    bundle = finish_flight_probe(flight)
+    if bundle:
+        print(f"FLIGHT bundle -> {bundle}")
     rec, cap_path = evaluate_and_report(args, spec, trace, results,
-                                        rings, out_dir)
+                                        rings, out_dir,
+                                        flight_bundle=bundle)
     print(f"CAPACITY json -> {cap_path}")
     print(json.dumps({k: rec[k] for k in
                       ("value", "unit", "slo_attained", "slo_statuses",
                        "chips", "window_s") if k in rec}, indent=1))
 
     if args.smoke:
-        probs = _smoke_check(args, spec, trace, results, rec, cap_path)
+        probs = _smoke_check(args, spec, trace, results, rec, cap_path,
+                             flight_bundle=bundle)
         if probs:
             for p in probs:
                 print(f"SMOKE problem: {p}")

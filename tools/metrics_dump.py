@@ -677,6 +677,58 @@ def smoke():
         print("SMOKE FAIL: export did not account its bytes")
         return 1
 
+    # flight recorder (ISSUE 18): enable the black box, run a short
+    # burst so real serving events land in the ring, cut one manual
+    # bundle — the mxtpu_flight_* series (events / drops / dumps by
+    # trigger / bundle bytes) must land in the SAME exposition as
+    # everything above, and the bundle must pass flight_inspect
+    # --check (manifest present, CRCs good, every payload valid JSON)
+    from mxnet_tpu.observability import get_flightrecorder
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from flight_inspect import check as flight_check
+    finally:
+        sys.path.pop(0)
+    fl = get_flightrecorder()
+    was_on = fl.enabled
+    before = parse_exposition(reg.expose())
+    dumps0 = before.get(("mxtpu_flight_dumps_total",
+                         (("trigger", "manual"),)), 0)
+    events0 = before.get(("mxtpu_flight_events_total", ()), 0)
+    bytes0 = before.get(("mxtpu_flight_bundle_bytes_total", ()), 0)
+    with tempfile.TemporaryDirectory() as d:
+        fl.enable(out_dir=d)
+        fsmoke = serving.ModelServer(
+            lambda b: b + 1.0, buckets=[1, 2], max_delay_ms=1.0,
+            item_shape=(3,), dtype="float32", name="smoke_flight")
+        fsmoke.start()
+        for fut in [fsmoke.submit(np.zeros(3, np.float32))
+                    for _ in range(3)]:
+            fut.result(timeout=30)
+        bundle = fl.dump(trigger="manual", reason="smoke")
+        fsmoke.shutdown()
+        fprobs = flight_check(bundle)
+        if fprobs:
+            print(f"SMOKE FAIL: flight bundle problems: {fprobs}")
+            return 1
+    if not was_on:
+        fl.disable()
+    fsamples = parse_exposition(reg.expose())
+    if fsamples.get(("mxtpu_flight_events_total", ()), 0) <= events0:
+        print("SMOKE FAIL: serving burst recorded no flight events")
+        return 1
+    if ("mxtpu_flight_events_dropped_total", ()) not in fsamples:
+        print("SMOKE FAIL: no flight drop counter in exposition")
+        return 1
+    if fsamples.get(("mxtpu_flight_dumps_total",
+                     (("trigger", "manual"),)), 0) != dumps0 + 1:
+        print("SMOKE FAIL: manual flight dump not counted once")
+        return 1
+    if fsamples.get(("mxtpu_flight_bundle_bytes_total", ()),
+                    0) <= bytes0:
+        print("SMOKE FAIL: flight bundle bytes not accounted")
+        return 1
+
     # JSONL round-trip through the env-gated writer (re-scrape: the
     # export above moved the mxtpu_trace_* counters)
     samples = parse_exposition(reg.expose())
