@@ -380,6 +380,35 @@ def ragged_flat_attention(q, k_pages, v_pages, block_tables, seq_ids,
                                float(scale), bool(interpret))
 
 
+def ragged_flat_attention_sharded(q, k_pages, v_pages, block_tables,
+                                  seq_ids, positions, axis_name=None,
+                                  scale=None, use_pallas=None,
+                                  interpret=None, k_scales=None,
+                                  v_scales=None):
+    """Head-sharded flat variant for ``shard_map`` bodies (ISSUE 19),
+    incl. the quantized-page form: ``q [T, H_local, D]``, pages
+    ``(N, bs, H_local, D)`` and scale pools ``(N, bs, H_local)``
+    carry ONLY this shard's heads; ``block_tables/seq_ids/positions``
+    ride replicated (host-global block accounting).
+
+    Attention is per-head independent and the softmax scale is
+    ``1/sqrt(head_dim)`` — never head-count-dependent — so the local
+    call IS this shard's full contribution: there is NO collective in
+    here. The all-reduce that merges shards belongs to the caller's
+    o-projection (fused into the one step program), which keeps this
+    kernel dispatch per-shard and collective placement explicit.
+    ``axis_name`` is accepted for symmetry/documentation; the scale
+    default is pinned to head_dim explicitly so a future head-count
+    -dependent rescale can't silently break shard independence."""
+    del axis_name  # no collective here by design — see docstring
+    if scale is None:
+        scale = float(1.0 / (q.shape[-1] ** 0.5))  # head_dim only
+    return ragged_flat_attention(
+        q, k_pages, v_pages, block_tables, seq_ids, positions,
+        scale=scale, use_pallas=use_pallas, interpret=interpret,
+        k_scales=k_scales, v_scales=v_scales)
+
+
 def _chunk_kernel(bt_ref, len_ref, qlen_ref, q_ref, k_ref, v_ref,
                   o_ref, acc_ref, m_ref, l_ref, *, scale, block_size,
                   num_blocks, q_tokens):

@@ -14,7 +14,7 @@ Axes convention (How-to-Scale-Your-Model recipe):
 """
 from .compat import shard_map  # noqa: F401  (version-proof import path)
 from .mesh import (make_mesh, local_mesh, data_parallel_spec,  # noqa: F401
-                   mesh_shard_info, parse_mesh)  # noqa: F401
+                   mesh_shard_info, parse_mesh, llm_mesh)  # noqa: F401
 from .functional import functional_call, extract_params, load_params  # noqa: F401
 from .trainer import ShardedTrainer, shard_batch  # noqa: F401
 from .ring_attention import ring_attention, sequence_shard  # noqa: F401

@@ -16,9 +16,9 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "local_mesh", "data_parallel_spec",
-           "mesh_shard_info", "parse_mesh", "batch_spec", "leaf_spec",
-           "round_up_to_dp", "spans_processes", "place_global", "to_host",
-           "spmd_metrics", "note_mesh"]
+           "mesh_shard_info", "parse_mesh", "llm_mesh", "batch_spec",
+           "leaf_spec", "round_up_to_dp", "spans_processes",
+           "place_global", "to_host", "spmd_metrics", "note_mesh"]
 
 
 def make_mesh(dp: Optional[int] = None, tp: int = 1, pp: int = 1,
@@ -81,6 +81,52 @@ def parse_mesh(spec, devices=None) -> Mesh:
     if dp is not None and dp < 0:
         dp = None
     return make_mesh(dp=dp, devices=devices, **axes)
+
+
+def llm_mesh(spec, devices=None) -> Mesh:
+    """Build the serving mesh from a compact string spec — the
+    CLI/env spelling for the LLM engine (``llm_bench.py --mesh``,
+    ``MXNET_TPU_LLM_MESH``). Same axis grammar as :func:`parse_mesh`
+    but with SERVING defaults: only ``dp``/``tp`` axes exist, a bare
+    integer means tensor-parallel width, and ``dp`` defaults to 1
+    instead of absorbing leftover devices (an engine that silently
+    grew replica groups because the host had spare chips would break
+    the one-scheduler accounting; ask for dp explicitly).
+
+    - ``"tp=2"``       → 1x2 (dp, tp) mesh
+    - ``"2"``          → tp=2
+    - ``"dp=2,tp=2"``  → 2 replica groups of 2-way tensor parallel
+    - ``"dp=-1,tp=2"`` → dp absorbs the remaining devices
+    """
+    spec = str("" if spec is None else spec).strip()
+    axes = {"dp": 1, "tp": 1}
+    if spec.isdigit():
+        axes["tp"] = int(spec)
+    elif spec:
+        for part in spec.split(","):
+            k, _, v = part.partition("=")
+            k, v = k.strip(), v.strip()
+            if k not in axes:
+                raise ValueError(f"unknown llm mesh axis {k!r} in "
+                                 f"{spec!r} (axes: dp, tp)")
+            axes[k] = int(v)
+    devices = list(devices if devices is not None else jax.devices())
+    dp, tp = axes["dp"], axes["tp"]
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if dp < 0:
+        if len(devices) % tp:
+            raise ValueError(f"{len(devices)} devices not divisible "
+                             f"by tp={tp}")
+        dp = len(devices) // tp
+    if dp < 1:
+        raise ValueError(f"dp must be >= 1, got {dp}")
+    total = dp * tp
+    if total > len(devices):
+        raise ValueError(f"llm mesh dp={dp},tp={tp} needs {total} "
+                         f"devices, have {len(devices)}")
+    arr = _np.array(devices[:total]).reshape(dp, tp)
+    return Mesh(arr, ("dp", "tp"))
 
 
 # ----------------------------------------------------------- placement --

@@ -80,7 +80,8 @@ from ...resilience import faults
 __all__ = ["LLMEngine"]
 
 
-def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False):
+def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False,
+                  axis_name=None):
     """Build the target step program body for (model, spec_k): ONE
     program covering chunked prefill + decode + speculative verify
     over the FLAT ragged layout — a packed ``[total_q_tokens]`` batch
@@ -114,8 +115,18 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False):
     f32 [S] (alpha/rank; 0.0 on adapter-less rows). Adapter selection
     is traced data: a mixed-adapter batch — including adapter-less
     rows through the all-zero null page — runs in this ONE fixed-shape
-    program, so publish/evict/switch never compiles."""
+    program, so publish/evict/switch never compiles.
+
+    ``axis_name`` (ISSUE 19) marks this body as the PER-SHARD half
+    of a ``shard_map`` over a tensor-parallel mesh axis — it is
+    threaded into :meth:`model.decode_flat`, which places the two
+    in-program psums (o-projection, MLP down-projection); the accept
+    rule below then runs on replicated logits, identically on every
+    shard. ``None`` (the default) is the plain single-device body —
+    the kwarg is only forwarded when set, so third-party models
+    without SPMD support keep working unsharded."""
     import jax.numpy as jnp
+    dkw = {} if axis_name is None else {"axis_name": axis_name}
 
     def _accept(logits, win_idx, draft_tokens, draft_probs, n_draft,
                 temperature, top_k, top_p, seeds, counters):
@@ -142,7 +153,7 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False):
                 params, tokens, positions, seq_ids, valid, k_pages,
                 v_pages, block_tables, k_scales=k_scales,
                 v_scales=v_scales,
-                adapter=(a_pages, b_pages, a_tables, a_scales))
+                adapter=(a_pages, b_pages, a_tables, a_scales), **dkw)
             toks, n_acc = _accept(logits, win_idx, draft_tokens,
                                   draft_probs, n_draft, temperature,
                                   top_k, top_p, seeds, counters)
@@ -157,7 +168,7 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False):
             logits, kp2, vp2, ks2, vs2 = model.decode_flat(
                 params, tokens, positions, seq_ids, valid, k_pages,
                 v_pages, block_tables, k_scales=k_scales,
-                v_scales=v_scales)
+                v_scales=v_scales, **dkw)
             toks, n_acc = _accept(logits, win_idx, draft_tokens,
                                   draft_probs, n_draft, temperature,
                                   top_k, top_p, seeds, counters)
@@ -172,7 +183,7 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False):
             logits, k_pages2, v_pages2 = model.decode_flat(
                 params, tokens, positions, seq_ids, valid, k_pages,
                 v_pages, block_tables,
-                adapter=(a_pages, b_pages, a_tables, a_scales))
+                adapter=(a_pages, b_pages, a_tables, a_scales), **dkw)
             toks, n_acc = _accept(logits, win_idx, draft_tokens,
                                   draft_probs, n_draft, temperature,
                                   top_k, top_p, seeds, counters)
@@ -184,7 +195,7 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False):
              n_draft, temperature, top_k, top_p, seeds, counters):
         logits, k_pages2, v_pages2 = model.decode_flat(
             params, tokens, positions, seq_ids, valid, k_pages,
-            v_pages, block_tables)
+            v_pages, block_tables, **dkw)
         toks, n_acc = _accept(logits, win_idx, draft_tokens,
                               draft_probs, n_draft, temperature,
                               top_k, top_p, seeds, counters)
@@ -193,15 +204,19 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False):
     return step
 
 
-def _make_draft_fn(model, sampled, quantized=False):
+def _make_draft_fn(model, sampled, quantized=False, axis_name=None):
     """Build the draft proposal program body: the same flat layout
     against the draft cache, returning one proposal per row plus
     (sampled variant) the full adjusted probability vector the accept
     rule needs. The greedy variant proposes by raw argmax — the
     greedy accept rule never reads probabilities, so it returns zeros
     there. ``last_idx`` int32 [S]: the flat index of each row's last
-    fed token (0 for inactive rows; outputs discarded)."""
+    fed token (0 for inactive rows; outputs discarded).
+    ``axis_name``: see :func:`_make_step_fn` — the draft rides the
+    same tensor-parallel mesh as the target (same block ids, same
+    head split)."""
     import jax.numpy as jnp
+    dkw = {} if axis_name is None else {"axis_name": axis_name}
 
     def _propose(logits, last_idx, temperature, top_k, top_p, seeds,
                  counters):
@@ -221,7 +236,7 @@ def _make_draft_fn(model, sampled, quantized=False):
             logits, kp2, vp2, ks2, vs2 = model.decode_flat(
                 params, tokens, positions, seq_ids, valid, k_pages,
                 v_pages, block_tables, k_scales=k_scales,
-                v_scales=v_scales)
+                v_scales=v_scales, **dkw)
             toks, probs = _propose(logits, last_idx, temperature,
                                    top_k, top_p, seeds, counters)
             return toks, probs, kp2, vp2, ks2, vs2
@@ -232,7 +247,7 @@ def _make_draft_fn(model, sampled, quantized=False):
               top_p, seeds, counters):
         logits, k_pages2, v_pages2 = model.decode_flat(
             params, tokens, positions, seq_ids, valid, k_pages,
-            v_pages, block_tables)
+            v_pages, block_tables, **dkw)
         toks, probs = _propose(logits, last_idx, temperature, top_k,
                                top_p, seeds, counters)
         return toks, probs, k_pages2, v_pages2
@@ -265,6 +280,93 @@ def _cached_program(model, kind, key, build):
     return progs[full]
 
 
+def _resolve_engine_mesh(mesh, model, draft_model):
+    """Normalize the engine's ``mesh`` argument to a flat 1-axis
+    ``("tp",)`` Mesh (or None = unsharded): accepts a Mesh, a spec
+    string (:func:`~....parallel.mesh.llm_mesh` grammar), or None
+    (falls back to ``MXNET_TPU_LLM_MESH``). Any axis other than
+    ``tp`` must have extent 1 — dp replica groups belong to
+    :class:`~.server.LLMServer`, which hands each engine its own tp
+    row. Validates the head/d_ff splits for the target AND draft
+    models up front so misconfiguration fails at construction, not
+    at trace time. Returns ``(mesh_or_none, tp)``."""
+    from jax.sharding import Mesh
+    if mesh is None:
+        spec = _env_str("MXNET_TPU_LLM_MESH", "").strip()
+        if spec:
+            mesh = spec
+    if mesh is None:
+        return None, 1
+    if isinstance(mesh, str):
+        from ...parallel.mesh import llm_mesh
+        mesh = llm_mesh(mesh)
+    extents = dict(mesh.shape)
+    extra = {a: e for a, e in extents.items()
+             if a != "tp" and int(e) != 1}
+    if extra:
+        raise ValueError(
+            f"engine mesh must be tensor-parallel only; axes {extra} "
+            f"have extent > 1 (dp replica groups are LLMServer's — "
+            f"pass the dp mesh there, it hands each engine a tp row)")
+    tp = int(extents.get("tp", 1))
+    for which, m in (("model", model), ("draft_model", draft_model)):
+        if m is None:
+            continue
+        if m.num_heads % tp:
+            raise ValueError(
+                f"{which} has {m.num_heads} heads, not divisible by "
+                f"tp={tp}")
+        d_ff = getattr(getattr(m, "config", None), "d_ff", None)
+        if d_ff is not None and d_ff % tp:
+            raise ValueError(
+                f"{which} has d_ff {d_ff}, not divisible by tp={tp}")
+    if tuple(mesh.axis_names) != ("tp",):
+        devs = np.asarray(list(mesh.devices.flat))
+        mesh = Mesh(devs, ("tp",))
+    return mesh, tp
+
+
+def _place_param_tree(params, model, mesh):
+    """Place a param pytree onto ``mesh`` per the model's
+    :meth:`param_specs` (column/row Megatron split, everything else
+    replicated). Flattened against the PARAMS treedef so the spec
+    tree only needs to be a tree prefix — and so a PartitionSpec
+    never gets mistaken for a container by ``tree_map``."""
+    import jax
+    from ...parallel.mesh import place_global
+    specs = model.param_specs(axis="tp")
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    spec_leaves = treedef.flatten_up_to(specs)
+    placed = [place_global(a, mesh, s)
+              for a, s in zip(leaves, spec_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+def _spmd_wrap(fn, mesh, cache, param_specs, extra):
+    """Wrap a step/draft program body in ``shard_map`` over the
+    engine's ``("tp",)`` mesh: params enter per ``param_specs``, the
+    KV pools (and int8 scale pools) head-sharded, everything else —
+    LoRA factor pools, the packed batch, block tables, sampling
+    vectors — replicated. The first two outputs (tokens + accepts,
+    or proposals + probs) come back replicated (the in-body psums
+    make every shard compute identical logits); the pools come back
+    sharded as they went in. The collectives live INSIDE ``fn``
+    (see ``TinyDecoder.decode_flat``), so jitting the wrapped fn
+    yields the ONE donated whole-step program per (mesh, bucket,
+    variant). ``extra`` = (replicated leading pool count, replicated
+    batch arg count)."""
+    from jax.sharding import PartitionSpec as P
+    from ...parallel.compat import shard_map, SHARD_MAP_KWARGS
+    pool, scale = cache.pool_spec(), cache.scale_spec()
+    pools = [pool, pool] + ([scale, scale] if cache.quantized else [])
+    n_lora, n_batch = extra
+    in_specs = tuple([param_specs] + pools
+                     + [P()] * (n_lora + n_batch))
+    out_specs = tuple([P(), P()] + pools)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **SHARD_MAP_KWARGS)
+
+
 class LLMEngine:
     """Token-level scheduler + ONE fixed-shape jitted chunked step.
 
@@ -289,11 +391,26 @@ class LLMEngine:
                  num_blocks=None, max_context=None, prefill_chunk=None,
                  draft_model=None, draft_params=None, spec_k=None,
                  stats=None, dtype="float32", breaker=None,
-                 prefix_cache=None, kv_dtype=None, adapter_bank=None):
+                 prefix_cache=None, kv_dtype=None, adapter_bank=None,
+                 mesh=None):
         import jax
         import jax.numpy as jnp
         self.model = model
         d_model = model.num_heads * model.head_dim
+        # SPMD decode (ISSUE 19): constructor arg (Mesh or spec
+        # string) > MXNET_TPU_LLM_MESH env > unsharded. The ENGINE
+        # mesh is tensor-parallel only — dp replica groups are
+        # LLMServer's job (one engine per tp row behind one
+        # scheduler), so a dp>1 mesh here is a config error, not a
+        # silent absorb.
+        self.mesh, self.tp = _resolve_engine_mesh(mesh, model,
+                                                  draft_model)
+        self._axis_name = "tp" if self.mesh is not None else None
+        self._mesh_key = None if self.mesh is None else (
+            tuple(self.mesh.axis_names),
+            tuple(dict(self.mesh.shape).items()),
+            tuple(d.id for d in self.mesh.devices.flat))
+        self.spmd_dispatches = 0
         if adapter_bank is not None:
             if (adapter_bank.num_layers != model.num_layers
                     or adapter_bank.d_model != d_model):
@@ -385,7 +502,7 @@ class LLMEngine:
         self.cache = PagedKVCache(
             model.num_layers, model.num_heads, model.head_dim,
             block_size, num_blocks, max_context, dtype=kv_dtype,
-            prefix_cache=self.prefix_enabled)
+            prefix_cache=self.prefix_enabled, mesh=self.mesh)
         self.quantized = self.cache.quantized
         self.scheduler = Scheduler(self.max_seqs)
         self._stats = stats
@@ -400,6 +517,9 @@ class LLMEngine:
         self.prefix_hits = 0
         self.prefill_tokens_saved = 0
         self._params = jax.tree_util.tree_map(jnp.asarray, params)
+        if self.mesh is not None:
+            self._params = _place_param_tree(self._params, model,
+                                             self.mesh)
         # donation is a TPU/HBM lever; CPU backends ignore it with a
         # warning per call site, so only request it where it works
         from ...ops.flash_attention import _on_tpu
@@ -420,15 +540,22 @@ class LLMEngine:
         lora_key = None if not lora else (
             self.bank.num_pages, self.bank.max_pages_per_adapter,
             self.bank.page_rank)
+
+        def _build_step(s):
+            fn = _make_step_fn(model, self.spec_k, s, self.quantized,
+                               lora=lora, axis_name=self._axis_name)
+            if self.mesh is not None:
+                fn = _spmd_wrap(fn, self.mesh, self.cache,
+                                model.param_specs(axis="tp"),
+                                self._step_extra_specs(lora))
+            return jax.jit(fn, donate_argnums=donate)
+
         self._step_jits = {
             sampled: _cached_program(
                 model, "step",
                 (self.spec_k, sampled, self.quantized, donate,
-                 lora_key),
-                lambda s=sampled: jax.jit(
-                    _make_step_fn(model, self.spec_k, s,
-                                  self.quantized, lora=lora),
-                    donate_argnums=donate))
+                 lora_key, self._mesh_key),
+                lambda s=sampled: _build_step(s))
             for sampled in (False, True)}
         if self.draft_model is not None:
             if draft_model.vocab_size != model.vocab_size:
@@ -445,17 +572,28 @@ class LLMEngine:
             self.draft_cache = PagedKVCache(
                 draft_model.num_layers, draft_model.num_heads,
                 draft_model.head_dim, block_size, num_blocks,
-                max_context, dtype=kv_dtype)
+                max_context, dtype=kv_dtype, mesh=self.mesh)
             self._draft_params = jax.tree_util.tree_map(
                 jnp.asarray, draft_params)
+            if self.mesh is not None:
+                self._draft_params = _place_param_tree(
+                    self._draft_params, draft_model, self.mesh)
+
+            def _build_draft(s):
+                fn = _make_draft_fn(draft_model, s, self.quantized,
+                                    axis_name=self._axis_name)
+                if self.mesh is not None:
+                    fn = _spmd_wrap(
+                        fn, self.mesh, self.draft_cache,
+                        draft_model.param_specs(axis="tp"), (0, 11))
+                return jax.jit(fn, donate_argnums=donate)
+
             self._draft_jits = {
                 sampled: _cached_program(
                     draft_model, "draft",
-                    (sampled, self.quantized, donate),
-                    lambda s=sampled: jax.jit(
-                        _make_draft_fn(draft_model, s,
-                                       self.quantized),
-                        donate_argnums=donate))
+                    (sampled, self.quantized, donate,
+                     self._mesh_key),
+                    lambda s=sampled: _build_draft(s))
                 for sampled in (False, True)}
         else:
             self.draft_cache = None
@@ -466,11 +604,37 @@ class LLMEngine:
         if self.prefix_enabled:
             n_arrs = len(self._cow_arrays())
             cow_donate = tuple(range(n_arrs)) if _on_tpu() else ()
+
+            def _build_cow():
+                fn = _make_copy_fn(n_arrs)
+                if self.mesh is not None:
+                    # the COW program must carry the pools' sharding
+                    # through: an unconstrained jit would satisfy its
+                    # default (single-device) placement by RESHARDING
+                    # the pools on the first cache-hit divergence —
+                    # silently unsharding the fleet's KV and
+                    # recompiling every step program. shard_map pins
+                    # in/out layouts to the head-sharded specs.
+                    from jax.sharding import PartitionSpec as P
+                    from ...parallel.compat import (shard_map,
+                                                    SHARD_MAP_KWARGS)
+                    pool = self.cache.pool_spec()
+                    scale = self.cache.scale_spec()
+                    per_cache = [pool, pool] + (
+                        [scale, scale] if self.quantized else [])
+                    arr_specs = per_cache * (n_arrs // len(per_cache))
+                    fn = shard_map(
+                        fn, mesh=self.mesh,
+                        in_specs=tuple(arr_specs) + (P(), P()),
+                        out_specs=tuple(arr_specs),
+                        **SHARD_MAP_KWARGS)
+                return jax.jit(fn, donate_argnums=cow_donate)
+
             self._cow_jit = _cached_program(
                 model, "cow", (n_arrs, self.quantized, cow_donate,
-                               self.draft_model is not None),
-                lambda: jax.jit(_make_copy_fn(n_arrs),
-                                donate_argnums=cow_donate))
+                               self.draft_model is not None,
+                               self._mesh_key),
+                _build_cow)
         else:
             self._cow_jit = None
         self._warmed = False
@@ -481,6 +645,12 @@ class LLMEngine:
         self._draft_bufs = {}
         self._arange = np.arange(self.q_tokens, dtype=np.int32)
         self._device_get = jax.device_get
+        # (source pools, replicated copies) — see _replicated_lora
+        self._lora_placed = None
+        if self.mesh is not None and self._stats is not None:
+            self._stats.record_spmd_mesh(
+                int(self.mesh.devices.size), {"tp": self.tp},
+                self.cache.heads_per_shard)
         # circuit breaker (shared with the server): successful
         # step dispatches close it, failing ones trip it — the
         # server's submit path rejects while it is open
@@ -498,6 +668,15 @@ class LLMEngine:
         self._poison_pending = []
 
     # -------------------------------------------- pool call helpers --
+    def _step_extra_specs(self, lora):
+        """(leading replicated pool count, trailing replicated batch
+        arg count) of the step program after params + KV pools: the
+        two LoRA factor pools when a bank is attached, then the 14
+        packed-batch/sampling args (+2 adapter-table args under
+        lora). Keeps :func:`_spmd_wrap` in sync with
+        :func:`_make_step_fn`'s signatures."""
+        return (2, 16) if lora else (0, 14)
+
     def _cow_arrays(self):
         """Every device pool array a COW copy must cover, in the fixed
         order the copy program was built for."""
@@ -534,6 +713,12 @@ class LLMEngine:
         makes a concurrent publish visible to the very next step."""
         jit = self._step_jits[sampled]
         lora = () if self.bank is None else self.bank.pools()
+        if lora and self.mesh is not None:
+            lora = self._replicated_lora(lora)
+        if self.mesh is not None:
+            self.spmd_dispatches += 1
+            if self._stats:
+                self._stats.record_spmd_dispatch()
         if self.quantized:
             toks, n_acc, kp, vp, ks, vs = jit(
                 self._params, self.cache.k_pages, self.cache.v_pages,
@@ -546,6 +731,26 @@ class LLMEngine:
                 *lora, *batch)
             self.cache.swap(kp, vp)
         return toks, n_acc
+
+    def _replicated_lora(self, pools):
+        """Mesh-replicated snapshot of the bank's A/B factor pools.
+        The bank publishes single-device arrays; feeding those into a
+        meshed program would re-place them on EVERY dispatch (a
+        host-side copy per step — a latent single-device assumption).
+        Cache the replicated copies keyed by pool identity: one
+        device_put per publish, a tuple-compare no-op per step. The
+        cache holds strong refs to the source pools, so the identity
+        compare can never alias a collected array."""
+        cached = self._lora_placed
+        if (cached is not None and len(cached[0]) == len(pools)
+                and all(a is b for a, b in zip(cached[0], pools))):
+            return cached[1]
+        from jax.sharding import PartitionSpec as P
+        from ...parallel.mesh import place_global
+        placed = tuple(place_global(p, self.mesh, P())
+                       for p in pools)
+        self._lora_placed = (tuple(pools), placed)
+        return placed
 
     def _call_draft(self, sampled, batch):
         jit = self._draft_jits[sampled]
@@ -1605,5 +1810,13 @@ class LLMEngine:
                              "tokens_saved": self.prefill_tokens_saved},
             "adapters": self.bank.stats() if self.bank is not None
             else None,
+            "mesh": None if self.mesh is None else {
+                "devices": int(self.mesh.devices.size),
+                "axes": {k: int(v)
+                         for k, v in dict(self.mesh.shape).items()},
+                "tp": self.tp,
+                "spmd_step_dispatches": self.spmd_dispatches,
+                "kv": self.cache.shard_info(),
+            },
             "sequences": seqs,
         }

@@ -325,7 +325,7 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_heads, head_dim, block_size,
                  num_blocks, max_context, dtype="float32",
-                 prefix_cache=False):
+                 prefix_cache=False, mesh=None, shard_axis="tp"):
         import jax.numpy as jnp
         if max_context < 1:
             raise ValueError(f"max_context must be >= 1, {max_context}")
@@ -348,6 +348,17 @@ class PagedKVCache:
         self.prefix_evictions = 0
         self.cow_count = 0                 # engine-maintained
         self.on_prefix_evict = None        # optional stats hook
+        self.mesh = mesh
+        self.shard_axis = str(shard_axis)
+        self.shards = 1
+        if mesh is not None:
+            self.shards = int(dict(mesh.shape).get(self.shard_axis, 1))
+            if self.num_heads % self.shards:
+                raise ValueError(
+                    f"num_heads {self.num_heads} not divisible by "
+                    f"{self.shard_axis}={self.shards} — head-sharded "
+                    f"pools need an even head split")
+        self.heads_per_shard = self.num_heads // self.shards
         shape = (self.num_layers, self.num_blocks, self.block_size,
                  self.num_heads, self.head_dim)
         self.k_pages = jnp.zeros(shape, dtype=jnp.dtype(self.dtype))
@@ -359,6 +370,62 @@ class PagedKVCache:
         else:
             self.k_scales = None
             self.v_scales = None
+        if mesh is not None:
+            # pools live head-sharded on the mesh from birth: every
+            # chip holds [L, N, bs, H/shards, Dh] (scales
+            # [L, N, bs, H/shards]) — the block axis is NOT sharded,
+            # the ONE host-global BlockAllocator owns every block id
+            # on every shard
+            from ...parallel.mesh import place_global
+            self.k_pages = place_global(self.k_pages, mesh,
+                                        self.pool_spec())
+            self.v_pages = place_global(self.v_pages, mesh,
+                                        self.pool_spec())
+            if self.quantized:
+                self.k_scales = place_global(self.k_scales, mesh,
+                                             self.scale_spec())
+                self.v_scales = place_global(self.v_scales, mesh,
+                                             self.scale_spec())
+
+    # ----------------------------------------------------- sharding --
+    def pool_spec(self):
+        """PartitionSpec of a page pool ``[L, N, bs, H, Dh]``: heads
+        sharded over ``shard_axis``, everything else replicated (the
+        block axis stays global so block tables and the allocator are
+        mesh-independent)."""
+        from jax.sharding import PartitionSpec as P
+        if self.mesh is None:
+            return P()
+        return P(None, None, None, self.shard_axis, None)
+
+    def scale_spec(self):
+        """PartitionSpec of an int8 scale pool ``[L, N, bs, H]``."""
+        from jax.sharding import PartitionSpec as P
+        if self.mesh is None:
+            return P()
+        return P(None, None, None, self.shard_axis)
+
+    def shard_info(self):
+        """Per-shard KV placement block for ``debug_status()`` /
+        flight-recorder bundles: which heads live on which device.
+        ``None`` for an unsharded pool."""
+        if self.mesh is None:
+            return None
+        names = list(self.mesh.axis_names)
+        k = names.index(self.shard_axis)
+        devs = np.moveaxis(self.mesh.devices, k, 0).reshape(
+            self.shards, -1)
+        hps = self.heads_per_shard
+        return {
+            "axis": self.shard_axis,
+            "shards": self.shards,
+            "heads_per_shard": hps,
+            "placement": [
+                {"shard": i, "heads": [i * hps, (i + 1) * hps],
+                 "devices": [str(d) for d in row]}
+                for i, row in enumerate(devs)
+            ],
+        }
 
     # ------------------------------------------------------- tables --
     def blocks_for(self, num_tokens):
@@ -474,4 +541,6 @@ class PagedKVCache:
             "prefix_blocks": self.prefix_blocks,
             "prefix_evictions": self.prefix_evictions,
             "cow_copies": self.cow_count,
+            "shards": self.shards,
+            "heads_per_shard": self.heads_per_shard,
         }

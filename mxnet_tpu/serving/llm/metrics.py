@@ -186,6 +186,26 @@ class LLMStats:
             "Adapter-tagged generations attributed per tenant (tagged "
             "requests only).", ("server", "tenant", "adapter"))
         self._tenant_adapter_children = {}
+        self._spmd_dispatches = r.counter(
+            "mxtpu_llm_spmd_step_dispatch_total",
+            "Unified-step launches of the shard_map (SPMD) program — "
+            "exactly one device dispatch per engine step when a mesh "
+            "is attached (unsharded engines create no series).",
+            lbl).labels(**s)
+        self._spmd_devices = r.gauge(
+            "mxtpu_llm_spmd_mesh_devices",
+            "Devices in the engine's decode mesh (0/absent = "
+            "unsharded).", lbl).labels(**s)
+        self._spmd_axis = r.gauge(
+            "mxtpu_llm_spmd_mesh_axis_extent",
+            "Extent of each mesh axis the decode step is sharded "
+            "over (one series per axis; set at engine construction).",
+            ("server", "axis"))
+        self._spmd_axis_children = {}
+        self._spmd_heads_per_shard = r.gauge(
+            "mxtpu_llm_spmd_kv_heads_per_shard",
+            "KV heads resident on each tp shard of the paged pool "
+            "(num_heads / tp).", lbl).labels(**s)
         # the overload/failure series share the single-shot server's
         # mxtpu_serving_* catalog (one dashboard for both front ends)
         self._overload = OverloadStats(r, self._server)
@@ -332,6 +352,21 @@ class LLMStats:
     def record_adapter_publish(self, n=1):
         self._adapter_publishes.inc(n)
 
+    # --------------------------------------------------- SPMD series --
+    def record_spmd_mesh(self, devices, axes, heads_per_shard):
+        """Engine construction under a mesh: publish its shape (total
+        devices, per-axis extents) and the per-shard KV-head count so
+        dashboards can tell a tp=4 fleet from four tp=1 replicas."""
+        self._spmd_devices.set(int(devices))
+        for axis, extent in axes.items():
+            self._labeled_child(self._spmd_axis,
+                                self._spmd_axis_children,
+                                axis=str(axis)).set(int(extent))
+        self._spmd_heads_per_shard.set(int(heads_per_shard))
+
+    def record_spmd_dispatch(self, n=1):
+        self._spmd_dispatches.inc(n)
+
     # ------------------------------------------------- tenant series --
     def record_tenant(self, tenant, outcome, n=1):
         """Per-tenant outcome attribution (no-op for tenant None)."""
@@ -395,6 +430,14 @@ class LLMStats:
                     "p50": self._latency.percentile(50) * 1e3,
                     "p99": self._latency.percentile(99) * 1e3,
                 },
+                "spmd_step_dispatches": int(
+                    self._spmd_dispatches.value),
+                "spmd_mesh_devices": int(self._spmd_devices.value),
+                "spmd_mesh_axes": {
+                    k[0][1]: int(c.value) for k, c in
+                    self._spmd_axis_children.items()},
+                "spmd_kv_heads_per_shard": int(
+                    self._spmd_heads_per_shard.value),
                 "adapters_resident": int(
                     self._adapters_resident.value),
                 "adapter_publishes": int(

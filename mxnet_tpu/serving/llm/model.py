@@ -28,7 +28,8 @@ import functools
 import numpy as np
 
 from ...ops.ragged_attention import (ragged_paged_attention,
-                                     ragged_flat_attention)
+                                     ragged_flat_attention,
+                                     ragged_flat_attention_sharded)
 from ...ops.flash_attention import attention_reference
 from ...ops.lora import (paged_lora_delta, gather_adapter,
                          PROJ_Q, PROJ_K, PROJ_V, PROJ_O)
@@ -157,6 +158,30 @@ class TinyDecoder:
             "layers": layers,
         }
 
+    def param_specs(self, axis="tp"):
+        """PartitionSpec pytree matching :meth:`init_params` for
+        tensor-parallel placement over mesh axis ``axis`` — the
+        Megatron split: ``wq/wk/wv`` column-parallel (output heads),
+        ``wo`` row-parallel (psum after), ``w1``/``b1``
+        column-parallel, ``w2`` row-parallel (psum after, ``b2``
+        replicated so it is added once). Everything position-,
+        vocab- or norm-shaped rides replicated. Structure is a tree
+        PREFIX of the params pytree (one spec per weight leaf)."""
+        from jax.sharding import PartitionSpec as P
+        layer = {
+            "ln1_g": P(), "ln1_b": P(),
+            "wq": P(None, axis), "wk": P(None, axis),
+            "wv": P(None, axis), "wo": P(axis, None),
+            "ln2_g": P(), "ln2_b": P(),
+            "w1": P(None, axis), "b1": P(axis),
+            "w2": P(axis, None), "b2": P(),
+        }
+        return {
+            "embed": P(), "pos": P(), "lnf_g": P(), "lnf_b": P(),
+            "head": P(),
+            "layers": [dict(layer) for _ in range(self.config.num_layers)],
+        }
+
     # ------------------------------------------------------ prefill --
     def forward(self, params, tokens, lora=None):
         """Dense causal forward. tokens: int32 [B, T] (T <=
@@ -270,7 +295,7 @@ class TinyDecoder:
 
     def decode_flat(self, params, tokens, positions, seq_ids, valid,
                     k_pages, v_pages, block_tables, k_scales=None,
-                    v_scales=None, adapter=None):
+                    v_scales=None, adapter=None, axis_name=None):
         """The FLAT ragged step: a packed ``[T]`` batch of query
         tokens from many sequences — no per-sequence padding, so a
         mixed prefill/decode/verify step computes exactly the tokens
@@ -304,6 +329,25 @@ class TinyDecoder:
         low-rank delta to the four attention projections; rows whose
         table is all null page 0 (scale 0) get an exact-zero delta —
         one program serves any adapter mix.
+
+        SPMD (ISSUE 19): with ``axis_name`` set this is the PER-SHARD
+        body of a ``shard_map`` over a tensor-parallel mesh axis —
+        ``wq/wk/wv/w1(+b1)`` arrive column-sharded and ``wo/w2``
+        row-sharded (:meth:`param_specs`), and the KV pools (and
+        their int8 scale pools) carry only this shard's heads. The
+        attention inner loop needs NO collective (per-head
+        independent; the softmax scale is 1/sqrt(head_dim), never
+        head-count-dependent), so the only collectives in the step
+        are one ``psum`` after the o-projection and one after the
+        MLP down-projection — fused into the caller's single donated
+        program. Batch inputs, layer norms, embeddings and the LM
+        head ride replicated, as do the LoRA factor pools: q/k/v
+        deltas are computed full-width and column-sliced to this
+        shard, the o-delta sees the ``all_gather``-reassembled
+        attention output and lands after the psum, so adapter maths
+        is bitwise the single-device result. At axis extent 1 every
+        collective is the identity — bit-exact vs the unsharded
+        program by construction.
         """
         import jax
         import jax.numpy as jnp
@@ -311,6 +355,11 @@ class TinyDecoder:
         T = tokens.shape[0]
         bs = k_pages.shape[2]
         quantized = k_scales is not None
+        if axis_name is None:
+            attn = ragged_flat_attention
+        else:
+            attn = functools.partial(ragged_flat_attention_sharded,
+                                     axis_name=axis_name)
         vmask = valid.astype(bool)
         bidx = jnp.where(
             vmask,
@@ -332,12 +381,25 @@ class TinyDecoder:
             k = x @ lp["wk"]
             v = x @ lp["wv"]
             if adapter is not None:
-                q = q + _delta(x, li, PROJ_Q)
-                k = k + _delta(x, li, PROJ_K)
-                v = v + _delta(x, li, PROJ_V)
-            q = q.reshape(T, c.num_heads, c.head_dim)
-            k = k.reshape(T, c.num_heads, c.head_dim)
-            v = v.reshape(T, c.num_heads, c.head_dim)
+                if axis_name is None:
+                    q = q + _delta(x, li, PROJ_Q)
+                    k = k + _delta(x, li, PROJ_K)
+                    v = v + _delta(x, li, PROJ_V)
+                else:
+                    # deltas are full-width (replicated factors);
+                    # take this shard's column slice
+                    d_loc = q.shape[-1]
+                    col0 = jax.lax.axis_index(axis_name) * d_loc
+                    q = q + jax.lax.dynamic_slice_in_dim(
+                        _delta(x, li, PROJ_Q), col0, d_loc, axis=1)
+                    k = k + jax.lax.dynamic_slice_in_dim(
+                        _delta(x, li, PROJ_K), col0, d_loc, axis=1)
+                    v = v + jax.lax.dynamic_slice_in_dim(
+                        _delta(x, li, PROJ_V), col0, d_loc, axis=1)
+            heads_here = q.shape[-1] // c.head_dim  # local under tp
+            q = q.reshape(T, heads_here, c.head_dim)
+            k = k.reshape(T, heads_here, c.head_dim)
+            v = v.reshape(T, heads_here, c.head_dim)
             if quantized:
                 ksc = jnp.maximum(
                     jnp.max(jnp.abs(k), axis=-1) / 127.0, 1e-8)
@@ -351,7 +413,7 @@ class TinyDecoder:
                 v_pages = v_pages.at[li, bidx, slot].set(vq)
                 k_scales = k_scales.at[li, bidx, slot].set(ksc)
                 v_scales = v_scales.at[li, bidx, slot].set(vsc)
-                att = ragged_flat_attention(
+                att = attn(
                     q, k_pages[li], v_pages[li], block_tables,
                     seq_ids, positions, k_scales=k_scales[li],
                     v_scales=v_scales[li])
@@ -360,18 +422,31 @@ class TinyDecoder:
                     k.astype(k_pages.dtype))
                 v_pages = v_pages.at[li, bidx, slot].set(
                     v.astype(v_pages.dtype))
-                att = ragged_flat_attention(q, k_pages[li],
-                                            v_pages[li],
-                                            block_tables, seq_ids,
-                                            positions)
-            att2d = att.reshape(T, c.d_model)
+                att = attn(q, k_pages[li],
+                           v_pages[li],
+                           block_tables, seq_ids,
+                           positions)
+            att2d = att.reshape(T, heads_here * c.head_dim)
             o = att2d @ lp["wo"]
+            if axis_name is not None:
+                o = jax.lax.psum(o, axis_name)
             if adapter is not None:
-                o = o + _delta(att2d, li, PROJ_O)
+                if axis_name is None:
+                    o = o + _delta(att2d, li, PROJ_O)
+                else:
+                    # heads are sharded contiguously, so the tiled
+                    # gather reassembles the full-width att output
+                    # in column order; the delta lands post-psum,
+                    # replicated
+                    att_full = jax.lax.all_gather(
+                        att2d, axis_name, axis=1, tiled=True)
+                    o = o + _delta(att_full, li, PROJ_O)
             h = h + o
             x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
-            h = h + jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"] \
-                + lp["b2"]
+            mlp = jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"]
+            if axis_name is not None:
+                mlp = jax.lax.psum(mlp, axis_name)
+            h = h + mlp + lp["b2"]
         logits = _layer_norm(h, params["lnf_g"],
                              params["lnf_b"]) @ params["head"]
         if quantized:

@@ -47,6 +47,7 @@ breaker_state}`` overload series.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -68,6 +69,46 @@ from ...observability.flightrecorder import get_flightrecorder
 from ...resilience import faults
 
 __all__ = ["LLMServer", "SequenceEvictedError", "GenerationResult"]
+
+
+def _resolve_server_mesh(mesh):
+    """Split the server-level decode mesh into per-replica tp rows.
+
+    The SERVER owns the ``dp`` axis (replica groups of engines behind
+    one scheduler thread); each :class:`~.engine.LLMEngine` owns only
+    ``tp`` (tensor-parallel shards fused into its one step program).
+    Accepts a ``jax.sharding.Mesh``, a spec string for
+    :func:`~...parallel.mesh.llm_mesh` (``"tp=2"``, ``"dp=2,tp=2"``,
+    bare ``"4"`` = tp), or ``None`` with the ``MXNET_TPU_LLM_MESH``
+    env var as fallback. Returns ``(info, submeshes)`` where ``info``
+    is ``{"devices", "dp", "tp"}`` and ``submeshes`` is one flat
+    tp-only Mesh per dp replica — or ``(None, None)`` unsharded."""
+    if mesh is None:
+        mesh = os.environ.get("MXNET_TPU_LLM_MESH", "").strip() or None
+    if mesh is None:
+        return None, None
+    if isinstance(mesh, str):
+        from ...parallel.mesh import llm_mesh
+        mesh = llm_mesh(mesh)
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for ax, n in axes.items():
+        if ax not in ("dp", "tp") and n != 1:
+            raise ValueError(
+                f"LLMServer shards over dp/tp only; mesh axis "
+                f"{ax!r} has extent {n}")
+    dp = int(axes.get("dp", 1))
+    tp = int(axes.get("tp", 1))
+    arr = np.asarray(mesh.devices)
+    names = list(mesh.axis_names)
+    if "dp" in names:
+        arr = np.moveaxis(arr, names.index("dp"), 0)
+    else:
+        arr = arr[None, ...]
+    arr = arr.reshape(dp, tp)
+    from jax.sharding import Mesh
+    subs = [Mesh(arr[i], ("tp",)) for i in range(dp)]
+    info = {"devices": int(arr.size), "dp": dp, "tp": tp}
+    return info, subs
 
 
 class GenerationResult:
@@ -98,7 +139,18 @@ class LLMServer:
     ``max_context``, ``prefill_chunk``, and ``draft_model`` /
     ``draft_params`` / ``spec_k`` for speculative decoding) pass
     through to :class:`~.engine.LLMEngine`, each defaulting to its
-    ``MXNET_TPU_LLM_*`` env var. Overload knobs: ``max_queue``
+    ``MXNET_TPU_LLM_*`` env var.
+
+    ``mesh`` (optional; env ``MXNET_TPU_LLM_MESH``): a decode mesh —
+    a ``jax.sharding.Mesh`` or an :func:`~...parallel.mesh.llm_mesh`
+    spec string (``"tp=2"``, ``"dp=2,tp=2"``, bare ``"4"`` = tp).
+    The server consumes the ``dp`` axis as replica groups: one
+    :class:`~.engine.LLMEngine` per dp row (each on its own flat
+    tp-only submesh, tensor-parallel via shard_map), all behind this
+    one front end — submit routes each sequence to the least-loaded
+    replica; drain/failure semantics cover every replica's Futures.
+
+    Overload knobs: ``max_queue``
     (``MXNET_TPU_SERVE_MAX_QUEUE``), ``deadline_ms``
     (``MXNET_TPU_SERVE_DEADLINE_MS``), ``breaker_threshold`` /
     ``breaker_cooldown_ms`` (``MXNET_TPU_SERVE_BREAKER_*``).
@@ -106,7 +158,7 @@ class LLMServer:
 
     def __init__(self, model, params, name="llm", max_queue=None,
                  deadline_ms=None, breaker_threshold=None,
-                 breaker_cooldown_ms=None, **engine_kw):
+                 breaker_cooldown_ms=None, mesh=None, **engine_kw):
         self.name = name
         self._stats = LLMStats(server=name)
         self._flight = get_flightrecorder()
@@ -114,8 +166,31 @@ class LLMServer:
             threshold=breaker_threshold,
             cooldown_ms=breaker_cooldown_ms,
             on_state=self._on_breaker_state)
-        self._engine = LLMEngine(model, params, stats=self._stats,
-                                 breaker=self._breaker, **engine_kw)
+        # dp replica groups: the server consumes the dp axis (one
+        # engine per replica row, all driven by the ONE worker thread
+        # below); each engine gets a flat tp-only submesh and fuses
+        # its shards into its single step program
+        self._mesh_info, submeshes = _resolve_server_mesh(mesh)
+        if submeshes is None:
+            self._engines = [LLMEngine(model, params,
+                                       stats=self._stats,
+                                       breaker=self._breaker,
+                                       **engine_kw)]
+        else:
+            self._engines = [LLMEngine(model, params,
+                                       stats=self._stats,
+                                       breaker=self._breaker,
+                                       mesh=sub, **engine_kw)
+                             for sub in submeshes]
+            # engines published their tp-submesh shape; overwrite
+            # with the full fleet view (dp included) once
+            self._stats.record_spmd_mesh(
+                self._mesh_info["devices"],
+                {"dp": self._mesh_info["dp"],
+                 "tp": self._mesh_info["tp"]},
+                self._engines[0].cache.heads_per_shard)
+        self._engine = self._engines[0]
+        self.dp = len(self._engines)
         self.max_queue, self.default_deadline_ms = \
             resolve_overload_knobs(max_queue, deadline_ms)
         self._cv = threading.Condition()
@@ -187,12 +262,20 @@ class LLMServer:
             raise RuntimeError(
                 "warmup() must run before start(): the engine thread "
                 "owns the KV cache once serving begins")
-        return self._engine.warmup()
+        out = dict(self._engines[0].warmup())
+        # dp replicas: identical programs per replica, but each
+        # submesh's device set keys its own executable — warm them
+        # all so steady state never compiles on ANY replica
+        for i, eng in enumerate(self._engines[1:], start=1):
+            for key, secs in eng.warmup().items():
+                out[f"dp{i}.{key}"] = secs
+        return out
 
     # -------------------------------------------------------- submit --
     def _queue_depth(self):   # guarded-by: caller
         """Admission backlog: sequences holding NO KV blocks yet."""
-        return len(self._pending) + self._engine.scheduler.num_waiting
+        return len(self._pending) + sum(
+            e.scheduler.num_waiting for e in self._engines)
 
     def submit(self, prompt_tokens, max_new_tokens, stop_token=None,
                deadline_ms=None, tenant=None, sampling=None,
@@ -396,6 +479,9 @@ class LLMServer:
         lookups = snap.get("prefix_lookups", 0)
         snap["prefix_hit_rate"] = (snap.get("prefix_hits", 0) / lookups
                                    if lookups else 0.0)
+        snap["dp"] = self.dp
+        if self._mesh_info is not None:
+            snap["mesh"] = dict(self._mesh_info)
         if self._engine.bank is not None:
             snap["adapters"] = self._engine.bank.stats()
         return snap
@@ -411,7 +497,7 @@ class LLMServer:
             pending = len(self._pending)
             closed, quiesced = self._closed, self._quiesced
             live = self._live
-        return {
+        out = {
             "kind": "llm",
             "server": self.name,
             "started": self._started,
@@ -419,12 +505,19 @@ class LLMServer:
             "quiesced": quiesced,
             "live_futures": live,
             "pending": pending,
-            "queue_depth": pending
-            + self._engine.scheduler.num_waiting,
+            "queue_depth": pending + sum(
+                e.scheduler.num_waiting for e in self._engines),
             "max_queue": self.max_queue,
             "breaker_state": self._breaker.state,
+            "dp": self.dp,
+            "mesh": (dict(self._mesh_info)
+                     if self._mesh_info is not None else None),
             "engine": self._engine.debug_status(),
         }
+        if self.dp > 1:
+            out["engines"] = [e.debug_status()
+                              for e in self._engines[1:]]
+        return out
 
     # --------------------------------------------------------- drain --
     def shutdown(self, drain=True, deadline_ms=None):
@@ -619,14 +712,16 @@ class LLMServer:
         seq.future.set_exception(exc)
 
     def _flush_engine(self):
-        """Resolve everything the engine retired since the last call:
-        completions, deadline/cancel expiries, poison isolations."""
-        for seq in self._engine.pop_finished():
-            self._resolve_finished(seq)
-        for seq, reason in self._engine.pop_dead():
-            self._resolve_dead(seq, reason)
-        for seq, exc in self._engine.pop_poison():
-            self._resolve_poison(seq, exc)
+        """Resolve everything every engine retired since the last
+        call: completions, deadline/cancel expiries, poison
+        isolations."""
+        for eng in self._engines:
+            for seq in eng.pop_finished():
+                self._resolve_finished(seq)
+            for seq, reason in eng.pop_dead():
+                self._resolve_dead(seq, reason)
+            for seq, exc in eng.pop_poison():
+                self._resolve_poison(seq, exc)
 
     def _fail_everything(self, exc):
         """Worker-death cleanup: resolve EVERY live Future (engine +
@@ -643,7 +738,10 @@ class LLMServer:
         self._flush_engine()
         err = ServerClosed(f"llm engine worker died: {exc!r}")
         err.__cause__ = exc
-        for seq in orphans + self._engine.evict_all("engine_error"):
+        evicted = []
+        for eng in self._engines:
+            evicted.extend(eng.evict_all("engine_error"))
+        for seq in orphans + evicted:
             if seq.future.done():       # defensive: never double-set
                 continue
             self._stats.record_failure()
@@ -667,18 +765,28 @@ class LLMServer:
             self._fail_everything(exc)
             raise
 
+    def _route(self, seq):
+        """Pick the replica for one admitted sequence: least loaded
+        by live sequences (waiting + running), first replica winning
+        ties — deterministic, and exact because the ONE worker thread
+        is the only writer of every engine's scheduler."""
+        return min(self._engines,
+                   key=lambda e: (e.scheduler.num_waiting
+                                  + e.scheduler.num_running))
+
     def _run_loop_inner(self):
-        engine = self._engine
+        engines = self._engines
         while True:
             with self._cv:
-                while (not self._pending and not engine.has_work()
+                while (not self._pending
+                       and not any(e.has_work() for e in engines)
                        and not self._closed):
                     self._cv.wait(timeout=0.05)
                 pending, self._pending = self._pending, []
                 closed, drain = self._closed, self._drain
                 deadline = self._deadline
             for seq in pending:
-                engine.add(seq)
+                self._route(seq).add(seq)
             # chaos-harness point: crash_at_point("llm.worker")
             # simulates the engine thread dying mid-loop
             faults.point("llm.worker")
@@ -689,14 +797,18 @@ class LLMServer:
                     reason = ("shutdown" if not drain
                               else "drain_deadline")
                     self._flush_engine()
-                    for seq in engine.evict_all(reason):
-                        self._resolve_evicted(seq, reason)
+                    for eng in engines:
+                        for seq in eng.evict_all(reason):
+                            self._resolve_evicted(seq, reason)
                     return
-                if not engine.has_work():
+                if not any(e.has_work() for e in engines):
                     self._flush_engine()
                     return
-            if not engine.has_work():
-                self._flush_engine()
-                continue
-            engine.step()
+            stepped = False
+            for eng in engines:
+                if eng.has_work():
+                    eng.step()
+                    stepped = True
             self._flush_engine()
+            if not stepped:
+                continue

@@ -111,7 +111,10 @@ _WORKER = textwrap.dedent("""
 """)
 
 
-@pytest.mark.parametrize("nproc", [2])
+@pytest.mark.parametrize(
+    "nproc", [pytest.param(2, marks=pytest.mark.slow)])
+# ~9s on 1 CPU (tier-1 budget): two fresh jax processes; launcher
+# lifecycle stays fast via the teardown + multihost-emulation tests
 def test_multiprocess_dist_sync(tmp_path, nproc, monkeypatch):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"

@@ -268,6 +268,9 @@ def test_spec_greedy_bit_identical_to_target_only(model, params,
     assert snap["decode_steps"] < snap["tokens_generated"]
 
 
+@pytest.mark.slow   # ~32s on 1 CPU (tier-1 budget): two full spec
+# warmups; spec-sampled coverage stays fast via the accept-rate pin
+# above, spec_rollback below, and test_llm_spmd's mixed spec traffic
 def test_spec_sampled_stream_is_deterministic(model, params, draft,
                                               draft_params):
     """Same seeds, two independent spec engines: identical sampled
@@ -380,15 +383,21 @@ def test_spec_rollback_keeps_block_accounting_exact(model, params):
         assert s.output_tokens() == ref
 
 
-def test_sampling_through_server_and_validation(model, params):
-    """SamplingParams ride submit()/generate() (dict form too) and the
-    knobs validate at construction."""
+def test_sampling_params_validate():
+    """The knobs validate at construction (server-independent)."""
     with pytest.raises(ValueError):
         SamplingParams(temperature=-0.1)
     with pytest.raises(ValueError):
         SamplingParams(top_k=-1)
     with pytest.raises(ValueError):
         SamplingParams(top_p=0.0)
+
+
+@pytest.mark.slow   # ~15s on 1 CPU (tier-1 budget): its own server
+# warmup; sampled-stream determinism stays fast at the engine level
+# (test_llm_spmd tp=1 bit-exact greedy AND sampled)
+def test_sampling_through_server_and_validation(model, params):
+    """SamplingParams ride submit()/generate() (dict form too)."""
     srv = LLMServer(model, params, name="sampling_t", max_seqs=2,
                     block_size=BS, max_context=CTX)
     srv.warmup()

@@ -108,6 +108,10 @@ def test_chained_block_hashes_bind_whole_prefix():
     assert len(prefix_block_hashes(list(range(15)), 8)) == 1
 
 
+@pytest.mark.slow   # ~24s on 1 CPU (tier-1 budget): a second
+# cache-OFF engine warmup; hit-path bit-exactness stays fast via
+# test_block_aligned_full_hit_cows_on_first_divergence below and
+# test_llm_spmd's prefix/COW pins
 def test_cache_on_equals_cache_off_mixed_shared_batches(model, params):
     """The headline parity pin: same mixed shared/unshared batch, same
     admission order, cache ON vs OFF — every token stream identical,

@@ -85,7 +85,12 @@ def _calib_batches(rng, n, shape):
             for _ in range(n)]
 
 
-@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+@pytest.mark.parametrize(
+    "calib_mode",
+    [pytest.param("naive", marks=pytest.mark.slow),  # ~8s (tier-1
+     # budget); the entropy variant + exclude_and_accuracy keep the
+     # quantize_net path fast
+     "entropy"])
 def test_quantize_net_dense_mlp(calib_mode):
     mx.random.seed(0)
     net = nn.HybridSequential()
@@ -118,6 +123,9 @@ def test_quantize_net_dense_mlp(calib_mode):
             np.abs(out - ref).mean() / scale
 
 
+@pytest.mark.slow   # ~7s on 1 CPU (tier-1 budget); conv
+# quantization numerics stay fast via quantized_fully_connected +
+# exclude_and_accuracy, NHWC conv via the layout op tests
 def test_quantize_net_conv_nhwc():
     mx.random.seed(1)
     net = nn.HybridSequential()

@@ -92,6 +92,8 @@ class TestFusedRNNOracle:
 
 
 class TestRNNLayers:
+    @pytest.mark.slow   # ~14s on 1 CPU (tier-1 budget); per-mode
+    # numerics stay fast via the lstm/gru numpy + unroll parity tests
     def test_shapes_all_modes(self):
         x = mx.nd.array(onp.random.randn(6, 2, 3).astype("f"))
         for cls, h in [(rnn.LSTM, 5), (rnn.GRU, 5), (rnn.RNN, 5)]:

@@ -371,6 +371,9 @@ def _assert_kv_clean(srv):
     assert eng.cache.check(live_block_ids=[])
 
 
+@pytest.mark.slow   # ~13s on 1 CPU (tier-1 budget); poison
+# isolation stays fast via test_llm_decode_poison_isolated +
+# test_llm_poison_with_shared_prefix_isolated
 def test_llm_prefill_poison_isolated(model, params):
     """A poison prompt (prefill raises) fails only ITS Future with the
     original exception; other sequences decode normally; no KV leak."""
@@ -710,6 +713,10 @@ def test_llm_mid_verify_death_resolves_typed_partial_tokens(model,
     _assert_kv_clean(srv)
 
 
+@pytest.mark.slow   # ~30s on 1 CPU (tier-1 budget); drain-with-
+# partial-tokens stays fast via test_llm_queue_overflow_and_drain +
+# test_llm_drain_with_shared_blocks_refcounts_settle, and the
+# mid-verify death variant below it is already slow-tiered
 def test_llm_drain_mid_verify_evicts_with_partial_tokens(model,
                                                          params):
     """Drain/evict while a verify round is parked mid-flight: the

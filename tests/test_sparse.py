@@ -64,6 +64,8 @@ def test_csr_roundtrip_and_spmm():
     assert not c2.densified
 
 
+@pytest.mark.slow   # ~7s on 1 CPU (tier-1 budget); csr dot
+# coverage stays fast via csr_roundtrip_and_spmm + csr_dot_vector_rhs
 def test_csr_dot_gradient_flows():
     """Autograd through sparse.dot: grad wrt the dense rhs must equal
     the dense-oracle csr.T @ dy (regression: the csr path used to build

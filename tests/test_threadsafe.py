@@ -99,6 +99,9 @@ def test_concurrent_first_call_trace_races():
         np.testing.assert_array_equal(results[tid], expected[tid])
 
 
+@pytest.mark.slow   # ~13s on 1 CPU (tier-1 budget); concurrency
+# coverage stays fast via concurrent_inference_matches_serial,
+# trace_state_is_thread_local and the recording/backward-thread tests
 def test_concurrent_mixed_signatures():
     """Different batch shapes concurrently -> distinct jit signatures
     being traced/executed at once."""

@@ -205,8 +205,8 @@ def scan_llm(repo=REPO):
         rnd = int(m.group(1)) if m else 0
         row = {"round": rnd, "status": "valid", "tokens_s": None,
                "ttft_p50": None, "ttft_p99": None, "accept": None,
-               "hit_rate": None, "adapters": None, "tag": "",
-               "note": ""}
+               "hit_rate": None, "adapters": None, "tp": None,
+               "tag": "", "note": ""}
         try:
             with open(path) as f:
                 rec = json.load(f)
@@ -217,9 +217,36 @@ def scan_llm(repo=REPO):
         if isinstance(rec.get("round"), int):
             row["round"] = rec["round"]
         row["tag"] = rec.get("tag") or ""
+        # SPMD decode (ISSUE 19): mesh shape of the headline run, or
+        # — on a structural sweep round (always headline-less) — the
+        # widest verified tp plus its per-width parity labels.
+        # Extracted BEFORE the skipped gate: the sweep's whole point
+        # is carried by a "skipped" artifact
+        mesh = rec.get("mesh") or {}
+        if mesh.get("tp"):
+            row["tp"] = mesh["tp"]
+            if (mesh.get("dp") or 1) > 1:
+                row["note"] = f"dp={mesh['dp']} replicas"
+        sweep = rec.get("mesh_sweep") or []
+        if sweep:
+            verified = [e for e in sweep
+                        if e.get("parity_kind") != "baseline"]
+            if verified:
+                row["tp"] = max(e.get("tp") or 1 for e in verified
+                                if e.get("parity_ok"))
+                parity = ", ".join(
+                    f"tp{e.get('tp')}:{e.get('parity_kind')}"
+                    + ("" if e.get("parity_ok") else "(FAILED)")
+                    for e in verified)
+                row["note"] = ("spmd structural sweep — " + parity
+                               + "; dispatches/step="
+                               + str(verified[-1].get(
+                                   "dispatches_per_step")))
         if rec.get("skipped") or rec.get("value") is None:
-            row.update(status="skipped",
-                       note=f"skipped: {rec.get('skipped')}")
+            note = f"skipped: {rec.get('skipped')}"
+            if row["note"]:
+                note = row["note"] + " | " + note
+            row.update(status="skipped", note=note)
             rows.append(row)
             continue
         row["tokens_s"] = float(rec["value"])
@@ -268,8 +295,8 @@ def render_llm(rows):
         return pat % v if v is not None else "—"
     lines = [
         "| round | status | tokens/s | TTFT p50 (ms) | TTFT p99 (ms) "
-        "| accept rate | hit rate | adapters | config | note |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "| accept rate | hit rate | adapters | tp | config | note |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         lines.append(
@@ -280,6 +307,7 @@ def render_llm(rows):
             f"| {fmt(r.get('accept'), '%.3f')} "
             f"| {fmt(r.get('hit_rate'), '%.3f')} "
             f"| {fmt(r.get('adapters'), '%d')} "
+            f"| {fmt(r.get('tp'), '%d')} "
             f"| {r['tag']} | {r['note']} |")
     valid = [r for r in rows if r["status"] == "valid"
              and r["tokens_s"] is not None]

@@ -44,6 +44,51 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def _mesh_device_need(argv):
+    """Devices the requested mesh needs: product of the axis extents
+    for ``--mesh dp=2,tp=2``, max tp for ``--mesh-sweep 1,2,4``.
+    Parsed from raw argv because the fleet must exist BEFORE jax
+    initializes (below), which is before argparse can run."""
+    need = 1
+
+    def _value(flag):
+        for i, a in enumerate(argv):
+            if a == flag and i + 1 < len(argv):
+                return argv[i + 1]
+            if a.startswith(flag + "="):
+                return a.split("=", 1)[1]
+        return None
+
+    spec = _value("--mesh")
+    if spec:
+        total = 1
+        for part in str(spec).split(","):
+            try:
+                total *= max(1, int(part.strip().split("=")[-1]))
+            except ValueError:
+                pass
+        need = max(need, total)
+    sweep = _value("--mesh-sweep")
+    if sweep:
+        for part in str(sweep).split(","):
+            try:
+                need = max(need, int(part.strip()))
+            except ValueError:
+                pass
+    return need
+
+
+_NEED = _mesh_device_need(sys.argv[1:])
+if (_NEED > 1 and "host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")):
+    # a --mesh run on the CPU backend gets a virtual-device fleet (the
+    # flag is inert on real TPU fleets, which bring their own chips)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_NEED}").strip()
+
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import serving  # noqa: E402
 from mxnet_tpu.serving.llm import (TinyDecoder, DecoderConfig,  # noqa: E402
@@ -65,14 +110,17 @@ def _load_model(args):
     """One model instance per configuration for the whole process:
     compiled programs are cached ON the model object, so the
     cache-off control pass and the measured pass share every compiled
-    program instead of each paying a full XLA warmup."""
-    key = (args.model, args.max_context)
+    program instead of each paying a full XLA warmup. A --mesh run
+    sizes the built-in decoder's head count to the widest tp shard
+    (heads must split evenly over the axis)."""
+    heads = max(2, _NEED)
+    key = (args.model, args.max_context, heads)
     if key not in _MODEL_CACHE:
         if args.model:
             _MODEL_CACHE[key] = mx.deploy.load_decoder(args.model)
         else:
             _MODEL_CACHE[key] = _builtin_decoder(
-                max_context=args.max_context)
+                max_context=args.max_context, heads=heads)
     return _MODEL_CACHE[key]
 
 
@@ -200,7 +248,8 @@ def run_overload(args):
     model, params = _load_model(args)
     max_queue = args.max_queue or 2 * args.max_seqs
     srv = LLMServer(model, params, name="llm_bench_overload",
-                    max_queue=max_queue, **_engine_kw(args, model, params))
+                    max_queue=max_queue, mesh=(args.mesh or None),
+                    **_engine_kw(args, model, params))
     warm = srv.warmup()
     srv.start()
 
@@ -312,6 +361,7 @@ def run(args, prefix_cache=None, name="llm_bench", adapter_bank=None,
         n_adapters=0):
     model, params = _load_model(args)
     srv = LLMServer(model, params, name=name,
+                    mesh=(args.mesh or None),
                     **_engine_kw(args, model, params,
                                  prefix_cache=prefix_cache,
                                  adapter_bank=adapter_bank))
@@ -403,6 +453,8 @@ def run(args, prefix_cache=None, name="llm_bench", adapter_bank=None,
         "preemptions": stats["preemptions"],
         "decode_steps": stats["decode_steps"],
         "compiles_during_load": cc.count,
+        "mesh": stats.get("mesh"),
+        "spmd_step_dispatches": stats.get("spmd_step_dispatches", 0),
         "completed": stats["requests_completed"],
         "failed": stats["requests_failed"] + stats["requests_evicted"],
         "errors": errors[:5],
@@ -426,6 +478,99 @@ def run(args, prefix_cache=None, name="llm_bench", adapter_bank=None,
                 is not None),
             "bank": stats.get("adapters"),
         }
+    print(json.dumps(report, indent=1))
+    return report
+
+
+def run_mesh_sweep(args):
+    """SPMD structural sweep (ISSUE 19): serve one fixed mixed
+    workload (chunked prefill + greedy + sampled) through bare
+    engines at each ``--mesh-sweep`` tp width and record STRUCTURE,
+    not speed — virtual CPU devices run the real shard_map program
+    but their collectives time nothing like ICI, so the emitted
+    BENCH json carries no timing headline. Per pass: compile count
+    during load (must be 0 after warmup), unified-step dispatches
+    per engine step (exactly 1 when sharded), and a ``parity_kind``
+    label — ``bitexact`` at tp=1 (greedy AND sampled streams equal
+    the unsharded baseline token-for-token), ``greedy`` at tp>1
+    (greedy streams equal the baseline; sampled streams may lawfully
+    differ, float reduction order changes under sharding)."""
+    from mxnet_tpu.serving.llm.engine import LLMEngine
+    from mxnet_tpu.serving.llm.scheduler import Sequence
+    from mxnet_tpu.serving.llm.sampling import SamplingParams
+    model, params = _load_model(args)
+    kw = _engine_kw(args, model, params)
+    kw.setdefault("prefill_chunk", 8)
+    jobs = [
+        (list(range(1, 15)), None),             # chunked prefill
+        ([4, 5, 6], None),
+        ([7, 8], SamplingParams(temperature=0.8, top_k=8, seed=13)),
+        ([9, 10, 11], None),
+    ]
+
+    def one_pass(mesh):
+        eng = LLMEngine(model, params, mesh=mesh, **kw)
+        warm = eng.warmup()
+        seqs = [Sequence(list(p), 8, sampling=s) for p, s in jobs]
+        d0, steps, outs = eng.spmd_dispatches, 0, {}
+        with serving.CompileCounter() as cc:
+            for s in seqs:
+                eng.add(s)
+            while eng.has_work():
+                eng.step()
+                steps += 1
+                for s in eng.pop_finished():
+                    outs[s.seq_id] = list(s.generated)
+                assert steps < 1000
+        assert not eng.pop_dead(), "sweep sequences died"
+        streams = [outs[s.seq_id] for s in seqs]
+        disp = eng.spmd_dispatches - d0
+        return {
+            "mesh": mesh or "none",
+            "devices": 0 if mesh is None else eng.mesh.devices.size,
+            "tp": eng.tp,
+            "engine_steps": steps,
+            "spmd_step_dispatches": disp,
+            "dispatches_per_step": round(disp / max(steps, 1), 4),
+            "compiles_during_load": cc.count,
+            "warmup_s": round(sum(warm.values()), 4),
+            "kv": (eng.cache.shard_info() or {}),
+        }, streams
+
+    tps = sorted({int(x) for x in str(args.mesh_sweep).split(",")})
+    base_entry, base_streams = one_pass(None)
+    base_greedy = [t for (_, s), t in zip(jobs, base_streams)
+                   if s is None]
+    sweep = [dict(base_entry, parity_kind="baseline", parity_ok=True)]
+    for tp in tps:
+        entry, streams = one_pass(f"tp={tp}")
+        greedy = [t for (_, s), t in zip(jobs, streams) if s is None]
+        if tp == 1:
+            entry["parity_kind"] = "bitexact"
+            entry["parity_ok"] = streams == base_streams
+        else:
+            entry["parity_kind"] = "greedy"
+            entry["parity_ok"] = greedy == base_greedy
+        sweep.append(entry)
+    report = {
+        "mode": "mesh_sweep",
+        "structural_only": True,
+        "note": "structure evidence only (CPU virtual devices): "
+                "real shard_map programs, meaningless collective "
+                "timings — no tokens/sec headline",
+        "requests": len(jobs) * len(sweep),
+        "tokens_per_sec": None,
+        "ttft_ms": None,
+        "kv_occupancy": None,
+        "preemptions": 0,
+        "compiles_during_load": sum(e["compiles_during_load"]
+                                    for e in sweep),
+        "completed": len(jobs) * len(sweep),
+        "failed": sum(0 if e["parity_ok"] else 1 for e in sweep),
+        "errors": [f"parity failed at {e['mesh']}" for e in sweep
+                   if not e["parity_ok"]],
+        "mesh_sweep": sweep,
+    }
     print(json.dumps(report, indent=1))
     return report
 
@@ -473,6 +618,12 @@ def emit_bench(report, out_dir):
             # warmed program set
             "adapters": report.get("adapters"),
             "adapters_curve": report.get("adapters_curve"),
+            # SPMD decode (ISSUE 19): the serving mesh shape (and
+            # with --mesh-sweep the per-tp structural table) rides
+            # the snapshot so the trend can attribute a headline to
+            # its sharding configuration
+            "mesh": report.get("mesh"),
+            "mesh_sweep": report.get("mesh_sweep"),
         },
         "_capture": {
             "tag": "llm_bench",
@@ -482,6 +633,10 @@ def emit_bench(report, out_dir):
         },
     }
     reasons = []
+    if report.get("structural_only"):
+        # a --mesh-sweep run is deliberately headline-less: the
+        # structure table is the payload, not the (CPU) clock
+        reasons.append(report["note"])
     if report["compiles_during_load"]:
         reasons.append(f"{report['compiles_during_load']} XLA "
                        "recompiles during the measured window")
@@ -536,6 +691,20 @@ def main():
                          "the base model, ALL passes from one "
                          "AdapterBank — i.e. one warmed program set; "
                          "the curve lands in the BENCH json")
+    ap.add_argument("--mesh", default="",
+                    help="decode mesh spec (MXNET_TPU_LLM_MESH "
+                         "syntax: 'tp=2', 'dp=2,tp=2', bare '4' = "
+                         "tp): shard the unified step tensor-"
+                         "parallel and/or run dp replica engines; on "
+                         "the CPU backend a virtual-device fleet is "
+                         "forced to match")
+    ap.add_argument("--mesh-sweep", default="",
+                    help="comma-separated tp widths (e.g. 1,2,4): "
+                         "run the SPMD structural sweep — parity "
+                         "kind per width, dispatches/step, compile "
+                         "counts — and emit it WITHOUT a timing "
+                         "headline (virtual devices prove structure, "
+                         "not speed)")
     ap.add_argument("--kv-dtype", choices=("float32", "int8"),
                     default="float32",
                     help="KV page storage dtype: int8 = per-slot-"
@@ -584,7 +753,9 @@ def main():
                 args.prefix_share = 0.5
 
     counts = _adapter_counts(args)
-    if args.overload:
+    if args.mesh_sweep:
+        report = run_mesh_sweep(args)
+    elif args.overload:
         report = run_overload(args)
     elif counts:
         # the multi-LoRA sweep: one pass per adapter count, every

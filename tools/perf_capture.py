@@ -401,6 +401,14 @@ def emit_llm_snapshot(rec, out_dir=None):
             out["adapters"] = extra["adapters"]
         if extra.get("adapters_curve") is not None:
             out["adapters_curve"] = extra["adapters_curve"]
+    # SPMD decode (ISSUE 19): the mesh shape / structural sweep ride
+    # BOTH branches — a --mesh-sweep run is deliberately "skipped"
+    # (virtual devices prove structure, never a timing headline), yet
+    # its per-tp table IS the artifact's payload
+    if extra.get("mesh") is not None:
+        out["mesh"] = extra["mesh"]
+    if extra.get("mesh_sweep") is not None:
+        out["mesh_sweep"] = extra["mesh_sweep"]
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
         f.write("\n")
