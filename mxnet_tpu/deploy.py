@@ -27,7 +27,7 @@ import numpy as _np
 
 __all__ = ["export_predictor", "load_predictor", "Predictor",
            "export_decoder", "load_decoder",
-           "flatten_params", "unflatten_params"]
+           "flatten_params", "unflatten_params", "params_from_arrays"]
 
 _MAGIC = b"MXTPUPRED1"
 _LLM_MAGIC = b"MXTPULLM01"
@@ -220,28 +220,79 @@ def unflatten_params(flat):
     return params
 
 
+# scale arrays ride in the same npz under a reserved prefix; the
+# prefix contains "." so flatten_params can never produce a colliding
+# weight path (it refuses dotted dict keys)
+_SCALE_PREFIX = "scale."
+
+
 def export_decoder(model, params, path=None):
     """Serialize a paged-decode model (a ``serving.llm.TinyDecoder``-
     shaped object: ``.config`` + param pytree) into a self-contained
     decode-serving artifact. Returns the bytes; writes ``path`` if
     given. Load with :func:`load_decoder`, serve with
-    ``serving.llm.LLMServer``."""
+    ``serving.llm.LLMServer``.
+
+    ``params`` may be a ``serving.llm.QuantizedWeights`` (ISSUE 20):
+    the int8/fp8 leaves serialize as-is (npz stores fp8-e4m3 natively),
+    the per-channel scale dict rides under ``scale.``-prefixed npz
+    keys, and the header records ``weight_dtype`` / ``weight_calib``
+    so :func:`load_decoder` rebuilds the QuantizedWeights — letting
+    ``serving.fleet.FleetRouter.publish`` hot-swap an fp32 model to
+    its quantized twin through the same artifact path."""
     import io
+    meta = {
+        "format": "mxtpu-llm-decoder/npz",
+        "config": model.config.to_dict(),
+    }
+    qw = None
+    if hasattr(params, "scales") and hasattr(params, "params") \
+            and hasattr(params, "dtype"):      # QuantizedWeights
+        qw = params
+        params = qw.params
     flat = flatten_params(params)
+    if qw is not None:
+        meta["weight_dtype"] = qw.dtype
+        meta["weight_calib"] = qw.method
+        if getattr(qw, "methods", None):
+            meta["weight_methods"] = dict(qw.methods)
+        meta["scales"] = sorted(qw.scales)
+        for k, v in qw.scales.items():
+            flat[_SCALE_PREFIX + k] = _np.asarray(v)
     buf = io.BytesIO()
     _np.savez(buf, **flat)
     blob = buf.getvalue()
-    header = json.dumps({
-        "format": "mxtpu-llm-decoder/npz",
-        "config": model.config.to_dict(),
-        "arrays": sorted(flat),
-    }).encode()
+    meta["arrays"] = sorted(flat)
+    header = json.dumps(meta).encode()
     artifact = _LLM_MAGIC + struct.pack("<I", len(header)) \
         + header + blob
     if path:
         with open(path, "wb") as f:
             f.write(artifact)
     return artifact
+
+
+def params_from_arrays(flat):
+    """Rebuild decoder params from a flat ``{path: ndarray}`` dict —
+    the shape ``FleetRouter.publish`` hands to builders. Plain trees
+    come back via :func:`unflatten_params`; when ``scale.``-prefixed
+    entries are present (a quantized weight set, ISSUE 20) the result
+    is a ``serving.llm.QuantizedWeights`` instead, so one fleet
+    builder serves fp32 and quantized publishes alike::
+
+        builder = lambda arrays: LLMServer(
+            model, mx.deploy.params_from_arrays(arrays))
+    """
+    scales = {k[len(_SCALE_PREFIX):]: _np.asarray(v)
+              for k, v in flat.items() if k.startswith(_SCALE_PREFIX)}
+    if not scales:
+        return unflatten_params(flat)
+    from .serving.llm.quant import QuantizedWeights
+    weights = {k: _np.asarray(v) for k, v in flat.items()
+               if not k.startswith(_SCALE_PREFIX)}
+    qleaf = weights[next(iter(sorted(scales)))]
+    return QuantizedWeights(unflatten_params(weights), scales,
+                            qleaf.dtype.name)
 
 
 def load_decoder(path_or_bytes):
@@ -268,4 +319,22 @@ def load_decoder(path_or_bytes):
         raise ValueError(f"decoder artifact missing arrays: "
                          f"{sorted(missing)[:4]}")
     model = TinyDecoder(DecoderConfig.from_dict(meta["config"]))
+    if meta.get("weight_dtype"):
+        from .serving.llm.quant import QuantizedWeights
+        scales = {k[len(_SCALE_PREFIX):]: v for k, v in flat.items()
+                  if k.startswith(_SCALE_PREFIX)}
+        weights = {k: v for k, v in flat.items()
+                   if not k.startswith(_SCALE_PREFIX)}
+        # npz stores fp8-e4m3 bytes faithfully but reads them back as
+        # raw void ("|V1") — the descr cannot name the extended dtype.
+        # The scale list identifies exactly the quantized leaves, so
+        # view-cast those back to the header dtype.
+        wdt = _np.dtype(meta["weight_dtype"])
+        for k in scales:
+            if k in weights and weights[k].dtype != wdt:
+                weights[k] = weights[k].view(wdt)
+        return model, QuantizedWeights(
+            unflatten_params(weights), scales, meta["weight_dtype"],
+            method=meta.get("weight_calib", "absmax"),
+            methods=meta.get("weight_methods"))
     return model, unflatten_params(flat)

@@ -34,8 +34,8 @@ contract as the BENCH trajectory.
 """
 from __future__ import annotations
 
-__all__ = ["DEFAULT_USER_MODEL", "FRONTEND_METRICS", "measure_frontend",
-           "build_report"]
+__all__ = ["DEFAULT_USER_MODEL", "DEFAULT_HBM_MODEL",
+           "FRONTEND_METRICS", "measure_frontend", "build_report"]
 
 # Declared per-user demand assumptions (config, NOT measurement — the
 # report embeds them so every derived number is reproducible).
@@ -44,6 +44,19 @@ __all__ = ["DEFAULT_USER_MODEL", "FRONTEND_METRICS", "measure_frontend",
 DEFAULT_USER_MODEL = {
     "requests_per_user_per_s": 0.005,
     "tokens_per_user_per_s": 1.5,
+}
+
+# Declared per-chip HBM assumptions for the models-per-chip derivation
+# (config, NOT measurement — carried verbatim in the report): a
+# v4-class chip's 32 GiB HBM with half budgeted for resident weights,
+# the other half holding KV pages + activations + programs. The
+# measured input is the replay server's device-resident weight bytes
+# (quantized leaves + f32 scales for a quantized checkpoint), so
+# ``models_per_chip = weight_budget // weight_bytes`` — the
+# capacity-economics column ISSUE 20's weight quantization moves.
+DEFAULT_HBM_MODEL = {
+    "hbm_bytes_per_chip": 32 * 2 ** 30,
+    "weight_fraction": 0.5,
 }
 
 # Which registry series drive each front end's partition. "expired"
@@ -117,7 +130,8 @@ def measure_frontend(ring, kind, server, chips=1, latency_slo=None):
 
 
 def build_report(ring, slo_reports, frontends, chips=1,
-                 user_model=None, trace=None):
+                 user_model=None, trace=None, llm_weights=None,
+                 hbm_model=None):
     """Assemble the capacity record ``perf_capture.
     emit_capacity_snapshot`` commits.
 
@@ -126,7 +140,13 @@ def build_report(ring, slo_reports, frontends, chips=1,
     end — each replay window measures against its OWN snapshots, so a
     front end replayed later is not diluted over the other's window);
     ``slo_reports`` — the :meth:`~.slo.SLOEngine.evaluate` output;
-    ``trace`` — the replay's trace spec/digest block (audit trail).
+    ``trace`` — the replay's trace spec/digest block (audit trail);
+    ``llm_weights`` — the decode server's measured weight block
+    (``{dtype, bytes, params_per_chip, ...}`` from its stats): when
+    present the report gains a ``models_per_chip`` column derived
+    under the declared ``hbm_model`` (:data:`DEFAULT_HBM_MODEL`
+    overridable per key) — weight bytes are measured, the HBM budget
+    is a declared assumption the report carries verbatim.
     The function never invents a value: a front end whose series are
     absent contributes nothing, and a report with no usable front end
     comes back with ``value: None`` + ``skipped`` so the emission
@@ -168,6 +188,14 @@ def build_report(ring, slo_reports, frontends, chips=1,
                         + [round(ring.span_s(), 3)]),
         "snapshots": len(ring),
     }
+    if llm_weights is not None:
+        hbm = dict(DEFAULT_HBM_MODEL, **(hbm_model or {}))
+        budget = hbm["hbm_bytes_per_chip"] * hbm["weight_fraction"]
+        blk = dict(llm_weights)
+        wb = blk.get("bytes") or 0
+        blk["models_per_chip"] = int(budget // wb) if wb > 0 else None
+        blk["hbm_model"] = hbm
+        rec["llm_weights"] = blk
     if not usable:
         rec["skipped"] = ("no front end produced a measurable "
                           "sustained rate (empty replay window?)")

@@ -17,9 +17,14 @@ range bookkeeping composes.
 """
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
 
+from .flash_attention import _on_tpu
 from .registry import _REGISTRY, Operator, alias, register
 
 
@@ -267,6 +272,81 @@ def _quantized_embedding(data, weight, min_weight, max_weight,
 
 _reg("_contrib_quantized_embedding", _quantized_embedding, nout=3,
      differentiable=False)
+
+
+# ------------------------------------- weight-only serving matmuls --
+# ISSUE 20: the serving stack's per-output-channel WEIGHT-ONLY
+# quantization (activations stay f32; weights are int8/fp8-e4m3 with
+# an f32 scale per output column). Unlike the reference's int8×int8
+# ops above, the contraction here runs in f32 on the MXU with the
+# dequant FUSED into the matmul — ``(x @ W_q.astype(f32)) * s`` — so
+# the f32 weight matrix never materializes in HBM. Gated exactly like
+# ragged_attention: plain-jnp reference off-TPU (and as the oracle),
+# Pallas kernel on TPU with the int8/fp8 tile dequantized in VMEM.
+
+
+def quantized_matmul_reference(x, qw, w_scale):
+    """Oracle: ``x [T, K] f32 @ qw [K, N] int8/fp8`` with per-output-
+    channel ``w_scale [N]`` f32. The scale factors out of each output
+    column's contraction, so scaling AFTER the accumulation is the
+    same quantity with one multiply per output instead of per
+    weight."""
+    return (x @ qw.astype(jnp.float32)) * w_scale
+
+
+def _wq_matmul_kernel(x_ref, qw_ref, s_ref, o_ref):
+    # dequant fused in VMEM: the quantized tile and its channel scales
+    # are widened to f32 right before the MXU contraction — HBM only
+    # ever holds the 1-byte weights
+    w = qw_ref[...].astype(jnp.float32) * s_ref[...]
+    o_ref[...] = x_ref[...].astype(jnp.float32) @ w
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _wq_matmul_pallas(x, qw, w_scale, block_t, block_n, interpret):
+    T, K = x.shape
+    N = qw.shape[1]
+    grid = (pl.cdiv(T, block_t), pl.cdiv(N, block_n))
+    return pl.pallas_call(
+        _wq_matmul_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_t, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((K, block_n), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_t, block_n), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((T, N), jnp.float32),
+        interpret=interpret,
+    )(x, qw, w_scale.reshape(1, N))
+
+
+def quantized_matmul(x, qw, w_scale, use_pallas=None, interpret=None,
+                     block_t=None, block_n=None):
+    """Per-output-channel weight-only quantized matmul:
+    ``out[t, c] = (sum_k x[t, k] * qw[k, c]) * w_scale[c]``.
+
+    x: f32 ``[T, K]``; qw: int8 or fp8-e4m3 ``[K, N]``; w_scale: f32
+    ``[N]`` (``serving.llm.quant.quantize_leaf`` scales). Gating as in
+    :mod:`.ragged_attention`: ``use_pallas=None`` picks the Pallas
+    kernel on TPU and the jnp reference elsewhere; ``interpret`` runs
+    the kernel in interpret mode for off-TPU parity tests."""
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if not use_pallas:
+        return quantized_matmul_reference(x, qw, w_scale)
+    if interpret is None:
+        interpret = not _on_tpu()
+    T, K = x.shape
+    N = qw.shape[1]
+    bt = int(block_t) if block_t else min(T, 256)
+    bn = int(block_n) if block_n else min(N, 256)
+    return _wq_matmul_pallas(x, qw, w_scale, bt, bn, bool(interpret))
+
+
+_reg("_contrib_quantized_matmul", quantized_matmul,
+     differentiable=False)
+alias("quantized_matmul", "_contrib_quantized_matmul")
 
 
 def _calibrate_entropy(hist, hist_edges, num_quantized_bins=255):

@@ -55,6 +55,8 @@ from .kv_cache import (BlockAllocator, PagedKVCache, KVCacheError,
 from .scheduler import Sequence, Scheduler
 from .sampling import SamplingParams, GREEDY
 from .model import DecoderConfig, TinyDecoder, greedy_decode_reference
+from .quant import (QuantizedWeights, quantize_weights, fp8_supported,
+                    resolve_weight_dtype)
 from .engine import LLMEngine
 from .metrics import LLMStats
 from .server import LLMServer, GenerationResult
@@ -68,4 +70,6 @@ __all__ = [
     "greedy_decode_reference", "LLMEngine", "LLMStats", "LLMServer",
     "SequenceEvictedError", "DeadlineExceededError", "Overloaded",
     "GenerationResult",
+    "QuantizedWeights", "quantize_weights", "fp8_supported",
+    "resolve_weight_dtype",
 ]

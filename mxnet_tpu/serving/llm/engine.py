@@ -69,6 +69,8 @@ from ..envutil import env_int as _env_int, env_str as _env_str
 from ..adapters.bank import AdapterError, NULL_ADAPTER_PAGE
 from .kv_cache import (PagedKVCache, KVCacheError, NULL_BLOCK,
                        prefix_block_hashes)
+from .quant import (QuantizedWeights, quantize_weights,
+                    resolve_weight_dtype, fp8_supported, FP8_NAME)
 from .scheduler import Scheduler, Sequence, RUNNING, FINISHED, EVICTED
 from .sampling import (TAG_SAMPLE, TAG_ACCEPT, TAG_DRAFT, row_keys,
                        sample_and_probs, spec_accept,
@@ -81,7 +83,7 @@ __all__ = ["LLMEngine"]
 
 
 def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False,
-                  axis_name=None):
+                  axis_name=None, wq=False):
     """Build the target step program body for (model, spec_k): ONE
     program covering chunked prefill + decode + speculative verify
     over the FLAT ragged layout — a packed ``[total_q_tokens]`` batch
@@ -124,9 +126,24 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False,
     rule below then runs on replicated logits, identically on every
     shard. ``None`` (the default) is the plain single-device body —
     the kwarg is only forwarded when set, so third-party models
-    without SPMD support keep working unsharded."""
+    without SPMD support keep working unsharded.
+
+    ``wq`` (ISSUE 20) selects the quantized-WEIGHTS variant: the
+    traced ``params`` argument is the ``{"w": quantized tree, "s":
+    flat scale dict}`` wrapper the engine builds from a
+    :class:`~.quant.QuantizedWeights` checkpoint — unpacked here and
+    forwarded as ``decode_flat(..., w_scales=...)``, so positional
+    pool/batch signatures (and the donation indices) are identical to
+    the f32 program and a quantized hot-swap reuses every warmed
+    rung."""
     import jax.numpy as jnp
     dkw = {} if axis_name is None else {"axis_name": axis_name}
+    if wq:
+        def _wparams(params):
+            return params["w"], dict(dkw, w_scales=params["s"])
+    else:
+        def _wparams(params):
+            return params, dkw
 
     def _accept(logits, win_idx, draft_tokens, draft_probs, n_draft,
                 temperature, top_k, top_p, seeds, counters):
@@ -149,11 +166,12 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False,
                  block_tables, win_idx, draft_tokens, draft_probs,
                  n_draft, temperature, top_k, top_p, seeds, counters,
                  a_tables, a_scales):
+            p, mkw = _wparams(params)
             logits, kp2, vp2, ks2, vs2 = model.decode_flat(
-                params, tokens, positions, seq_ids, valid, k_pages,
+                p, tokens, positions, seq_ids, valid, k_pages,
                 v_pages, block_tables, k_scales=k_scales,
                 v_scales=v_scales,
-                adapter=(a_pages, b_pages, a_tables, a_scales), **dkw)
+                adapter=(a_pages, b_pages, a_tables, a_scales), **mkw)
             toks, n_acc = _accept(logits, win_idx, draft_tokens,
                                   draft_probs, n_draft, temperature,
                                   top_k, top_p, seeds, counters)
@@ -165,10 +183,11 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False,
                  positions, seq_ids, valid, block_tables, win_idx,
                  draft_tokens, draft_probs, n_draft, temperature,
                  top_k, top_p, seeds, counters):
+            p, mkw = _wparams(params)
             logits, kp2, vp2, ks2, vs2 = model.decode_flat(
-                params, tokens, positions, seq_ids, valid, k_pages,
+                p, tokens, positions, seq_ids, valid, k_pages,
                 v_pages, block_tables, k_scales=k_scales,
-                v_scales=v_scales, **dkw)
+                v_scales=v_scales, **mkw)
             toks, n_acc = _accept(logits, win_idx, draft_tokens,
                                   draft_probs, n_draft, temperature,
                                   top_k, top_p, seeds, counters)
@@ -180,10 +199,11 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False,
                  positions, seq_ids, valid, block_tables, win_idx,
                  draft_tokens, draft_probs, n_draft, temperature,
                  top_k, top_p, seeds, counters, a_tables, a_scales):
+            p, mkw = _wparams(params)
             logits, k_pages2, v_pages2 = model.decode_flat(
-                params, tokens, positions, seq_ids, valid, k_pages,
+                p, tokens, positions, seq_ids, valid, k_pages,
                 v_pages, block_tables,
-                adapter=(a_pages, b_pages, a_tables, a_scales), **dkw)
+                adapter=(a_pages, b_pages, a_tables, a_scales), **mkw)
             toks, n_acc = _accept(logits, win_idx, draft_tokens,
                                   draft_probs, n_draft, temperature,
                                   top_k, top_p, seeds, counters)
@@ -193,9 +213,10 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False,
     def step(params, k_pages, v_pages, tokens, positions, seq_ids,
              valid, block_tables, win_idx, draft_tokens, draft_probs,
              n_draft, temperature, top_k, top_p, seeds, counters):
+        p, mkw = _wparams(params)
         logits, k_pages2, v_pages2 = model.decode_flat(
-            params, tokens, positions, seq_ids, valid, k_pages,
-            v_pages, block_tables, **dkw)
+            p, tokens, positions, seq_ids, valid, k_pages,
+            v_pages, block_tables, **mkw)
         toks, n_acc = _accept(logits, win_idx, draft_tokens,
                               draft_probs, n_draft, temperature,
                               top_k, top_p, seeds, counters)
@@ -204,7 +225,8 @@ def _make_step_fn(model, spec_k, sampled, quantized=False, lora=False,
     return step
 
 
-def _make_draft_fn(model, sampled, quantized=False, axis_name=None):
+def _make_draft_fn(model, sampled, quantized=False, axis_name=None,
+                   wq=False):
     """Build the draft proposal program body: the same flat layout
     against the draft cache, returning one proposal per row plus
     (sampled variant) the full adjusted probability vector the accept
@@ -214,9 +236,17 @@ def _make_draft_fn(model, sampled, quantized=False, axis_name=None):
     fed token (0 for inactive rows; outputs discarded).
     ``axis_name``: see :func:`_make_step_fn` — the draft rides the
     same tensor-parallel mesh as the target (same block ids, same
-    head split)."""
+    head split). ``wq``: quantized-weights draft (ISSUE 20's int8
+    draft for speculative decoding) — same ``{"w", "s"}`` params
+    wrapper as the target step."""
     import jax.numpy as jnp
     dkw = {} if axis_name is None else {"axis_name": axis_name}
+    if wq:
+        def _wparams(params):
+            return params["w"], dict(dkw, w_scales=params["s"])
+    else:
+        def _wparams(params):
+            return params, dkw
 
     def _propose(logits, last_idx, temperature, top_k, top_p, seeds,
                  counters):
@@ -233,10 +263,11 @@ def _make_draft_fn(model, sampled, quantized=False, axis_name=None):
                   tokens, positions, seq_ids, valid, block_tables,
                   last_idx, temperature, top_k, top_p, seeds,
                   counters):
+            p, mkw = _wparams(params)
             logits, kp2, vp2, ks2, vs2 = model.decode_flat(
-                params, tokens, positions, seq_ids, valid, k_pages,
+                p, tokens, positions, seq_ids, valid, k_pages,
                 v_pages, block_tables, k_scales=k_scales,
-                v_scales=v_scales, **dkw)
+                v_scales=v_scales, **mkw)
             toks, probs = _propose(logits, last_idx, temperature,
                                    top_k, top_p, seeds, counters)
             return toks, probs, kp2, vp2, ks2, vs2
@@ -245,9 +276,10 @@ def _make_draft_fn(model, sampled, quantized=False, axis_name=None):
     def draft(params, k_pages, v_pages, tokens, positions, seq_ids,
               valid, block_tables, last_idx, temperature, top_k,
               top_p, seeds, counters):
+        p, mkw = _wparams(params)
         logits, k_pages2, v_pages2 = model.decode_flat(
-            params, tokens, positions, seq_ids, valid, k_pages,
-            v_pages, block_tables, **dkw)
+            p, tokens, positions, seq_ids, valid, k_pages,
+            v_pages, block_tables, **mkw)
         toks, probs = _propose(logits, last_idx, temperature, top_k,
                                top_p, seeds, counters)
         return toks, probs, k_pages2, v_pages2
@@ -342,6 +374,34 @@ def _place_param_tree(params, model, mesh):
     return jax.tree_util.tree_unflatten(treedef, placed)
 
 
+def _resolve_kv_dtype(name):
+    """Map an ``fp8`` KV-dtype request onto the backend: returns
+    ``(dtype_name, fell_back)`` — ``float8_e4m3fn`` where the stack
+    carries the dtype, else ``int8`` with ``fell_back=True`` (the
+    caller counts a warning; serving proceeds at the next-best
+    quantized width instead of crashing a fleet config on an older
+    backend). Non-fp8 names pass through untouched."""
+    low = str(name).strip().lower()
+    if low in ("fp8", "float8", "e4m3", "float8_e4m3", FP8_NAME):
+        if fp8_supported():
+            return FP8_NAME, False
+        return "int8", True
+    return name, False
+
+
+def _place_scales(scales, model, mesh):
+    """Place a flat per-channel weight-scale dict onto ``mesh``: each
+    scale vector follows its weight's output axis per the model's
+    :meth:`weight_scale_specs` (replicated when the model doesn't
+    declare scale specs — correct, just not bandwidth-minimal)."""
+    from jax.sharding import PartitionSpec as P
+    from ...parallel.mesh import place_global
+    specs = model.weight_scale_specs(axis="tp") \
+        if hasattr(model, "weight_scale_specs") else {}
+    return {k: place_global(v, mesh, specs.get(k, P()))
+            for k, v in scales.items()}
+
+
 def _spmd_wrap(fn, mesh, cache, param_specs, extra):
     """Wrap a step/draft program body in ``shard_map`` over the
     engine's ``("tp",)`` mesh: params enter per ``param_specs``, the
@@ -392,7 +452,8 @@ class LLMEngine:
                  draft_model=None, draft_params=None, spec_k=None,
                  stats=None, dtype="float32", breaker=None,
                  prefix_cache=None, kv_dtype=None, adapter_bank=None,
-                 mesh=None):
+                 mesh=None, weight_dtype=None, weight_calib=None,
+                 draft_weight_dtype=None):
         import jax
         import jax.numpy as jnp
         self.model = model
@@ -496,9 +557,22 @@ class LLMEngine:
                                          1))
         self.prefix_enabled = bool(prefix_cache)
         # quantized KV storage: constructor arg >
-        # MXNET_TPU_LLM_KV_DTYPE env > the float `dtype` arg
+        # MXNET_TPU_LLM_KV_DTYPE env > the float `dtype` arg. "fp8"
+        # resolves to float8_e4m3fn where the backend has it, else
+        # int8 with a counted warning (ISSUE 20 availability guard).
         if kv_dtype is None:
             kv_dtype = _env_str("MXNET_TPU_LLM_KV_DTYPE", dtype)
+        kv_dtype, kv_fell_back = _resolve_kv_dtype(kv_dtype)
+        self.kv_dtype_fallbacks = 0
+        if kv_fell_back:
+            import warnings
+            self.kv_dtype_fallbacks = 1
+            if stats is not None:
+                stats.record_quant_fallback()
+            warnings.warn(
+                "fp8 KV requested but float8_e4m3fn is unavailable on "
+                "this backend; serving int8 KV instead", RuntimeWarning,
+                stacklevel=2)
         self.cache = PagedKVCache(
             model.num_layers, model.num_heads, model.head_dim,
             block_size, num_blocks, max_context, dtype=kv_dtype,
@@ -516,10 +590,43 @@ class LLMEngine:
         self.prefix_lookups = 0
         self.prefix_hits = 0
         self.prefill_tokens_saved = 0
-        self._params = jax.tree_util.tree_map(jnp.asarray, params)
-        if self.mesh is not None:
-            self._params = _place_param_tree(self._params, model,
-                                             self.mesh)
+        # quantized weights (ISSUE 20): `params` may already be a
+        # QuantizedWeights checkpoint (deploy/fleet hand-off), or a
+        # f32 tree quantized here per weight_dtype arg >
+        # MXNET_TPU_LLM_WEIGHT_DTYPE env > full precision. The engine
+        # params become the {"w": tree, "s": scales} wrapper — ONE
+        # traced argument, so every positional pool/batch index (and
+        # the donation tuple) matches the f32 program exactly.
+        qw = self._resolve_weight_input(params, weight_dtype,
+                                        weight_calib,
+                                        "MXNET_TPU_LLM_WEIGHT_DTYPE")
+        self.weight_dtype = "float32" if qw is None else qw.dtype
+        self.weight_calib = None if qw is None else qw.method
+        self.weight_quantized = qw is not None
+        if qw is None:
+            self._params = jax.tree_util.tree_map(jnp.asarray, params)
+            if self.mesh is not None:
+                self._params = _place_param_tree(self._params, model,
+                                                 self.mesh)
+            self.weight_bytes = int(sum(
+                np.asarray(a).nbytes for a in
+                jax.tree_util.tree_leaves(params)))
+            self.weight_params = int(sum(
+                np.asarray(a).size for a in
+                jax.tree_util.tree_leaves(params)))
+        else:
+            self.weight_bytes = qw.nbytes()
+            self.weight_params = qw.num_params()
+            qp = jax.tree_util.tree_map(jnp.asarray, qw.params)
+            sc = {k: jnp.asarray(v) for k, v in qw.scales.items()}
+            if self.mesh is not None:
+                qp = _place_param_tree(qp, model, self.mesh)
+                sc = _place_scales(sc, model, self.mesh)
+            self._params = {"w": qp, "s": sc}
+        if self._stats is not None:
+            self._stats.record_weight_quant(
+                self.weight_dtype, self.weight_bytes,
+                self.weight_params // max(1, self.tp))
         # donation is a TPU/HBM lever; CPU backends ignore it with a
         # warning per call site, so only request it where it works
         from ...ops.flash_attention import _on_tpu
@@ -543,10 +650,14 @@ class LLMEngine:
 
         def _build_step(s):
             fn = _make_step_fn(model, self.spec_k, s, self.quantized,
-                               lora=lora, axis_name=self._axis_name)
+                               lora=lora, axis_name=self._axis_name,
+                               wq=self.weight_quantized)
             if self.mesh is not None:
-                fn = _spmd_wrap(fn, self.mesh, self.cache,
-                                model.param_specs(axis="tp"),
+                pspecs = model.param_specs(axis="tp")
+                if self.weight_quantized:
+                    pspecs = {"w": pspecs, "s": self._scale_spec_dict(
+                        model, self._params["s"])}
+                fn = _spmd_wrap(fn, self.mesh, self.cache, pspecs,
                                 self._step_extra_specs(lora))
             return jax.jit(fn, donate_argnums=donate)
 
@@ -554,7 +665,7 @@ class LLMEngine:
             sampled: _cached_program(
                 model, "step",
                 (self.spec_k, sampled, self.quantized, donate,
-                 lora_key, self._mesh_key),
+                 lora_key, self._mesh_key, self.weight_dtype),
                 lambda s=sampled: _build_step(s))
             for sampled in (False, True)}
         if self.draft_model is not None:
@@ -573,30 +684,59 @@ class LLMEngine:
                 draft_model.num_layers, draft_model.num_heads,
                 draft_model.head_dim, block_size, num_blocks,
                 max_context, dtype=kv_dtype, mesh=self.mesh)
-            self._draft_params = jax.tree_util.tree_map(
-                jnp.asarray, draft_params)
-            if self.mesh is not None:
-                self._draft_params = _place_param_tree(
-                    self._draft_params, draft_model, self.mesh)
+            # int8 draft (ISSUE 20): the cheap-draft lever — draft
+            # quality only moves the accept rate, never the committed
+            # stream (the accept rule guarantees target-distribution
+            # output), so the draft is the safest place to shed bytes
+            dqw = self._resolve_weight_input(
+                draft_params, draft_weight_dtype, weight_calib,
+                "MXNET_TPU_LLM_DRAFT_WEIGHT_DTYPE")
+            self.draft_weight_dtype = \
+                "float32" if dqw is None else dqw.dtype
+            self.draft_weight_quantized = dqw is not None
+            if dqw is None:
+                self._draft_params = jax.tree_util.tree_map(
+                    jnp.asarray, draft_params)
+                if self.mesh is not None:
+                    self._draft_params = _place_param_tree(
+                        self._draft_params, draft_model, self.mesh)
+            else:
+                dqp = jax.tree_util.tree_map(jnp.asarray, dqw.params)
+                dsc = {k: jnp.asarray(v)
+                       for k, v in dqw.scales.items()}
+                if self.mesh is not None:
+                    dqp = _place_param_tree(dqp, draft_model,
+                                            self.mesh)
+                    dsc = _place_scales(dsc, draft_model, self.mesh)
+                self._draft_params = {"w": dqp, "s": dsc}
 
             def _build_draft(s):
                 fn = _make_draft_fn(draft_model, s, self.quantized,
-                                    axis_name=self._axis_name)
+                                    axis_name=self._axis_name,
+                                    wq=self.draft_weight_quantized)
                 if self.mesh is not None:
+                    dspecs = draft_model.param_specs(axis="tp")
+                    if self.draft_weight_quantized:
+                        dspecs = {"w": dspecs,
+                                  "s": self._scale_spec_dict(
+                                      draft_model,
+                                      self._draft_params["s"])}
                     fn = _spmd_wrap(
                         fn, self.mesh, self.draft_cache,
-                        draft_model.param_specs(axis="tp"), (0, 11))
+                        dspecs, (0, 11))
                 return jax.jit(fn, donate_argnums=donate)
 
             self._draft_jits = {
                 sampled: _cached_program(
                     draft_model, "draft",
                     (sampled, self.quantized, donate,
-                     self._mesh_key),
+                     self._mesh_key, self.draft_weight_dtype),
                     lambda s=sampled: _build_draft(s))
                 for sampled in (False, True)}
         else:
             self.draft_cache = None
+            self.draft_weight_dtype = None
+            self.draft_weight_quantized = False
         # the copy-on-write program: one fixed-shape jitted copy of
         # block row src -> dst across every pool array (target K/V,
         # quant scales, draft pools) — warmed once, dispatched when a
@@ -668,6 +808,48 @@ class LLMEngine:
         self._poison_pending = []
 
     # -------------------------------------------- pool call helpers --
+    def _resolve_weight_input(self, params, weight_dtype, weight_calib,
+                              env_name):
+        """Normalize a params argument to its quantized form: a
+        :class:`~.quant.QuantizedWeights` passes through (the
+        deploy/fleet hand-off — already calibrated, dtype pinned in
+        the artifact), a f32 tree is quantized here when
+        ``weight_dtype`` arg or the ``env_name`` env var asks for it,
+        and ``None`` means serve full precision. fp8 requests fall
+        back to int8 with a counted warning on backends without the
+        dtype."""
+        if isinstance(params, QuantizedWeights):
+            return params
+        req = weight_dtype if weight_dtype is not None \
+            else _env_str(env_name, "")
+        wd, fell_back = resolve_weight_dtype(req)
+        if fell_back:
+            import warnings
+            if self._stats is not None:
+                self._stats.record_quant_fallback()
+            warnings.warn(
+                "fp8 weights requested but float8_e4m3fn is "
+                "unavailable on this backend; quantizing to int8 "
+                "instead", RuntimeWarning, stacklevel=3)
+        if wd is None:
+            return None
+        calib = weight_calib if weight_calib is not None \
+            else _env_str("MXNET_TPU_LLM_WEIGHT_CALIB", "absmax")
+        pct = float(_env_str("MXNET_TPU_LLM_WEIGHT_PERCENTILE",
+                             "99.9"))
+        return quantize_weights(params, dtype=wd, method=calib,
+                                percentile=pct)
+
+    def _scale_spec_dict(self, m, scales):
+        """Per-key PartitionSpecs for a flat scale dict, defaulting
+        any key the model's :meth:`weight_scale_specs` doesn't cover
+        to replicated — the spec tree must match the traced dict
+        key-for-key under ``shard_map``."""
+        from jax.sharding import PartitionSpec as P
+        base = m.weight_scale_specs(axis="tp") \
+            if hasattr(m, "weight_scale_specs") else {}
+        return {k: base.get(k, P()) for k in scales}
+
     def _step_extra_specs(self, lora):
         """(leading replicated pool count, trailing replicated batch
         arg count) of the step program after params + KV pools: the
@@ -1808,6 +1990,15 @@ class LLMEngine:
                              "lookups": self.prefix_lookups,
                              "hits": self.prefix_hits,
                              "tokens_saved": self.prefill_tokens_saved},
+            "weights": {"dtype": self.weight_dtype,
+                        "calib": self.weight_calib,
+                        "bytes": self.weight_bytes,
+                        "params": self.weight_params,
+                        "params_per_chip":
+                            self.weight_params // max(1, self.tp),
+                        "draft_dtype": self.draft_weight_dtype,
+                        "kv_dtype": self.cache.dtype.name,
+                        "kv_dtype_fallbacks": self.kv_dtype_fallbacks},
             "adapters": self.bank.stats() if self.bank is not None
             else None,
             "mesh": None if self.mesh is None else {

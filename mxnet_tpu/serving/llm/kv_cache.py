@@ -313,6 +313,10 @@ class PagedKVCache:
     and per-(layer, block, slot, head) f32 scales ride in
     ``k_scales``/``v_scales`` — the engine's programs quantize on
     write and the ragged kernels dequantize on read.
+    ``dtype="float8_e4m3fn"`` (ISSUE 20; engines accept the ``fp8``
+    alias with an availability guard) stores the same scale-per-slot
+    layout at fp8 width — the write path scales into ±448 and lets
+    the cast round, the dequant multiply is identical.
 
     ``prefix_cache=True`` enables the content-addressed prefix index:
     :meth:`register` maps a chained block hash to a live block,
@@ -321,7 +325,7 @@ class PagedKVCache:
     on ``prefix_evictions`` / fire ``on_prefix_evict``.
     """
 
-    QUANTIZED_DTYPES = ("int8",)
+    QUANTIZED_DTYPES = ("int8", "float8_e4m3fn")
 
     def __init__(self, num_layers, num_heads, head_dim, block_size,
                  num_blocks, max_context, dtype="float32",

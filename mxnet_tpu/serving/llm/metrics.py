@@ -206,6 +206,27 @@ class LLMStats:
             "mxtpu_llm_spmd_kv_heads_per_shard",
             "KV heads resident on each tp shard of the paged pool "
             "(num_heads / tp).", lbl).labels(**s)
+        self._weight_dtype = r.gauge(
+            "mxtpu_llm_weight_dtype",
+            "Serving weight storage dtype (one series per dtype, "
+            "value 1 on the active one; set at engine construction).",
+            ("server", "dtype"))
+        self._weight_dtype_children = {}
+        self._weight_bytes = r.gauge(
+            "mxtpu_llm_weight_bytes",
+            "Device-resident bytes of the serving weight tree "
+            "(quantized leaves + f32 scales + untouched leaves).",
+            lbl).labels(**s)
+        self._weight_params_per_chip = r.gauge(
+            "mxtpu_llm_weight_params_per_chip",
+            "Model parameters resident per chip (total params / tp) — "
+            "with mxtpu_llm_weight_bytes this prices params-per-chip "
+            "at each weight dtype.", lbl).labels(**s)
+        self._quant_fallbacks = r.counter(
+            "mxtpu_llm_quant_fallback_total",
+            "fp8 weight/KV requests served as int8 because the "
+            "backend lacks float8_e4m3fn (availability-guard "
+            "fallbacks).", lbl).labels(**s)
         # the overload/failure series share the single-shot server's
         # mxtpu_serving_* catalog (one dashboard for both front ends)
         self._overload = OverloadStats(r, self._server)
@@ -367,6 +388,23 @@ class LLMStats:
     def record_spmd_dispatch(self, n=1):
         self._spmd_dispatches.inc(n)
 
+    # --------------------------------------------- quantized weights --
+    def record_weight_quant(self, dtype, weight_bytes,
+                            params_per_chip):
+        """Engine construction: publish the serving weight dtype (a
+        1-valued series per dtype label — float32 engines publish too,
+        so dashboards can diff a mixed fleet), resident weight bytes
+        and the params-per-chip headline."""
+        self._labeled_child(self._weight_dtype,
+                            self._weight_dtype_children,
+                            dtype=str(dtype)).set(1)
+        self._weight_bytes.set(int(weight_bytes))
+        self._weight_params_per_chip.set(int(params_per_chip))
+
+    def record_quant_fallback(self, n=1):
+        """One fp8→int8 availability-guard fallback (weights or KV)."""
+        self._quant_fallbacks.inc(n)
+
     # ------------------------------------------------- tenant series --
     def record_tenant(self, tenant, outcome, n=1):
         """Per-tenant outcome attribution (no-op for tenant None)."""
@@ -438,6 +476,13 @@ class LLMStats:
                     self._spmd_axis_children.items()},
                 "spmd_kv_heads_per_shard": int(
                     self._spmd_heads_per_shard.value),
+                "weight_dtype": {
+                    k[0][1]: int(c.value) for k, c in
+                    self._weight_dtype_children.items()},
+                "weight_bytes": int(self._weight_bytes.value),
+                "weight_params_per_chip": int(
+                    self._weight_params_per_chip.value),
+                "quant_fallbacks": int(self._quant_fallbacks.value),
                 "adapters_resident": int(
                     self._adapters_resident.value),
                 "adapter_publishes": int(

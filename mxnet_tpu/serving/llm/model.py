@@ -33,6 +33,7 @@ from ...ops.ragged_attention import (ragged_paged_attention,
 from ...ops.flash_attention import attention_reference
 from ...ops.lora import (paged_lora_delta, gather_adapter,
                          PROJ_Q, PROJ_K, PROJ_V, PROJ_O)
+from ...ops.quantization import quantized_matmul
 
 __all__ = ["DecoderConfig", "TinyDecoder", "greedy_decode_reference"]
 
@@ -182,6 +183,23 @@ class TinyDecoder:
             "layers": [dict(layer) for _ in range(self.config.num_layers)],
         }
 
+    def weight_scale_specs(self, axis="tp"):
+        """PartitionSpecs for the flat per-channel weight-scale dict
+        (``serving.llm.quant.QuantizedWeights.scales``): each scale
+        vector shards with its weight's OUTPUT axis — column-parallel
+        matrices (``wq/wk/wv/w1``) carry axis-sharded scales, row-
+        parallel ones (``wo/w2``) replicate their full-width output
+        scale (the per-column factor commutes with the psum), and the
+        replicated embedding/position/head scales ride replicated."""
+        from jax.sharding import PartitionSpec as P
+        specs = {"embed": P(), "pos": P(), "head": P()}
+        for li in range(self.config.num_layers):
+            for n, s in (("wq", P(axis)), ("wk", P(axis)),
+                         ("wv", P(axis)), ("wo", P()),
+                         ("w1", P(axis)), ("w2", P())):
+                specs[f"layers.{li}.{n}"] = s
+        return specs
+
     # ------------------------------------------------------ prefill --
     def forward(self, params, tokens, lora=None):
         """Dense causal forward. tokens: int32 [B, T] (T <=
@@ -295,7 +313,8 @@ class TinyDecoder:
 
     def decode_flat(self, params, tokens, positions, seq_ids, valid,
                     k_pages, v_pages, block_tables, k_scales=None,
-                    v_scales=None, adapter=None, axis_name=None):
+                    v_scales=None, adapter=None, axis_name=None,
+                    w_scales=None):
         """The FLAT ragged step: a packed ``[T]`` batch of query
         tokens from many sequences — no per-sequence padding, so a
         mixed prefill/decode/verify step computes exactly the tokens
@@ -329,6 +348,22 @@ class TinyDecoder:
         low-rank delta to the four attention projections; rows whose
         table is all null page 0 (scale 0) get an exact-zero delta —
         one program serves any adapter mix.
+
+        Quantized weights (ISSUE 20): ``w_scales`` is the flat
+        ``{dot.path: [cols] f32}`` per-output-channel scale dict of a
+        ``serving.llm.quant.QuantizedWeights`` checkpoint — the
+        matching ``params`` leaves are int8/fp8 and every base matmul
+        routes through the registry's weight-only
+        ``quantized_matmul`` (dequant fused into the contraction);
+        the embedding/position gathers dequantize after the lookup.
+        Leaves without a scale entry (norms, biases) run f32
+        unchanged, and LoRA deltas stay f32, applied AFTER the
+        dequantized base matmul. Scales are traced arguments, so
+        hot-swapping a quantized checkpoint reuses the warmed
+        program. Under ``axis_name`` the scales arrive pre-sharded
+        per :meth:`weight_scale_specs` — column-split weights carry
+        their scale shard, row-split weights a replicated full-width
+        scale (per-column factors commute with the psum).
 
         SPMD (ISSUE 19): with ``axis_name`` set this is the PER-SHARD
         body of a ``shard_map`` over a tensor-parallel mesh axis —
@@ -365,6 +400,18 @@ class TinyDecoder:
             vmask,
             block_tables[seq_ids, positions // bs], 0)  # null block
         slot = jnp.where(vmask, positions % bs, 0)
+        ws = w_scales if w_scales is not None else {}
+
+        def _mm(x2d, w, s):
+            if s is None:
+                return x2d @ w
+            return quantized_matmul(x2d, w, s)
+
+        def _lookup(table, idx, s):
+            g = table[idx]
+            if s is None:
+                return g
+            return g.astype(jnp.float32) * s
         if adapter is not None:
             la_pages, lb_pages, a_tables, a_scales = adapter
             pages_tok = a_tables[seq_ids]               # [T, P]
@@ -374,12 +421,15 @@ class TinyDecoder:
                 return paged_lora_delta(
                     x2d, *gather_adapter(la_pages, lb_pages, pages_tok,
                                          li, proj), scale_tok)
-        h = params["embed"][tokens] + params["pos"][positions]
+        h = _lookup(params["embed"], tokens, ws.get("embed")) \
+            + _lookup(params["pos"], positions, ws.get("pos"))
         for li, lp in enumerate(params["layers"]):
+            def _lsc(n, _li=li):
+                return ws.get(f"layers.{_li}.{n}")
             x = _layer_norm(h, lp["ln1_g"], lp["ln1_b"])
-            q = x @ lp["wq"]
-            k = x @ lp["wk"]
-            v = x @ lp["wv"]
+            q = _mm(x, lp["wq"], _lsc("wq"))
+            k = _mm(x, lp["wk"], _lsc("wk"))
+            v = _mm(x, lp["wv"], _lsc("wv"))
             if adapter is not None:
                 if axis_name is None:
                     q = q + _delta(x, li, PROJ_Q)
@@ -401,14 +451,26 @@ class TinyDecoder:
             k = k.reshape(T, heads_here, c.head_dim)
             v = v.reshape(T, heads_here, c.head_dim)
             if quantized:
+                # int8 pages round to ±127 steps; fp8-e4m3 pages
+                # (ISSUE 20) scale into the ±448 finite range and let
+                # the cast round — clipped first because the
+                # float32→e4m3 cast does NOT saturate, it NaNs
+                int8_kv = k_pages.dtype == jnp.int8
+                qmax = 127.0 if int8_kv else 448.0
                 ksc = jnp.maximum(
-                    jnp.max(jnp.abs(k), axis=-1) / 127.0, 1e-8)
+                    jnp.max(jnp.abs(k), axis=-1) / qmax, 1e-8)
                 vsc = jnp.maximum(
-                    jnp.max(jnp.abs(v), axis=-1) / 127.0, 1e-8)
-                kq = jnp.clip(jnp.round(k / ksc[..., None]),
-                              -127, 127).astype(jnp.int8)
-                vq = jnp.clip(jnp.round(v / vsc[..., None]),
-                              -127, 127).astype(jnp.int8)
+                    jnp.max(jnp.abs(v), axis=-1) / qmax, 1e-8)
+                if int8_kv:
+                    kq = jnp.clip(jnp.round(k / ksc[..., None]),
+                                  -127, 127).astype(jnp.int8)
+                    vq = jnp.clip(jnp.round(v / vsc[..., None]),
+                                  -127, 127).astype(jnp.int8)
+                else:
+                    kq = jnp.clip(k / ksc[..., None], -qmax,
+                                  qmax).astype(k_pages.dtype)
+                    vq = jnp.clip(v / vsc[..., None], -qmax,
+                                  qmax).astype(v_pages.dtype)
                 k_pages = k_pages.at[li, bidx, slot].set(kq)
                 v_pages = v_pages.at[li, bidx, slot].set(vq)
                 k_scales = k_scales.at[li, bidx, slot].set(ksc)
@@ -427,7 +489,7 @@ class TinyDecoder:
                            block_tables, seq_ids,
                            positions)
             att2d = att.reshape(T, heads_here * c.head_dim)
-            o = att2d @ lp["wo"]
+            o = _mm(att2d, lp["wo"], _lsc("wo"))
             if axis_name is not None:
                 o = jax.lax.psum(o, axis_name)
             if adapter is not None:
@@ -443,12 +505,13 @@ class TinyDecoder:
                     o = o + _delta(att_full, li, PROJ_O)
             h = h + o
             x2 = _layer_norm(h, lp["ln2_g"], lp["ln2_b"])
-            mlp = jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"]
+            mlp = _mm(jax.nn.gelu(_mm(x2, lp["w1"], _lsc("w1"))
+                                  + lp["b1"]), lp["w2"], _lsc("w2"))
             if axis_name is not None:
                 mlp = jax.lax.psum(mlp, axis_name)
             h = h + mlp + lp["b2"]
-        logits = _layer_norm(h, params["lnf_g"],
-                             params["lnf_b"]) @ params["head"]
+        logits = _mm(_layer_norm(h, params["lnf_g"], params["lnf_b"]),
+                     params["head"], ws.get("head"))
         if quantized:
             return logits, k_pages, v_pages, k_scales, v_scales
         return logits, k_pages, v_pages

@@ -1,0 +1,236 @@
+"""Weight quantization for LLM serving (ISSUE 20).
+
+The weight half of PR 13's quantization story: a published fp32 param
+tree is converted OFFLINE (host numpy, deterministic) to per-output-
+channel int8 — or fp8-e4m3 where the backend has the dtype — quantized
+weights plus an f32 scale vector per matrix. The quantized tree keeps
+the exact pytree structure of the fp32 tree (so ``param_specs``
+placement, deploy flattening and fleet manifests all apply unchanged)
+and the scales ride in a FLAT ``{dot.path: [cols] f32}`` dict keyed by
+:func:`mxnet_tpu.deploy.flatten_params` paths — a stable pytree the
+engine threads through the donated step as a traced argument, so
+publishing a quantized checkpoint never recompiles.
+
+Quantization is symmetric per output channel (the last axis of every
+2-D float leaf): ``scale[c] = absmax(W[:, c]) / QMAX`` and
+``W_q[:, c] = round/cast(W[:, c] / scale[c])``. Two calibrators:
+
+- ``absmax`` — exact range cover, no clipping; the scale eats outlier
+  channels' dynamic range.
+- ``percentile`` — per-channel percentile of ``|W|`` (default 99.9);
+  outliers clip but the bulk of the channel quantizes finer. Wins
+  whenever a channel has a few large entries over a narrow bulk.
+- ``auto`` — scores both against a small calibration batch set
+  (provided activations or a seeded Gaussian probe) per leaf and keeps
+  the one with the lower mean-abs matmul error.
+
+The tolerance contract this enables is pinned in
+``tests/test_weight_quant.py``: logit tolerance + top-1 oracle
+agreement vs the fp32 engine, bit-identical hit==miss, zero
+steady-state recompiles.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["QuantizedWeights", "quantize_weights", "quantize_leaf",
+           "dequantize_leaf", "fp8_supported", "resolve_weight_dtype",
+           "calibration_error", "FP8_NAME", "FP8_MAX", "WEIGHT_DTYPES"]
+
+FP8_NAME = "float8_e4m3fn"
+FP8_MAX = 448.0                      # e4m3fn finite max
+_SCALE_FLOOR = 1e-8                  # all-zero channels quantize to 0
+WEIGHT_DTYPES = ("int8", "fp8", FP8_NAME)
+
+
+def fp8_supported():
+    """True when the backend's numpy/jax stack carries fp8-e4m3
+    (ml_dtypes registers it; absent on minimal installs)."""
+    try:
+        np.dtype(FP8_NAME)
+        import jax.numpy as jnp
+        return hasattr(jnp, FP8_NAME)
+    except Exception:
+        return False
+
+
+def resolve_weight_dtype(name):
+    """Canonicalize a weight/KV dtype request. Returns
+    ``(canonical_name_or_None, fell_back)`` — ``None`` means full
+    precision; ``fell_back`` is True when fp8 was requested but the
+    backend lacks the dtype (callers count a warning and serve int8,
+    per the ISSUE 20 availability guard)."""
+    if name is None:
+        return None, False
+    name = str(name).strip().lower()
+    if name in ("", "float32", "f32", "fp32", "none"):
+        return None, False
+    if name == "int8":
+        return "int8", False
+    if name in ("fp8", "e4m3", "float8", FP8_NAME, "float8_e4m3"):
+        if fp8_supported():
+            return FP8_NAME, False
+        return "int8", True
+    raise ValueError(
+        f"unsupported weight dtype {name!r} (expected float32, int8 "
+        f"or fp8/{FP8_NAME})")
+
+
+def _channel_range(w, method, percentile):
+    a = np.abs(w)
+    if method == "percentile":
+        return np.percentile(a, percentile, axis=0).astype(np.float32)
+    if method == "absmax":
+        return a.max(axis=0).astype(np.float32)
+    raise ValueError(f"unknown calibration method {method!r}")
+
+
+def quantize_leaf(w, dtype="int8", method="absmax", percentile=99.9,
+                  per_channel=True):
+    """Quantize one 2-D f32 matrix. Returns ``(q, scale)`` with
+    ``scale`` f32 ``[cols]`` (per output channel) or scalar-shaped
+    ``[1]`` with ``per_channel=False`` (the per-tensor baseline the
+    calibration tests beat). fp8 values are CLIPPED to ±448 before the
+    cast — numpy's float32→e4m3 cast does not saturate, it NaNs."""
+    w = np.asarray(w, np.float32)
+    if w.ndim != 2:
+        raise ValueError(f"quantize_leaf wants 2-D weights, got {w.shape}")
+    if per_channel:
+        rng = _channel_range(w, method, percentile)
+    else:
+        rng = np.asarray(
+            [_channel_range(w.reshape(-1, 1), method, percentile)[0]],
+            np.float32)
+    if dtype in ("fp8", "e4m3", "float8", "float8_e4m3"):
+        dtype = FP8_NAME
+    if dtype == "int8":
+        scale = np.maximum(rng / 127.0, _SCALE_FLOOR).astype(np.float32)
+        q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    elif dtype == FP8_NAME:
+        scale = np.maximum(rng / FP8_MAX, _SCALE_FLOOR).astype(np.float32)
+        q = np.clip(w / scale, -FP8_MAX, FP8_MAX).astype(np.dtype(FP8_NAME))
+    else:
+        raise ValueError(f"unsupported quantized dtype {dtype!r}")
+    return q, scale
+
+
+def dequantize_leaf(q, scale):
+    """f32 reconstruction ``q * scale`` (broadcast over the channel
+    axis) — the oracle the quantized matmul kernels are tested
+    against."""
+    return np.asarray(q, np.float32) * np.asarray(scale, np.float32)
+
+
+def calibration_error(w, q, scale, xs):
+    """Mean absolute matmul error ``|xs @ W - (xs @ W_q) * scale|``
+    over a calibration batch ``xs [B, K]`` — the score ``auto``
+    calibration minimizes per leaf."""
+    w = np.asarray(w, np.float32)
+    xs = np.asarray(xs, np.float32)
+    ref = xs @ w
+    got = (xs @ np.asarray(q, np.float32)) * np.asarray(scale, np.float32)
+    return float(np.mean(np.abs(ref - got)))
+
+
+class QuantizedWeights:
+    """A quantized checkpoint: ``params`` (same pytree structure as the
+    fp32 tree, 2-D float leaves replaced by int8/fp8 arrays),
+    ``scales`` (flat ``{dot.path: [cols] f32}`` over exactly the
+    quantized leaves) plus the dtype/calibration provenance. This is
+    what ``LLMEngine`` accepts in place of a param tree, what
+    ``deploy.export_decoder`` serializes, and what
+    ``FleetRouter.publish`` hot-swaps in."""
+
+    def __init__(self, params, scales, dtype, method="absmax",
+                 methods=None):
+        self.params = params
+        self.scales = dict(scales)
+        self.dtype = str(dtype)
+        self.method = str(method)
+        self.methods = dict(methods or {})
+
+    def dequantize(self):
+        """fp32 reconstruction of the full tree (host numpy)."""
+        from ...deploy import flatten_params, unflatten_params
+        flat = flatten_params(self.params)
+        out = {}
+        for path, arr in flat.items():
+            if path in self.scales:
+                out[path] = dequantize_leaf(arr, self.scales[path])
+            else:
+                out[path] = np.asarray(arr)
+        return unflatten_params(out)
+
+    def nbytes(self):
+        """Device-resident weight bytes: quantized leaves + their f32
+        scales + untouched leaves."""
+        from ...deploy import flatten_params
+        total = sum(np.asarray(a).nbytes
+                    for a in flatten_params(self.params).values())
+        total += sum(np.asarray(s).nbytes for s in self.scales.values())
+        return int(total)
+
+    def num_params(self):
+        from ...deploy import flatten_params
+        return int(sum(np.asarray(a).size
+                       for a in flatten_params(self.params).values()))
+
+    def __repr__(self):
+        return (f"QuantizedWeights(dtype={self.dtype!r}, "
+                f"method={self.method!r}, "
+                f"quantized_leaves={len(self.scales)})")
+
+
+def _probe_batch(k, seed, rows=8):
+    rs = np.random.RandomState((seed * 1000003 + k) % (2 ** 31 - 1))
+    return rs.randn(rows, k).astype(np.float32)
+
+
+def quantize_weights(params, dtype="int8", method="absmax",
+                     percentile=99.9, calib=None, calib_seed=0):
+    """Calibration pass: fp32 param pytree → :class:`QuantizedWeights`.
+
+    Every 2-D float32 leaf (attention/MLP matrices, embedding, position
+    table, LM head) is quantized per output channel; 1-D leaves (norm
+    gains, biases) stay f32. ``method``: ``absmax`` | ``percentile`` |
+    ``auto``. ``calib``: optional flat ``{dot.path: [B, K] f32}``
+    activation batches for ``auto`` scoring; leaves without an entry
+    are scored against a deterministic Gaussian probe batch
+    (``calib_seed``). Deterministic: same inputs → bit-identical
+    output."""
+    from ...deploy import flatten_params, unflatten_params
+    dtype, _ = resolve_weight_dtype(dtype)
+    if dtype is None:
+        raise ValueError("quantize_weights needs a quantized dtype "
+                         "(int8 or fp8); got a full-precision request")
+    if method not in ("absmax", "percentile", "auto"):
+        raise ValueError(f"unknown calibration method {method!r}")
+    flat = flatten_params(params)
+    qflat, scales, methods = {}, {}, {}
+    for path in sorted(flat):
+        w = np.asarray(flat[path])
+        if w.ndim != 2 or w.dtype != np.float32:
+            qflat[path] = w
+            continue
+        if method == "auto":
+            xs = None if calib is None else calib.get(path)
+            if xs is None:
+                xs = _probe_batch(w.shape[0], calib_seed)
+            best = None
+            for m in ("absmax", "percentile"):
+                q, s = quantize_leaf(w, dtype, m, percentile)
+                err = calibration_error(w, q, s, xs)
+                if best is None or err < best[0]:
+                    best = (err, m, q, s)
+            _, m, q, s = best
+        else:
+            m = method
+            q, s = quantize_leaf(w, dtype, m, percentile)
+        qflat[path] = q
+        scales[path] = s
+        methods[path] = m
+    if not scales:
+        raise ValueError("param tree has no 2-D float32 leaves to "
+                         "quantize")
+    return QuantizedWeights(unflatten_params(qflat), scales, dtype,
+                            method=method, methods=methods)

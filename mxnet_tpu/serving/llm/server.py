@@ -476,6 +476,11 @@ class LLMServer:
         snap["max_seqs"] = self._engine.max_seqs
         snap["prefix_cache"] = self._engine.prefix_enabled
         snap["kv_dtype"] = self._engine.cache.dtype.name
+        snap["weight_dtype"] = self._engine.weight_dtype
+        snap["weight_bytes"] = self._engine.weight_bytes
+        snap["weight_params_per_chip"] = (
+            self._engine.weight_params // max(1, self._engine.tp))
+        snap["draft_weight_dtype"] = self._engine.draft_weight_dtype
         lookups = snap.get("prefix_lookups", 0)
         snap["prefix_hit_rate"] = (snap.get("prefix_hits", 0) / lookups
                                    if lookups else 0.0)

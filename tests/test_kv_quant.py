@@ -230,3 +230,91 @@ def test_kv_dtype_env_knob(model, params, monkeypatch):
     eng2 = LLMEngine(model, params, max_seqs=4, block_size=BS,
                      max_context=CTX, prefill_chunk=8)
     assert not eng2.quantized and eng2.cache.k_scales is None
+
+
+# ------------------------------------------------ fp8 KV (ISSUE 20) --
+FP8_KV_LOGIT_TOL = 0.15     # e4m3 pages: coarser mantissa than int8's
+# 255-step grid at small |x|, finer near zero; measured ~0.05
+
+
+def test_decode_flat_fp8_kv_logit_tolerance(model, params):
+    """fp8-e4m3 KV pages: one mixed flat dispatch stays within
+    FP8_KV_LOGIT_TOL of the fp32 run — the in-trace write path clips
+    into the finite +-448 range before the cast (which would NaN
+    out-of-range values, not saturate)."""
+    from mxnet_tpu.serving.llm import fp8_supported
+    if not fp8_supported():
+        pytest.skip("no fp8-e4m3 dtype on this backend")
+    rng = np.random.RandomState(7)
+    L, H, D = model.num_layers, model.num_heads, model.head_dim
+    N = 9
+    fp8 = jnp.dtype("float8_e4m3fn")
+    kp = jnp.zeros((L, N, BS, H, D), jnp.float32)
+    vp = jnp.zeros((L, N, BS, H, D), jnp.float32)
+    kq = jnp.zeros((L, N, BS, H, D), fp8)
+    vq = jnp.zeros((L, N, BS, H, D), fp8)
+    ks = jnp.ones((L, N, BS, H), jnp.float32)
+    vs = jnp.ones((L, N, BS, H), jnp.float32)
+    T = 16
+    toks = rng.randint(0, VOCAB, T).astype(np.int32)
+    pos = np.arange(T, dtype=np.int32)
+    sid = np.zeros(T, np.int32)
+    valid = np.ones(T, np.int32)
+    bt = np.zeros((4, 8), np.int32)
+    bt[0, :2] = [3, 5]
+    lf = model.decode_flat(params, toks, pos, sid, valid, kp, vp, bt)[0]
+    lq = model.decode_flat(params, toks, pos, sid, valid, kq, vq, bt,
+                           k_scales=ks, v_scales=vs)[0]
+    assert not np.isnan(np.asarray(lq)).any()
+    diff = float(jnp.max(jnp.abs(lf - lq)))
+    assert diff < FP8_KV_LOGIT_TOL, \
+        f"fp8 KV logit drift {diff} > {FP8_KV_LOGIT_TOL}"
+
+
+def test_kv_dtype_env_knob_fp8(model, params, monkeypatch):
+    """MXNET_TPU_LLM_KV_DTYPE=fp8 builds float8_e4m3fn pools riding
+    the SAME scale-pool plumbing as int8 (PR 13)."""
+    from mxnet_tpu.serving.llm import fp8_supported
+    if not fp8_supported():
+        pytest.skip("no fp8-e4m3 dtype on this backend")
+    monkeypatch.setenv("MXNET_TPU_LLM_KV_DTYPE", "fp8")
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefill_chunk=8)
+    assert eng.quantized
+    assert eng.cache.dtype.name == "float8_e4m3fn"
+    assert eng.cache.k_scales is not None
+    assert eng.kv_dtype_fallbacks == 0
+    assert eng.cache.stats()["kv_dtype"] == "float8_e4m3fn"
+
+
+@pytest.mark.slow   # compiles its own fp8-KV program set
+def test_engine_fp8_kv_serves_zero_recompiles(model, params):
+    """End to end: fp8-KV continuous batching serves greedy traffic
+    with zero steady-state recompiles and clean block accounting.
+    Token parity vs fp32 is NOT pinned for fp8 (near-tie positions
+    may flip within FP8_KV_LOGIT_TOL) — the per-dispatch tolerance
+    above is the contract."""
+    from mxnet_tpu.serving.llm import fp8_supported
+    if not fp8_supported():
+        pytest.skip("no fp8-e4m3 dtype on this backend")
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, VOCAB, size=n).tolist()
+               for n in (3, 8, 13)]
+    eng = LLMEngine(model, params, max_seqs=4, block_size=BS,
+                    max_context=CTX, prefill_chunk=8, kv_dtype="fp8")
+    eng.warmup()
+    seqs = [Sequence(p, 6) for p in prompts]
+    with serving.CompileCounter() as cc:
+        for s in seqs:
+            eng.add(s)
+        steps = 0
+        while eng.has_work():
+            eng.step()
+            steps += 1
+            assert steps < 1000
+    assert cc.count == 0, f"{cc.count} recompiles on the fp8 KV path"
+    for s in seqs:
+        assert len(s.output_tokens()) == 6
+        assert all(0 <= t < VOCAB for t in s.output_tokens())
+    assert eng.cache.allocator.num_used == 0
+    eng.cache.check(live_block_ids=[])

@@ -206,7 +206,7 @@ def scan_llm(repo=REPO):
         row = {"round": rnd, "status": "valid", "tokens_s": None,
                "ttft_p50": None, "ttft_p99": None, "accept": None,
                "hit_rate": None, "adapters": None, "tp": None,
-               "tag": "", "note": ""}
+               "wdtype": None, "tag": "", "note": ""}
         try:
             with open(path) as f:
                 rec = json.load(f)
@@ -242,6 +242,30 @@ def scan_llm(repo=REPO):
                                + "; dispatches/step="
                                + str(verified[-1].get(
                                    "dispatches_per_step")))
+        # quantized weights (ISSUE 20): the served dtype, or — on a
+        # --weight-dtype sweep round — the swept dtypes plus the best
+        # params-per-chip ratio vs fp32. Extracted BEFORE the skipped
+        # gate: the byte-ratio evidence is structural and survives a
+        # refused timing headline
+        w = rec.get("weights") or {}
+        if w.get("dtype"):
+            row["wdtype"] = w["dtype"]
+        wsweep = rec.get("weight_sweep") or []
+        if wsweep:
+            row["wdtype"] = "/".join(
+                c.get("requested_dtype") or c.get("weight_dtype")
+                or "?" for c in wsweep)
+            ratios = [c for c in wsweep
+                      if c.get("params_per_chip_ratio")
+                      and c.get("weight_dtype") != "float32"]
+            if ratios:
+                best = max(ratios,
+                           key=lambda c: c["params_per_chip_ratio"])
+                row["note"] = (
+                    (row["note"] + " " if row["note"] else "")
+                    + f"params/chip ×"
+                    f"{best['params_per_chip_ratio']:.2f} at "
+                    f"{best['weight_dtype']}")
         if rec.get("skipped") or rec.get("value") is None:
             note = f"skipped: {rec.get('skipped')}"
             if row["note"]:
@@ -295,8 +319,9 @@ def render_llm(rows):
         return pat % v if v is not None else "—"
     lines = [
         "| round | status | tokens/s | TTFT p50 (ms) | TTFT p99 (ms) "
-        "| accept rate | hit rate | adapters | tp | config | note |",
-        "|---|---|---|---|---|---|---|---|---|---|---|",
+        "| accept rate | hit rate | adapters | tp | weights | config "
+        "| note |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         lines.append(
@@ -308,6 +333,7 @@ def render_llm(rows):
             f"| {fmt(r.get('hit_rate'), '%.3f')} "
             f"| {fmt(r.get('adapters'), '%d')} "
             f"| {fmt(r.get('tp'), '%d')} "
+            f"| {r.get('wdtype') or '—'} "
             f"| {r['tag']} | {r['note']} |")
     valid = [r for r in rows if r["status"] == "valid"
              and r["tokens_s"] is not None]
@@ -465,6 +491,15 @@ def scan_capacity(repo=REPO):
             rows.append(row)
             continue
         row["chips_per_m"] = float(rec["value"])
+        # quantized-weight capacity column (ISSUE 20): the replay
+        # server's dtype + derived models-per-chip under the declared
+        # HBM model, so the footprint delta trends with the headline
+        lw = rec.get("llm_weights") or {}
+        if lw.get("models_per_chip") is not None:
+            row["note"] = (
+                (row["note"] + " " if row["note"] else "")
+                + f"weights {lw.get('dtype')}: "
+                f"{lw['models_per_chip']} models/chip")
         attained = rec.get("slo_attained")
         row["slo"] = ("attained" if attained
                       else "—" if attained is None else "BREACHED")

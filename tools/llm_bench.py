@@ -146,14 +146,19 @@ def _truncated_draft(model, params):
 
 
 def _engine_kw(args, model, params, prefix_cache=None,
-               adapter_bank=None):
+               adapter_bank=None, weight_dtype=None):
     """Engine sizing + speed knobs shared by both run modes: chunked
-    prefill size, KV storage dtype (--kv-dtype int8 = quantized
-    pages), prefix caching, and, with --spec-k > 0, the built-in
-    layer-truncated draft for speculative decoding."""
+    prefill size, KV storage dtype (--kv-dtype int8/fp8 = quantized
+    pages), weight storage dtype (--weight-dtype int8/fp8 = per-channel
+    quantized weights, ISSUE 20 — the draft rides the same dtype, the
+    cheap-draft lever), prefix caching, and, with --spec-k > 0, the
+    built-in layer-truncated draft for speculative decoding."""
     kw = dict(max_seqs=args.max_seqs, block_size=args.block_size,
               max_context=min(args.max_context, model.max_context),
               kv_dtype=args.kv_dtype)
+    quantized = weight_dtype and weight_dtype not in ("float32", "fp32")
+    if quantized:
+        kw["weight_dtype"] = weight_dtype
     if prefix_cache is not None:
         kw["prefix_cache"] = prefix_cache
     if adapter_bank is not None:
@@ -164,6 +169,8 @@ def _engine_kw(args, model, params, prefix_cache=None,
         draft, dparams = _truncated_draft(model, params)
         kw.update(draft_model=draft, draft_params=dparams,
                   spec_k=args.spec_k)
+        if quantized:
+            kw["draft_weight_dtype"] = weight_dtype
     return kw
 
 
@@ -172,6 +179,15 @@ def _adapter_counts(args):
     if not args.adapters:
         return []
     return [int(x) for x in str(args.adapters).split(",")]
+
+
+def _weight_dtypes(args):
+    """Parse --weight-dtype "float32,int8,fp8" into the sweep's dtype
+    list (one entry = a plain run at that dtype, no sweep)."""
+    if not args.weight_dtype:
+        return []
+    return [x.strip() for x in str(args.weight_dtype).split(",")
+            if x.strip()]
 
 
 def _bench_bank(model, pool_size):
@@ -247,9 +263,11 @@ def run_overload(args):
                                    SequenceEvictedError)
     model, params = _load_model(args)
     max_queue = args.max_queue or 2 * args.max_seqs
+    wds = _weight_dtypes(args)
     srv = LLMServer(model, params, name="llm_bench_overload",
                     max_queue=max_queue, mesh=(args.mesh or None),
-                    **_engine_kw(args, model, params))
+                    **_engine_kw(args, model, params,
+                                 weight_dtype=wds[0] if wds else None))
     warm = srv.warmup()
     srv.start()
 
@@ -358,13 +376,14 @@ def run_overload(args):
 
 
 def run(args, prefix_cache=None, name="llm_bench", adapter_bank=None,
-        n_adapters=0):
+        n_adapters=0, weight_dtype=None):
     model, params = _load_model(args)
     srv = LLMServer(model, params, name=name,
                     mesh=(args.mesh or None),
                     **_engine_kw(args, model, params,
                                  prefix_cache=prefix_cache,
-                                 adapter_bank=adapter_bank))
+                                 adapter_bank=adapter_bank,
+                                 weight_dtype=weight_dtype))
     warm = srv.warmup()
     srv.start()
 
@@ -450,6 +469,15 @@ def run(args, prefix_cache=None, name="llm_bench", adapter_bank=None,
         "kv_occupancy": round(stats["kv_cache"]["occupancy"], 4),
         "kv_blocks_total": stats["kv_blocks_total"],
         "kv_dtype": stats["kv_dtype"],
+        # quantized-weight economics (ISSUE 20): served dtype, device-
+        # resident weight bytes (quantized leaves + f32 scales) and the
+        # per-chip param count — what the --weight-dtype sweep trends
+        "weights": {
+            "dtype": stats["weight_dtype"],
+            "bytes": stats["weight_bytes"],
+            "params_per_chip": stats["weight_params_per_chip"],
+            "draft_dtype": stats["draft_weight_dtype"],
+        },
         "preemptions": stats["preemptions"],
         "decode_steps": stats["decode_steps"],
         "compiles_during_load": cc.count,
@@ -606,6 +634,8 @@ def emit_bench(report, out_dir):
                     report.get("prefill_chunk"),
                 "MXNET_TPU_LLM_SPEC_K": report.get("spec_k"),
                 "MXNET_TPU_LLM_KV_DTYPE": report.get("kv_dtype"),
+                "MXNET_TPU_LLM_WEIGHT_DTYPE":
+                    (report.get("weights") or {}).get("dtype"),
                 "MXNET_TPU_LLM_PREFIX_CACHE":
                     int(bool(report.get("prefix", {}).get("enabled"))),
             },
@@ -624,6 +654,11 @@ def emit_bench(report, out_dir):
             # its sharding configuration
             "mesh": report.get("mesh"),
             "mesh_sweep": report.get("mesh_sweep"),
+            # quantized weights (ISSUE 20): the served dtype's byte /
+            # params-per-chip economics, and with --weight-dtype a,b
+            # the full per-dtype sweep curve
+            "weights": report.get("weights"),
+            "weight_sweep": report.get("weight_sweep"),
         },
         "_capture": {
             "tag": "llm_bench",
@@ -705,11 +740,22 @@ def main():
                          "counts — and emit it WITHOUT a timing "
                          "headline (virtual devices prove structure, "
                          "not speed)")
-    ap.add_argument("--kv-dtype", choices=("float32", "int8"),
+    ap.add_argument("--kv-dtype", choices=("float32", "int8", "fp8"),
                     default="float32",
-                    help="KV page storage dtype: int8 = per-slot-"
+                    help="KV page storage dtype: int8/fp8 = per-slot-"
                          "scale quantized pages, dequantized inside "
-                         "the ragged kernel (MXNET_TPU_LLM_KV_DTYPE)")
+                         "the ragged kernel (MXNET_TPU_LLM_KV_DTYPE); "
+                         "fp8 falls back to int8 with a counted "
+                         "warning on backends without the dtype")
+    ap.add_argument("--weight-dtype", default="",
+                    help="weight storage dtype, or a comma-separated "
+                         "sweep (e.g. float32,int8,fp8): each pass "
+                         "serves the SAME workload with per-channel "
+                         "quantized weights at that dtype (the draft "
+                         "rides the same dtype when --spec-k is on); "
+                         "the per-dtype bytes / params-per-chip curve "
+                         "lands in the BENCH json "
+                         "(MXNET_TPU_LLM_WEIGHT_DTYPE)")
     ap.add_argument("--out", default=None,
                     help="directory for the BENCH_llm_rNN.json "
                          "(default: a temp dir, printed)")
@@ -753,10 +799,44 @@ def main():
                 args.prefix_share = 0.5
 
     counts = _adapter_counts(args)
+    dtypes = _weight_dtypes(args)
+    wd_single = dtypes[0] if len(dtypes) == 1 else None
     if args.mesh_sweep:
         report = run_mesh_sweep(args)
     elif args.overload:
         report = run_overload(args)
+    elif len(dtypes) > 1:
+        # the weight-dtype sweep (ISSUE 20): one pass per dtype over
+        # the SAME workload and model instance. Each pass warms its
+        # own program variant once (weight dtype keys the program
+        # cache), then serves recompile-free — compiles_during_load
+        # per pass proves it. The param count is dtype-invariant, so
+        # params-per-chip at a fixed HBM budget scales as
+        # fp32_bytes / dtype_bytes: that ratio is the headline the
+        # curve commits.
+        curve, report = [], None
+        for wd in dtypes:
+            rep = run(args, name=f"llm_bench_w_{wd}", weight_dtype=wd)
+            curve.append({
+                "requested_dtype": wd,
+                "weight_dtype": rep["weights"]["dtype"],
+                "draft_weight_dtype": rep["weights"]["draft_dtype"],
+                "tokens_per_sec": rep["tokens_per_sec"],
+                "ttft_ms": rep["ttft_ms"],
+                "compiles_during_load": rep["compiles_during_load"],
+                "weight_bytes": rep["weights"]["bytes"],
+                "params_per_chip": rep["weights"]["params_per_chip"],
+                "kv_blocks_per_chip": rep["kv_blocks_total"],
+                "spec_accept_rate": rep["spec_accept_rate"],
+            })
+            report = rep
+        base = next((c for c in curve
+                     if c["weight_dtype"] == "float32"), None)
+        for c in curve:
+            c["params_per_chip_ratio"] = (
+                round(base["weight_bytes"] / c["weight_bytes"], 4)
+                if base and c["weight_bytes"] else None)
+        report["weight_sweep"] = curve
     elif counts:
         # the multi-LoRA sweep: one pass per adapter count, every
         # pass against the SAME AdapterBank (same pool geometry ->
@@ -768,7 +848,8 @@ def main():
         curve, report = [], None
         for n in counts:
             rep = run(args, name=f"llm_bench_a{n}",
-                      adapter_bank=bank, n_adapters=n)
+                      adapter_bank=bank, n_adapters=n,
+                      weight_dtype=wd_single)
             curve.append({
                 "adapters": n,
                 "tokens_per_sec": rep["tokens_per_sec"],
@@ -789,10 +870,11 @@ def main():
             # silently measure nothing under an ambient
             # MXNET_TPU_LLM_PREFIX_CACHE=0
             control = run(args, prefix_cache=False,
-                          name="llm_bench_ctl")
-            report = run(args, prefix_cache=True)
+                          name="llm_bench_ctl", weight_dtype=wd_single)
+            report = run(args, prefix_cache=True,
+                         weight_dtype=wd_single)
         else:
-            report = run(args)
+            report = run(args, weight_dtype=wd_single)
         if control is not None:
             report["prefix"]["ttft_ms_control"] = control["ttft_ms"]
             report["prefix"]["ttft_p50_delta_ms"] = round(

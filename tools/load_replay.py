@@ -463,11 +463,18 @@ def run_llm(args, spec, trace, ring):
     # prefix_cache pinned ON: the tenant system-prompt workload (and
     # the smoke's hit-rate gate) exists to exercise it, regardless of
     # the ambient MXNET_TPU_LLM_PREFIX_CACHE value
+    # --weight-dtype int8/fp8 (ISSUE 20): serve the replay from a
+    # per-channel quantized checkpoint — the engine quantizes at
+    # construction and the capacity report gains the models-per-chip
+    # column the smaller resident footprint buys
+    wkw = {}
+    if args.weight_dtype and args.weight_dtype != "float32":
+        wkw["weight_dtype"] = args.weight_dtype
     srv = LLMServer(model, model.init_params(0), name="replay_llm",
                     max_seqs=args.max_seqs, block_size=block_size,
                     max_context=args.max_context,
                     max_queue=args.max_queue, prefix_cache=True,
-                    adapter_bank=bank)
+                    adapter_bank=bank, **wkw)
     srv.warmup()
     srv.start()
     max_prompt = max(2, args.max_context // 2)
@@ -525,6 +532,14 @@ def run_llm(args, spec, trace, ring):
             "hit_rate": round(stats["prefix_hit_rate"], 4),
             "prefill_tokens_saved": stats["prefill_tokens_saved"],
             "evictions": stats["prefix_evictions"],
+        },
+        # quantized-weight footprint (ISSUE 20): measured device-
+        # resident weight bytes + dtype — the models-per-chip input
+        # the capacity model derives against its declared HBM budget
+        "weights": {
+            "dtype": stats["weight_dtype"],
+            "bytes": stats["weight_bytes"],
+            "params_per_chip": stats["weight_params_per_chip"],
         },
         # per-tenant LoRA economics: residency hits vs registry
         # fault-ins and the capacity evictions the fault-ins forced —
@@ -1024,13 +1039,16 @@ def evaluate_and_report(args, spec, trace, results, rings, out_dir,
     except Exception:
         pass
 
+    llm_weights = next((b.get("weights") for b in results
+                        if b["frontend"] == "llm"), None)
     rec = cap_mod.build_report(
         rings[results[0]["frontend"]], slo_reports, frontends,
         chips=chips,
         user_model={"requests_per_user_per_s": args.rpu,
                     "tokens_per_user_per_s": args.tpu},
         trace={"spec": spec.to_dict(), "requests": len(trace),
-               "schedule_sha256": schedule_digest(trace)})
+               "schedule_sha256": schedule_digest(trace)},
+        llm_weights=llm_weights)
     rec["tenants"] = tenants
     rec["outcomes"] = {b["frontend"]: b["outcomes"] for b in results}
     rec["compiles_during_replay"] = sum(b["compiles_during_replay"]
@@ -1234,6 +1252,14 @@ def main():
     ap.add_argument("--max-seqs", type=int, default=4)
     ap.add_argument("--max-context", type=int, default=64)
     ap.add_argument("--max-queue", type=int, default=512)
+    ap.add_argument("--weight-dtype",
+                    choices=("float32", "int8", "fp8"),
+                    default="float32",
+                    help="LLM front-end weight storage dtype: "
+                         "int8/fp8 serves the replay from a per-"
+                         "channel quantized checkpoint, and the "
+                         "capacity report derives the models-per-chip "
+                         "delta from the measured weight bytes")
     ap.add_argument("--slo-latency-ms", type=float,
                     default=_env_float("MXNET_TPU_SLO_LATENCY_MS",
                                        250.0))

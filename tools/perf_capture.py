@@ -409,6 +409,15 @@ def emit_llm_snapshot(rec, out_dir=None):
         out["mesh"] = extra["mesh"]
     if extra.get("mesh_sweep") is not None:
         out["mesh_sweep"] = extra["mesh_sweep"]
+    # quantized weights (ISSUE 20): the served dtype's bytes /
+    # params-per-chip block and the --weight-dtype sweep curve ride
+    # BOTH branches too — the params-per-chip ratio is structural
+    # evidence (byte counts, not clocks) and must survive even when a
+    # run's timing headline is refused
+    if extra.get("weights") is not None:
+        out["weights"] = extra["weights"]
+    if extra.get("weight_sweep") is not None:
+        out["weight_sweep"] = extra["weight_sweep"]
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
         f.write("\n")
@@ -473,6 +482,10 @@ def emit_capacity_snapshot(rec, out_dir=None):
             # + bank hit/evict counters — how many variants the same
             # chip count actually served
             "llm_adapters": rec.get("llm_adapters"),
+            # quantized-weight economics (ISSUE 20): served dtype,
+            # weight bytes and the models-per-chip derivation under
+            # the declared HBM model
+            "llm_weights": rec.get("llm_weights"),
             "metrics_log": cap.get("metrics_log"),
             "detail": rec.get("detail"),
         })
